@@ -1,0 +1,62 @@
+"""daft_tpu: a TPU-native distributed dataframe / query engine.
+
+Same capability surface as the reference engine (see SURVEY.md), built
+TPU-first: Arrow C++ host columns, jit-compiled XLA relational operators,
+ICI-collective shuffles over a jax device Mesh.
+"""
+
+from .datatype import DataType, ImageFormat, ImageMode, TimeUnit
+from .expressions import (
+    Expression, col, lit, element, coalesce, interval, list_, struct,
+)
+from .schema import Field, Schema
+from .series import Series
+from .recordbatch import RecordBatch
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DataType", "ImageFormat", "ImageMode", "TimeUnit",
+    "Expression", "col", "lit", "element", "coalesce", "interval",
+    "list_", "struct", "Field", "Schema", "Series", "RecordBatch",
+]
+
+
+def __getattr__(name):
+    # heavier subsystems load lazily to keep `import daft_tpu` fast
+    if name in ("DataFrame",):
+        from .dataframe import DataFrame
+        return DataFrame
+    if name in ("from_pydict", "from_arrow", "from_pandas", "from_pylist",
+                "from_glob_path", "range"):
+        from . import dataframe as _df
+        return getattr(_df, name)
+    if name in ("read_parquet", "read_csv", "read_json"):
+        from . import io as _io
+        return getattr(_io, name)
+    if name == "sql":
+        from .sql import sql
+        return sql
+    if name == "sql_expr":
+        from .sql import sql_expr
+        return sql_expr
+    if name == "udf":
+        from .udf import udf
+        return udf
+    if name == "context":
+        from . import context
+        return context
+    if name in ("set_execution_config", "set_planning_config", "execution_config_ctx",
+                "get_context", "set_runner_native", "set_runner_tpu_distributed"):
+        from . import context as _ctx
+        return getattr(_ctx, name)
+    if name == "Window":
+        from .window import Window
+        return Window
+    if name == "Catalog":
+        from .catalog import Catalog
+        return Catalog
+    if name == "Session":
+        from .session import Session
+        return Session
+    raise AttributeError(f"module 'daft_tpu' has no attribute {name!r}")

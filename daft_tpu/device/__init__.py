@@ -1,0 +1,16 @@
+"""TPU device tier: columns as padded JAX arrays, operators as XLA programs.
+
+Design (SURVEY.md §7 "hard parts"):
+- **Static shapes**: every morsel is padded to a power-of-two capacity bucket;
+  a ``row_mask`` marks live rows. jax.jit caches one executable per
+  (bucket, dtypes, op-structure) — bounded recompiles.
+- **Selection as masks**: filters AND into ``row_mask`` instead of moving
+  data; compaction happens only at sort/join/materialize boundaries.
+- **Strings** dictionary-encode host-side with a *sorted* dictionary so code
+  order == string order; device compares/sorts/groups int32 codes.
+- **Group-by / join** are sort-based (``lax.sort`` + ``segment_sum``): the
+  XLA-friendly formulation of the reference's hash tables
+  (``probeable/probe_table.rs``).
+"""
+
+from . import column, compiler, kernels, runtime  # noqa: F401

@@ -1,0 +1,189 @@
+"""Host⇄device column transport: Series/RecordBatch → DeviceTable and back.
+
+The DeviceTable is the device twin of a RecordBatch (SURVEY.md §7.1
+"DeviceColumnSet"): a dict of fixed-width JAX arrays plus validity planes and a
+live-row mask, padded to a power-of-two capacity bucket.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+import jax
+import jax.numpy as jnp
+
+from ..datatype import DataType
+from ..schema import Field, Schema
+from ..series import Series
+
+jax.config.update("jax_enable_x64", True)
+
+# persistent compile cache: cold TPU compiles can take minutes (remote
+# compile); re-runs of the same (bucket, dtype, op) shapes must hit disk
+_cache_dir = os.environ.get("DAFT_TPU_COMPILE_CACHE",
+                            os.path.expanduser("~/.cache/daft_tpu_xla"))
+if _cache_dir:
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+_MIN_CAPACITY = 16
+
+
+def bucket_capacity(n: int) -> int:
+    """Pad row counts to power-of-two buckets to bound jit recompiles."""
+    c = _MIN_CAPACITY
+    while c < n:
+        c <<= 1
+    return c
+
+
+def _backend() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+def supports_f64() -> bool:
+    """TPUs have no native f64; compute those columns in f32 on TPU."""
+    return _backend() not in ("tpu", "axon")
+
+
+@dataclass
+class DeviceColumn:
+    data: jax.Array                  # [capacity]
+    validity: jax.Array              # [capacity] bool
+    dtype: DataType                  # logical dtype
+    dictionary: Optional[pa.Array] = None  # sorted dictionary for code columns
+
+    @property
+    def is_coded(self) -> bool:
+        return self.dictionary is not None
+
+
+@dataclass
+class DeviceTable:
+    columns: Dict[str, DeviceColumn]
+    row_mask: jax.Array              # [capacity] bool — live rows
+    row_count: int                   # host-side live count
+    capacity: int
+
+    def schema(self) -> Schema:
+        return Schema([Field(n, c.dtype) for n, c in self.columns.items()])
+
+
+def _np_encode(s: Series) -> "tuple[np.ndarray, np.ndarray, Optional[pa.Array]]":
+    """Series → (values ndarray, validity ndarray, dictionary|None)."""
+    arr = s.to_arrow()
+    dt = s.datatype()
+    n = len(arr)
+    validity = np.asarray(pc.is_valid(arr).to_numpy(zero_copy_only=False),
+                          dtype=np.bool_)
+    if dt.is_string() or dt.is_binary():
+        enc = arr.dictionary_encode()
+        d = enc.dictionary
+        sort_idx = pc.array_sort_indices(d).to_numpy()
+        ranks = np.empty(len(d), dtype=np.int32)
+        ranks[sort_idx] = np.arange(len(d), dtype=np.int32)
+        codes_raw = pc.fill_null(enc.indices, 0).to_numpy(zero_copy_only=False)
+        codes = ranks[np.asarray(codes_raw, dtype=np.int64)] if len(d) else \
+            np.zeros(n, dtype=np.int32)
+        sorted_dict = d.take(pa.array(sort_idx))
+        return codes.astype(np.int32), validity, sorted_dict
+    phys = dt.to_physical()
+    rep = phys.device_repr()
+    if rep is None:
+        raise ValueError(f"column {s.name()!r}: {dt!r} is not device-representable")
+    if dt.kind == "date":
+        arr = arr.cast(pa.int32())
+    elif dt.is_temporal():
+        arr = arr.cast(pa.int64())
+    elif dt.is_decimal():
+        arr = arr.cast(pa.float64())
+    if dt.is_boolean():
+        vals = np.asarray(pc.fill_null(arr, False).to_numpy(zero_copy_only=False),
+                          dtype=np.bool_)
+    else:
+        if not validity.all():
+            # fill at the Arrow level so nullable ints don't decay to float64
+            arr = pc.fill_null(arr, pa.scalar(0, type=arr.type))
+        vals = np.asarray(arr.to_numpy(zero_copy_only=False))
+    if vals.dtype == np.float64 and not supports_f64():
+        vals = vals.astype(np.float32)
+    return vals, validity, None
+
+
+def encode_series(s: Series, capacity: int) -> DeviceColumn:
+    vals, validity, dictionary = _np_encode(s)
+    n = len(vals)
+    if n < capacity:
+        vals = np.concatenate(
+            [vals, np.zeros(capacity - n, dtype=vals.dtype)])
+        validity = np.concatenate(
+            [validity, np.zeros(capacity - n, dtype=np.bool_)])
+    return DeviceColumn(jnp.asarray(vals), jnp.asarray(validity),
+                        s.datatype(), dictionary)
+
+
+def encode_batch(batch, columns: Optional[List[str]] = None) -> DeviceTable:
+    names = columns if columns is not None else batch.column_names()
+    n = len(batch)
+    cap = bucket_capacity(n)
+    cols = {nm: encode_series(batch.get_column(nm), cap) for nm in names}
+    mask = np.zeros(cap, dtype=np.bool_)
+    mask[:n] = True
+    return DeviceTable(cols, jnp.asarray(mask), n, cap)
+
+
+def decode_column(name: str, col: DeviceColumn, count: int) -> Series:
+    """DeviceColumn → Series, taking the first ``count`` rows (post-compaction)."""
+    vals = np.asarray(jax.device_get(col.data))[:count]
+    validity = np.asarray(jax.device_get(col.validity))[:count]
+    dt = col.dtype
+    if col.dictionary is not None:
+        codes = np.where(validity, vals.astype(np.int64), 0)
+        arr = col.dictionary.take(pa.array(codes, type=pa.int64()))
+        if arr.type != dt.to_arrow():
+            arr = arr.cast(dt.to_arrow())
+        if not validity.all():
+            arr = pc.if_else(pa.array(validity), arr,
+                             pa.nulls(count, type=dt.to_arrow()))
+        return Series(name, dt, arrow=arr)
+    target = dt.to_arrow()
+    if dt.kind == "date":
+        arr = pa.array(vals.astype(np.int32), mask=~validity).cast(target)
+    elif dt.is_temporal():
+        arr = pa.array(vals.astype(np.int64), mask=~validity).cast(target)
+    elif dt.is_boolean():
+        arr = pa.array(vals.astype(np.bool_), mask=~validity)
+    else:
+        rep = dt.device_repr()
+        if rep is not None and vals.dtype != rep:
+            vals = vals.astype(rep)
+        arr = pa.array(vals, mask=~validity)
+        if arr.type != target:
+            arr = arr.cast(target)
+    return Series(name, dt, arrow=arr)
+
+
+def decode_table(dt: DeviceTable, compact_perm: Optional[np.ndarray] = None):
+    """DeviceTable → RecordBatch. If rows are not already compacted (live rows
+    first), pass a permutation from ``kernels.compaction_perm``."""
+    from ..recordbatch import RecordBatch
+    cols = []
+    for name, col in dt.columns.items():
+        if compact_perm is not None:
+            data = jnp.take(col.data, compact_perm, axis=0)
+            valid = jnp.take(col.validity, compact_perm, axis=0)
+            col = DeviceColumn(data, valid, col.dtype, col.dictionary)
+        cols.append(decode_column(name, col, dt.row_count))
+    return RecordBatch.from_series(cols) if cols else RecordBatch.empty()

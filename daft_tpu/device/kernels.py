@@ -1,0 +1,298 @@
+"""Sort-based relational kernels as jit-compiled XLA programs.
+
+These replace the reference's hash-table kernels (probe tables
+``src/daft-recordbatch/src/probeable/probe_table.rs:19``, grouped aggregate
+``src/daft-local-execution/src/sinks/grouped_aggregate.rs``) with the
+XLA-friendly sort + segment-reduce formulation (SURVEY.md §7 hard-part #3):
+
+- ``grouped_agg``: lexicographic ``lax.sort`` on key planes → segment ids via
+  boundary cumsum → ``jax.ops.segment_*`` reductions. Static shapes
+  throughout; outputs padded to capacity with a live-group count.
+- ``argsort``: multi-key, per-key descending + nulls-first, returns a
+  permutation (host applies it with Arrow take — device computes *indices*,
+  variable-width payloads never leave the host).
+- ``merge_join_indices``: two-phase sort/searchsorted inner-equi-join index
+  generation with the prefix-sum expansion trick.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _sort_key_plane(v: jnp.ndarray, valid: jnp.ndarray, descending: bool,
+                    nulls_first: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(null_rank, transformed_value) planes for one sort key."""
+    null_rank = jnp.where(valid,
+                          jnp.int8(1) if nulls_first else jnp.int8(0),
+                          jnp.int8(0) if nulls_first else jnp.int8(1))
+    x = v
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.int8)
+    if descending:
+        if jnp.issubdtype(x.dtype, jnp.unsignedinteger):
+            x = jnp.asarray(jnp.iinfo(x.dtype).max, x.dtype) - x
+        elif jnp.issubdtype(x.dtype, jnp.floating):
+            x = -x
+        else:
+            x = -x.astype(jnp.int64) if x.dtype == jnp.int64 else -x.astype(jnp.int32) \
+                if x.dtype in (jnp.int8, jnp.int16, jnp.int32) else -x
+    x = jnp.where(valid, x, jnp.zeros((), x.dtype))
+    return null_rank, x
+
+
+@partial(jax.jit, static_argnames=("descending", "nulls_first"))
+def argsort_kernel(keys, valids, row_mask, descending: Tuple[bool, ...],
+                   nulls_first: Tuple[bool, ...]):
+    """Returns the permutation placing live rows first in key order."""
+    C = row_mask.shape[0]
+    operands = [(~row_mask).astype(jnp.int8)]
+    for v, valid, d, nf in zip(keys, valids, descending, nulls_first):
+        nr, x = _sort_key_plane(v, valid & row_mask, d, nf)
+        operands.append(nr)
+        operands.append(x)
+    operands.append(jnp.arange(C, dtype=jnp.int32))
+    out = lax.sort(tuple(operands), num_keys=len(operands) - 1, is_stable=True)
+    return out[-1]
+
+
+@partial(jax.jit)
+def compaction_perm(row_mask):
+    """Permutation moving live rows to the front (stable)."""
+    C = row_mask.shape[0]
+    out = lax.sort(((~row_mask).astype(jnp.int8),
+                    jnp.arange(C, dtype=jnp.int32)), num_keys=1, is_stable=True)
+    return out[1]
+
+
+# ---------------------------------------------------------------------------
+# grouped aggregation
+
+_SEGMENT_AGGS = ("sum", "count", "min", "max", "mean", "var", "stddev",
+                 "any_value", "bool_and", "bool_or")
+
+
+def _identity_for(dtype, op):
+    if op == "min":
+        if jnp.issubdtype(dtype, jnp.floating):
+            return jnp.asarray(jnp.inf, dtype)
+        return jnp.asarray(jnp.iinfo(dtype).max, dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(-jnp.inf, dtype)
+    return jnp.asarray(jnp.iinfo(dtype).min, dtype)
+
+
+@partial(jax.jit, static_argnames=("ops",))
+def grouped_agg_kernel(keys, key_valids, vals, val_valids, row_mask,
+                       ops: Tuple[str, ...]):
+    """Sort-based grouped aggregation over padded device columns.
+
+    keys/vals: tuples of [C] arrays. Returns (out_keys, out_key_valids,
+    out_vals, out_val_valids, group_count); outputs are [C]-padded, groups in
+    ascending key order (so string-code groups decode in sorted order).
+    """
+    C = row_mask.shape[0]
+    dead = (~row_mask).astype(jnp.int8)
+    operands = [dead]
+    for k, kv in zip(keys, key_valids):
+        nr, x = _sort_key_plane(k, kv & row_mask, False, False)
+        operands.append(nr)
+        operands.append(x)
+    payload = list(keys) + [v & row_mask for v in key_valids] + list(vals) + \
+        [vv & row_mask for vv in val_valids] + [row_mask]
+    nk_ops = len(operands)
+    out = lax.sort(tuple(operands) + tuple(payload), num_keys=nk_ops,
+                   is_stable=True)
+    sorted_ops = out[:nk_ops]
+    p = list(out[nk_ops:])
+    nkeys = len(keys)
+    nvals = len(vals)
+    s_keys = p[:nkeys]
+    s_kvalids = p[nkeys:2 * nkeys]
+    s_vals = p[2 * nkeys:2 * nkeys + nvals]
+    s_vvalids = p[2 * nkeys + nvals:2 * nkeys + 2 * nvals]
+    s_live = p[-1]
+
+    # boundary detection over (key value, key validity) among live rows
+    idx = jnp.arange(C)
+    diff = jnp.zeros(C, dtype=jnp.bool_).at[0].set(True)
+    for k, kv in zip(s_keys, s_kvalids):
+        prev_k = jnp.concatenate([k[:1], k[:-1]])
+        prev_v = jnp.concatenate([kv[:1], kv[:-1]])
+        diff = diff | (k != prev_k) | (kv != prev_v)
+    prev_live = jnp.concatenate([jnp.zeros(1, jnp.bool_), s_live[:-1]])
+    diff = diff | (s_live & ~prev_live)
+    flags = diff & s_live
+    seg = jnp.cumsum(flags.astype(jnp.int32)) - 1
+    seg = jnp.where(s_live, seg, C - 1)  # dead rows -> trailing segment
+    group_count = jnp.sum(flags.astype(jnp.int32))
+
+    first_idx = jax.ops.segment_min(
+        jnp.where(s_live, idx, C - 1), seg, num_segments=C)
+    first_idx = jnp.clip(first_idx, 0, C - 1)
+
+    out_keys = tuple(jnp.take(k, first_idx) for k in s_keys)
+    out_kvalids = tuple(jnp.take(kv, first_idx) for kv in s_kvalids)
+
+    out_vals = []
+    out_valids = []
+    live_group = idx < group_count
+    for v, vv, op in zip(s_vals, s_vvalids, ops):
+        contrib = s_live & vv
+        cnt = jax.ops.segment_sum(contrib.astype(jnp.int64), seg, num_segments=C)
+        if op == "count":
+            out_vals.append(cnt)
+            out_valids.append(live_group)
+            continue
+        if op in ("sum", "mean", "var", "stddev"):
+            acc_dt = v.dtype if jnp.issubdtype(v.dtype, jnp.floating) else jnp.int64
+            x = jnp.where(contrib, v, jnp.zeros((), v.dtype)).astype(acc_dt)
+            s1 = jax.ops.segment_sum(x, seg, num_segments=C)
+            if op == "sum":
+                out_vals.append(s1)
+                out_valids.append(live_group & (cnt > 0))
+                continue
+            # widest float the backend supports (f64, or f32 under TPU x32)
+            fdt = s1.astype(jnp.float64).dtype if s1.dtype != jnp.float32 \
+                else jnp.float32
+            safe_cnt = jnp.maximum(cnt, 1).astype(fdt)
+            mean = s1.astype(fdt) / safe_cnt
+            if op == "mean":
+                out_vals.append(mean)
+                out_valids.append(live_group & (cnt > 0))
+                continue
+            x2 = x.astype(fdt) * x.astype(fdt)
+            s2 = jax.ops.segment_sum(x2, seg, num_segments=C)
+            var = s2 / safe_cnt - mean * mean
+            var = jnp.maximum(var, 0.0)
+            out_vals.append(jnp.sqrt(var) if op == "stddev" else var)
+            out_valids.append(live_group & (cnt > 0))
+            continue
+        if op in ("min", "max", "bool_and", "bool_or"):
+            base = v.astype(jnp.int8) if v.dtype == jnp.bool_ else v
+            red_op = "min" if op in ("min", "bool_and") else "max"
+            ident = _identity_for(base.dtype, red_op)
+            x = jnp.where(contrib, base, ident)
+            fn = jax.ops.segment_min if red_op == "min" else jax.ops.segment_max
+            r = fn(x, seg, num_segments=C)
+            if v.dtype == jnp.bool_:
+                r = r.astype(jnp.bool_)
+            out_vals.append(r)
+            out_valids.append(live_group & (cnt > 0))
+            continue
+        if op == "any_value":
+            fi = jax.ops.segment_min(
+                jnp.where(contrib, idx, C - 1), seg, num_segments=C)
+            fi = jnp.clip(fi, 0, C - 1)
+            out_vals.append(jnp.take(v, fi))
+            out_valids.append(live_group & (cnt > 0))
+            continue
+        raise ValueError(f"unsupported device agg {op}")
+
+    return out_keys, out_kvalids, tuple(out_vals), tuple(out_valids), group_count
+
+
+# ---------------------------------------------------------------------------
+# global aggregation
+
+@partial(jax.jit, static_argnames=("ops",))
+def global_agg_kernel(vals, val_valids, row_mask, ops: Tuple[str, ...]):
+    outs = []
+    for v, vv, op in zip(vals, val_valids, ops):
+        contrib = row_mask & vv
+        cnt = jnp.sum(contrib.astype(jnp.int64))
+        if op == "count":
+            outs.append((cnt, jnp.asarray(True)))
+            continue
+        if op in ("sum", "mean", "var", "stddev"):
+            acc_dt = v.dtype if jnp.issubdtype(v.dtype, jnp.floating) else jnp.int64
+            x = jnp.where(contrib, v, jnp.zeros((), v.dtype)).astype(acc_dt)
+            s1 = jnp.sum(x)
+            if op == "sum":
+                outs.append((s1, cnt > 0))
+                continue
+            fdt = jnp.float32 if v.dtype == jnp.float32 else s1.astype(jnp.float64).dtype
+            safe = jnp.maximum(cnt, 1).astype(fdt)
+            mean = s1.astype(fdt) / safe
+            if op == "mean":
+                outs.append((mean, cnt > 0))
+                continue
+            s2 = jnp.sum(x.astype(fdt) * x.astype(fdt))
+            var = jnp.maximum(s2 / safe - mean * mean, 0.0)
+            outs.append((jnp.sqrt(var) if op == "stddev" else var, cnt > 0))
+            continue
+        if op in ("min", "max", "bool_and", "bool_or"):
+            base = v.astype(jnp.int8) if v.dtype == jnp.bool_ else v
+            red = "min" if op in ("min", "bool_and") else "max"
+            ident = _identity_for(base.dtype, red)
+            x = jnp.where(contrib, base, ident)
+            r = jnp.min(x) if red == "min" else jnp.max(x)
+            if v.dtype == jnp.bool_:
+                r = r.astype(jnp.bool_)
+            outs.append((r, cnt > 0))
+            continue
+        if op == "any_value":
+            C = row_mask.shape[0]
+            fi = jnp.min(jnp.where(contrib, jnp.arange(C), C - 1))
+            outs.append((v[fi], cnt > 0))
+            continue
+        raise ValueError(f"unsupported device agg {op}")
+    return tuple(outs)
+
+
+# ---------------------------------------------------------------------------
+# sort-merge equi-join (index generation)
+
+@partial(jax.jit)
+def join_phase_sort(r_key, r_valid, r_mask):
+    """Sort the right side's key column; invalid/dead rows to the end."""
+    C = r_key.shape[0]
+    live = r_valid & r_mask
+    nr, x = _sort_key_plane(r_key, live, False, False)
+    dead = (~live).astype(jnp.int8)
+    s = lax.sort((dead, x, jnp.arange(C, dtype=jnp.int32)), num_keys=2,
+                 is_stable=True)
+    live_count = jnp.sum(live.astype(jnp.int32))
+    # dead/padding slots carry value 0 after sort; overwrite with the dtype max
+    # so the array stays monotonic for searchsorted
+    maxval = jnp.asarray(jnp.inf, x.dtype) \
+        if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.asarray(jnp.iinfo(x.dtype).max, x.dtype)
+    sorted_keys = jnp.where(jnp.arange(C) < live_count, s[1], maxval)
+    return sorted_keys, s[2], live_count
+
+
+@partial(jax.jit)
+def join_phase_count(l_key, l_valid, l_mask, r_sorted, r_live_count):
+    """Per-left-row match counts against the sorted right keys."""
+    live = l_valid & l_mask
+    starts = jnp.searchsorted(r_sorted, l_key, side="left")
+    ends = jnp.searchsorted(r_sorted, l_key, side="right")
+    ends = jnp.minimum(ends, r_live_count)
+    starts = jnp.minimum(starts, r_live_count)
+    counts = jnp.where(live, ends - starts, 0)
+    return counts, starts, jnp.sum(counts)
+
+
+@partial(jax.jit, static_argnames=("out_capacity",))
+def join_phase_expand(counts, starts, r_perm, out_capacity: int):
+    """Prefix-sum expansion: slot j → (left row, right row) index pair."""
+    C = counts.shape[0]
+    cum = jnp.cumsum(counts)
+    total = cum[-1]
+    j = jnp.arange(out_capacity, dtype=counts.dtype)
+    owner = jnp.searchsorted(cum, j, side="right")
+    owner = jnp.clip(owner, 0, C - 1)
+    cum0 = cum - counts  # exclusive prefix
+    offset = j - jnp.take(cum0, owner)
+    r_slot = jnp.take(starts, owner) + offset
+    r_idx = jnp.take(r_perm, jnp.clip(r_slot, 0, C - 1))
+    valid = j < total
+    return owner.astype(jnp.int32), r_idx.astype(jnp.int32), valid

@@ -1,0 +1,270 @@
+"""Device dispatch: decides per-batch whether an op runs as XLA or on host.
+
+This is the dispatch seam the reference has per-operator
+(SURVEY.md §7 hard-part #2: "keep a principled host-fallback per operator").
+Returns None from ``try_*`` → caller falls back to the Arrow host tier.
+
+Controls:
+- ``DAFT_TPU_DEVICE=0`` disables the device tier entirely.
+- ``DAFT_TPU_DEVICE_MIN_ROWS`` (default 0) bypasses the device for small
+  batches where transfer overhead dominates.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+import jax
+import jax.numpy as jnp
+
+from ..datatype import DataType
+from ..expressions.expressions import Expression
+from ..schema import Schema
+from ..series import Series
+from . import column as dcol
+from . import compiler, kernels
+
+_DEVICE_AGGS = {"sum", "mean", "min", "max", "count", "stddev", "var",
+                "any_value", "bool_and", "bool_or"}
+
+
+def device_enabled() -> bool:
+    return os.environ.get("DAFT_TPU_DEVICE", "1") != "0"
+
+
+def _min_rows() -> int:
+    return int(os.environ.get("DAFT_TPU_DEVICE_MIN_ROWS", "0"))
+
+
+_projection_cache: Dict[Tuple, compiler.Compiled] = {}
+
+
+def _schema_key(schema: Schema) -> Tuple:
+    return tuple((f.name, hash(f.dtype)) for f in schema)
+
+
+def _get_compiled(exprs: List[Expression], schema: Schema
+                  ) -> Optional[compiler.Compiled]:
+    key = (tuple(e._key() for e in exprs), _schema_key(schema))
+    hit = _projection_cache.get(key)
+    if hit is not None:
+        return hit
+    try:
+        c = compiler.compile_projection(exprs, schema)
+    except (compiler.NotCompilable, NotImplementedError, ValueError,
+            TypeError, KeyError, OverflowError):
+        return None
+    _projection_cache[key] = c
+    return c
+
+
+def _string_out_source(e: Expression) -> Optional[str]:
+    """If expr output is a passthrough of a string column, its source name."""
+    inner = e._unalias()
+    return inner.params[0] if inner.op == "col" else None
+
+
+def _prep_scalars(c: compiler.Compiled, dt: dcol.DeviceTable):
+    scalars = []
+    for spec in c.scalar_specs:
+        d = dt.columns[spec.col].dictionary
+        if d is None:
+            d = pa.array([], type=pa.large_string())
+        scalars.append(jnp.asarray(spec.fn(d)))
+    return tuple(scalars)
+
+
+def _run_compiled(c: compiler.Compiled, batch, exprs: List[Expression]):
+    """Encode inputs, run the fused program, return per-expr device outputs."""
+    dt = dcol.encode_batch(batch, c.needs_cols)
+    arrays = {n: col.data for n, col in dt.columns.items()}
+    valids = {n: col.validity for n, col in dt.columns.items()}
+    scalars = _prep_scalars(c, dt)
+    outs = c.fn(arrays, valids, dt.row_mask, scalars)
+    return dt, outs
+
+
+def try_eval_projection(batch, exprs: List[Expression]):
+    """Full projection on device; None → host fallback."""
+    from ..recordbatch import RecordBatch
+    if not device_enabled() or len(batch) < max(_min_rows(), 1):
+        return None
+    schema = batch.schema
+    out_fields = []
+    try:
+        for e in exprs:
+            out_fields.append(e.to_field(schema))
+    except Exception:
+        return None
+    # every output must be decodable
+    for e, f in zip(exprs, out_fields):
+        if f.dtype.is_string() or f.dtype.is_binary():
+            if _string_out_source(e) is None:
+                return None
+        elif f.dtype.device_repr() is None:
+            return None
+    c = _get_compiled(exprs, schema)
+    if c is None:
+        return None
+    for name in c.needs_cols:
+        if batch.get_column(name).is_pyobject():
+            return None
+    dt, outs = _run_compiled(c, batch, exprs)
+    n = len(batch)
+    cols = []
+    for e, f, (val, valid) in zip(exprs, out_fields, outs):
+        dictionary = None
+        if f.dtype.is_string() or f.dtype.is_binary():
+            dictionary = dt.columns[_string_out_source(e)].dictionary
+        dc = dcol.DeviceColumn(val, valid, f.dtype, dictionary)
+        cols.append(dcol.decode_column(f.name, dc, n))
+    return RecordBatch.from_series(cols)
+
+
+def try_eval_predicate(batch, predicate: Expression) -> Optional[np.ndarray]:
+    """Predicate → host boolean mask (for arrow-side filtering)."""
+    if not device_enabled() or len(batch) < max(_min_rows(), 1):
+        return None
+    c = _get_compiled([predicate], batch.schema)
+    if c is None:
+        return None
+    for name in c.needs_cols:
+        if batch.get_column(name).is_pyobject():
+            return None
+    dt, outs = _run_compiled(c, batch, [predicate])
+    val, valid = outs[0]
+    mask = np.asarray(jax.device_get(val & valid))[:len(batch)]
+    return mask.astype(bool)
+
+
+def try_argsort(key_series: List[Series], descending: List[bool],
+                nulls_first: List[bool]) -> Optional[np.ndarray]:
+    if not device_enabled() or not key_series:
+        return None
+    n = len(key_series[0])
+    if n < max(_min_rows(), 2):
+        return None
+    for s in key_series:
+        if s.is_pyobject():
+            return None
+        dt = s.datatype()
+        if not (dt.is_device_representable() or dt.is_string()):
+            return None
+    cap = dcol.bucket_capacity(n)
+    try:
+        cols = [dcol.encode_series(s, cap) for s in key_series]
+    except (ValueError, pa.ArrowInvalid):
+        return None
+    mask = np.zeros(cap, dtype=np.bool_)
+    mask[:n] = True
+    perm = kernels.argsort_kernel(
+        tuple(c.data for c in cols), tuple(c.validity for c in cols),
+        jnp.asarray(mask), tuple(bool(d) for d in descending),
+        tuple(bool(x) for x in nulls_first))
+    return np.asarray(jax.device_get(perm))[:n].astype(np.int64)
+
+
+def try_agg(batch, to_agg: List[Expression], group_by: List[Expression]):
+    """Grouped/global aggregation on device; None → host fallback."""
+    from ..aggs import split_agg_expr
+    from ..recordbatch import RecordBatch
+    if not device_enabled() or len(batch) < max(_min_rows(), 1):
+        return None
+    schema = batch.schema
+    try:
+        specs = [split_agg_expr(e) for e in to_agg]
+    except ValueError:
+        return None
+    for op, child, name, params in specs:
+        if op not in _DEVICE_AGGS:
+            return None
+        if op == "count" and params and params[0] != "valid":
+            return None
+    try:
+        out_fields = [e.to_field(schema) for e in to_agg]
+        key_fields = [e.to_field(schema) for e in group_by]
+    except Exception:
+        return None
+    for e, f in zip(group_by, key_fields):
+        if f.dtype.is_string() or f.dtype.is_binary():
+            if _string_out_source(e) is None:
+                return None
+        elif f.dtype.device_repr() is None:
+            return None
+    for (op, child, _, _), f in zip(specs, out_fields):
+        if f.dtype.is_string() or f.dtype.is_binary():
+            if child is None or _string_out_source(child) is None:
+                return None
+        elif f.dtype.device_repr() is None:
+            return None
+
+    # compile keys + agg children as one projection
+    child_exprs = []
+    for i, (op, child, name, params) in enumerate(specs):
+        child_exprs.append((child if child is not None
+                            else Expression._lit(True)).alias(f"__in{i}__"))
+    proj = list(group_by) + child_exprs
+    c = _get_compiled(proj, schema)
+    if c is None:
+        return None
+    for nm in c.needs_cols:
+        if batch.get_column(nm).is_pyobject():
+            return None
+
+    dt, outs = _run_compiled(c, batch, proj)
+    nk = len(group_by)
+    key_outs = outs[:nk]
+    val_outs = outs[nk:]
+    ops = tuple(s[0] for s in specs)
+
+    def bcast(v, m):
+        if v.ndim == 0:
+            v = jnp.broadcast_to(v, dt.row_mask.shape)
+            m = jnp.broadcast_to(m, dt.row_mask.shape)
+        return v, m
+
+    if nk == 0:
+        vals, valids = zip(*[bcast(v, m) for v, m in val_outs]) if val_outs \
+            else ((), ())
+        results = kernels.global_agg_kernel(tuple(vals), tuple(valids),
+                                            dt.row_mask, ops)
+        cols = []
+        for (op, child, name, params), f, (rv, rm) in zip(specs, out_fields, results):
+            v = np.asarray(jax.device_get(rv)).reshape(1)
+            m = np.asarray(jax.device_get(rm)).reshape(1)
+            cols.append(_decode_scalar(name, f.dtype, v, m))
+        return RecordBatch.from_series(cols)
+
+    keys_b = [bcast(v, m) for v, m in key_outs]
+    vals_b = [bcast(v, m) for v, m in val_outs]
+    out_keys, out_kvalids, out_vals, out_valids, gcount = \
+        kernels.grouped_agg_kernel(
+            tuple(v for v, _ in keys_b), tuple(m for _, m in keys_b),
+            tuple(v for v, _ in vals_b), tuple(m for _, m in vals_b),
+            dt.row_mask, ops)
+    g = int(jax.device_get(gcount))
+    cols = []
+    for e, f, kv, km in zip(group_by, key_fields, out_keys, out_kvalids):
+        dictionary = None
+        if f.dtype.is_string() or f.dtype.is_binary():
+            dictionary = dt.columns[_string_out_source(e)].dictionary
+        dc = dcol.DeviceColumn(kv, km, f.dtype, dictionary)
+        cols.append(dcol.decode_column(f.name, dc, g))
+    for (op, child, name, params), f, vv, vm in zip(specs, out_fields,
+                                                    out_vals, out_valids):
+        dictionary = None
+        if f.dtype.is_string() or f.dtype.is_binary():
+            dictionary = dt.columns[_string_out_source(child)].dictionary
+        dc = dcol.DeviceColumn(vv, vm, f.dtype, dictionary)
+        cols.append(dcol.decode_column(name, dc, g))
+    return RecordBatch.from_series(cols)
+
+
+def _decode_scalar(name: str, dtype: DataType, v: np.ndarray, m: np.ndarray
+                   ) -> Series:
+    dc = dcol.DeviceColumn(jnp.asarray(v), jnp.asarray(m), dtype, None)
+    return dcol.decode_column(name, dc, 1)

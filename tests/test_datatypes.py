@@ -1,0 +1,76 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from daft_tpu import DataType, Field, Schema, TimeUnit
+from daft_tpu.datatype import ImageMode
+
+
+def test_simple_roundtrip_arrow():
+    for dt in [DataType.bool(), DataType.int8(), DataType.int64(),
+               DataType.uint32(), DataType.float32(), DataType.float64(),
+               DataType.string(), DataType.binary(), DataType.date(),
+               DataType.timestamp(TimeUnit.us), DataType.duration(TimeUnit.ms),
+               DataType.decimal128(10, 2), DataType.list(DataType.int64()),
+               DataType.fixed_size_list(DataType.float32(), 4),
+               DataType.struct({"a": DataType.int64(), "b": DataType.string()}),
+               DataType.map(DataType.string(), DataType.int64())]:
+        assert DataType.from_arrow_type(dt.to_arrow()) == dt
+
+
+def test_equality_and_hash():
+    assert DataType.int64() == DataType.int64()
+    assert DataType.int64() != DataType.int32()
+    assert hash(DataType.list(DataType.int8())) == hash(DataType.list(DataType.int8()))
+
+
+def test_image_physical_lowering():
+    # reference: dtype.rs:307-335 — Image -> Struct{data, channel, h, w, mode}
+    img = DataType.image("RGB")
+    phys = img.to_physical()
+    assert phys.is_struct()
+    assert set(phys.fields.keys()) == {"data", "channel", "height", "width", "mode"}
+    fsi = DataType.fixed_shape_image("RGB", 4, 6)
+    assert fsi.to_physical() == DataType.fixed_size_list(DataType.uint8(), 4 * 6 * 3)
+
+
+def test_tensor_physical_lowering():
+    t = DataType.tensor(DataType.float32())
+    phys = t.to_physical()
+    assert phys.is_struct() and set(phys.fields.keys()) == {"data", "shape"}
+    ft = DataType.tensor(DataType.float32(), (2, 3))
+    assert ft.to_physical() == DataType.fixed_size_list(DataType.float32(), 6)
+
+
+def test_embedding():
+    e = DataType.embedding(DataType.float32(), 128)
+    assert e.is_embedding()
+    assert e.to_physical() == DataType.fixed_size_list(DataType.float32(), 128)
+    assert e.device_repr() == np.dtype(np.float32)
+
+
+def test_device_repr():
+    assert DataType.int64().device_repr() == np.dtype(np.int64)
+    assert DataType.string().device_repr() == np.dtype(np.int32)  # dict codes
+    assert DataType.date().device_repr() == np.dtype(np.int32)
+    assert DataType.python().device_repr() is None
+    assert DataType.list(DataType.int64()).device_repr() is None
+
+
+def test_schema():
+    s = Schema.from_pydict({"a": DataType.int64(), "b": DataType.string()})
+    assert s.column_names == ["a", "b"]
+    assert s["a"].dtype == DataType.int64()
+    assert "b" in s and "c" not in s
+    with pytest.raises(ValueError):
+        Schema([Field("x", DataType.int64()), Field("x", DataType.int32())])
+    u = s.non_distinct_union(Schema.from_pydict({"b": DataType.int8(),
+                                                 "c": DataType.bool()}))
+    assert u.column_names == ["a", "b", "c"]
+    assert u["b"].dtype == DataType.string()  # left wins
+
+
+def test_schema_arrow_roundtrip():
+    s = Schema.from_pydict({"a": DataType.int64(), "b": DataType.string(),
+                            "c": DataType.list(DataType.float64())})
+    assert Schema.from_arrow(s.to_arrow()) == s

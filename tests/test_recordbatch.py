@@ -1,0 +1,254 @@
+"""Kernel-level tests (reference model: ``tests/recordbatch/``), run on both
+execution tiers via the ``device_tier`` fixture."""
+
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from daft_tpu import DataType, RecordBatch, Series, col, lit
+
+
+@pytest.fixture
+def batch():
+    return RecordBatch.from_pydict({
+        "a": [1, 2, 3, 4, None, 6],
+        "b": [10.0, 20.0, None, 40.0, 50.0, 60.0],
+        "s": ["x", "y", "x", None, "z", "y"],
+        "flag": [True, False, True, True, None, False],
+    })
+
+
+def test_project_arith(batch, device_tier):
+    out = batch.eval_expression_list([
+        (col("a") + 1).alias("a1"),
+        (col("a") * col("b")).alias("ab"),
+        (col("b") / 2).alias("half"),
+    ])
+    assert out.to_pydict() == {
+        "a1": [2, 3, 4, 5, None, 7],
+        "ab": [10.0, 40.0, None, 160.0, None, 360.0],
+        "half": [5.0, 10.0, None, 20.0, 25.0, 30.0],
+    }
+
+
+def test_compare_and_filter(batch, device_tier):
+    out = batch.filter((col("a") >= 2) & (col("b") < 60.0))
+    assert out.to_pydict()["a"] == [2, 4]
+
+
+def test_string_compare(batch, device_tier):
+    assert batch.filter(col("s") == "x").to_pydict()["a"] == [1, 3]
+    assert batch.filter(col("s") != "x").to_pydict()["a"] == [2, None, 6]
+    assert batch.filter(col("s") <= "x").to_pydict()["a"] == [1, 3]
+    assert batch.filter(col("s") > "x").to_pydict()["a"] == [2, None, 6]
+    assert batch.filter(col("s") < "a").to_pydict()["a"] == []
+
+
+def test_is_null_fill_null(batch, device_tier):
+    out = batch.eval_expression_list([
+        col("a").is_null().alias("n"),
+        col("a").fill_null(0).alias("f"),
+    ])
+    assert out.to_pydict() == {"n": [False, False, False, False, True, False],
+                               "f": [1, 2, 3, 4, 0, 6]}
+
+
+def test_if_else_between_isin(batch, device_tier):
+    out = batch.eval_expression_list([
+        (col("a") > 2).if_else(col("a"), 0).alias("ie"),
+        col("a").between(2, 4).alias("bt"),
+        col("a").is_in([1, 4]).alias("ii"),
+    ])
+    d = out.to_pydict()
+    assert d["ie"] == [0, 0, 3, 4, None, 6]
+    assert d["bt"] == [False, True, True, True, None, False]
+    assert d["ii"] == [True, False, False, True, None, False]
+
+
+def test_global_agg(batch, device_tier):
+    out = batch.agg([
+        col("a").sum().alias("sum"),
+        col("a").mean().alias("mean"),
+        col("a").count().alias("cnt"),
+        col("b").min().alias("min"),
+        col("b").max().alias("max"),
+    ])
+    d = out.to_pydict()
+    assert d == {"sum": [16], "mean": [3.2], "cnt": [5],
+                 "min": [10.0], "max": [60.0]}
+
+
+def test_grouped_agg(batch, device_tier):
+    out = batch.agg(
+        [col("a").sum().alias("sum"), col("b").mean().alias("mean"),
+         col("a").count().alias("cnt")],
+        [col("s")])
+    out = out.sort([col("s")])
+    d = out.to_pydict()
+    # groups: None, x, y, z — null group position depends on sort, check content
+    rows = dict(zip(d["s"], zip(d["sum"], d["mean"], d["cnt"])))
+    assert rows["x"] == (4, 10.0, 2)
+    assert rows["y"] == (8, 40.0, 2)
+    assert rows["z"] == (None, 50.0, 0)
+    assert rows[None] == (4, 40.0, 1)
+
+
+def test_grouped_agg_multi_key(device_tier):
+    b = RecordBatch.from_pydict({
+        "k1": ["a", "a", "b", "b", "a"],
+        "k2": [1, 2, 1, 1, 1],
+        "v": [10, 20, 30, 40, 50],
+    })
+    out = b.agg([col("v").sum()], [col("k1"), col("k2")]).sort(
+        [col("k1"), col("k2")])
+    assert out.to_pydict() == {"k1": ["a", "a", "b"], "k2": [1, 2, 1],
+                               "v": [60, 20, 70]}
+
+
+def test_sort_multi(device_tier):
+    b = RecordBatch.from_pydict({
+        "x": [2, 1, 2, None, 1],
+        "y": [1.0, 5.0, 0.0, 2.0, None],
+    })
+    # reference defaults: nulls_first = descending (nulls sort as greatest)
+    out = b.sort([col("x"), col("y")], descending=[False, True])
+    assert out.to_pydict()["x"] == [1, 1, 2, 2, None]
+    assert out.to_pydict()["y"] == [None, 5.0, 1.0, 0.0, 2.0]
+
+
+def test_sort_stability(device_tier):
+    b = RecordBatch.from_pydict({"k": [1, 1, 1, 0, 0], "i": [0, 1, 2, 3, 4]})
+    out = b.sort([col("k")])
+    assert out.to_pydict()["i"] == [3, 4, 0, 1, 2]
+
+
+def test_joins(device_tier):
+    l = RecordBatch.from_pydict({"k": [1, 2, 3, None], "v": [10, 20, 30, 40]})
+    r = RecordBatch.from_pydict({"k": [2, 2, 4, None], "w": [1.0, 2.0, 3.0, 4.0]})
+    inner = l.hash_join(r, [col("k")], [col("k")], "inner").sort([col("w")])
+    assert inner.to_pydict() == {"k": [2, 2], "v": [20, 20], "w": [1.0, 2.0]}
+    left = l.hash_join(r, [col("k")], [col("k")], "left")
+    assert len(left) == 5  # 2 matches + 3 unmatched left (incl. null key)
+    semi = l.hash_join(r, [col("k")], [col("k")], "semi")
+    assert semi.to_pydict()["v"] == [20]
+    anti = l.hash_join(r, [col("k")], [col("k")], "anti")
+    assert sorted(anti.to_pydict()["v"]) == [10, 30, 40]
+    outer = l.hash_join(r, [col("k")], [col("k")], "outer")
+    assert len(outer) == 7
+    ks = outer.to_pydict()["k"]
+    assert 4 in ks  # right-side key coalesced in
+
+
+def test_multi_key_join(device_tier):
+    l = RecordBatch.from_pydict({"a": [1, 1, 2], "b": ["x", "y", "x"], "v": [1, 2, 3]})
+    r = RecordBatch.from_pydict({"a": [1, 2], "b": ["y", "x"], "w": [100, 200]})
+    out = l.hash_join(r, [col("a"), col("b")], [col("a"), col("b")], "inner")
+    out = out.sort([col("v")])
+    assert out.to_pydict() == {"a": [1, 2], "b": ["y", "x"], "v": [2, 3],
+                               "w": [100, 200]}
+
+
+def test_explode(device_tier):
+    b = RecordBatch.from_pydict({"id": [1, 2, 3], "l": [[1, 2], [], [3]]})
+    out = b.explode([col("l").explode()])
+    assert out.to_pydict() == {"id": [1, 1, 2, 3], "l": [1, 2, None, 3]}
+
+
+def test_partition_by_hash(device_tier):
+    b = RecordBatch.from_pydict({"k": list(range(100)), "v": list(range(100))})
+    parts = b.partition_by_hash([col("k")], 4)
+    assert len(parts) == 4
+    assert sum(len(p) for p in parts) == 100
+    all_k = sorted(sum((p.to_pydict()["k"] for p in parts), []))
+    assert all_k == list(range(100))
+
+
+def test_distinct(device_tier):
+    b = RecordBatch.from_pydict({"a": [1, 1, 2, 2, 3], "b": ["x", "x", "y", "z", "x"]})
+    out = b.distinct().sort([col("a"), col("b")])
+    assert out.to_pydict() == {"a": [1, 2, 2, 3], "b": ["x", "y", "z", "x"]}
+
+
+def test_concat_and_slice(device_tier):
+    b1 = RecordBatch.from_pydict({"a": [1, 2]})
+    b2 = RecordBatch.from_pydict({"a": [3]})
+    out = RecordBatch.concat([b1, b2])
+    assert out.to_pydict() == {"a": [1, 2, 3]}
+    assert out.slice(1, 3).to_pydict() == {"a": [2, 3]}
+
+
+def test_unpivot(device_tier):
+    b = RecordBatch.from_pydict({"id": [1, 2], "x": [10, 20], "y": [30, 40]})
+    out = b.unpivot([col("id")], [col("x"), col("y")])
+    assert len(out) == 4
+    assert set(out.to_pydict()["variable"]) == {"x", "y"}
+
+
+def test_pivot(device_tier):
+    b = RecordBatch.from_pydict({
+        "g": ["a", "a", "b"], "p": ["x", "y", "x"], "v": [1, 2, 3]})
+    out = b.pivot([col("g")], col("p"), col("v"), ["x", "y"])
+    out = out.sort([col("g")])
+    assert out.to_pydict() == {"g": ["a", "b"], "x": [1, 3], "y": [2, None]}
+
+
+def test_str_functions(device_tier):
+    b = RecordBatch.from_pydict({"s": ["Hello", "world", None]})
+    out = b.eval_expression_list([
+        col("s").str.upper().alias("u"),
+        col("s").str.contains("orl").alias("c"),
+        col("s").str.length().alias("n"),
+    ])
+    assert out.to_pydict() == {"u": ["HELLO", "WORLD", None],
+                               "c": [False, True, None],
+                               "n": [5, 5, None]}
+
+
+def test_dt_functions(device_tier):
+    import datetime
+    b = RecordBatch.from_pydict(
+        {"d": [datetime.date(2024, 3, 15), datetime.date(1999, 12, 31), None]})
+    out = b.eval_expression_list([
+        col("d").dt.year().alias("y"),
+        col("d").dt.month().alias("m"),
+        col("d").dt.day().alias("dd"),
+    ])
+    assert out.to_pydict() == {"y": [2024, 1999, None], "m": [3, 12, None],
+                               "dd": [15, 31, None]}
+
+
+def test_date_compare(device_tier):
+    import datetime
+    b = RecordBatch.from_pydict(
+        {"d": [datetime.date(2024, 3, 15), datetime.date(1999, 12, 31)]})
+    out = b.filter(col("d") <= lit(datetime.date(2000, 1, 1)))
+    assert out.to_pydict()["d"] == [datetime.date(1999, 12, 31)]
+
+
+def test_cast(device_tier):
+    b = RecordBatch.from_pydict({"a": [1, 2, 3]})
+    out = b.eval_expression_list([col("a").cast(DataType.float64()).alias("f"),
+                                  col("a").cast(DataType.string()).alias("s")])
+    assert out.to_pydict() == {"f": [1.0, 2.0, 3.0], "s": ["1", "2", "3"]}
+
+
+def test_stddev_var(device_tier):
+    b = RecordBatch.from_pydict({"g": ["a", "a", "a", "b"],
+                                 "v": [1.0, 2.0, 3.0, 5.0]})
+    out = b.agg([col("v").stddev().alias("sd"), col("v").var().alias("vr")],
+                [col("g")]).sort([col("g")])
+    d = out.to_pydict()
+    assert d["sd"][0] == pytest.approx(math.sqrt(2.0 / 3.0))
+    assert d["vr"][0] == pytest.approx(2.0 / 3.0)
+    assert d["sd"][1] == pytest.approx(0.0)
+
+
+def test_pyobject_column(device_tier):
+    b = RecordBatch.from_pydict({"o": Series.from_pyobjects([{"x": 1}, [2], None]),
+                                 "k": [1, 2, 3]})
+    out = b.filter(col("k") > 1)
+    assert out.to_pydict()["o"] == [[2], None]
+    t = b.take(np.array([2, 0]))
+    assert t.to_pydict()["o"] == [None, {"x": 1}]
