@@ -12,6 +12,7 @@ from .expressions import (
 from .schema import Field, Schema
 from .series import Series
 from .recordbatch import RecordBatch
+from .udf import udf  # after submodule import, rebind name to the decorator
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,155 @@
+"""Global context: config + runner singleton.
+
+Reference: ``src/daft-context/src/lib.rs`` (runner transitions),
+``src/common/daft-config/src/lib.rs:40-100`` (the two frozen config objects
+and their ~26 knobs), ``daft/context.py:156-269`` (python surface).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanningConfig:
+    default_io_config: Optional[Any] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutionConfig:
+    """Frozen-per-query execution knobs (reference defaults at
+    ``src/common/daft-config/src/lib.rs:70-100``)."""
+
+    scan_tasks_min_size_bytes: int = 96 * 1024 * 1024
+    scan_tasks_max_size_bytes: int = 384 * 1024 * 1024
+    max_sources_per_scan_task: int = 10
+    broadcast_join_size_bytes_threshold: int = 10 * 1024 * 1024
+    sort_merge_join_sort_with_aligned_boundaries: bool = False
+    hash_join_partition_size_leniency: float = 0.5
+    sample_size_for_sort: int = 20
+    parquet_split_row_groups_max_files: int = 10
+    num_preview_rows: int = 8
+    parquet_target_filesize: int = 512 * 1024 * 1024
+    parquet_target_row_group_size: int = 128 * 1024 * 1024
+    parquet_inflation_factor: float = 3.0
+    csv_target_filesize: int = 512 * 1024 * 1024
+    csv_inflation_factor: float = 0.5
+    shuffle_aggregation_default_partitions: int = 200
+    partial_aggregation_threshold: int = 10000
+    high_cardinality_aggregation_threshold: float = 0.8
+    read_sql_partition_size_bytes: int = 512 * 1024 * 1024
+    enable_aqe: bool = False
+    default_morsel_size: int = 128 * 1024
+    min_cpu_per_task: float = 1.0
+    enable_ray_tracing: bool = False
+    flight_shuffle_dirs: tuple = ("/tmp",)
+    # TPU-specific knobs
+    device_min_rows: int = 0
+    device_enabled: bool = True
+    target_partition_size_bytes: int = 512 * 1024 * 1024
+
+
+def _exec_config_from_env() -> ExecutionConfig:
+    kwargs: Dict[str, Any] = {}
+    for f in dataclasses.fields(ExecutionConfig):
+        env = os.environ.get(f"DAFT_{f.name.upper()}")
+        if env is not None:
+            if f.type == "bool" or isinstance(f.default, bool):
+                kwargs[f.name] = env not in ("0", "false", "False")
+            elif isinstance(f.default, int):
+                kwargs[f.name] = int(env)
+            elif isinstance(f.default, float):
+                kwargs[f.name] = float(env)
+    return ExecutionConfig(**kwargs)
+
+
+class Context:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._runner = None
+        self.planning_config = PlanningConfig()
+        self.execution_config = _exec_config_from_env()
+
+    def get_or_create_runner(self):
+        with self._lock:
+            if self._runner is None:
+                name = os.environ.get("DAFT_RUNNER", "native").lower()
+                if name in ("native", "py"):
+                    from .runners.native_runner import NativeRunner
+                    self._runner = NativeRunner()
+                elif name in ("tpu_distributed", "distributed"):
+                    from .runners.distributed_runner import DistributedRunner
+                    self._runner = DistributedRunner()
+                else:
+                    raise ValueError(f"unknown DAFT_RUNNER {name!r}")
+            return self._runner
+
+    def set_runner(self, runner):
+        with self._lock:
+            self._runner = runner
+
+
+_context: Optional[Context] = None
+_context_lock = threading.Lock()
+
+
+def get_context() -> Context:
+    global _context
+    with _context_lock:
+        if _context is None:
+            _context = Context()
+        return _context
+
+
+def set_runner_native() -> Context:
+    ctx = get_context()
+    from .runners.native_runner import NativeRunner
+    ctx.set_runner(NativeRunner())
+    return ctx
+
+
+def set_runner_tpu_distributed(num_workers: Optional[int] = None) -> Context:
+    ctx = get_context()
+    from .runners.distributed_runner import DistributedRunner
+    ctx.set_runner(DistributedRunner(num_workers=num_workers))
+    return ctx
+
+
+def set_execution_config(config: Optional[ExecutionConfig] = None, **kwargs) -> Context:
+    ctx = get_context()
+    base = config or ctx.execution_config
+    ctx.execution_config = dataclasses.replace(base, **kwargs)
+    return ctx
+
+
+def set_planning_config(config: Optional[PlanningConfig] = None, **kwargs) -> Context:
+    ctx = get_context()
+    base = config or ctx.planning_config
+    ctx.planning_config = dataclasses.replace(base, **kwargs)
+    return ctx
+
+
+@contextlib.contextmanager
+def execution_config_ctx(**kwargs):
+    ctx = get_context()
+    old = ctx.execution_config
+    try:
+        ctx.execution_config = dataclasses.replace(old, **kwargs)
+        yield ctx
+    finally:
+        ctx.execution_config = old
+
+
+@contextlib.contextmanager
+def planning_config_ctx(**kwargs):
+    ctx = get_context()
+    old = ctx.planning_config
+    try:
+        ctx.planning_config = dataclasses.replace(old, **kwargs)
+        yield ctx
+    finally:
+        ctx.planning_config = old

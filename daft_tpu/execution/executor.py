@@ -1,0 +1,356 @@
+"""Streaming partition-parallel local executor.
+
+The single-node engine (reference: "Swordfish",
+``src/daft-local-execution``): operators stream MicroPartitions, pipelined
+ops run on a shared thread pool (Arrow C++ and XLA both release the GIL, so
+threads scale), pipeline breakers (sort / final agg / join build) materialize.
+Ordering is preserved via bounded in-order future windows
+(the RoundRobin dispatcher of ``dispatcher.rs:24-60``).
+
+Global sort follows the reference's sample→boundaries→range-partition→merge
+pipeline (``daft/execution/physical_plan.py:1632``).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import os
+from typing import Callable, Iterator, List, Optional
+
+import numpy as np
+
+from ..context import get_context
+from ..expressions import Expression, col
+from ..micropartition import MicroPartition
+from ..physical import plan as pp
+from ..recordbatch import RecordBatch
+from ..series import Series
+
+_POOL: Optional[cf.ThreadPoolExecutor] = None
+
+
+def _pool() -> cf.ThreadPoolExecutor:
+    global _POOL
+    if _POOL is None:
+        _POOL = cf.ThreadPoolExecutor(
+            max_workers=max(os.cpu_count() or 4, 4),
+            thread_name_prefix="daft-tpu-exec")
+    return _POOL
+
+
+def _ordered_parallel(inputs: Iterator, fn: Callable,
+                      width: Optional[int] = None) -> Iterator:
+    """Map fn over inputs on the pool, yielding results in order with a
+    bounded in-flight window (backpressure)."""
+    width = width or max((os.cpu_count() or 4), 4) * 2
+    pool = _pool()
+    pending: List[cf.Future] = []
+    it = iter(inputs)
+    done = False
+    while True:
+        while not done and len(pending) < width:
+            try:
+                x = next(it)
+            except StopIteration:
+                done = True
+                break
+            pending.append(pool.submit(fn, x))
+        if not pending:
+            return
+        yield pending.pop(0).result()
+
+
+class LocalExecutor:
+    """Interprets a physical plan into a stream of MicroPartitions."""
+
+    def __init__(self):
+        self.cfg = get_context().execution_config
+
+    def run(self, plan: pp.PhysicalPlan) -> Iterator[MicroPartition]:
+        return self._exec(plan)
+
+    # ------------------------------------------------------------------
+    def _exec(self, node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
+        h = getattr(self, "_exec_" + type(node).__name__, None)
+        if h is None:
+            raise NotImplementedError(f"executor for {type(node).__name__}")
+        return h(node)
+
+    # sources ----------------------------------------------------------
+    def _exec_ScanSource(self, node: pp.ScanSource):
+        def run(t):
+            mp = MicroPartition.from_scan_task(t)
+            mp._load()
+            return mp
+        if not node.tasks:
+            yield MicroPartition.empty(node.schema())
+            return
+        yield from _ordered_parallel(iter(node.tasks), run)
+
+    def _exec_InMemorySource(self, node: pp.InMemorySource):
+        if not node.partitions:
+            yield MicroPartition.empty(node.schema())
+            return
+        yield from iter(node.partitions)
+
+    # pipelined maps ---------------------------------------------------
+    def _exec_Project(self, node: pp.Project):
+        child = self._exec(node.children[0])
+        yield from _ordered_parallel(
+            child, lambda p: p.eval_expression_list(node.exprs))
+
+    def _exec_UDFProject(self, node: pp.UDFProject):
+        child = self._exec(node.children[0])
+        width = node.concurrency or None
+        yield from _ordered_parallel(
+            child, lambda p: p.eval_expression_list(node.exprs), width=width)
+
+    def _exec_Filter(self, node: pp.Filter):
+        child = self._exec(node.children[0])
+        yield from _ordered_parallel(child, lambda p: p.filter(node.predicate))
+
+    def _exec_Explode(self, node: pp.Explode):
+        child = self._exec(node.children[0])
+        yield from _ordered_parallel(child, lambda p: p.explode(node.exprs))
+
+    def _exec_Unpivot(self, node: pp.Unpivot):
+        child = self._exec(node.children[0])
+        yield from _ordered_parallel(
+            child, lambda p: p.unpivot(node.ids, node.values,
+                                       node.variable_name, node.value_name))
+
+    def _exec_Sample(self, node: pp.Sample):
+        child = self._exec(node.children[0])
+        yield from _ordered_parallel(
+            child, lambda p: p.sample(fraction=node.fraction, size=None,
+                                      with_replacement=node.with_replacement,
+                                      seed=node.seed)
+            if node.fraction is not None else p.head(node.size))
+
+    def _exec_MonotonicallyIncreasingId(self, node):
+        child = self._exec(node.children[0])
+        for i, p in enumerate(child):
+            yield p.add_monotonically_increasing_id(i, node.column_name)
+
+    def _exec_Limit(self, node: pp.Limit):
+        remaining = node.limit
+        to_skip = node.offset
+        for p in self._exec(node.children[0]):
+            n = len(p)
+            if to_skip:
+                if n <= to_skip:
+                    to_skip -= n
+                    continue
+                p = MicroPartition.from_recordbatch(
+                    p.combined().slice(to_skip, n))
+                to_skip = 0
+            if remaining <= 0:
+                break
+            if len(p) > remaining:
+                p = p.head(remaining)
+            remaining -= len(p)
+            yield p
+            if remaining <= 0:
+                break
+
+    def _exec_Concat(self, node: pp.Concat):
+        yield from self._exec(node.children[0])
+        yield from self._exec(node.children[1])
+
+    # aggregation ------------------------------------------------------
+    def _exec_Aggregate(self, node: pp.Aggregate):
+        child = self._exec(node.children[0])
+        yield from _ordered_parallel(
+            child, lambda p: p.agg(node.aggs, node.group_by)
+            .cast_to_schema(node.schema()))
+
+    def _exec_Dedup(self, node: pp.Dedup):
+        child = self._exec(node.children[0])
+        yield from _ordered_parallel(child, lambda p: p.distinct(node.on))
+
+    def _exec_Pivot(self, node: pp.Pivot):
+        for p in self._exec(node.children[0]):
+            yield p.pivot(node.group_by, node.pivot_col, node.value_col,
+                          node.names).cast_to_schema(node.schema())
+
+    def _exec_Window(self, node: pp.Window):
+        from ..window_exec import run_window
+        child = self._exec(node.children[0])
+        yield from _ordered_parallel(
+            child, lambda p: MicroPartition.from_recordbatch(
+                run_window(p.combined(), node)))
+
+    # sort -------------------------------------------------------------
+    def _exec_Sort(self, node: pp.Sort):
+        parts = list(self._exec(node.children[0]))
+        if len(parts) == 1:
+            yield parts[0].sort(node.sort_by, node.descending, node.nulls_first)
+            return
+        ranged = self._range_partition(parts, list(node.sort_by),
+                                       list(node.descending),
+                                       list(node.nulls_first))
+        yield from _ordered_parallel(
+            iter(ranged),
+            lambda p: p.sort(node.sort_by, node.descending, node.nulls_first))
+
+    def _exec_TopN(self, node: pp.TopN):
+        child = self._exec(node.children[0])
+        tops = list(_ordered_parallel(
+            child, lambda p: MicroPartition.from_recordbatch(
+                p.combined().top_n(node.sort_by, node.limit, node.descending,
+                                   node.nulls_first))))
+        merged = tops[0].concat(tops[1:]) if len(tops) > 1 else tops[0]
+        yield MicroPartition.from_recordbatch(
+            merged.combined().top_n(node.sort_by, node.limit, node.descending,
+                                    node.nulls_first))
+
+    # exchanges --------------------------------------------------------
+    def _exec_Exchange(self, node: pp.Exchange):
+        parts = list(self._exec(node.children[0]))
+        kind, n = node.kind, node.num_partitions
+        if kind == "gather" or (kind == "split" and n == 1):
+            yield parts[0].concat(parts[1:]) if len(parts) > 1 else parts[0]
+            return
+        if kind == "split":
+            yield from self._split(parts, n)
+            return
+        if kind == "random":
+            split = list(_ordered_parallel(
+                iter(list(enumerate(parts))),
+                lambda ip: ip[1].partition_by_random(n, seed=ip[0])))
+            yield from self._regroup(split, n)
+            return
+        if kind == "hash":
+            by = list(node.by)
+            split = list(_ordered_parallel(
+                iter(parts), lambda p: p.partition_by_hash(by, n)))
+            yield from self._regroup(split, n)
+            return
+        if kind == "range":
+            yield from self._range_partition(parts, list(node.by),
+                                             list(node.descending) or
+                                             [False] * len(node.by),
+                                             None, n)
+            return
+        raise NotImplementedError(f"exchange kind {kind}")
+
+    def _regroup(self, split: List[List[MicroPartition]], n: int):
+        for i in range(n):
+            subs = [s[i] for s in split]
+            yield subs[0].concat(subs[1:]) if len(subs) > 1 else subs[0]
+
+    def _split(self, parts: List[MicroPartition], n: int):
+        """Split/coalesce to exactly n partitions, preserving order."""
+        total = sum(len(p) for p in parts)
+        target = max((total + n - 1) // max(n, 1), 1)
+        combined = parts[0].concat(parts[1:]) if len(parts) > 1 else parts[0]
+        rb = combined.combined()
+        out = 0
+        start = 0
+        while out < n:
+            end = min(start + target, len(rb)) if out < n - 1 else len(rb)
+            yield MicroPartition.from_recordbatch(rb.slice(start, end))
+            start = end
+            out += 1
+
+    def _range_partition(self, parts: List[MicroPartition],
+                         by: List[Expression], descending: List[bool],
+                         nulls_first: Optional[List[bool]] = None,
+                         n: Optional[int] = None) -> List[MicroPartition]:
+        """Sample → boundaries → partition_by_range → regroup."""
+        n = n or len(parts)
+        nulls_first = nulls_first or list(descending)
+        if n == 1:
+            combined = parts[0].concat(parts[1:]) if len(parts) > 1 else parts[0]
+            return [combined]
+        k = self.cfg.sample_size_for_sort
+        samples = []
+        for p in parts:
+            rb = p.combined()
+            s = rb.sample(size=min(k, len(rb))) if len(rb) else rb
+            samples.append(s.eval_expression_list(by))
+        merged = RecordBatch.concat(samples)
+        merged = merged.filter(~_any_null(by, merged)) if len(merged) else merged
+        if len(merged) == 0:
+            combined = parts[0].concat(parts[1:]) if len(parts) > 1 else parts[0]
+            return [combined] + [MicroPartition.empty(parts[0].schema)
+                                 for _ in range(n - 1)]
+        skeys = [col(e.name()) for e in by]
+        merged_sorted = merged.sort(skeys, descending, nulls_first)
+        idx = [int(len(merged_sorted) * (i + 1) / n)
+               for i in range(n - 1)]
+        idx = [min(i, len(merged_sorted) - 1) for i in idx]
+        boundaries = merged_sorted.take(np.asarray(idx, dtype=np.int64))
+        split = list(_ordered_parallel(
+            iter(parts),
+            lambda p: p.partition_by_range(by, boundaries, descending)))
+        return list(self._regroup(split, n))
+
+    # joins ------------------------------------------------------------
+    def _exec_HashJoin(self, node: pp.HashJoin):
+        how = node.how
+        if node.strategy == "broadcast_right":
+            right = _gather_all(self._exec(node.children[1]))
+            child = self._exec(node.children[0])
+            yield from _ordered_parallel(
+                child, lambda p: p.hash_join(right, node.left_on,
+                                             node.right_on, how))
+            return
+        if node.strategy == "broadcast_left":
+            left = _gather_all(self._exec(node.children[0]))
+            child = self._exec(node.children[1])
+            yield from _ordered_parallel(
+                child, lambda p: left.hash_join(p, node.left_on,
+                                                node.right_on, how))
+            return
+        lparts = list(self._exec(node.children[0]))
+        rparts = list(self._exec(node.children[1]))
+        if len(lparts) != len(rparts):
+            # co-partition by concat-gather fallback
+            lparts = [_gather_all(iter(lparts))]
+            rparts = [_gather_all(iter(rparts))]
+        pairs = list(zip(lparts, rparts))
+        yield from _ordered_parallel(
+            iter(pairs),
+            lambda lr: lr[0].hash_join(lr[1], node.left_on, node.right_on, how))
+
+    def _exec_CrossJoin(self, node: pp.CrossJoin):
+        right = _gather_all(self._exec(node.children[1]))
+        child = self._exec(node.children[0])
+        yield from _ordered_parallel(child, lambda p: p.cross_join(right))
+
+    # writes -----------------------------------------------------------
+    def _exec_Write(self, node: pp.Write):
+        info = node.info
+        if info.get("kind") == "sink":
+            sink = info["sink"]
+            sink.start()
+            results = list(sink.write(self._exec(node.children[0])))
+            yield sink.finalize(results)
+            return
+        from ..io import writers
+        if info.get("mode") == "overwrite":
+            writers.overwrite_dir(info["root_dir"])
+        child = self._exec(node.children[0])
+        outs = list(_ordered_parallel(
+            child, lambda p: writers.write_micropartition(
+                p, info["kind"], info["root_dir"],
+                info.get("partition_cols"), info.get("options"))))
+        outs = [o for o in outs if len(o)]
+        if not outs:
+            yield MicroPartition.empty(node.schema())
+            return
+        yield MicroPartition.from_recordbatch(
+            RecordBatch.concat(outs).cast_to_schema(node.schema()))
+
+
+def _gather_all(parts: Iterator[MicroPartition]) -> MicroPartition:
+    ps = list(parts)
+    return ps[0].concat(ps[1:]) if len(ps) > 1 else ps[0]
+
+
+def _any_null(by: List[Expression], rb: RecordBatch) -> Expression:
+    e = col(by[0].name()).is_null()
+    for b in by[1:]:
+        e = e | col(b.name()).is_null()
+    return e

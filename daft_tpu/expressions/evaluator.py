@@ -79,8 +79,8 @@ def _eval(e: Expression, cols: Dict[str, Series], n: int) -> Series:
 
     # evaluate children
     kids = [_eval(a, cols, n) for a in e.args]
-    # broadcast scalars for elementwise multi-arg ops
-    max_len = max((len(k) for k in kids), default=n)
+    # broadcast scalars to the non-scalar operand length (0-length included)
+    max_len = next((len(k) for k in kids if len(k) != 1), 1)
 
     def b(s: Series) -> Series:
         return s.broadcast(max_len) if len(s) == 1 and max_len != 1 else s
@@ -94,7 +94,8 @@ def _eval(e: Expression, cols: Dict[str, Series], n: int) -> Series:
             return Series.from_arrow(
                 pc.binary_join_element_wise(
                     b(l).to_arrow().cast(pa.large_string()),
-                    b(r).to_arrow().cast(pa.large_string()), ""), l.name())
+                    b(r).to_arrow().cast(pa.large_string()),
+                    pa.scalar("", type=pa.large_string())), l.name())
         if l.datatype().is_temporal() or r.datatype().is_temporal():
             return _temporal_arith(op, b(l), b(r), out_field.dtype)
         return _bin_numeric(op, l, r, out_field.dtype)
@@ -249,6 +250,11 @@ def _eval(e: Expression, cols: Dict[str, Series], n: int) -> Series:
                                  kids[0].name())
     if op == "hash":
         return kids[0].hash(kids[1] if len(kids) > 1 else None)
+    if op == "udf":
+        u, arg_spec, kw_spec = e.params
+        out = u.run(kids, arg_spec, kw_spec, max_len)
+        nm = kids[0].name() if kids else u.name
+        return out.rename(nm)
     if op == "py_apply":
         fn, ret = e.params
         vals = kids[0].to_pylist()

@@ -250,6 +250,19 @@ class Expression:
     def apply(self, func: Callable, return_dtype: DataType) -> "Expression":
         return Expression("py_apply", (self,), (func, return_dtype))
 
+    # -- window ------------------------------------------------------------
+    def over(self, window) -> "Expression":
+        """Attach a window spec (reference: ``Expr::Over``)."""
+        return Expression("window", (self,), (window,))
+
+    def lag(self, offset: int = 1, default=None) -> "Expression":
+        args = (self,) if default is None else (self, Expression._to_expression(default))
+        return Expression("winfn.lag", args, (offset,))
+
+    def lead(self, offset: int = 1, default=None) -> "Expression":
+        args = (self,) if default is None else (self, Expression._to_expression(default))
+        return Expression("winfn.lead", args, (offset,))
+
     def explode(self) -> "Expression":
         return Expression("explode", (self,))
 

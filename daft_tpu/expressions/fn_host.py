@@ -97,7 +97,9 @@ def _str_fn(fn, e, kids, b, out_field) -> Series:
     if fn == "concat":
         other = b(kids[1])
         return Series.from_arrow(
-            pc.binary_join_element_wise(arr, _sa(other), ""), name)
+            pc.binary_join_element_wise(arr, _sa(other),
+                                        pa.scalar("", type=pa.large_string())),
+            name)
     if fn == "length":
         return Series.from_arrow(pc.utf8_length(arr), name).cast(DataType.uint64())
     if fn == "length_bytes":

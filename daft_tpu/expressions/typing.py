@@ -164,6 +164,17 @@ def infer_field(e, schema: Schema) -> Field:
         return Field(name, dt)
     if op == "hash":
         return Field(name, DataType.uint64())
+    if op == "udf":
+        u = e.params[0]
+        nm = child_fields[0].name if child_fields else u.name
+        return Field(nm, u.return_dtype)
+    if op == "window":
+        from ..window_exec import window_field
+        return window_field(e, schema)
+    if op in ("winfn.row_number", "winfn.rank", "winfn.dense_rank"):
+        return Field(op[6:], DataType.uint64())
+    if op in ("winfn.lag", "winfn.lead"):
+        return Field(child_fields[0].name, child_fields[0].dtype)
     if op == "minhash":
         return Field(name, DataType.fixed_size_list(DataType.uint32(), e.params[0]))
     if op == "py_apply":

@@ -1,0 +1,6 @@
+from .read import read_parquet, read_csv, read_json
+from .scan import Pushdowns, ScanOperator, ScanTask
+from .sink import DataSink, WriteResult
+
+__all__ = ["read_parquet", "read_csv", "read_json", "Pushdowns",
+           "ScanOperator", "ScanTask", "DataSink", "WriteResult"]

@@ -1,0 +1,56 @@
+"""Public read_* entry points (reference: ``daft/io/_parquet.py`` etc.)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from ..schema import Schema
+from .scan import GlobScanOperator
+
+
+def _df_from_scan(op):
+    from ..dataframe import DataFrame
+    from ..logical.builder import LogicalPlanBuilder
+    return DataFrame(LogicalPlanBuilder.from_scan(op))
+
+
+def read_parquet(path: Union[str, List[str]],
+                 schema: Optional[Dict[str, Any]] = None,
+                 hive_partitioning: bool = False,
+                 io_config: Any = None,
+                 **kwargs):
+    """Lazily read Parquet file(s) into a DataFrame
+    (reference: ``daft/io/_parquet.py:20``)."""
+    sch = Schema.from_pydict(schema) if isinstance(schema, dict) else schema
+    return _df_from_scan(GlobScanOperator(
+        path, "parquet", schema=sch, hive_partitioning=hive_partitioning))
+
+
+def read_csv(path: Union[str, List[str]],
+             has_headers: bool = True,
+             delimiter: Optional[str] = None,
+             schema: Optional[Dict[str, Any]] = None,
+             quote: Optional[str] = None,
+             escape_char: Optional[str] = None,
+             allow_variable_columns: bool = False,
+             hive_partitioning: bool = False,
+             io_config: Any = None,
+             **kwargs):
+    sch = Schema.from_pydict(schema) if isinstance(schema, dict) else schema
+    opts = {"has_headers": has_headers, "delimiter": delimiter,
+            "quote": quote, "escape_char": escape_char,
+            "allow_variable_columns": allow_variable_columns,
+            "schema": sch}
+    return _df_from_scan(GlobScanOperator(
+        path, "csv", schema=sch, format_options=opts,
+        hive_partitioning=hive_partitioning))
+
+
+def read_json(path: Union[str, List[str]],
+              schema: Optional[Dict[str, Any]] = None,
+              hive_partitioning: bool = False,
+              io_config: Any = None,
+              **kwargs):
+    sch = Schema.from_pydict(schema) if isinstance(schema, dict) else schema
+    return _df_from_scan(GlobScanOperator(
+        path, "json", schema=sch, hive_partitioning=hive_partitioning))

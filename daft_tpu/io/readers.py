@@ -1,0 +1,211 @@
+"""Format readers over Arrow C++ (pyarrow): parquet / csv / json.
+
+Reference capabilities: ``src/daft-parquet`` (bulk reads, row-group pruning
+via statistics ``statistics/``, byte-range coalescing), ``src/daft-csv`` /
+``src/daft-json`` (schema inference, projection/limit pushdown). The pruning
+and projection logic lives here; decode is Arrow C++.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import os
+from typing import Any, Dict, List, Optional
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.json as pajson
+import pyarrow.parquet as pq
+
+from ..datatype import DataType
+from ..expressions import Expression
+from ..recordbatch import RecordBatch
+from ..schema import Field, Schema
+from ..series import Series
+from .scan import Pushdowns, ScanTask
+
+
+def infer_schema(path: str, file_format: str,
+                 options: Dict[str, Any]) -> Schema:
+    if file_format == "parquet":
+        return Schema.from_arrow(pq.read_schema(path))
+    if file_format == "csv":
+        ropts, popts, copts = _csv_options(options)
+        with pacsv.open_csv(path, read_options=ropts, parse_options=popts,
+                            convert_options=copts) as rdr:
+            return Schema.from_arrow(rdr.schema)
+    if file_format == "json":
+        t = pajson.read_json(path)
+        return Schema.from_arrow(t.schema)
+    raise ValueError(f"unknown format {file_format}")
+
+
+def _csv_options(options: Dict[str, Any]):
+    ropts = pacsv.ReadOptions(
+        column_names=options.get("column_names"),
+        autogenerate_column_names=not options.get("has_headers", True)
+        and options.get("column_names") is None)
+    popts = pacsv.ParseOptions(
+        delimiter=options.get("delimiter") or ",",
+        quote_char=options.get("quote") or '"',
+        escape_char=options.get("escape_char") or False,
+        newlines_in_values=options.get("allow_variable_columns", False))
+    copts = pacsv.ConvertOptions()
+    if options.get("schema") is not None:
+        sch: Schema = options["schema"]
+        copts.column_types = {f.name: f.dtype.to_arrow() for f in sch}
+    return ropts, popts, copts
+
+
+def make_scan_tasks(path: str, file_format: str, schema: Schema,
+                    pushdowns: Pushdowns, options: Dict[str, Any],
+                    partition_values: Dict[str, Any]) -> List[ScanTask]:
+    """Per-file scan tasks, with parquet row-group pruning + split."""
+    if file_format == "parquet":
+        try:
+            md = pq.ParquetFile(path).metadata
+        except Exception:
+            md = None
+        if md is not None:
+            groups = _prune_row_groups(md, pushdowns.filters, schema)
+            nrows = sum(md.row_group(g).num_rows for g in groups) \
+                if groups is not None else md.num_rows
+            size = sum(md.row_group(g).total_byte_size for g in groups) \
+                if groups is not None else \
+                sum(md.row_group(i).total_byte_size for i in range(md.num_row_groups))
+            return [ScanTask([path], "parquet", schema, pushdowns, nrows, size,
+                             [groups] if groups is not None else None,
+                             options, partition_values)]
+    size = os.path.getsize(path) if os.path.exists(path) else None
+    return [ScanTask([path], file_format, schema, pushdowns, None, size, None,
+                     options, partition_values)]
+
+
+def _prune_row_groups(md, filters: Optional[Expression],
+                      schema: Schema) -> Optional[List[int]]:
+    """Zone-map pruning: drop row groups whose min/max can't satisfy the
+    filter (reference: ``daft-parquet/src/statistics``). Conservative — only
+    simple ``col <op> literal`` conjuncts are used."""
+    if filters is None:
+        return None
+    bounds = _extract_bounds(filters)
+    if not bounds:
+        return None
+    keep = []
+    name_to_idx = None
+    for g in range(md.num_row_groups):
+        rg = md.row_group(g)
+        if name_to_idx is None:
+            name_to_idx = {rg.column(i).path_in_schema: i
+                           for i in range(rg.num_columns)}
+        ok = True
+        for (cname, op, lit) in bounds:
+            ci = name_to_idx.get(cname)
+            if ci is None:
+                continue
+            stats = rg.column(ci).statistics
+            if stats is None or not stats.has_min_max:
+                continue
+            mn, mx = stats.min, stats.max
+            try:
+                if op == "lt" and not (mn < lit):
+                    ok = False
+                elif op == "le" and not (mn <= lit):
+                    ok = False
+                elif op == "gt" and not (mx > lit):
+                    ok = False
+                elif op == "ge" and not (mx >= lit):
+                    ok = False
+                elif op == "eq" and not (mn <= lit <= mx):
+                    ok = False
+            except TypeError:
+                continue
+            if not ok:
+                break
+        if ok:
+            keep.append(g)
+    return keep
+
+
+def _extract_bounds(e: Expression):
+    """Top-level AND conjuncts of form col <cmp> lit."""
+    out = []
+
+    def walk(x: Expression):
+        if x.op == "and":
+            walk(x.args[0])
+            walk(x.args[1])
+            return
+        if x.op in ("lt", "le", "gt", "ge", "eq"):
+            l, r = x.args
+            flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+            if l.op == "lit" and r._unalias().op == "col":
+                l, r = r, l
+                op = flip[x.op]
+            else:
+                op = x.op
+            li = l._unalias()
+            if li.op == "col" and r.op == "lit":
+                v = r.params[0]
+                import datetime
+                if isinstance(v, (datetime.date, datetime.datetime)):
+                    # parquet stats for date32 come back as datetime.date
+                    out.append((li.params[0], op, v))
+                elif isinstance(v, (int, float, str, bytes)):
+                    out.append((li.params[0], op, v))
+    walk(e)
+    return out
+
+
+def read_scan_task(task: ScanTask) -> List[RecordBatch]:
+    batches: List[RecordBatch] = []
+    cols = list(task.pushdowns.columns) if task.pushdowns.columns is not None \
+        else None
+    phys_cols = None
+    if cols is not None:
+        phys_cols = [c for c in cols if c not in task.partition_values]
+    for i, path in enumerate(task.paths):
+        if task.file_format == "parquet":
+            f = pq.ParquetFile(path)
+            rg = task.row_groups[i] if task.row_groups else None
+            file_cols = None
+            if phys_cols is not None:
+                names = set(f.schema_arrow.names)
+                file_cols = [c for c in phys_cols if c in names]
+            if rg is None:
+                t = f.read(columns=file_cols)
+            else:
+                t = f.read_row_groups(rg, columns=file_cols) if rg else \
+                    f.schema_arrow.empty_table()
+        elif task.file_format == "csv":
+            ropts, popts, copts = _csv_options(task.format_options)
+            if phys_cols is not None:
+                copts.include_columns = phys_cols
+                copts.include_missing_columns = True
+            t = pacsv.read_csv(path, read_options=ropts, parse_options=popts,
+                               convert_options=copts)
+        elif task.file_format == "json":
+            t = pajson.read_json(path)
+            if phys_cols is not None:
+                keep = [c for c in phys_cols if c in t.column_names]
+                t = t.select(keep)
+        else:
+            raise ValueError(f"unknown format {task.file_format}")
+        rb = RecordBatch.from_arrow_table(t)
+        if task.partition_values:
+            n = len(rb)
+            extra = []
+            for k, v in task.partition_values.items():
+                if cols is not None and k not in cols:
+                    continue
+                if k in rb.schema:
+                    continue
+                dt = task.schema[k].dtype if k in task.schema else None
+                s = Series.from_pylist([v] * n, k)
+                if dt is not None:
+                    s = s.cast(dt)
+                extra.append(s)
+            if extra:
+                rb = RecordBatch.from_series(rb.columns() + extra)
+        batches.append(rb)
+    return batches

@@ -1,0 +1,280 @@
+"""Scan planning: Pushdowns, ScanTask, ScanOperator, glob scans.
+
+Reference: ``src/common/scan-info/src/scan_operator.rs:12-37`` (ScanOperator
+trait + Pushdowns), ``src/daft-scan/src/lib.rs:417-436`` (ScanTask fields),
+``src/daft-scan/src/glob.rs:28`` (GlobScanOperator with schema inference from
+the first file), ``src/daft-scan/src/scan_task_iters/`` (merge-by-size 96–384MB
+and split-by-rowgroup).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+import pyarrow.dataset as pads
+import pyarrow.parquet as pq
+
+from ..datatype import DataType
+from ..expressions import Expression, col
+from ..recordbatch import RecordBatch
+from ..schema import Field, Schema
+
+
+@dataclasses.dataclass(frozen=True)
+class Pushdowns:
+    """Pushed-down scan constraints (reference: ``pushdowns.rs``)."""
+
+    filters: Optional[Expression] = None
+    partition_filters: Optional[Expression] = None
+    columns: Optional[Tuple[str, ...]] = None
+    limit: Optional[int] = None
+
+    def with_columns(self, columns: Optional[Sequence[str]]) -> "Pushdowns":
+        return dataclasses.replace(
+            self, columns=tuple(columns) if columns is not None else None)
+
+    def with_limit(self, limit: Optional[int]) -> "Pushdowns":
+        return dataclasses.replace(self, limit=limit)
+
+    def with_filters(self, filters: Optional[Expression]) -> "Pushdowns":
+        return dataclasses.replace(self, filters=filters)
+
+
+class ScanTask:
+    """One unit of scan work: file(s) + format + pushdowns.
+
+    ``execute()`` → list[RecordBatch]; runs on the executor's IO pool.
+    """
+
+    def __init__(self, paths: List[str], file_format: str, schema: Schema,
+                 pushdowns: Pushdowns = Pushdowns(),
+                 num_rows_hint: Optional[int] = None,
+                 size_bytes_hint: Optional[int] = None,
+                 row_groups: Optional[List[Optional[List[int]]]] = None,
+                 format_options: Optional[Dict[str, Any]] = None,
+                 partition_values: Optional[Dict[str, Any]] = None,
+                 generator: Optional[Callable[[], Iterator[RecordBatch]]] = None):
+        self.paths = paths
+        self.file_format = file_format
+        self.schema = schema
+        self.pushdowns = pushdowns
+        self._num_rows = num_rows_hint
+        self._size_bytes = size_bytes_hint
+        self.row_groups = row_groups
+        self.format_options = format_options or {}
+        self.partition_values = partition_values or {}
+        self.generator = generator
+
+    def materialized_schema(self) -> Schema:
+        if self.pushdowns.columns is not None:
+            keep = [n for n in self.pushdowns.columns if n in self.schema]
+            return self.schema.project(keep)
+        return self.schema
+
+    def num_rows(self) -> Optional[int]:
+        if self.pushdowns.filters is not None:
+            return None
+        if self._num_rows is not None and self.pushdowns.limit is not None:
+            return min(self._num_rows, self.pushdowns.limit)
+        return self._num_rows
+
+    def size_bytes(self) -> Optional[int]:
+        return self._size_bytes
+
+    def execute(self) -> List[RecordBatch]:
+        from . import readers
+        if self.generator is not None:
+            batches = list(self.generator())
+        else:
+            batches = readers.read_scan_task(self)
+        # apply residual pushdowns (reader may have applied some already)
+        out = []
+        remaining = self.pushdowns.limit
+        for b in batches:
+            if self.pushdowns.filters is not None:
+                b = b.filter(self.pushdowns.filters)
+            if remaining is not None:
+                if remaining <= 0:
+                    break
+                b = b.head(remaining)
+                remaining -= len(b)
+            if len(b):
+                out.append(b)
+        if not out:
+            return [RecordBatch.empty(self.materialized_schema())]
+        return out
+
+    def __repr__(self):
+        return (f"ScanTask({self.file_format}, {len(self.paths)} files, "
+                f"rows={self._num_rows}, pushdowns={self.pushdowns})")
+
+
+class ScanOperator:
+    """Produces ScanTasks for a source (reference trait: scan_operator.rs:12-37)."""
+
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def partitioning_keys(self) -> List[str]:
+        return []
+
+    def can_absorb_filter(self) -> bool:
+        return False
+
+    def can_absorb_limit(self) -> bool:
+        return True
+
+    def can_absorb_select(self) -> bool:
+        return True
+
+    def multiline_display(self) -> List[str]:
+        return [type(self).__name__]
+
+    def to_scan_tasks(self, pushdowns: Pushdowns) -> List[ScanTask]:
+        raise NotImplementedError
+
+
+def glob_paths(path_or_paths) -> List[str]:
+    """Local + file:// glob expansion (fanout-style, reference
+    ``object_store_glob.rs``). Directories expand to their files."""
+    paths = [path_or_paths] if isinstance(path_or_paths, str) else list(path_or_paths)
+    out: List[str] = []
+    for p in paths:
+        if p.startswith("file://"):
+            p = p[7:]
+        if any(ch in p for ch in "*?[]"):
+            matches = sorted(_glob.glob(p, recursive=True))
+            out.extend(m for m in matches if os.path.isfile(m))
+        elif os.path.isdir(p):
+            for root, _, files in sorted(os.walk(p)):
+                for f in sorted(files):
+                    if not f.startswith((".", "_")):
+                        out.append(os.path.join(root, f))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files found for {path_or_paths!r}")
+    return out
+
+
+class GlobScanOperator(ScanOperator):
+    """Scan over globbed files with schema inference from the first file
+    (reference: ``glob.rs:28``) plus hive partition-value inference
+    (``hive.rs``)."""
+
+    def __init__(self, paths, file_format: str,
+                 schema: Optional[Schema] = None,
+                 format_options: Optional[Dict[str, Any]] = None,
+                 hive_partitioning: bool = False):
+        from . import readers
+        self._paths = glob_paths(paths)
+        self._format = file_format
+        self._options = format_options or {}
+        self._hive = hive_partitioning
+        self._hive_fields: Dict[str, DataType] = {}
+        if schema is None:
+            schema = readers.infer_schema(self._paths[0], file_format,
+                                          self._options)
+        if hive_partitioning:
+            parts = _hive_values(self._paths[0])
+            for k, v in parts.items():
+                self._hive_fields[k] = DataType.infer_from_pylist([v])
+            schema = schema.non_distinct_union(
+                Schema([Field(k, t) for k, t in self._hive_fields.items()]))
+        self._schema = schema
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def partitioning_keys(self) -> List[str]:
+        return list(self._hive_fields)
+
+    def multiline_display(self) -> List[str]:
+        return [f"GlobScanOperator({self._format})",
+                f"paths = {self._paths[:3]}{'…' if len(self._paths) > 3 else ''}"]
+
+    def to_scan_tasks(self, pushdowns: Pushdowns) -> List[ScanTask]:
+        from . import readers
+        from ..context import get_context
+        cfg = get_context().execution_config
+        tasks: List[ScanTask] = []
+        for p in self._paths:
+            pv = _hive_values(p) if self._hive else {}
+            tasks.extend(readers.make_scan_tasks(
+                p, self._format, self._schema, pushdowns, self._options, pv))
+        return merge_scan_tasks(tasks, cfg.scan_tasks_min_size_bytes,
+                                cfg.scan_tasks_max_size_bytes,
+                                cfg.max_sources_per_scan_task)
+
+
+def _hive_values(path: str) -> Dict[str, Any]:
+    out = {}
+    for part in path.split(os.sep):
+        if "=" in part and not part.startswith("."):
+            k, _, v = part.partition("=")
+            if k and v and "." not in v:
+                out[k] = v
+    return out
+
+
+def merge_scan_tasks(tasks: List[ScanTask], min_size: int, max_size: int,
+                     max_sources: int) -> List[ScanTask]:
+    """Merge small adjacent tasks into 96–384MB targets
+    (reference: ``scan_task_iters``' merge-by-size)."""
+    out: List[ScanTask] = []
+    acc: Optional[ScanTask] = None
+    acc_size = 0
+    for t in tasks:
+        sz = t.size_bytes() or max_size  # unknown size → don't merge
+        limited = t.pushdowns.limit is not None
+        if (acc is not None and not limited
+                and acc_size + sz <= max_size
+                and len(acc.paths) + len(t.paths) <= max_sources
+                and acc.file_format == t.file_format
+                and acc.row_groups is None and t.row_groups is None
+                and acc.partition_values == t.partition_values):
+            acc = ScanTask(acc.paths + t.paths, acc.file_format, acc.schema,
+                           acc.pushdowns,
+                           None if (acc._num_rows is None or t._num_rows is None)
+                           else acc._num_rows + t._num_rows,
+                           acc_size + sz, None, acc.format_options,
+                           acc.partition_values)
+            acc_size += sz
+            if acc_size >= min_size:
+                out.append(acc)
+                acc, acc_size = None, 0
+            continue
+        if acc is not None:
+            out.append(acc)
+            acc, acc_size = None, 0
+        if sz >= min_size or limited:
+            out.append(t)
+        else:
+            acc, acc_size = t, sz
+    if acc is not None:
+        out.append(acc)
+    return out
+
+
+class InMemoryScanOperator(ScanOperator):
+    """Scan over already-materialized partitions (cache entries)."""
+
+    def __init__(self, schema: Schema, partitions):
+        self._schema = schema
+        self._parts = partitions
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def to_scan_tasks(self, pushdowns: Pushdowns) -> List[ScanTask]:
+        tasks = []
+        for p in self._parts:
+            def gen(p=p):
+                return iter(p.batches())
+            tasks.append(ScanTask([], "memory", self._schema, pushdowns,
+                                  p.metadata_num_rows(), None, generator=gen))
+        return tasks

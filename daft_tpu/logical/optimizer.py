@@ -1,0 +1,377 @@
+"""Rule-based logical optimizer.
+
+Reference: ``src/daft-logical-plan/src/optimization/optimizer.rs:40-215`` —
+rule batches with Once/FixedPoint strategies; rules modeled on the reference's
+set (PushDownFilter, PushDownProjection, PushDownLimit, DropRepartition,
+SimplifyExpressions, DetectMonotonicId …). Join reordering is planned for a
+later round (reference: ``reorder_joins/``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..expressions import Expression, col, lit
+from . import plan as lp
+
+
+class Rule:
+    name = "rule"
+
+    def apply(self, plan: lp.LogicalPlan) -> lp.LogicalPlan:
+        raise NotImplementedError
+
+
+class Batch:
+    def __init__(self, name: str, rules: List[Rule], strategy: str = "once",
+                 max_passes: int = 5):
+        self.name = name
+        self.rules = rules
+        self.strategy = strategy
+        self.max_passes = max_passes
+
+
+class Optimizer:
+    def __init__(self, batches: Optional[List[Batch]] = None):
+        self.batches = batches or [
+            Batch("simplify", [SimplifyExpressions()], "fixed_point"),
+            Batch("pushdowns", [PushDownFilter(), PushDownProjection(),
+                                PushDownLimit(), DropRepartition()],
+                  "fixed_point"),
+            Batch("materialize", [MaterializeScans()], "once"),
+        ]
+
+    def optimize(self, plan: lp.LogicalPlan) -> lp.LogicalPlan:
+        for batch in self.batches:
+            passes = 1 if batch.strategy == "once" else batch.max_passes
+            prev_key = None
+            for _ in range(passes):
+                for rule in batch.rules:
+                    plan = rule.apply(plan)
+                key = plan.semantic_id()
+                if key == prev_key:  # fixed point reached (cycle guard)
+                    break
+                prev_key = key
+        return plan
+
+
+# ---------------------------------------------------------------------------
+# expression helpers
+
+def substitute_columns(e: Expression, mapping: Dict[str, Expression]
+                       ) -> Expression:
+    if e.op == "col" and e.params[0] in mapping:
+        sub = mapping[e.params[0]]
+        return sub
+    if not e.args:
+        return e
+    return e.with_children([substitute_columns(c, mapping) for c in e.args])
+
+
+def split_conjuncts(e: Expression) -> List[Expression]:
+    if e.op == "and":
+        return split_conjuncts(e.args[0]) + split_conjuncts(e.args[1])
+    return [e]
+
+
+def combine_conjuncts(es: List[Expression]) -> Expression:
+    out = es[0]
+    for e in es[1:]:
+        out = out & e
+    return out
+
+
+def _has_effectful(e: Expression) -> bool:
+    """UDFs and explode change cardinality/cost — don't push filters through."""
+    if e.op in ("py_apply", "explode", "udf"):
+        return True
+    return any(_has_effectful(c) for c in e.args)
+
+
+# ---------------------------------------------------------------------------
+# rules
+
+class SimplifyExpressions(Rule):
+    """Basic algebraic simplification (reference: daft-algebra simplify_expr)."""
+
+    name = "simplify_expressions"
+
+    def apply(self, plan):
+        def fn(node):
+            if isinstance(node, lp.Filter):
+                return lp.Filter(node.children[0], simplify(node.predicate))
+            if isinstance(node, lp.Project):
+                return lp.Project(node.children[0],
+                                  [simplify(e) for e in node.exprs])
+            return node
+        return plan.transform_up(fn)
+
+
+def simplify(e: Expression) -> Expression:
+    if e.args:
+        e = e.with_children([simplify(c) for c in e.args])
+    # not(not(x)) -> x
+    if e.op == "not" and e.args[0].op == "not":
+        return e.args[0].args[0]
+    # x == True -> x ; x == False -> not x
+    if e.op in ("eq", "neq"):
+        l, r = e.args
+        for a, b in ((l, r), (r, l)):
+            if b.op == "lit" and isinstance(b.params[0], bool):
+                truthy = b.params[0] if e.op == "eq" else not b.params[0]
+                return a if truthy else Expression("not", (a,))
+    # True & x -> x ; False | x -> x
+    if e.op == "and":
+        l, r = e.args
+        for a, b in ((l, r), (r, l)):
+            if a.op == "lit" and a.params[0] is True:
+                return b
+    if e.op == "or":
+        l, r = e.args
+        for a, b in ((l, r), (r, l)):
+            if a.op == "lit" and a.params[0] is False:
+                return b
+    return e
+
+
+class PushDownFilter(Rule):
+    name = "push_down_filter"
+
+    def apply(self, plan):
+        def fn(node):
+            if not isinstance(node, lp.Filter):
+                return node
+            child = node.children[0]
+            pred = node.predicate
+            # merge adjacent filters
+            if isinstance(child, lp.Filter):
+                return lp.Filter(child.children[0], child.predicate & pred)
+            # through project (substituting expressions), if deterministic
+            if isinstance(child, lp.Project):
+                mapping = {}
+                ok = True
+                for e in child.exprs:
+                    inner = e._unalias()
+                    if _has_effectful(inner):
+                        if e.name() in pred.column_names():
+                            ok = False
+                            break
+                    mapping[e.name()] = inner
+                if ok:
+                    new_pred = substitute_columns(pred, mapping)
+                    return lp.Project(
+                        lp.Filter(child.children[0], new_pred), child.exprs)
+            # through ops that don't change rows' values
+            if isinstance(child, (lp.Sort, lp.Repartition, lp.Concat)):
+                pushed = [lp.Filter(c, pred) for c in child.children]
+                return child.with_children(pushed)
+            # into join sides
+            if isinstance(child, lp.Join) and child.how in ("inner", "left",
+                                                            "right", "semi",
+                                                            "anti"):
+                l_names = set(child.children[0].schema().column_names)
+                r_names = set(child.schema().column_names) - l_names
+                keep, to_l, to_r = [], [], []
+                for c in split_conjuncts(pred):
+                    cols_used = set(c.column_names())
+                    if cols_used <= l_names and child.how in ("inner", "left",
+                                                              "semi", "anti"):
+                        to_l.append(c)
+                    elif cols_used <= r_names and child.how in ("inner", "right"):
+                        # map prefixed names back to right child columns
+                        rc_names = set(child.children[1].schema().column_names)
+                        mapping = {}
+                        for nm in cols_used:
+                            base = nm[6:] if nm.startswith("right.") else nm
+                            if base in rc_names:
+                                mapping[nm] = col(base)
+                        to_r.append(substitute_columns(c, mapping))
+                    else:
+                        keep.append(c)
+                if to_l or to_r:
+                    newl = child.children[0]
+                    newr = child.children[1]
+                    if to_l:
+                        newl = lp.Filter(newl, combine_conjuncts(to_l))
+                    if to_r:
+                        newr = lp.Filter(newr, combine_conjuncts(to_r))
+                    new_join = child.with_children([newl, newr])
+                    return lp.Filter(new_join, combine_conjuncts(keep)) \
+                        if keep else new_join
+            # into the scan's pushdowns
+            if isinstance(child, lp.Source) and child.scan_op is not None:
+                pd = child.pushdowns
+                new_f = pred if pd.filters is None else (pd.filters & pred)
+                return child.with_pushdowns(pd.with_filters(new_f))
+            return node
+        return plan.transform_up(fn)
+
+
+class PushDownProjection(Rule):
+    """Column pruning: push required-column sets into scans and collapse
+    redundant projections."""
+
+    name = "push_down_projection"
+
+    def apply(self, plan):
+        return self._prune(plan, None)
+
+    def _prune(self, node: lp.LogicalPlan,
+               required: Optional[Set[str]]) -> lp.LogicalPlan:
+        # `required is None` → all columns needed
+        if isinstance(node, lp.Source):
+            if (required is not None and node.scan_op is not None
+                    and node.pushdowns.columns is None):
+                avail = node._source_schema.column_names
+                filt_cols = set()
+                if node.pushdowns.filters is not None:
+                    filt_cols = set(node.pushdowns.filters.column_names())
+                needed = [c for c in avail if c in (required | filt_cols)]
+                if len(needed) < len(avail):
+                    return node.with_pushdowns(
+                        node.pushdowns.with_columns(needed))
+            return node
+        if isinstance(node, (lp.Project, lp.UDFProject)):
+            child = node.children[0]
+            exprs = node.exprs
+            if required is not None:
+                exprs = [e for e in exprs if e.name() in required] or exprs[:1]
+            child_req = set()
+            for e in exprs:
+                child_req.update(e.column_names())
+            # collapse project(project) when outer is pure column selection
+            new_child = self._prune(child, child_req)
+            if (isinstance(node, lp.Project)
+                    and isinstance(new_child, lp.Project)
+                    and all(e._unalias().op == "col" for e in exprs)):
+                inner_map = {ie.name(): ie for ie in new_child.exprs}
+                merged = []
+                ok = True
+                for e in exprs:
+                    src = e._unalias().params[0]
+                    if src not in inner_map:
+                        ok = False
+                        break
+                    ie = inner_map[src]
+                    merged.append(ie if e.name() == ie.name()
+                                  else ie._unalias().alias(e.name()))
+                if ok:
+                    return lp.Project(new_child.children[0], merged)
+            cls = lp.Project if isinstance(node, lp.Project) else lp.UDFProject
+            if isinstance(node, lp.UDFProject):
+                return lp.UDFProject(new_child, list(exprs), node.concurrency)
+            return lp.Project(new_child, list(exprs))
+        if isinstance(node, lp.Filter):
+            child_req = None if required is None else \
+                required | set(node.predicate.column_names())
+            return lp.Filter(self._prune(node.children[0], child_req),
+                             node.predicate)
+        if isinstance(node, lp.Aggregate):
+            child_req = set()
+            for e in node.aggs + node.group_by:
+                child_req.update(e.column_names())
+            return lp.Aggregate(self._prune(node.children[0], child_req),
+                                node.aggs, node.group_by)
+        if isinstance(node, lp.Join):
+            l_names = set(node.children[0].schema().column_names)
+            if required is None:
+                l_req = r_req = None
+            else:
+                out_l = set()
+                out_r = set()
+                for nm in required:
+                    if nm in l_names:
+                        out_l.add(nm)
+                    else:
+                        base = nm[6:] if nm.startswith("right.") else nm
+                        out_r.add(base)
+                for e in node.left_on:
+                    out_l.update(e.column_names())
+                for e in node.right_on:
+                    out_r.update(e.column_names())
+                l_req, r_req = out_l, out_r
+            return node.with_children([
+                self._prune(node.children[0], l_req),
+                self._prune(node.children[1], r_req)])
+        if isinstance(node, lp.Sort):
+            child_req = None if required is None else \
+                required | {c for e in node.sort_by for c in e.column_names()}
+            return node.with_children(
+                [self._prune(node.children[0], child_req)])
+        if isinstance(node, lp.TopN):
+            child_req = None if required is None else \
+                required | {c for e in node.sort_by for c in e.column_names()}
+            return node.with_children(
+                [self._prune(node.children[0], child_req)])
+        if isinstance(node, lp.Repartition):
+            child_req = None if required is None else \
+                required | {c for e in node.spec.by for c in e.column_names()}
+            return node.with_children(
+                [self._prune(node.children[0], child_req)])
+        # other nodes: require everything below
+        return node.with_children(
+            [self._prune(c, None) for c in node.children])
+
+
+class PushDownLimit(Rule):
+    name = "push_down_limit"
+
+    def apply(self, plan):
+        def fn(node):
+            if not isinstance(node, lp.Limit) or node.offset:
+                return node
+            child = node.children[0]
+            if isinstance(child, lp.Limit):
+                return lp.Limit(child.children[0],
+                                min(node.limit, child.limit))
+            if isinstance(child, (lp.Project,)):
+                return child.with_children(
+                    [lp.Limit(child.children[0], node.limit)])
+            if isinstance(child, lp.Sort):
+                return lp.TopN(child.children[0], child.sort_by,
+                               child.descending, child.nulls_first, node.limit)
+            if isinstance(child, lp.Source) and child.scan_op is not None \
+                    and child.pushdowns.filters is None:
+                pd = child.pushdowns
+                new_l = node.limit if pd.limit is None \
+                    else min(pd.limit, node.limit)
+                return lp.Limit(child.with_pushdowns(pd.with_limit(new_l)),
+                                node.limit)
+            return node
+        return plan.transform_up(fn)
+
+
+class DropRepartition(Rule):
+    name = "drop_repartition"
+
+    def apply(self, plan):
+        def fn(node):
+            if isinstance(node, lp.Repartition):
+                child = node.children[0]
+                # repartition(repartition(x)) -> repartition(x)
+                if isinstance(child, lp.Repartition):
+                    return lp.Repartition(child.children[0], node.spec)
+                # same clustering already → no-op
+                cs = child.clustering_spec()
+                if (node.spec.kind == "hash" and cs.kind == "hash"
+                        and cs.num_partitions == node.spec.num_partitions
+                        and [e._key() for e in cs.by]
+                        == [e._key() for e in node.spec.by]):
+                    return child
+            return node
+        return plan.transform_up(fn)
+
+
+class MaterializeScans(Rule):
+    """Turn glob-scan sources into concrete scan-task lists
+    (reference: MaterializeScans + EnrichWithStats)."""
+
+    name = "materialize_scans"
+
+    def apply(self, plan):
+        def fn(node):
+            if isinstance(node, lp.Source) and node.scan_op is not None:
+                tasks = node.scan_op.to_scan_tasks(node.pushdowns)
+                node.materialized_tasks = tasks
+            return node
+        return plan.transform_up(fn)

@@ -1,0 +1,188 @@
+"""Physical plan nodes (reference: ``src/daft-local-plan/src/plan.rs:20`` —
+~30 variants — plus the distributed exchange ops of
+``src/daft-physical-plan/src/plan.rs:18-52``)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..expressions import Expression
+from ..schema import Schema
+
+
+class PhysicalPlan:
+    def __init__(self, children: List["PhysicalPlan"], schema: Schema):
+        self.children = children
+        self._schema = schema
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class ScanSource(PhysicalPlan):
+    def __init__(self, tasks: List[Any], schema: Schema):
+        super().__init__([], schema)
+        self.tasks = tasks
+
+
+class InMemorySource(PhysicalPlan):
+    def __init__(self, partitions: List[Any], schema: Schema):
+        super().__init__([], schema)
+        self.partitions = partitions
+
+
+class Project(PhysicalPlan):
+    def __init__(self, child, exprs: List[Expression], schema: Schema):
+        super().__init__([child], schema)
+        self.exprs = exprs
+
+
+class UDFProject(PhysicalPlan):
+    def __init__(self, child, exprs: List[Expression], schema: Schema,
+                 concurrency: Optional[int]):
+        super().__init__([child], schema)
+        self.exprs = exprs
+        self.concurrency = concurrency
+
+
+class Filter(PhysicalPlan):
+    def __init__(self, child, predicate: Expression):
+        super().__init__([child], child.schema())
+        self.predicate = predicate
+
+
+class Limit(PhysicalPlan):
+    def __init__(self, child, limit: int, offset: int = 0):
+        super().__init__([child], child.schema())
+        self.limit = limit
+        self.offset = offset
+
+
+class Explode(PhysicalPlan):
+    def __init__(self, child, exprs, schema):
+        super().__init__([child], schema)
+        self.exprs = exprs
+
+
+class Unpivot(PhysicalPlan):
+    def __init__(self, child, ids, values, variable_name, value_name, schema):
+        super().__init__([child], schema)
+        self.ids = ids
+        self.values = values
+        self.variable_name = variable_name
+        self.value_name = value_name
+
+
+class Sample(PhysicalPlan):
+    def __init__(self, child, fraction, size, with_replacement, seed):
+        super().__init__([child], child.schema())
+        self.fraction = fraction
+        self.size = size
+        self.with_replacement = with_replacement
+        self.seed = seed
+
+
+class MonotonicallyIncreasingId(PhysicalPlan):
+    def __init__(self, child, column_name, schema):
+        super().__init__([child], schema)
+        self.column_name = column_name
+
+
+class Aggregate(PhysicalPlan):
+    """One aggregation stage. mode: single | partial | final."""
+
+    def __init__(self, child, aggs, group_by, schema, mode: str = "single"):
+        super().__init__([child], schema)
+        self.aggs = aggs
+        self.group_by = group_by
+        self.mode = mode
+
+
+class Dedup(PhysicalPlan):
+    def __init__(self, child, on):
+        super().__init__([child], child.schema())
+        self.on = on
+
+
+class Pivot(PhysicalPlan):
+    def __init__(self, child, group_by, pivot_col, value_col, names, schema):
+        super().__init__([child], schema)
+        self.group_by = group_by
+        self.pivot_col = pivot_col
+        self.value_col = value_col
+        self.names = names
+
+
+class Window(PhysicalPlan):
+    def __init__(self, child, window_exprs, partition_by, order_by,
+                 descending, nulls_first, frame, schema):
+        super().__init__([child], schema)
+        self.window_exprs = window_exprs
+        self.partition_by = partition_by
+        self.order_by = order_by
+        self.descending = descending
+        self.nulls_first = nulls_first
+        self.frame = frame
+
+
+class Sort(PhysicalPlan):
+    def __init__(self, child, sort_by, descending, nulls_first):
+        super().__init__([child], child.schema())
+        self.sort_by = sort_by
+        self.descending = descending
+        self.nulls_first = nulls_first
+
+
+class TopN(PhysicalPlan):
+    def __init__(self, child, sort_by, descending, nulls_first, limit):
+        super().__init__([child], child.schema())
+        self.sort_by = sort_by
+        self.descending = descending
+        self.nulls_first = nulls_first
+        self.limit = limit
+
+
+class Exchange(PhysicalPlan):
+    """Repartition boundary — the TPU analogue of ShuffleExchange
+    (``ops/shuffle_exchange.rs:41-58``); strategy chosen by the runner:
+    in-process for the local runner, ICI all_to_all / host gRPC for the
+    distributed runner."""
+
+    def __init__(self, child, kind: str, num_partitions: int,
+                 by: Tuple[Expression, ...] = (),
+                 descending: Tuple[bool, ...] = ()):
+        super().__init__([child], child.schema())
+        self.kind = kind          # hash | random | range | split | gather
+        self.num_partitions = num_partitions
+        self.by = by
+        self.descending = descending
+
+
+class Concat(PhysicalPlan):
+    def __init__(self, left, right):
+        super().__init__([left, right], left.schema())
+
+
+class HashJoin(PhysicalPlan):
+    def __init__(self, left, right, left_on, right_on, how, schema,
+                 strategy: str = "hash"):
+        super().__init__([left, right], schema)
+        self.left_on = left_on
+        self.right_on = right_on
+        self.how = how
+        self.strategy = strategy  # hash | broadcast_right | broadcast_left
+
+
+class CrossJoin(PhysicalPlan):
+    def __init__(self, left, right, schema):
+        super().__init__([left, right], schema)
+
+
+class Write(PhysicalPlan):
+    def __init__(self, child, info: Dict, schema: Schema):
+        super().__init__([child], schema)
+        self.info = info

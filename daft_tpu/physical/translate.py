@@ -1,0 +1,280 @@
+"""Logical → physical translation.
+
+Reference: ``src/daft-local-plan/src/translate.rs:19-434`` (direct lowering,
+Aggregate → partial/final split) and
+``src/daft-physical-plan/src/physical_planner/translate.rs:639,914``
+(``populate_aggregation_stages``, shuffle insertion, broadcast-join decision
+by size threshold).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..context import get_context
+from ..datatype import DataType
+from ..expressions import Expression, col, lit
+from ..logical import plan as lp
+from ..schema import Schema
+from . import plan as pp
+
+# aggs that cannot be split into partial/final stages → single-stage agg
+_NON_DECOMPOSABLE = {"count_distinct", "approx_count_distinct",
+                     "approx_percentiles", "skew", "set"}
+
+
+def translate(plan: lp.LogicalPlan) -> pp.PhysicalPlan:
+    cfg = get_context().execution_config
+    return _t(plan, cfg)
+
+
+def _t(node: lp.LogicalPlan, cfg) -> pp.PhysicalPlan:
+    if isinstance(node, lp.Source):
+        if node.partitions is not None:
+            return pp.InMemorySource(node.partitions, node.schema())
+        tasks = getattr(node, "materialized_tasks", None)
+        if tasks is None:
+            tasks = node.scan_op.to_scan_tasks(node.pushdowns)
+        return pp.ScanSource(tasks, node.schema())
+    if isinstance(node, lp.Project):
+        return pp.Project(_t(node.children[0], cfg), node.exprs, node.schema())
+    if isinstance(node, lp.UDFProject):
+        return pp.UDFProject(_t(node.children[0], cfg), node.exprs,
+                             node.schema(), node.concurrency)
+    if isinstance(node, lp.Filter):
+        return pp.Filter(_t(node.children[0], cfg), node.predicate)
+    if isinstance(node, lp.Limit):
+        return pp.Limit(_t(node.children[0], cfg), node.limit, node.offset)
+    if isinstance(node, lp.Explode):
+        return pp.Explode(_t(node.children[0], cfg), node.exprs, node.schema())
+    if isinstance(node, lp.Unpivot):
+        return pp.Unpivot(_t(node.children[0], cfg), node.ids, node.values,
+                          node.variable_name, node.value_name, node.schema())
+    if isinstance(node, lp.Sample):
+        return pp.Sample(_t(node.children[0], cfg), node.fraction, node.size,
+                         node.with_replacement, node.seed)
+    if isinstance(node, lp.MonotonicallyIncreasingId):
+        return pp.MonotonicallyIncreasingId(_t(node.children[0], cfg),
+                                            node.column_name, node.schema())
+    if isinstance(node, lp.Sort):
+        return pp.Sort(_t(node.children[0], cfg), node.sort_by,
+                       node.descending, node.nulls_first)
+    if isinstance(node, lp.TopN):
+        return pp.TopN(_t(node.children[0], cfg), node.sort_by,
+                       node.descending, node.nulls_first, node.limit)
+    if isinstance(node, lp.Repartition):
+        child = _t(node.children[0], cfg)
+        spec = node.spec
+        kind = {"hash": "hash", "random": "random", "range": "range",
+                "unknown": "split"}[spec.kind]
+        return pp.Exchange(child, kind, spec.num_partitions, spec.by,
+                           spec.descending)
+    if isinstance(node, lp.Distinct):
+        child = _t(node.children[0], cfg)
+        on = node.on or [col(n) for n in node.schema().column_names]
+        ex = pp.Exchange(child, "hash", max(_nparts(node.children[0]), 1),
+                         tuple(on))
+        return pp.Dedup(ex, on)
+    if isinstance(node, lp.Aggregate):
+        return _translate_agg(node, cfg)
+    if isinstance(node, lp.Pivot):
+        child = _t(node.children[0], cfg)
+        gather = pp.Exchange(child, "gather", 1)
+        return pp.Pivot(gather, node.group_by, node.pivot_col, node.value_col,
+                        node.names, node.schema())
+    if isinstance(node, lp.Window):
+        child = _t(node.children[0], cfg)
+        if node.partition_by:
+            child = pp.Exchange(child, "hash", _nparts(node.children[0]),
+                                tuple(node.partition_by))
+        else:
+            child = pp.Exchange(child, "gather", 1)
+        return pp.Window(child, node.window_exprs, node.partition_by,
+                         node.order_by, node.descending, node.nulls_first,
+                         node.frame, node.schema())
+    if isinstance(node, lp.Concat):
+        return pp.Concat(_t(node.children[0], cfg), _t(node.children[1], cfg))
+    if isinstance(node, lp.Join):
+        return _translate_join(node, cfg)
+    if isinstance(node, lp.Sink):
+        child = _t(node.children[0], cfg)
+        return pp.Write(child, node.info, node.schema())
+    raise NotImplementedError(f"translate for {node.name()}")
+
+
+def _nparts(node: lp.LogicalPlan) -> int:
+    return max(node.num_partitions(), 1)
+
+
+def _estimate_size(node: lp.LogicalPlan) -> Optional[int]:
+    """Best-effort size estimate for join-strategy choice."""
+    if isinstance(node, lp.Source):
+        if node.partitions is not None:
+            try:
+                return sum(p.size_bytes() or 0 for p in node.partitions)
+            except Exception:
+                return None
+        tasks = getattr(node, "materialized_tasks", None)
+        if tasks is None and node.scan_op is not None:
+            tasks = node.scan_op.to_scan_tasks(node.pushdowns)
+            node.materialized_tasks = tasks
+        if tasks is not None:
+            sizes = [t.size_bytes() for t in tasks]
+            if all(s is not None for s in sizes):
+                return sum(sizes)
+        return None
+    if isinstance(node, (lp.Filter, lp.Sample)):
+        base = _estimate_size(node.children[0])
+        return None if base is None else int(base * 0.2)
+    if isinstance(node, lp.Limit):
+        return 1024 * node.limit  # rough
+    if isinstance(node, (lp.Aggregate, lp.Distinct)):
+        base = _estimate_size(node.children[0])
+        return None if base is None else max(int(base * 0.05), 1024)
+    if node.children:
+        sizes = [_estimate_size(c) for c in node.children]
+        if any(s is None for s in sizes):
+            return None
+        return sum(sizes)
+    return None
+
+
+def _translate_join(node: lp.Join, cfg) -> pp.PhysicalPlan:
+    left, right = node.children
+    pl, pr = _t(left, cfg), _t(right, cfg)
+    if node.how == "cross":
+        gather_r = pp.Exchange(pr, "gather", 1)
+        return pp.CrossJoin(pl, gather_r, node.schema())
+    lsize, rsize = _estimate_size(left), _estimate_size(right)
+    threshold = cfg.broadcast_join_size_bytes_threshold
+    strategy = node.strategy
+    if strategy is None:
+        if (rsize is not None and rsize <= threshold
+                and node.how in ("inner", "left", "semi", "anti")):
+            strategy = "broadcast_right"
+        elif (lsize is not None and lsize <= threshold
+              and node.how in ("inner", "right")):
+            strategy = "broadcast_left"
+        else:
+            strategy = "hash"
+    elif strategy == "broadcast":
+        strategy = "broadcast_right" if node.how in ("inner", "left", "semi",
+                                                     "anti") else "hash"
+    if strategy == "hash" and (_nparts(left) > 1 or _nparts(right) > 1):
+        n = max(_nparts(left), _nparts(right))
+        pl = pp.Exchange(pl, "hash", n, tuple(node.left_on))
+        pr = pp.Exchange(pr, "hash", n, tuple(node.right_on))
+    elif strategy == "broadcast_right":
+        pr = pp.Exchange(pr, "gather", 1)
+    elif strategy == "broadcast_left":
+        pl = pp.Exchange(pl, "gather", 1)
+    return pp.HashJoin(pl, pr, node.left_on, node.right_on, node.how,
+                       node.schema(), strategy)
+
+
+def _translate_agg(node: lp.Aggregate, cfg) -> pp.PhysicalPlan:
+    from ..aggs import split_agg_expr
+    child = node.children[0]
+    pchild = _t(child, cfg)
+    nparts = _nparts(child)
+    specs = [split_agg_expr(e) for e in node.aggs]
+    decomposable = all(op not in _NON_DECOMPOSABLE for op, _, _, _ in specs)
+
+    if not decomposable:
+        # gather everything and aggregate once
+        if node.group_by:
+            ex = pp.Exchange(pchild, "hash",
+                             min(nparts, cfg.shuffle_aggregation_default_partitions),
+                             tuple(node.group_by))
+        else:
+            ex = pp.Exchange(pchild, "gather", 1)
+        return pp.Aggregate(ex, node.aggs, node.group_by, node.schema(),
+                            "single")
+
+    partial_aggs, final_aggs, final_proj = _split_aggs(node, child.schema())
+    p1_schema = _agg_schema(node.group_by, partial_aggs, child.schema())
+    p1 = pp.Aggregate(pchild, partial_aggs, node.group_by, p1_schema, "partial")
+    if node.group_by:
+        ex = pp.Exchange(
+            p1, "hash",
+            min(max(nparts, 1), cfg.shuffle_aggregation_default_partitions)
+            if nparts > 1 else 1,
+            tuple(col(e.name()) for e in node.group_by))
+    else:
+        ex = pp.Exchange(p1, "gather", 1)
+    gb2 = [col(e.name()) for e in node.group_by]
+    f_schema = _agg_schema(gb2, final_aggs, p1_schema)
+    p2 = pp.Aggregate(ex, final_aggs, gb2, f_schema, "final")
+    proj = [col(e.name()) for e in node.group_by] + final_proj
+    return pp.Project(p2, proj, node.schema())
+
+
+def _agg_schema(group_by, aggs, input_schema: Schema) -> Schema:
+    fields = [e.to_field(input_schema) for e in group_by]
+    fields += [e.to_field(input_schema) for e in aggs]
+    return Schema(fields)
+
+
+def _split_aggs(node: lp.Aggregate, in_schema: Schema):
+    """populate_aggregation_stages: per-agg partial exprs, final exprs over
+    partial outputs, and the final projection."""
+    partials: List[Expression] = []
+    finals: List[Expression] = []
+    projs: List[Expression] = []
+    seen_partial = {}
+
+    def add_partial(e: Expression) -> str:
+        k = e._key()
+        if k in seen_partial:
+            return seen_partial[k]
+        nm = e.name() if e.op == "alias" else f"__p{len(partials)}__{e.name()}"
+        seen_partial[k] = nm
+        partials.append(e.alias(nm) if e.name() != nm else e)
+        return nm
+
+    for e in node.aggs:
+        out_name = e.name()
+        inner = e._unalias()
+        op = inner.op[4:]
+        child = inner.args[0] if inner.args else None
+        out_field = e.to_field(in_schema)
+        if op in ("sum", "min", "max", "any_value", "bool_and", "bool_or",
+                  "list", "concat"):
+            p = add_partial(Expression(inner.op, inner.args, inner.params)
+                            .alias(out_name))
+            f_op = {"sum": "agg.sum", "min": "agg.min", "max": "agg.max",
+                    "any_value": "agg.any_value", "bool_and": "agg.bool_and",
+                    "bool_or": "agg.bool_or", "list": "agg.concat",
+                    "concat": "agg.concat"}[op]
+            finals.append(Expression(f_op, (col(p),),
+                                     inner.params).alias(out_name))
+            projs.append(col(out_name))
+        elif op == "count":
+            p = add_partial(inner.alias(out_name))
+            finals.append(col(p).sum().alias(out_name))
+            projs.append(col(out_name).cast(DataType.uint64()).alias(out_name))
+        elif op == "mean":
+            s = add_partial(child.sum().alias(f"__sum_{out_name}__"))
+            c = add_partial(child.count().alias(f"__count_{out_name}__"))
+            fs = f"__fsum_{out_name}__"
+            fc = f"__fcount_{out_name}__"
+            finals.append(col(s).sum().alias(fs))
+            finals.append(col(c).sum().alias(fc))
+            projs.append((col(fs).cast(DataType.float64())
+                          / col(fc).cast(DataType.float64())).alias(out_name))
+        elif op in ("stddev", "var"):
+            s = add_partial(child.sum().alias(f"__sum_{out_name}__"))
+            c = add_partial(child.count().alias(f"__count_{out_name}__"))
+            s2 = add_partial((child * child).sum().alias(f"__sumsq_{out_name}__"))
+            fs, fc, fs2 = (f"__fs_{out_name}__", f"__fc_{out_name}__",
+                           f"__fs2_{out_name}__")
+            finals.append(col(s).sum().alias(fs))
+            finals.append(col(c).sum().alias(fc))
+            finals.append(col(s2).sum().alias(fs2))
+            mean = col(fs).cast(DataType.float64()) / col(fc).cast(DataType.float64())
+            var = (col(fs2).cast(DataType.float64())
+                   / col(fc).cast(DataType.float64())) - mean * mean
+            projs.append((var.sqrt() if op == "stddev" else var).alias(out_name))
+        else:
+            raise NotImplementedError(f"agg split for {op}")
+    return partials, finals, projs

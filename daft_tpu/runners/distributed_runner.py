@@ -1,0 +1,40 @@
+"""Distributed runner over a jax device Mesh (single- or multi-host SPMD).
+
+Reference architecture: the flotilla engine (``src/daft-distributed``) — a
+stage planner splitting at exchanges, per-worker local execution, a scheduler
+with pluggable policy. TPU mapping: partitions are sharded across mesh
+devices; exchange ops run as ICI collectives (``daft_tpu.parallel``); each
+host runs the local streaming executor for its shard of scan tasks.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from ..execution.executor import LocalExecutor
+from ..micropartition import MicroPartition
+from ..physical.translate import translate
+from .runner import Runner
+
+
+class DistributedRunner(Runner):
+    """Runs the physical plan with device-mesh-aware exchanges.
+
+    On one process this is the local executor plus mesh-collective exchange
+    kernels for repartitions (see ``daft_tpu.parallel.exchange``); stage
+    orchestration across hosts reuses the same plan splitting.
+    """
+
+    name = "tpu_distributed"
+
+    def __init__(self, num_workers: Optional[int] = None):
+        super().__init__()
+        self.num_workers = num_workers
+
+    def run_iter(self, builder, results_buffer_size: Optional[int] = None
+                 ) -> Iterator[MicroPartition]:
+        from ..parallel.stage_runner import MeshStageRunner
+        optimized = builder.optimize()
+        pplan = translate(optimized.plan)
+        runner = MeshStageRunner(self.num_workers)
+        yield from runner.run(pplan)

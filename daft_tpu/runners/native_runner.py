@@ -1,0 +1,24 @@
+"""NativeRunner: optimize → translate → local streaming executor.
+
+Reference: ``daft/runners/native_runner.py:49-99``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from ..execution.executor import LocalExecutor
+from ..micropartition import MicroPartition
+from ..physical.translate import translate
+from .runner import Runner
+
+
+class NativeRunner(Runner):
+    name = "native"
+
+    def run_iter(self, builder, results_buffer_size: Optional[int] = None
+                 ) -> Iterator[MicroPartition]:
+        optimized = builder.optimize()
+        pplan = translate(optimized.plan)
+        executor = LocalExecutor()
+        yield from executor.run(pplan)

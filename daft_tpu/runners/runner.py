@@ -1,0 +1,92 @@
+"""Runner abstraction + partition sets.
+
+Reference: ``daft/runners/runner.py:25-70`` (Runner ABC: run / run_iter /
+run_iter_tables + partition-set cache) and ``daft/runners/partitioning.py``
+(PartitionSet / MaterializedResult / PartitionSetCache).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+import weakref
+from typing import Dict, Iterator, List, Optional
+
+from ..micropartition import MicroPartition
+from ..recordbatch import RecordBatch
+from ..schema import Schema
+
+
+class PartitionSet:
+    """Materialized query result: an ordered list of MicroPartitions."""
+
+    def __init__(self, partitions: List[MicroPartition], schema: Schema):
+        self.partitions = partitions
+        self.schema = schema
+
+    def num_partitions(self) -> int:
+        return len(self.partitions)
+
+    def __len__(self) -> int:
+        return sum(len(p) for p in self.partitions)
+
+    def size_bytes(self) -> int:
+        return sum(p.size_bytes() for p in self.partitions)
+
+    def to_recordbatch(self) -> RecordBatch:
+        batches = []
+        for p in self.partitions:
+            batches.extend(p.batches())
+        batches = [b for b in batches if len(b)] or batches[:1]
+        if not batches:
+            return RecordBatch.empty(self.schema)
+        return RecordBatch.concat(batches).cast_to_schema(self.schema)
+
+
+class PartitionSetCache:
+    """Keeps collected results alive for downstream queries
+    (reference: ``runner.py:22-35``, InMemoryPartitionSetCache)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sets: Dict[str, PartitionSet] = {}
+
+    def put(self, ps: PartitionSet) -> str:
+        key = uuid.uuid4().hex
+        with self._lock:
+            self._sets[key] = ps
+        return key
+
+    def get(self, key: str) -> Optional[PartitionSet]:
+        with self._lock:
+            return self._sets.get(key)
+
+    def rm(self, key: str):
+        with self._lock:
+            self._sets.pop(key, None)
+
+    def clear(self):
+        with self._lock:
+            self._sets.clear()
+
+
+class Runner:
+    def __init__(self):
+        self.partition_set_cache = PartitionSetCache()
+
+    def run(self, builder) -> PartitionSet:
+        parts = list(self.run_iter(builder))
+        return PartitionSet(parts, builder.schema())
+
+    def run_iter(self, builder,
+                 results_buffer_size: Optional[int] = None
+                 ) -> Iterator[MicroPartition]:
+        raise NotImplementedError
+
+    def run_iter_tables(self, builder,
+                        results_buffer_size: Optional[int] = None
+                        ) -> Iterator[RecordBatch]:
+        for p in self.run_iter(builder, results_buffer_size):
+            for b in p.batches():
+                if len(b):
+                    yield b

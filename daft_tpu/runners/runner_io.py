@@ -1,0 +1,14 @@
+"""Planning-time materialization helpers (e.g. pivot distinct-values probe)."""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def materialize_for_planning(builder) -> List:
+    """Run a small plan eagerly and return the single column as a pylist."""
+    from ..context import get_context
+    runner = get_context().get_or_create_runner()
+    ps = runner.run(builder)
+    rb = ps.to_recordbatch()
+    return rb.get_column(rb.column_names()[0]).to_pylist()

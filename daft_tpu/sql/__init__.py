@@ -1,0 +1,3 @@
+from .sql import sql, sql_expr, SQLCatalog
+
+__all__ = ["sql", "sql_expr", "SQLCatalog"]

@@ -1,0 +1,43 @@
+"""daft_tpu.sql — SQL → LogicalPlan frontend entry points.
+
+Reference: ``daft/sql/sql.py`` (binding against in-scope DataFrames via
+SQLCatalog) over ``src/daft-sql``'s planner. The parser/planner itself lives
+in ``planner.py`` (hand-written recursive descent — no third-party SQL
+dependency exists in this environment).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Dict, Optional
+
+
+class SQLCatalog:
+    def __init__(self, tables: Dict[str, "object"]):
+        self.tables = dict(tables)
+
+    def register_table(self, name: str, df):
+        self.tables[name] = df
+
+
+def sql(query: str, catalog: Optional[SQLCatalog] = None, **kwargs):
+    """Run SQL against DataFrames bound by name (caller locals or catalog)."""
+    from .planner import SQLPlanner
+    from ..dataframe import DataFrame
+    tables = {}
+    if catalog is None:
+        frame = inspect.currentframe().f_back
+        for scope in (frame.f_globals, frame.f_locals):
+            for k, v in scope.items():
+                if isinstance(v, DataFrame):
+                    tables[k] = v
+    else:
+        tables.update(catalog.tables)
+    tables.update({k: v for k, v in kwargs.items()
+                   if isinstance(v, DataFrame)})
+    return SQLPlanner(tables).plan_query(query)
+
+
+def sql_expr(expr: str):
+    from .planner import SQLPlanner
+    return SQLPlanner({}).plan_expression(expr)

@@ -1,0 +1,39 @@
+"""Torch dataset bridges (reference: ``daft/dataframe/to_torch.py``)."""
+
+from __future__ import annotations
+
+
+class TorchMapDataset:
+    def __init__(self, df):
+        import torch.utils.data
+        self._rows = df.to_pylist()
+
+        class _DS(torch.utils.data.Dataset):
+            def __init__(s):
+                pass
+
+            def __len__(s):
+                return len(self._rows)
+
+            def __getitem__(s, i):
+                return self._rows[i]
+        self._ds = _DS()
+
+    def __len__(self):
+        return len(self._ds)
+
+    def __getitem__(self, i):
+        return self._ds[i]
+
+
+class TorchIterDataset:
+    def __init__(self, df):
+        import torch.utils.data
+
+        class _DS(torch.utils.data.IterableDataset):
+            def __iter__(s):
+                return df.iter_rows()
+        self._ds = _DS()
+
+    def __iter__(self):
+        return iter(self._ds)
