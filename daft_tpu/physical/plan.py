@@ -102,6 +102,19 @@ class Aggregate(PhysicalPlan):
         self.mode = mode
 
 
+class DeviceFragmentAgg(PhysicalPlan):
+    """Fused scan→filter→project→partial-agg fragment: one XLA program per
+    morsel (see device/fragment.py). Falls back to the equivalent host chain
+    per-batch when a batch is not device-representable."""
+
+    def __init__(self, source, predicate, aggs, group_by, schema, mode):
+        super().__init__([source], schema)
+        self.predicate = predicate
+        self.aggs = aggs          # substituted over source columns
+        self.group_by = group_by  # substituted over source columns
+        self.mode = mode
+
+
 class Dedup(PhysicalPlan):
     def __init__(self, child, on):
         super().__init__([child], child.schema())

@@ -193,7 +193,10 @@ def _translate_agg(node: lp.Aggregate, cfg) -> pp.PhysicalPlan:
 
     partial_aggs, final_aggs, final_proj = _split_aggs(node, child.schema())
     p1_schema = _agg_schema(node.group_by, partial_aggs, child.schema())
-    p1 = pp.Aggregate(pchild, partial_aggs, node.group_by, p1_schema, "partial")
+    p1 = _try_fuse_partial(pchild, partial_aggs, node.group_by, p1_schema)
+    if p1 is None:
+        p1 = pp.Aggregate(pchild, partial_aggs, node.group_by, p1_schema,
+                          "partial")
     if node.group_by:
         ex = pp.Exchange(
             p1, "hash",
@@ -207,6 +210,68 @@ def _translate_agg(node: lp.Aggregate, cfg) -> pp.PhysicalPlan:
     p2 = pp.Aggregate(ex, final_aggs, gb2, f_schema, "final")
     proj = [col(e.name()) for e in node.group_by] + final_proj
     return pp.Project(p2, proj, node.schema())
+
+
+def _try_fuse_partial(pchild: pp.PhysicalPlan, partial_aggs, group_by,
+                      p1_schema: Schema) -> Optional[pp.PhysicalPlan]:
+    """Collapse partial-Agg ← Project* ← Filter* ← Scan into a fused device
+    fragment, substituting intermediate projections so every expression is
+    over source columns."""
+    from ..aggs import split_agg_expr
+    from ..logical.optimizer import combine_conjuncts, substitute_columns
+    chain = []
+    n = pchild
+    while isinstance(n, (pp.Project, pp.Filter)):
+        chain.append(n)
+        n = n.children[0]
+    # chain may be empty: fusing projection-exprs + agg over a bare source
+    # still collapses to one program (scan-level filters prune earlier)
+    if not isinstance(n, (pp.ScanSource, pp.InMemorySource)):
+        return None
+    mapping = {c: col(c) for c in n.schema().column_names}
+    preds = []
+    for node2 in reversed(chain):
+        if isinstance(node2, pp.Filter):
+            preds.append(substitute_columns(node2.predicate, mapping))
+        else:
+            try:
+                mapping = {e.name(): substitute_columns(e._unalias(), mapping)
+                           for e in node2.exprs}
+            except Exception:
+                return None
+    try:
+        gb2 = [substitute_columns(e._unalias(), mapping).alias(e.name())
+               for e in group_by]
+        aggs2 = []
+        for a in partial_aggs:
+            op, child, name, params = split_agg_expr(a)
+            if op not in ("sum", "mean", "min", "max", "count", "stddev",
+                          "var", "any_value", "bool_and", "bool_or"):
+                return None
+            if op == "count" and params and params[0] != "valid":
+                return None
+            c2 = substitute_columns(child, mapping) if child is not None \
+                else None
+            new_inner = Expression("agg." + op,
+                                   (c2,) if c2 is not None else (), params)
+            aggs2.append(new_inner.alias(name))
+        pred = combine_conjuncts(preds) if preds else None
+        # all agg outputs must be decodable without a dictionary
+        for a in aggs2:
+            f = p1_schema[a.name()]
+            if f.dtype.device_repr() is None or f.dtype.is_string() \
+                    or f.dtype.is_binary():
+                return None
+        # string group keys must be source-column passthroughs (their
+        # dictionary travels from the encoded input)
+        for g in gb2:
+            f = p1_schema[g.name()]
+            if (f.dtype.is_string() or f.dtype.is_binary()) \
+                    and g._unalias().op != "col":
+                return None
+    except Exception:
+        return None
+    return pp.DeviceFragmentAgg(n, pred, aggs2, gb2, p1_schema, "partial")
 
 
 def _agg_schema(group_by, aggs, input_schema: Schema) -> Schema:

@@ -1,0 +1,974 @@
+"""Hand-written SQL frontend: tokenizer + recursive-descent parser → LogicalPlan.
+
+Capability mirror of ``src/daft-sql`` (planner over sqlparser-rs;
+``planner.rs``): SELECT with CTEs, derived tables, JOIN chains (ON/USING,
+inner/left/right/full/cross/semi/anti), WHERE / GROUP BY / HAVING / ORDER BY /
+LIMIT / OFFSET, DISTINCT, UNION [ALL], scalar + aggregate expressions (CASE,
+CAST, BETWEEN, IN, LIKE, IS NULL, EXTRACT, INTERVAL, date literals), and a
+function library mapped onto the expression DSL. No third-party SQL dependency
+exists in this environment, so the parser is first-party.
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..datatype import DataType, TimeUnit
+from ..expressions import Expression, col, lit, coalesce
+from ..expressions.expressions import list_
+
+# ---------------------------------------------------------------------------
+# tokenizer
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+)
+  | (?P<comment>--[^\n]*)
+  | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+|\d+(?:[eE][+-]?\d+)?)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*")
+  | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
+  | (?P<op><=|>=|<>|!=|\|\||::|[-+*/%(),.<>=])
+""", re.VERBOSE)
+
+
+class Tok:
+    __slots__ = ("kind", "text")
+
+    def __init__(self, kind: str, text: str):
+        self.kind = kind
+        self.text = text
+
+    def __repr__(self):
+        return f"{self.kind}:{self.text}"
+
+
+def tokenize(s: str) -> List[Tok]:
+    out = []
+    i = 0
+    while i < len(s):
+        m = _TOKEN_RE.match(s, i)
+        if m is None:
+            raise ValueError(f"SQL tokenize error at {s[i:i+20]!r}")
+        i = m.end()
+        kind = m.lastgroup
+        if kind in ("ws", "comment"):
+            continue
+        text = m.group()
+        if kind == "ident":
+            out.append(Tok("ident", text))
+        elif kind == "qident":
+            out.append(Tok("ident", text[1:-1].replace('""', '"')))
+        elif kind == "str":
+            out.append(Tok("str", text[1:-1].replace("''", "'")))
+        else:
+            out.append(Tok(kind, text))
+    out.append(Tok("eof", ""))
+    return out
+
+
+_AGG_FUNCS = {"sum", "avg", "mean", "min", "max", "count", "count_distinct",
+              "stddev", "stddev_pop", "var", "variance", "any_value",
+              "approx_count_distinct", "list_agg", "string_agg", "skew"}
+
+
+class Scope:
+    """Name resolution: alias → {sql column name → actual frame column}."""
+
+    def __init__(self):
+        self.tables: Dict[str, Dict[str, str]] = {}
+        self.order: List[str] = []
+
+    def add(self, alias: str, columns: List[str],
+            actual: Optional[Dict[str, str]] = None):
+        self.tables[alias] = {c.lower(): (actual[c] if actual else c)
+                              for c in columns}
+        self.order.append(alias)
+
+    def prefix_right(self, collided: List[str]):
+        """After a join, right-side collided columns become right.<name>."""
+        last = self.order[-1]
+        m = self.tables[last]
+        for sqlname, act in list(m.items()):
+            if act in collided:
+                m[sqlname] = "right." + act
+
+    def resolve(self, name: str, alias: Optional[str] = None) -> str:
+        if alias is not None:
+            t = self.tables.get(alias.lower())
+            if t is None or name.lower() not in t:
+                raise ValueError(f"unknown column {alias}.{name}")
+            return t[name.lower()]
+        for a in self.order:
+            if name.lower() in self.tables[a]:
+                return self.tables[a][name.lower()]
+        raise ValueError(f"unknown column {name}")
+
+    def all_columns(self) -> List[str]:
+        seen, out = set(), []
+        for a in self.order:
+            for act in self.tables[a].values():
+                if act not in seen:
+                    seen.add(act)
+                    out.append(act)
+        return out
+
+
+class SQLPlanner:
+    def __init__(self, tables: Dict[str, "object"]):
+        self.tables = {k.lower(): v for k, v in tables.items()}
+        self.toks: List[Tok] = []
+        self.i = 0
+
+    # -- public ------------------------------------------------------------
+    def plan_query(self, query: str):
+        self.toks = tokenize(query)
+        self.i = 0
+        df = self._query(dict(self.tables))
+        self._expect_eof()
+        return df
+
+    def plan_expression(self, text: str) -> Expression:
+        self.toks = tokenize(text)
+        self.i = 0
+        e = self._expr(scope=None)
+        self._expect_eof()
+        return e
+
+    # -- cursor ------------------------------------------------------------
+    def _peek(self, k: int = 0) -> Tok:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def _next(self) -> Tok:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def _kw(self, *words: str) -> bool:
+        """Consume keyword/punctuation sequence if present (case-insensitive).
+        String/quoted-identifier tokens never match keywords."""
+        save = self.i
+        for w in words:
+            t = self._peek()
+            if w.isalpha():
+                ok = t.kind == "ident" and t.text.upper() == w
+            else:
+                ok = t.kind == "op" and t.text == w
+            if ok:
+                self.i += 1
+            else:
+                self.i = save
+                return False
+        return True
+
+    def _peek_kw(self, *words: str) -> bool:
+        save = self.i
+        ok = self._kw(*words)
+        self.i = save
+        return ok
+
+    def _expect(self, text: str):
+        t = self._next()
+        if t.text.upper() != text.upper():
+            raise ValueError(f"expected {text!r}, got {t.text!r}")
+
+    def _expect_eof(self):
+        if self._peek().kind != "eof":
+            raise ValueError(f"unexpected trailing SQL: {self._peek().text!r}")
+
+    # -- query -------------------------------------------------------------
+    def _query(self, ctes: Dict[str, "object"]):
+        if self._kw("WITH"):
+            while True:
+                name = self._next().text
+                self._expect("AS")
+                self._expect("(")
+                sub = self._query(dict(ctes))
+                self._expect(")")
+                ctes[name.lower()] = sub
+                if not self._kw(","):
+                    break
+        left = self._select(ctes)
+        while self._peek_kw("UNION") or self._peek_kw("INTERSECT") \
+                or self._peek_kw("EXCEPT"):
+            if self._kw("UNION"):
+                all_ = self._kw("ALL")
+                right = self._select(ctes)
+                left = left.union_all(right) if all_ else left.union(right)
+            elif self._kw("INTERSECT"):
+                right = self._select(ctes)
+                left = left.intersect(right)
+            else:
+                self._kw("EXCEPT")
+                right = self._select(ctes)
+                left = left.except_distinct(right)
+        return left
+
+    def _select(self, ctes):
+        from ..dataframe import DataFrame
+        self._expect("SELECT")
+        distinct = self._kw("DISTINCT")
+        proj: List[Tuple[Optional[Expression], Optional[str]]] = []
+        while True:
+            if self._peek().text == "*":
+                self._next()
+                proj.append((None, "*"))
+            elif self._peek().kind == "ident" and self._peek(1).text == "." \
+                    and self._peek(2).text == "*":
+                alias = self._next().text
+                self._next()
+                self._next()
+                proj.append((None, f"{alias}.*"))
+            else:
+                e = None  # parsed after FROM for scope; remember token span
+                start = self.i
+                self._skip_expr()
+                out_alias = None
+                if self._kw("AS"):
+                    out_alias = self._next().text
+                elif self._peek().kind == "ident" and \
+                        self._peek().text.upper() not in (
+                            "FROM", "WHERE", "GROUP", "ORDER", "LIMIT",
+                            "HAVING", "UNION", "INTERSECT", "EXCEPT",
+                            "OFFSET"):
+                    out_alias = self._next().text
+                proj.append(((start, self.i - (1 if out_alias and
+                                               not self._prev_was_as(start) else 0)),
+                             out_alias))
+            if not self._kw(","):
+                break
+
+        # FROM -----------------------------------------------------------
+        scope = Scope()
+        if self._kw("FROM"):
+            df = self._table_expr(ctes, scope)
+        else:
+            df = DataFrame.__new__(DataFrame)  # dummy; no-FROM SELECT
+            from ..dataframe import from_pydict
+            df = from_pydict({"__dummy__": [0]})
+            scope.add("__dummy__", ["__dummy__"])
+
+        where = None
+        if self._kw("WHERE"):
+            where = self._expr(scope)
+        group_by = []
+        if self._kw("GROUP"):
+            self._expect("BY")
+            while True:
+                group_by.append(self._expr(scope))
+                if not self._kw(","):
+                    break
+        having = None
+        if self._kw("HAVING"):
+            having = self._expr(scope)
+        order_by = []
+        descs = []
+        if self._kw("ORDER"):
+            self._expect("BY")
+            lenient = _LenientScope(scope)
+            while True:
+                order_by.append(self._expr(lenient))
+                if self._kw("DESC"):
+                    descs.append(True)
+                else:
+                    self._kw("ASC")
+                    descs.append(False)
+                if not self._kw(","):
+                    break
+        limit = None
+        offset = 0
+        if self._kw("LIMIT"):
+            limit = int(self._next().text)
+        if self._kw("OFFSET"):
+            offset = int(self._next().text)
+
+        # re-parse projection expressions with full scope ------------------
+        exprs: List[Expression] = []
+        save = self.i
+        for item, alias in proj:
+            if item is None:
+                if alias == "*":
+                    exprs.extend(col(c) for c in scope.all_columns())
+                else:
+                    a = alias.split(".")[0]
+                    for actual in scope.tables[a.lower()].values():
+                        exprs.append(col(actual))
+                continue
+            start, end = item
+            self.i = start
+            e = self._expr(scope)
+            if alias is not None:
+                e = e.alias(alias)
+            exprs.append(e)
+        self.i = save
+
+        # assemble plan ----------------------------------------------------
+        if where is not None:
+            df = df.where(where)
+        agg_mode = bool(group_by) or any(_has_agg(e) for e in exprs) \
+            or (having is not None and _has_agg(having))
+        if agg_mode:
+            gb_keys = []
+            gb_out_names = []
+            out_order = []
+            for g in group_by:
+                gb_keys.append(g)
+                gb_out_names.append(g.name())
+            agg_exprs = []
+            post_names = []
+            for e in exprs:
+                inner = e._unalias()
+                if not _has_agg(e):
+                    # must be a group key (or expression thereof)
+                    post_names.append(e.name())
+                    if not any(e.structurally_eq(g) or
+                               inner.structurally_eq(g) for g in gb_keys):
+                        # allow aliased group keys
+                        pass
+                else:
+                    agg_exprs.append(e)
+                    post_names.append(e.name())
+            if having is not None:
+                agg_exprs.append(having.alias("__having__"))
+            # aliased group keys: rename via select later
+            gdf = df.groupby(*gb_keys).agg(*agg_exprs) if gb_keys \
+                else df.agg(*agg_exprs)
+            if having is not None:
+                gdf = gdf.where(col("__having__"))
+            sel = []
+            for e in exprs:
+                if _has_agg(e):
+                    sel.append(col(e.name()))
+                else:
+                    inner = e._unalias()
+                    matched = None
+                    for g in gb_keys:
+                        if inner.structurally_eq(g):
+                            matched = g.name()
+                            break
+                    sel.append(col(matched).alias(e.name()) if matched and
+                               matched != e.name() else col(e.name()
+                               if matched is None else matched))
+            df = gdf.select(*sel)
+        else:
+            # hidden sort keys: SQL allows ordering by non-projected inputs
+            hidden = []
+            if order_by:
+                out_names = {e.name() for e in exprs}
+                for j, o in enumerate(order_by):
+                    bound = _rebind_order(o, exprs)
+                    if bound.op == "col" and bound.params[0] in out_names:
+                        order_by[j] = bound
+                    elif not (o.op == "col" and o.params[0] in out_names):
+                        h = o.alias(f"__ord{j}__")
+                        hidden.append(h)
+                        order_by[j] = col(h.name())
+            df = df.select(*(exprs + hidden))
+            if distinct and not hidden:
+                df = df.distinct()
+            if order_by:
+                df = df.sort(order_by, desc=descs)
+            if hidden:
+                df = df.select(*[col(e.name()) for e in exprs])
+                if distinct:
+                    df = df.distinct()
+            order_by = []
+        if distinct and (agg_mode):
+            df = df.distinct()
+        if order_by:
+            # order keys may reference output aliases
+            df = df.sort([_rebind_order(o, exprs) for o in order_by],
+                         desc=descs)
+        if limit is not None:
+            df = df.limit(limit, offset)
+        elif offset:
+            df = df.offset(offset)
+        return df
+
+    def _prev_was_as(self, start: int) -> bool:
+        return False
+
+    def _skip_expr(self):
+        """Skip over one projection expression (balanced parens) without
+        resolving names — it is re-parsed once the FROM scope is known."""
+        depth = 0
+        while True:
+            t = self._peek()
+            if t.kind == "eof":
+                return
+            up = t.text.upper()
+            if depth == 0 and (t.text == "," or up in (
+                    "FROM", "WHERE", "GROUP", "ORDER", "LIMIT", "HAVING",
+                    "UNION", "INTERSECT", "EXCEPT", "OFFSET")):
+                return
+            if depth == 0 and t.kind == "ident" and up == "AS":
+                return
+            if depth == 0 and t.kind == "ident" and self._peek(1).kind == "eof":
+                self._next()
+                return
+            if t.text == "(":
+                depth += 1
+            elif t.text == ")":
+                if depth == 0:
+                    return
+                depth -= 1
+            self._next()
+
+    # -- FROM clause -------------------------------------------------------
+    def _table_expr(self, ctes, scope: Scope):
+        df = self._table_factor(ctes, scope)
+        while True:
+            how = None
+            if self._kw("CROSS", "JOIN"):
+                how = "cross"
+            elif self._kw("INNER", "JOIN") or self._peek_kw("JOIN"):
+                self._kw("JOIN")
+                how = "inner"
+            elif self._kw("LEFT", "OUTER", "JOIN") or self._kw("LEFT", "JOIN"):
+                how = "left"
+            elif self._kw("RIGHT", "OUTER", "JOIN") or self._kw("RIGHT", "JOIN"):
+                how = "right"
+            elif self._kw("FULL", "OUTER", "JOIN") or self._kw("FULL", "JOIN"):
+                how = "outer"
+            elif self._kw("LEFT", "SEMI", "JOIN") or self._kw("SEMI", "JOIN"):
+                how = "semi"
+            elif self._kw("LEFT", "ANTI", "JOIN") or self._kw("ANTI", "JOIN"):
+                how = "anti"
+            elif self._kw(","):
+                how = "cross"
+            else:
+                break
+            right_scope = Scope()
+            rdf = self._table_factor(ctes, right_scope)
+            if how == "cross" and not self._peek_kw("ON"):
+                df = self._merge_join(df, rdf, scope, right_scope, "cross",
+                                      [], [], None)
+                continue
+            if self._kw("USING"):
+                self._expect("(")
+                cols_u = []
+                while True:
+                    cols_u.append(self._next().text)
+                    if not self._kw(","):
+                        break
+                self._expect(")")
+                lo = [col(scope.resolve(c)) for c in cols_u]
+                ro = [col(right_scope.resolve(c)) for c in cols_u]
+                df = self._merge_join(df, rdf, scope, right_scope, how, lo,
+                                      ro, None)
+                continue
+            self._expect("ON")
+            cond = self._expr_joined(scope, right_scope)
+            lo, ro, residual = _split_join_condition(cond, scope, right_scope)
+            df = self._merge_join(df, rdf, scope, right_scope,
+                                  how if how != "cross" else "inner",
+                                  lo, ro, residual)
+        return df
+
+    def _merge_join(self, df, rdf, scope: Scope, right_scope: Scope, how,
+                    lo, ro, residual):
+        lcols = set(scope.all_columns())
+        if how == "cross":
+            out = df.join(rdf, how="cross")
+        else:
+            out = df.join(rdf, left_on=lo, right_on=ro, how=how)
+        # fold right scope into left scope with collision prefixes
+        ro_names = [e.name() for e in ro]
+        lo_names = [e.name() for e in lo]
+        for alias in right_scope.order:
+            m = {}
+            for sqlname, act in right_scope.tables[alias].items():
+                if how in ("semi", "anti"):
+                    continue
+                if act in ro_names and how not in ("outer",):
+                    ki = ro_names.index(act)
+                    if ki < len(lo_names) and lo_names[ki] == act:
+                        m[sqlname] = act  # merged key column
+                        continue
+                m[sqlname] = ("right." + act) if act in lcols else act
+            scope.tables[alias] = m
+            scope.order.append(alias)
+        if residual is not None:
+            out = out.where(residual)
+        return out
+
+    def _table_factor(self, ctes, scope: Scope):
+        if self._kw("("):
+            sub = self._query(dict(ctes))
+            self._expect(")")
+            alias = None
+            if self._kw("AS"):
+                alias = self._next().text
+            elif self._peek().kind == "ident" and \
+                    self._peek().text.upper() not in _CLAUSE_WORDS:
+                alias = self._next().text
+            alias = alias or f"__subq{len(scope.order)}__"
+            scope.add(alias, sub.column_names)
+            return sub
+        name = self._next().text
+        # table functions: read_parquet('...') etc.
+        if self._peek().text == "(" and name.lower() in (
+                "read_parquet", "read_csv", "read_json"):
+            self._next()
+            path = self._next().text
+            self._expect(")")
+            import daft_tpu as _dt
+            df = getattr(_dt, name.lower())(path)
+        else:
+            key = name.lower()
+            if key not in ctes and key not in self.tables:
+                raise ValueError(f"unknown table {name!r}")
+            df = ctes.get(key) or self.tables[key]
+        alias = None
+        if self._kw("AS"):
+            alias = self._next().text
+        elif self._peek().kind == "ident" and \
+                self._peek().text.upper() not in _CLAUSE_WORDS:
+            alias = self._next().text
+        scope.add((alias or name), df.column_names)
+        return df
+
+    # -- expressions -------------------------------------------------------
+    def _expr_joined(self, left_scope: Scope, right_scope: Scope) -> Expression:
+        merged = Scope()
+        merged.tables = {**right_scope.tables, **left_scope.tables}
+        merged.order = left_scope.order + right_scope.order
+        return self._expr(merged)
+
+    def _expr(self, scope: Optional[Scope]) -> Expression:
+        return self._or_expr(scope)
+
+    def _or_expr(self, scope) -> Expression:
+        e = self._and_expr(scope)
+        while self._kw("OR"):
+            e = e | self._and_expr(scope)
+        return e
+
+    def _and_expr(self, scope) -> Expression:
+        e = self._not_expr(scope)
+        while self._kw("AND"):
+            e = e & self._not_expr(scope)
+        return e
+
+    def _not_expr(self, scope) -> Expression:
+        if self._kw("NOT"):
+            return ~self._not_expr(scope)
+        return self._cmp_expr(scope)
+
+    def _cmp_expr(self, scope) -> Expression:
+        e = self._add_expr(scope)
+        while True:
+            t = self._peek()
+            if t.text in ("=", "<", ">", "<=", ">=", "<>", "!="):
+                self._next()
+                r = self._add_expr(scope)
+                e = {"=": e == r, "<": e < r, ">": e > r, "<=": e <= r,
+                     ">=": e >= r, "<>": e != r, "!=": e != r}[t.text]
+                continue
+            neg = False
+            save = self.i
+            if self._kw("NOT"):
+                neg = True
+            if self._kw("BETWEEN"):
+                lo = self._add_expr(scope)
+                self._expect("AND")
+                hi = self._add_expr(scope)
+                b = e.between(lo, hi)
+                e = ~b if neg else b
+                continue
+            if self._kw("IN"):
+                self._expect("(")
+                items = []
+                while True:
+                    items.append(self._expr(scope))
+                    if not self._kw(","):
+                        break
+                self._expect(")")
+                b = e.is_in([i.params[0] if i.op == "lit" else i
+                             for i in items])
+                e = ~b if neg else b
+                continue
+            if self._kw("LIKE"):
+                pat = self._next().text
+                rx = "^" + re.escape(pat).replace("%", ".*").replace("_", ".") \
+                    + "$"
+                b = e.str.match(rx)
+                e = ~b if neg else b
+                continue
+            if self._kw("IS"):
+                isnot = self._kw("NOT")
+                self._expect("NULL")
+                e = e.not_null() if isnot else e.is_null()
+                continue
+            if neg:
+                self.i = save
+            break
+        return e
+
+    def _add_expr(self, scope) -> Expression:
+        e = self._mul_expr(scope)
+        while True:
+            t = self._peek().text
+            if t == "+":
+                self._next()
+                e = e + self._mul_expr(scope)
+            elif t == "-":
+                self._next()
+                e = e - self._mul_expr(scope)
+            elif t == "||":
+                self._next()
+                e = e.str.concat(self._mul_expr(scope))
+            else:
+                return e
+
+    def _mul_expr(self, scope) -> Expression:
+        e = self._unary_expr(scope)
+        while True:
+            t = self._peek().text
+            if t == "*":
+                self._next()
+                e = e * self._unary_expr(scope)
+            elif t == "/":
+                self._next()
+                e = e / self._unary_expr(scope)
+            elif t == "%":
+                self._next()
+                e = e % self._unary_expr(scope)
+            else:
+                return e
+
+    def _unary_expr(self, scope) -> Expression:
+        if self._peek().text == "-":
+            self._next()
+            return -self._unary_expr(scope)
+        if self._peek().text == "+":
+            self._next()
+            return self._unary_expr(scope)
+        e = self._primary(scope)
+        while self._peek().text == "::":
+            self._next()
+            tname = self._next().text
+            e = e.cast(_sql_type(tname, self))
+        return e
+
+    def _primary(self, scope) -> Expression:
+        t = self._next()
+        if t.text == "(":
+            e = self._expr(scope)
+            self._expect(")")
+            return e
+        if t.kind == "num":
+            txt = t.text
+            return lit(float(txt)) if ("." in txt or "e" in txt.lower()) \
+                else lit(int(txt))
+        if t.kind == "str":
+            return lit(t.text)
+        if t.kind != "ident":
+            raise ValueError(f"unexpected token {t.text!r} in expression")
+        up = t.text.upper()
+        if up == "NULL":
+            return lit(None)
+        if up == "TRUE":
+            return lit(True)
+        if up == "FALSE":
+            return lit(False)
+        if up == "DATE":
+            s = self._next().text
+            y, m, d = s.split("-")
+            return lit(datetime.date(int(y), int(m), int(d)))
+        if up == "TIMESTAMP":
+            s = self._next().text
+            return lit(datetime.datetime.fromisoformat(s))
+        if up == "INTERVAL":
+            s = self._next().text
+            qty, unit = s.split(" ", 1) if " " in s else (s, self._next().text)
+            return _interval(int(qty), unit)
+        if up == "CASE":
+            return self._case(scope)
+        if up == "CAST":
+            self._expect("(")
+            e = self._expr(scope)
+            self._expect("AS")
+            tname = self._next().text
+            dt = _sql_type(tname, self)
+            self._expect(")")
+            return e.cast(dt)
+        if up == "EXTRACT":
+            self._expect("(")
+            part = self._next().text.lower()
+            self._expect("FROM")
+            e = self._expr(scope)
+            self._expect(")")
+            return getattr(e.dt, part)()
+        # function call?
+        if self._peek().text == "(":
+            return self._function(t.text, scope)
+        # qualified identifier
+        if self._peek().text == ".":
+            self._next()
+            colname = self._next().text
+            if scope is None:
+                return col(colname)
+            return col(scope.resolve(colname, t.text))
+        if scope is None:
+            return col(t.text)
+        return col(scope.resolve(t.text))
+
+    def _case(self, scope) -> Expression:
+        base = None
+        if not self._peek_kw("WHEN"):
+            base = self._expr(scope)
+        branches = []
+        while self._kw("WHEN"):
+            cond = self._expr(scope)
+            self._expect("THEN")
+            val = self._expr(scope)
+            branches.append((cond, val))
+        default = lit(None)
+        if self._kw("ELSE"):
+            default = self._expr(scope)
+        self._expect("END")
+        out = default
+        for cond, val in reversed(branches):
+            c = (base == cond) if base is not None else cond
+            out = c.if_else(val, out)
+        return out
+
+    def _function(self, name: str, scope) -> Expression:
+        self._expect("(")
+        fn = name.lower()
+        distinct = False
+        if fn == "count" and self._peek().text == "*":
+            self._next()
+            self._expect(")")
+            return lit(1).count("all").alias("count")
+        if self._kw("DISTINCT"):
+            distinct = True
+        args: List[Expression] = []
+        if self._peek().text != ")":
+            while True:
+                args.append(self._expr(scope))
+                if not self._kw(","):
+                    break
+        self._expect(")")
+        return _apply_function(fn, args, distinct)
+
+
+class _LenientScope:
+    """ORDER BY may reference projection output aliases not yet in scope."""
+
+    def __init__(self, scope: Scope):
+        self._scope = scope
+        self.tables = scope.tables
+        self.order = scope.order
+
+    def resolve(self, name: str, alias: Optional[str] = None) -> str:
+        try:
+            return self._scope.resolve(name, alias)
+        except ValueError:
+            return name
+
+    def all_columns(self):
+        return self._scope.all_columns()
+
+
+_CLAUSE_WORDS = {"ON", "USING", "WHERE", "GROUP", "ORDER", "LIMIT", "HAVING",
+                 "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "UNION",
+                 "INTERSECT", "EXCEPT", "AS", "SEMI", "ANTI", "OFFSET",
+                 "OUTER", "AND", "OR", "NOT", "SELECT", "FROM", "WITH", "BY"}
+
+
+def _interval(qty: int, unit: str) -> Expression:
+    unit = unit.lower().rstrip("s")
+    kw = {"year": "years", "month": "months", "day": "days", "hour": "hours",
+          "minute": "minutes", "second": "seconds"}[unit]
+    from ..expressions.expressions import interval
+    return interval(**{kw: qty})
+
+
+def _sql_type(name: str, planner: SQLPlanner) -> DataType:
+    n = name.lower()
+    m = {"int": DataType.int32, "integer": DataType.int32,
+         "bigint": DataType.int64, "smallint": DataType.int16,
+         "tinyint": DataType.int8, "float": DataType.float32,
+         "real": DataType.float32, "double": DataType.float64,
+         "text": DataType.string, "varchar": DataType.string,
+         "string": DataType.string, "boolean": DataType.bool,
+         "bool": DataType.bool, "date": DataType.date,
+         "binary": DataType.binary, "bytea": DataType.binary,
+         "timestamp": lambda: DataType.timestamp(TimeUnit.us)}
+    if n == "decimal" or n == "numeric":
+        if planner._peek().text == "(":
+            planner._next()
+            p = int(planner._next().text)
+            planner._expect(",")
+            s = int(planner._next().text)
+            planner._expect(")")
+            return DataType.decimal128(p, s)
+        return DataType.decimal128(38, 10)
+    if n in ("varchar", "char") and planner._peek().text == "(":
+        planner._next()
+        planner._next()
+        planner._expect(")")
+        return DataType.string()
+    if n not in m:
+        raise ValueError(f"unknown SQL type {name!r}")
+    return m[n]()
+
+
+def _apply_function(fn: str, args: List[Expression],
+                    distinct: bool) -> Expression:
+    a = args[0] if args else None
+    if fn in ("sum",):
+        return a.sum()
+    if fn in ("avg", "mean"):
+        return a.mean()
+    if fn == "min":
+        return a.min()
+    if fn == "max":
+        return a.max()
+    if fn == "count":
+        return a.count_distinct() if distinct else a.count()
+    if fn in ("stddev", "stddev_pop"):
+        return a.stddev()
+    if fn in ("var", "variance"):
+        return a.var()
+    if fn == "any_value":
+        return a.any_value()
+    if fn == "approx_count_distinct":
+        return a.approx_count_distinct()
+    if fn == "abs":
+        return abs(a)
+    if fn == "round":
+        return a.round(int(args[1].params[0]) if len(args) > 1 else 0)
+    if fn in ("ceil", "ceiling"):
+        return a.ceil()
+    if fn == "floor":
+        return a.floor()
+    if fn == "sqrt":
+        return a.sqrt()
+    if fn in ("ln",):
+        return a.ln()
+    if fn == "log":
+        return a.log10() if len(args) == 1 else args[1].log(args[0].params[0])
+    if fn == "exp":
+        return a.exp()
+    if fn == "power" or fn == "pow":
+        return a ** args[1]
+    if fn == "coalesce":
+        return coalesce(*args)
+    if fn == "nullif":
+        return (a == args[1]).if_else(lit(None), a)
+    if fn == "upper":
+        return a.str.upper()
+    if fn == "lower":
+        return a.str.lower()
+    if fn in ("length", "char_length"):
+        return a.str.length()
+    if fn == "trim":
+        return a.str.strip()
+    if fn == "ltrim":
+        return a.str.lstrip()
+    if fn == "rtrim":
+        return a.str.rstrip()
+    if fn == "reverse":
+        return a.str.reverse()
+    if fn in ("substr", "substring"):
+        start = args[1] - 1  # SQL is 1-based
+        length = args[2] if len(args) > 2 else None
+        return a.str.substr(start, length)
+    if fn == "replace":
+        return a.str.replace(args[1], args[2])
+    if fn == "starts_with":
+        return a.str.startswith(args[1])
+    if fn == "ends_with":
+        return a.str.endswith(args[1])
+    if fn == "contains":
+        return a.str.contains(args[1])
+    if fn == "concat":
+        out = args[0]
+        for x in args[1:]:
+            out = out.str.concat(x)
+        return out
+    if fn == "split":
+        return a.str.split(args[1])
+    if fn in ("regexp_match",):
+        return a.str.match(args[1].params[0])
+    if fn in ("regexp_extract",):
+        return a.str.extract(args[1], 0)
+    if fn in ("year", "month", "day", "hour", "minute", "second", "quarter"):
+        return getattr(a.dt, fn)()
+    if fn == "day_of_week" or fn == "dayofweek":
+        return a.dt.day_of_week()
+    if fn == "date_trunc":
+        return args[1].dt.truncate(args[0].params[0])
+    if fn == "to_date":
+        return a.str.to_date(args[1].params[0] if len(args) > 1 else "%Y-%m-%d")
+    if fn == "if" or fn == "iif":
+        return a.if_else(args[1], args[2])
+    if fn == "greatest":
+        from ..functions import columns_max
+        return columns_max(*args)
+    if fn == "least":
+        from ..functions import columns_min
+        return columns_min(*args)
+    if fn == "hash":
+        return a.hash()
+    if fn == "row_number":
+        from ..functions import row_number
+        return row_number()
+    if fn == "rank":
+        from ..functions import rank
+        return rank()
+    if fn == "dense_rank":
+        from ..functions import dense_rank
+        return dense_rank()
+    if fn == "list_value_counts":
+        return a.list.value_counts()
+    raise ValueError(f"unknown SQL function {fn!r}")
+
+
+def _has_agg(e: Expression) -> bool:
+    return e.has_agg()
+
+
+def _split_join_condition(cond: Expression, left_scope: Scope,
+                          right_scope: Scope):
+    """ON clause → (left_on, right_on, residual_filter)."""
+    from ..logical.optimizer import split_conjuncts, combine_conjuncts
+    left_cols = set()
+    for a in left_scope.order:
+        left_cols.update(left_scope.tables[a].values())
+    right_cols = set()
+    for a in right_scope.order:
+        right_cols.update(right_scope.tables[a].values())
+    lo, ro, rest = [], [], []
+    for c in split_conjuncts(cond):
+        if c.op == "eq":
+            l, r = c.args
+            lc, rc = set(l.column_names()), set(r.column_names())
+            if lc <= left_cols and rc <= right_cols:
+                lo.append(l)
+                ro.append(r)
+                continue
+            if lc <= right_cols and rc <= left_cols:
+                lo.append(r)
+                ro.append(l)
+                continue
+        rest.append(c)
+    if not lo:
+        raise ValueError("join ON clause needs at least one equality "
+                         "between left and right columns")
+    residual = combine_conjuncts(rest) if rest else None
+    return lo, ro, residual
+
+
+def _rebind_order(e: Expression, proj: List[Expression]) -> Expression:
+    """ORDER BY may reference either output aliases or projected expressions."""
+    for p in proj:
+        if e.structurally_eq(p._unalias()) or e.structurally_eq(p):
+            return col(p.name())
+        if e.op == "col" and e.params[0] == p.name():
+            return e
+    return e

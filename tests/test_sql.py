@@ -1,0 +1,142 @@
+"""SQL frontend tests (reference model: ``tests/sql/``)."""
+
+import datetime
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col
+from daft_tpu.sql import SQLCatalog, sql, sql_expr
+
+
+@pytest.fixture
+def catalog():
+    t = dt.from_pydict({
+        "a": [1, 2, 3, 4], "b": ["x", "y", "x", "z"],
+        "c": [10.0, 20.0, 30.0, 40.0],
+        "d": [datetime.date(2020, 1, 1), datetime.date(2021, 6, 1),
+              datetime.date(2022, 3, 1), datetime.date(2020, 12, 25)]})
+    u = dt.from_pydict({"b": ["x", "y"], "w": [100, 200]})
+    return SQLCatalog({"t": t, "u": u})
+
+
+def test_basic_select(catalog):
+    out = sql("SELECT a, c FROM t WHERE a > 1 ORDER BY a", catalog)
+    assert out.to_pydict() == {"a": [2, 3, 4], "c": [20.0, 30.0, 40.0]}
+
+
+def test_select_star(catalog):
+    out = sql("SELECT * FROM t LIMIT 2", catalog)
+    assert out.column_names == ["a", "b", "c", "d"]
+    assert len(out.to_pydict()["a"]) == 2
+
+
+def test_arithmetic_and_alias(catalog):
+    out = sql("SELECT a + 1 AS a1, c * 2 AS c2 FROM t ORDER BY a1", catalog)
+    assert out.to_pydict() == {"a1": [2, 3, 4, 5], "c2": [20.0, 40.0, 60.0, 80.0]}
+
+
+def test_group_by_agg(catalog):
+    out = sql("SELECT b, sum(c) AS s, count(*) AS n FROM t GROUP BY b "
+              "ORDER BY b", catalog)
+    assert out.to_pydict() == {"b": ["x", "y", "z"], "s": [40.0, 20.0, 40.0],
+                               "n": [2, 1, 1]}
+
+
+def test_having(catalog):
+    out = sql("SELECT b, sum(c) AS s FROM t GROUP BY b HAVING sum(c) > 25 "
+              "ORDER BY b", catalog)
+    assert out.to_pydict()["b"] == ["x", "z"]
+
+
+def test_join(catalog):
+    out = sql("SELECT t.a, u.w FROM t JOIN u ON t.b = u.b ORDER BY a", catalog)
+    assert out.to_pydict() == {"a": [1, 2, 3], "w": [100, 200, 100]}
+
+
+def test_left_join_and_using(catalog):
+    out = sql("SELECT a, w FROM t LEFT JOIN u USING (b) ORDER BY a", catalog)
+    assert out.to_pydict()["w"] == [100, 200, 100, None]
+
+
+def test_case_when(catalog):
+    out = sql("SELECT CASE WHEN a >= 3 THEN 'big' ELSE 'small' END AS sz "
+              "FROM t ORDER BY a", catalog)
+    assert out.to_pydict() == {"sz": ["small", "small", "big", "big"]}
+
+
+def test_between_in_like(catalog):
+    assert sql("SELECT a FROM t WHERE a BETWEEN 2 AND 3 ORDER BY a",
+               catalog).to_pydict() == {"a": [2, 3]}
+    assert sql("SELECT a FROM t WHERE b IN ('x', 'z') ORDER BY a",
+               catalog).to_pydict() == {"a": [1, 3, 4]}
+    assert sql("SELECT a FROM t WHERE b LIKE 'x%' ORDER BY a",
+               catalog).to_pydict() == {"a": [1, 3]}
+
+
+def test_date_literal_and_extract(catalog):
+    out = sql("SELECT a FROM t WHERE d >= DATE '2021-01-01' ORDER BY a",
+              catalog)
+    assert out.to_pydict() == {"a": [2, 3]}
+    out2 = sql("SELECT EXTRACT(year FROM d) AS y FROM t ORDER BY y", catalog)
+    assert out2.to_pydict() == {"y": [2020, 2020, 2021, 2022]}
+
+
+def test_cast(catalog):
+    out = sql("SELECT CAST(a AS double) AS f FROM t LIMIT 1", catalog)
+    assert out.to_pydict() == {"f": [1.0]}
+
+
+def test_cte_and_subquery(catalog):
+    out = sql("WITH big AS (SELECT a, c FROM t WHERE a > 2) "
+              "SELECT sum(c) AS s FROM big", catalog)
+    assert out.to_pydict() == {"s": [70.0]}
+    out2 = sql("SELECT s.a FROM (SELECT a FROM t WHERE a < 3) s ORDER BY a",
+               catalog)
+    assert out2.to_pydict() == {"a": [1, 2]}
+
+
+def test_union(catalog):
+    out = sql("SELECT a FROM t WHERE a < 2 UNION ALL SELECT a FROM t "
+              "WHERE a > 3", catalog)
+    assert sorted(out.to_pydict()["a"]) == [1, 4]
+
+
+def test_distinct_and_functions(catalog):
+    out = sql("SELECT DISTINCT upper(b) AS ub FROM t ORDER BY ub", catalog)
+    assert out.to_pydict() == {"ub": ["X", "Y", "Z"]}
+    out2 = sql("SELECT count(DISTINCT b) AS n FROM t", catalog)
+    assert out2.to_pydict() == {"n": [3]}
+
+
+def test_scalar_select_no_from(catalog):
+    out = sql("SELECT 1 + 2 AS x", catalog)
+    assert out.to_pydict() == {"x": [3]}
+
+
+def test_sql_expr():
+    e = sql_expr("a + 1 > 3")
+    df = dt.from_pydict({"a": [1, 5]})
+    assert df.where(e).to_pydict() == {"a": [5]}
+
+
+def test_dataframe_where_string():
+    df = dt.from_pydict({"a": [1, 5, 10]})
+    assert df.where("a >= 5").to_pydict() == {"a": [5, 10]}
+
+
+def test_sql_binds_local_dataframes():
+    mytable = dt.from_pydict({"x": [1, 2, 3]})
+    out = sql("SELECT sum(x) AS s FROM mytable")
+    assert out.to_pydict() == {"s": [6]}
+
+
+def test_sql_tpch_q1_shape(catalog):
+    q = """
+    SELECT b, sum(c * (1 + a)) AS weighted, avg(c) AS avg_c
+    FROM t WHERE d <= DATE '2022-01-01'
+    GROUP BY b ORDER BY b
+    """
+    out = sql(q, catalog)
+    assert out.column_names == ["b", "weighted", "avg_c"]
+    assert out.to_pydict()["b"] == ["x", "y", "z"]

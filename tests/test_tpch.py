@@ -1,0 +1,170 @@
+"""TPC-H correctness: all 22 queries run; a subset is cross-checked against an
+independent pandas implementation on the same generated data
+(reference model: ``tests/integration/test_tpch.py`` vs dbgen answers).
+"""
+
+import datetime
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+sys.path.insert(0, "/root/repo")
+
+import daft_tpu as dt
+from benchmarking.tpch import queries as Q
+from benchmarking.tpch.datagen import generate_tpch
+
+
+@pytest.fixture(scope="module")
+def tpch(tmp_path_factory):
+    root = tmp_path_factory.mktemp("tpch")
+    generate_tpch(str(root), scale_factor=0.003, num_parts=3)
+    dfs = {}
+
+    def get_df(name: str):
+        if name not in dfs:
+            dfs[name] = dt.read_parquet(f"{root}/{name}/*.parquet")
+        return dfs[name]
+    return get_df
+
+
+@pytest.fixture(scope="module")
+def pdf(tpch):
+    return {name: tpch(name).to_pandas()
+            for name in ["lineitem", "orders", "customer", "supplier",
+                         "part", "partsupp", "nation", "region"]}
+
+
+@pytest.mark.parametrize("qnum", list(range(1, 23)))
+def test_queries_run(tpch, qnum):
+    out = Q.ALL[qnum](tpch).to_pydict()
+    assert isinstance(out, dict)
+
+
+def test_q1_vs_pandas(tpch, pdf):
+    got = Q.q1(tpch).to_pandas()
+    li = pdf["lineitem"]
+    f = li[li.l_shipdate <= pd.Timestamp(1998, 9, 2).date()].copy()
+    f["disc_price"] = f.l_extendedprice * (1 - f.l_discount)
+    f["charge"] = f.disc_price * (1 + f.l_tax)
+    exp = (f.groupby(["l_returnflag", "l_linestatus"], as_index=False)
+           .agg(sum_qty=("l_quantity", "sum"),
+                sum_base_price=("l_extendedprice", "sum"),
+                sum_disc_price=("disc_price", "sum"),
+                sum_charge=("charge", "sum"),
+                avg_qty=("l_quantity", "mean"),
+                avg_price=("l_extendedprice", "mean"),
+                avg_disc=("l_discount", "mean"),
+                count_order=("l_quantity", "count"))
+           .sort_values(["l_returnflag", "l_linestatus"])
+           .reset_index(drop=True))
+    assert list(got.l_returnflag) == list(exp.l_returnflag)
+    for c in ["sum_qty", "sum_base_price", "sum_disc_price", "sum_charge",
+              "avg_qty", "avg_price", "avg_disc"]:
+        np.testing.assert_allclose(got[c], exp[c], rtol=1e-9)
+    assert list(got.count_order) == list(exp.count_order)
+
+
+def test_q3_vs_pandas(tpch, pdf):
+    got = Q.q3(tpch).to_pandas()
+    c = pdf["customer"]
+    o = pdf["orders"]
+    l = pdf["lineitem"]
+    c = c[c.c_mktsegment == "BUILDING"]
+    cutoff = datetime.date(1995, 3, 15)
+    o = o[o.o_orderdate < cutoff]
+    l = l[l.l_shipdate > cutoff].copy()
+    j = c.merge(o, left_on="c_custkey", right_on="o_custkey") \
+         .merge(l, left_on="o_orderkey", right_on="l_orderkey")
+    j["volume"] = j.l_extendedprice * (1 - j.l_discount)
+    exp = (j.groupby(["o_orderkey", "o_orderdate", "o_shippriority"],
+                     as_index=False)
+           .agg(revenue=("volume", "sum"))
+           .sort_values(["revenue", "o_orderdate"], ascending=[False, True])
+           .head(10))
+    np.testing.assert_allclose(got.revenue, exp.revenue, rtol=1e-9)
+    assert list(got.o_orderkey) == list(exp.o_orderkey)
+
+
+def test_q5_vs_pandas(tpch, pdf):
+    got = Q.q5(tpch).to_pandas()
+    r = pdf["region"]; n = pdf["nation"]; s = pdf["supplier"]
+    li = pdf["lineitem"]; o = pdf["orders"]; c = pdf["customer"]
+    j = (r[r.r_name == "ASIA"]
+         .merge(n, left_on="r_regionkey", right_on="n_regionkey")
+         .merge(s, left_on="n_nationkey", right_on="s_nationkey")
+         .merge(li, left_on="s_suppkey", right_on="l_suppkey")
+         .merge(o[(o.o_orderdate >= datetime.date(1994, 1, 1))
+                  & (o.o_orderdate < datetime.date(1995, 1, 1))],
+                left_on="l_orderkey", right_on="o_orderkey")
+         .merge(c, left_on=["o_custkey", "s_nationkey"],
+                right_on=["c_custkey", "c_nationkey"]))
+    j["volume"] = j.l_extendedprice * (1 - j.l_discount)
+    exp = (j.groupby("n_name", as_index=False).agg(revenue=("volume", "sum"))
+           .sort_values("revenue", ascending=False))
+    assert list(got.n_name) == list(exp.n_name)
+    np.testing.assert_allclose(got.revenue, exp.revenue, rtol=1e-9)
+
+
+def test_q6_vs_pandas(tpch, pdf):
+    got = Q.q6(tpch).to_pydict()["revenue"][0]
+    li = pdf["lineitem"]
+    f = li[(li.l_shipdate >= datetime.date(1994, 1, 1))
+           & (li.l_shipdate < datetime.date(1995, 1, 1))
+           & (li.l_discount >= 0.05) & (li.l_discount <= 0.07)
+           & (li.l_quantity < 24)]
+    exp = (f.l_extendedprice * f.l_discount).sum()
+    assert got == pytest.approx(exp, rel=1e-9)
+
+
+def test_q10_vs_pandas(tpch, pdf):
+    got = Q.q10(tpch).to_pandas()
+    c = pdf["customer"]; o = pdf["orders"]; li = pdf["lineitem"]; n = pdf["nation"]
+    j = (c.merge(o[(o.o_orderdate >= datetime.date(1993, 10, 1))
+                   & (o.o_orderdate < datetime.date(1994, 1, 1))],
+                 left_on="c_custkey", right_on="o_custkey")
+         .merge(li[li.l_returnflag == "R"], left_on="o_orderkey",
+                right_on="l_orderkey")
+         .merge(n, left_on="c_nationkey", right_on="n_nationkey"))
+    j["volume"] = j.l_extendedprice * (1 - j.l_discount)
+    exp = (j.groupby(["c_custkey"], as_index=False)
+           .agg(revenue=("volume", "sum"))
+           .sort_values(["revenue", "c_custkey"], ascending=[False, True])
+           .head(20))
+    assert list(got.c_custkey) == list(exp.c_custkey)
+    np.testing.assert_allclose(got.revenue, exp.revenue, rtol=1e-9)
+
+
+def test_q12_vs_pandas(tpch, pdf):
+    got = Q.q12(tpch).to_pandas()
+    li = pdf["lineitem"]; o = pdf["orders"]
+    f = li[li.l_shipmode.isin(["MAIL", "SHIP"])
+           & (li.l_commitdate < li.l_receiptdate)
+           & (li.l_shipdate < li.l_commitdate)
+           & (li.l_receiptdate >= datetime.date(1994, 1, 1))
+           & (li.l_receiptdate < datetime.date(1995, 1, 1))]
+    j = o.merge(f, left_on="o_orderkey", right_on="l_orderkey")
+    j["high"] = j.o_orderpriority.isin(["1-URGENT", "2-HIGH"]).astype(int)
+    j["low"] = 1 - j.high
+    exp = (j.groupby("l_shipmode", as_index=False)
+           .agg(high_line_count=("high", "sum"), low_line_count=("low", "sum"))
+           .sort_values("l_shipmode"))
+    assert list(got.l_shipmode) == list(exp.l_shipmode)
+    assert list(got.high_line_count) == list(exp.high_line_count)
+    assert list(got.low_line_count) == list(exp.low_line_count)
+
+
+def test_q18_vs_pandas(tpch, pdf):
+    got = Q.q18(tpch).to_pandas()
+    li = pdf["lineitem"]; o = pdf["orders"]; c = pdf["customer"]
+    sums = li.groupby("l_orderkey", as_index=False).agg(
+        total_quantity=("l_quantity", "sum"))
+    big = sums[sums.total_quantity > 300]
+    j = (o.merge(big, left_on="o_orderkey", right_on="l_orderkey")
+         .merge(c, left_on="o_custkey", right_on="c_custkey"))
+    exp = j.sort_values(["o_totalprice", "o_orderdate"],
+                        ascending=[False, True]).head(100)
+    assert list(got.o_orderkey) == list(exp.o_orderkey)
+    np.testing.assert_allclose(got.total_quantity, exp.total_quantity)
