@@ -14,6 +14,11 @@ from .series import Series
 from .recordbatch import RecordBatch
 from .udf import udf  # after submodule import, rebind name to the decorator
 
+# Eager: the from-import must run at package init so the function binding
+# lands *after* the import machinery sets the `sql` submodule attribute
+# (otherwise `daft_tpu.sql` resolves to the module, not the callable).
+from .sql import sql, sql_expr
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -54,10 +59,25 @@ def __getattr__(name):
     if name == "Window":
         from .window import Window
         return Window
-    if name == "Catalog":
-        from .catalog import Catalog
-        return Catalog
+    if name in ("Catalog", "Table", "Identifier", "NotFoundError"):
+        from . import catalog as _cat
+        return getattr(_cat, name)
     if name == "Session":
         from .session import Session
         return Session
+    if name in _SESSION_VERBS:
+        from . import session as _sess
+        return getattr(_sess, name)
     raise AttributeError(f"module 'daft_tpu' has no attribute {name!r}")
+
+
+_SESSION_VERBS = frozenset((
+    "attach", "attach_catalog", "attach_table", "attach_function",
+    "detach_catalog", "detach_table", "detach_function", "create_namespace",
+    "create_namespace_if_not_exists", "create_table",
+    "create_table_if_not_exists", "create_temp_table", "drop_namespace",
+    "drop_table", "current_catalog", "current_namespace", "current_session",
+    "get_catalog", "get_table", "has_catalog", "has_namespace", "has_table",
+    "list_catalogs", "list_namespaces", "list_tables", "read_table",
+    "write_table", "set_catalog", "set_namespace", "use",
+))

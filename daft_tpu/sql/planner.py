@@ -116,8 +116,9 @@ class Scope:
 
 
 class SQLPlanner:
-    def __init__(self, tables: Dict[str, "object"]):
+    def __init__(self, tables: Dict[str, "object"], session=None):
         self.tables = {k.lower(): v for k, v in tables.items()}
+        self.session = session
         self.toks: List[Tok] = []
         self.i = 0
 
@@ -507,6 +508,10 @@ class SQLPlanner:
             scope.add(alias, sub.column_names)
             return sub
         name = self._next().text
+        # qualified names: cat.ns.table → single dotted lookup key
+        while self._peek().text == "." and self.toks[self.i + 1].kind == "ident":
+            self._next()
+            name += "." + self._next().text
         # table functions: read_parquet('...') etc.
         if self._peek().text == "(" and name.lower() in (
                 "read_parquet", "read_csv", "read_json"):
@@ -517,9 +522,18 @@ class SQLPlanner:
             df = getattr(_dt, name.lower())(path)
         else:
             key = name.lower()
-            if key not in ctes and key not in self.tables:
+            df = ctes[key] if key in ctes else self.tables.get(key)
+            if df is None and self.session is not None:
+                from ..catalog import NotFoundError
+                for candidate in (name, key):
+                    try:
+                        df = self.session.get_table(candidate).read()
+                        break
+                    except NotFoundError:
+                        pass
+            if df is None:
                 raise ValueError(f"unknown table {name!r}")
-            df = ctes.get(key) or self.tables[key]
+            name = name.rsplit(".", 1)[-1]
         alias = None
         if self._kw("AS"):
             alias = self._next().text
@@ -752,7 +766,19 @@ class SQLPlanner:
                 if not self._kw(","):
                     break
         self._expect(")")
-        return _apply_function(fn, args, distinct)
+        try:
+            return _apply_function(fn, args, distinct)
+        except ValueError as e:
+            # not a built-in: fall back to session-attached UDFs (built-ins
+            # keep precedence so attaching e.g. "sum" can't shadow SUM)
+            if (str(e).startswith("unknown SQL function")
+                    and self.session is not None
+                    and fn in self.session._functions):
+                if distinct:
+                    raise ValueError(
+                        f"DISTINCT is not supported for attached UDF {fn!r}")
+                return self.session._functions[fn](*args)
+            raise
 
 
 class _LenientScope:

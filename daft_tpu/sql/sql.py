@@ -35,7 +35,8 @@ def sql(query: str, catalog: Optional[SQLCatalog] = None, **kwargs):
         tables.update(catalog.tables)
     tables.update({k: v for k, v in kwargs.items()
                    if isinstance(v, DataFrame)})
-    return SQLPlanner(tables).plan_query(query)
+    from .. import session as _sess
+    return SQLPlanner(tables, session=_sess._SESSION).plan_query(query)
 
 
 def sql_expr(expr: str):

@@ -1,0 +1,170 @@
+"""Session / Catalog / Identifier / Table tests.
+
+Models the reference's tests/catalog/test_catalogs.py + session semantics
+(temp tables shadow catalogs, qualified SQL lookup, attach_function).
+"""
+
+import pytest
+
+import daft_tpu as daft
+from daft_tpu.catalog import Catalog, Identifier, InMemoryCatalog, NotFoundError, Table
+from daft_tpu.session import Session
+
+
+def test_identifier_basics():
+    i = Identifier("a", "b", "c")
+    assert len(i) == 3
+    assert str(i) == "a.b.c"
+    assert i[0] == "a" and i[-1] == "c"
+    assert Identifier.from_str("a.b.c") == i
+    assert i.drop(1) == Identifier("b", "c")
+    assert i + Identifier("d") == Identifier.from_str("a.b.c.d")
+    assert Identifier.from_sql('"Quoted".x') == Identifier("Quoted", "x")
+    with pytest.raises(ValueError):
+        i.drop(3)
+
+
+def test_catalog_from_pydict_and_verbs():
+    cat = Catalog.from_pydict({
+        "t1": {"x": [1, 2, 3]},
+        "ns.t2": {"y": ["a", "b"]},
+    }, name="mycat")
+    assert cat.name == "mycat"
+    assert cat.has_table("t1")
+    assert cat.has_table("ns.t2")
+    assert cat.has_namespace("ns")
+    assert [str(t) for t in cat.list_tables()] == ["ns.t2", "t1"]
+    df = cat.read_table("t1")
+    assert df.to_pydict() == {"x": [1, 2, 3]}
+    cat.drop_table("t1")
+    assert not cat.has_table("t1")
+    with pytest.raises(NotFoundError):
+        cat.get_table("t1")
+
+
+def test_catalog_create_table_from_schema_and_df():
+    cat = InMemoryCatalog("c")
+    df = daft.from_pydict({"a": [1, 2]})
+    t = cat.create_table("ns.tbl", df)
+    assert t.read().to_pydict() == {"a": [1, 2]}
+    t2 = cat.create_table("empty", df.schema())
+    assert t2.read().count_rows() == 0
+    # write modes on MemTable
+    t.append(daft.from_pydict({"a": [3]}))
+    assert t.read().to_pydict() == {"a": [1, 2, 3]}
+    t.overwrite(daft.from_pydict({"a": [9]}))
+    assert t.read().to_pydict() == {"a": [9]}
+    assert cat.create_table_if_not_exists("ns.tbl", df) is t
+
+
+def test_session_attach_and_temp_tables():
+    sess = Session()
+    cat = Catalog.from_pydict({"t": {"x": [1]}}, name="c1")
+    sess.attach(cat)
+    assert sess.list_catalogs() == ["c1"]
+    assert sess.current_catalog() is cat
+    sess.create_temp_table("tmp", {"y": [5, 6]})
+    assert sess.has_table("tmp")
+    assert sess.get_table("tmp").read().to_pydict() == {"y": [5, 6]}
+    # temp shadows catalog
+    sess.create_temp_table("t", {"x": [99]})
+    assert sess.get_table("t").read().to_pydict() == {"x": [99]}
+    sess.drop_table("t")
+    assert sess.get_table("t").read().to_pydict() == {"x": [1]}
+    # fully-qualified
+    assert sess.get_table("c1.t").read().to_pydict() == {"x": [1]}
+    sess.detach_catalog("c1")
+    assert sess.list_catalogs() == []
+    with pytest.raises(NotFoundError):
+        sess.get_catalog("c1")
+
+
+def test_session_namespaces_and_use():
+    sess = Session()
+    sess.attach_catalog(Catalog.from_pydict(
+        {"sales.orders": {"o": [1, 2, 3]}}, name="main"))
+    sess.use("main.sales")
+    assert str(sess.current_namespace()) == "sales"
+    assert sess.get_table("orders").read().count_rows() == 3
+
+
+def test_session_sql_resolution():
+    sess = Session()
+    sess.attach_catalog(Catalog.from_pydict({
+        "nums": {"v": [1, 2, 3, 4]},
+        "ns.qual": {"q": [10, 20]},
+    }, name="cat"))
+    sess.create_temp_table("tmp", {"v": [100]})
+    out = sess.sql("SELECT SUM(v) AS s FROM nums").to_pydict()
+    assert out == {"s": [10]}
+    out = sess.sql("SELECT v FROM tmp").to_pydict()
+    assert out == {"v": [100]}
+    out = sess.sql("SELECT q FROM cat.ns.qual ORDER BY q").to_pydict()
+    assert out == {"q": [10, 20]}
+
+
+def test_session_sql_attached_udf():
+    sess = Session()
+    sess.create_temp_table("t", {"x": [1, 2, 3]})
+
+    @daft.udf(return_dtype=daft.DataType.int64())
+    def double(c):
+        return [v * 2 for v in c.to_pylist()]
+
+    sess.attach_function(double, "double")
+    out = sess.sql("SELECT double(x) AS d FROM t ORDER BY d").to_pydict()
+    assert out == {"d": [2, 4, 6]}
+    sess.detach_function("double")
+    with pytest.raises(ValueError):
+        sess.sql("SELECT double(x) AS d FROM t")
+
+
+def test_sql_empty_cte_and_case_insensitive_session_lookup():
+    # empty CTE must not be treated as a missing table (truthiness bug)
+    t = daft.from_pydict({"x": [1, 2]})
+    out = daft.sql(
+        "WITH e AS (SELECT x FROM t WHERE x > 10) SELECT x FROM e", t=t
+    ).to_pydict()
+    assert out == {"x": []}
+    sess = Session()
+    sess.create_temp_table("mytab", {"w": [1]})
+    assert sess.sql("SELECT w FROM MYTAB").to_pydict() == {"w": [1]}
+
+
+def test_attached_udf_cannot_shadow_builtin():
+    sess = Session()
+    sess.create_temp_table("t", {"x": [1, 2, 3]})
+
+    @daft.udf(return_dtype=daft.DataType.int64())
+    def bad_sum(c):
+        return [0 for _ in c.to_pylist()]
+
+    sess.attach_function(bad_sum, "sum")
+    out = sess.sql("SELECT SUM(x) AS s FROM t").to_pydict()
+    assert out == {"s": [6]}  # built-in SUM wins
+
+
+def test_module_level_detach_function():
+    @daft.udf(return_dtype=daft.DataType.int64())
+    def inc(c):
+        return [v + 1 for v in c.to_pylist()]
+
+    daft.attach_function(inc, "inc_fn")
+    daft.create_temp_table("dt_t", {"x": [1]})
+    assert daft.sql("SELECT inc_fn(x) AS y FROM dt_t").to_pydict() == {"y": [2]}
+    daft.detach_function("inc_fn")
+    daft.drop_table("dt_t")
+
+
+def test_table_from_pydict_and_module_verbs():
+    t = Table.from_pydict("tt", {"z": [7]})
+    assert t.name == "tt"
+    assert t.read().to_pydict() == {"z": [7]}
+    # module-level ambient session verbs
+    daft.create_temp_table("ambient_t", {"w": [1, 2]})
+    assert daft.has_table("ambient_t")
+    assert daft.read_table("ambient_t").count_rows() == 2
+    out = daft.sql("SELECT w FROM ambient_t ORDER BY w DESC").to_pydict()
+    assert out == {"w": [2, 1]}
+    daft.drop_table("ambient_t")
+    assert not daft.has_table("ambient_t")
