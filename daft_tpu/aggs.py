@@ -93,6 +93,15 @@ def _global_one(op: str, s: Series, name: str, params) -> Series:
         v = (pc.min if op == "min" else pc.max)(arr).as_py() if len(arr) else None
         return Series.from_pylist([v], name, dtype=in_dtype)
     if op in ("count_distinct", "approx_count_distinct"):
+        if op == "approx_count_distinct":
+            from . import native
+            if native.AVAILABLE and not s.is_pyobject():
+                # HyperLogLog over native row hashes (reference: hyperloglog
+                # crate feeding approx_count_distinct in daft-core agg ops)
+                hashes = s.filter(s.not_null()).hash().to_numpy()
+                est = native.HyperLogLog().add_hashes(hashes).estimate()
+                return Series.from_pylist([int(round(est))], name,
+                                          dtype=DataType.uint64())
         v = pc.count_distinct(arr, mode="only_valid").as_py()
         return Series.from_pylist([v], name, dtype=DataType.uint64())
     if op == "any_value":

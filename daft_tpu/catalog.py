@@ -335,9 +335,12 @@ class InMemoryCatalog(Catalog):
     def _drop_namespace(self, ident: Identifier) -> None:
         if ident not in self._namespaces:
             raise NotFoundError(f"namespace {ident} not found")
-        self._namespaces.discard(ident)
+        # drop the namespace, any child namespaces, and all tables under them
+        pfx = tuple(ident)
+        self._namespaces = {ns for ns in self._namespaces
+                            if tuple(ns[:len(pfx)]) != pfx}
         self._tables = {k: v for k, v in self._tables.items()
-                        if tuple(k[:len(ident)]) != tuple(ident)}
+                        if tuple(k[:len(pfx)]) != pfx}
 
     def _drop_table(self, ident: Identifier) -> None:
         if ident not in self._tables:

@@ -250,6 +250,9 @@ def _eval(e: Expression, cols: Dict[str, Series], n: int) -> Series:
                                  kids[0].name())
     if op == "hash":
         return kids[0].hash(kids[1] if len(kids) > 1 else None)
+    if op == "minhash":
+        num_hashes, ngram_size, seed = e.params
+        return kids[0].minhash(num_hashes, ngram_size, seed)
     if op == "udf":
         u, arg_spec, kw_spec = e.params
         out = u.run(kids, arg_spec, kw_spec, max_len)

@@ -387,9 +387,14 @@ class RecordBatch:
         return parts, pvalues
 
     def _split_by_pid(self, pid: np.ndarray, n: int) -> List["RecordBatch"]:
-        order = np.argsort(pid, kind="stable")
+        from . import native
+        if native.AVAILABLE:
+            # single-pass C++ counting sort → gather list (stable)
+            counts, order = native.fanout_pid(pid, n)
+        else:
+            order = np.argsort(pid, kind="stable")
+            counts = np.bincount(pid, minlength=n)
         sorted_batch = self.take(order)
-        counts = np.bincount(pid, minlength=n)
         offsets = np.concatenate([[0], np.cumsum(counts)])
         return [sorted_batch.slice(int(offsets[i]), int(offsets[i + 1]))
                 for i in range(n)]

@@ -265,6 +265,39 @@ class Series:
             h = _splitmix64(h ^ sv)
         return Series(self._name, DataType.uint64(), arrow=pa.array(h))
 
+    def minhash(self, num_hashes: int, ngram_size: int = 1,
+                seed: int = 1) -> "Series":
+        """MinHash signature per string row → fixed_size_list<uint32>[num_hashes].
+
+        Reference capability: ``src/daft-minhash/src/lib.rs`` (word shingles,
+        k universal-hash permutations, per-permutation minimum). Native C++
+        path in ``daft_tpu/native``; Python fallback keeps the same contract.
+        """
+        if not self._dtype.is_string():
+            raise ValueError(f"minhash expects a string column, got {self._dtype!r}")
+        from . import native
+        arr = self.to_arrow().cast(pa.large_binary())
+        bufs = arr.buffers()
+        offsets = np.frombuffer(bufs[1], dtype=np.int64, count=len(arr) + 1,
+                                offset=arr.offset * 8)
+        data = np.frombuffer(bufs[2], dtype=np.uint8) if bufs[2] is not None \
+            else np.empty(0, dtype=np.uint8)
+        valid = np.asarray(pc.is_valid(arr).to_numpy(zero_copy_only=False),
+                           dtype=np.bool_)
+        if native.AVAILABLE:
+            sig = native.minhash(offsets, data, valid, num_hashes,
+                                 ngram_size, seed)
+        else:
+            sig = _minhash_fallback(self.to_pylist(), num_hashes,
+                                    ngram_size, seed)
+        flat = pa.array(sig.ravel(), type=pa.uint32())
+        out = pa.FixedSizeListArray.from_arrays(flat, num_hashes)
+        if not valid.all():
+            mask = pa.array(~valid)
+            out = pc.if_else(mask, pa.nulls(len(self), out.type), out)
+        return Series(self._name, DataType.fixed_size_list(
+            DataType.uint32(), num_hashes), arrow=out)
+
     # ---- repr ------------------------------------------------------------
     def __repr__(self):
         preview = self.to_pylist()[:10]
@@ -295,15 +328,19 @@ def _hash_array(s: Series) -> np.ndarray:
     dt = s.dtype
     valid = np.asarray(pc.is_valid(arr).to_numpy(zero_copy_only=False), dtype=np.bool_)
     if dt.is_string() or dt.is_binary():
-        # vectorized FNV-1a over the flat byte buffer using offsets
-        if not isinstance(arr, (pa.LargeStringArray, pa.LargeBinaryArray)):
-            arr = arr.cast(pa.large_binary())
         enc = arr.cast(pa.large_binary())
         buffers = enc.buffers()
         offsets = np.frombuffer(buffers[1], dtype=np.int64,
                                 count=len(enc) + 1, offset=enc.offset * 8)
         data = np.frombuffer(buffers[2], dtype=np.uint8) if buffers[2] is not None \
             else np.empty(0, dtype=np.uint8)
+        from . import native
+        if native.AVAILABLE:
+            # C++ xxh64 per row (reference hash.rs path is native too)
+            out = native.hash_var(offsets, data, valid)
+            out[~valid] = np.uint64(0x6E756C6C)
+            return out
+        # numpy fallback: vectorized FNV-1a over the flat byte buffer
         out = np.full(n, _FNV_OFFSET, dtype=np.uint64)
         lengths = offsets[1:] - offsets[:-1]
         maxlen = int(lengths.max()) if n else 0
@@ -330,4 +367,44 @@ def _hash_array(s: Series) -> np.ndarray:
             out = np.array([np.uint64(hash(v) & 0xFFFFFFFFFFFFFFFF)
                             for v in vals], dtype=np.uint64)
     out[~valid] = np.uint64(0x6E756C6C)  # b"null"
+    return out
+
+
+def _minhash_fallback(values, num_hashes: int, ngram_size: int,
+                      seed: int) -> np.ndarray:
+    """Pure-python minhash with the same shingle/permutation contract as the
+    native kernel. Shingles are hashed with FNV-1a (deterministic across
+    processes and runs — Python's builtin hash() is randomized per process
+    and would make signatures incomparable between workers)."""
+    p = (1 << 61) - 1
+    st = seed or 1
+    def nxt():
+        nonlocal st
+        st ^= (st << 13) & 0xFFFFFFFFFFFFFFFF
+        st ^= st >> 7
+        st ^= (st << 17) & 0xFFFFFFFFFFFFFFFF
+        return st
+    a = [nxt() % (p - 1) + 1 for _ in range(num_hashes)]
+    b = [nxt() % p for _ in range(num_hashes)]
+    def fnv1a(bs: bytes) -> int:
+        h = 14695981039346656037
+        for byte in bs:
+            h = ((h ^ byte) * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+        return h
+    out = np.full((len(values), num_hashes), 0xFFFFFFFF, dtype=np.uint32)
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        words = v.split()
+        if not words:
+            continue
+        nsh = max(1, len(words) - ngram_size + 1)
+        for s in range(nsh):
+            sh = " ".join(words[s:s + ngram_size])
+            hv = fnv1a(sh.encode("utf-8")) & p
+            for j in range(num_hashes):
+                ph = (a[j] * hv + b[j]) % p
+                val = np.uint32(ph & 0xFFFFFFFF)
+                if val < out[i, j]:
+                    out[i, j] = val
     return out

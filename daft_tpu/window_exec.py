@@ -109,7 +109,8 @@ def _segment_ids(keys: List[Series]) -> np.ndarray:
     for k in keys:
         vals = k.to_numpy()
         if vals.dtype == object:
-            cur = np.array([v != w for v, w in zip(vals[1:], vals[:-1])])
+            cur = np.array([v != w for v, w in zip(vals[1:], vals[:-1])],
+                           dtype=bool)
         else:
             a, b = vals[1:], vals[:-1]
             with np.errstate(invalid="ignore"):

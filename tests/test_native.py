@@ -1,0 +1,154 @@
+"""Native C++ kernel library tests (hashing, fanout, minhash, HLL, probe).
+
+Mirrors the reference's Rust unit tests for daft-hash / daft-minhash /
+hyperloglog and the recordbatch partition kernels.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import daft_tpu as daft
+from daft_tpu import native
+from daft_tpu.series import Series
+
+
+requires_native = pytest.mark.skipif(not native.AVAILABLE,
+                                     reason="native lib unavailable")
+
+
+@requires_native
+def test_xxh64_known_vectors():
+    # spec test vectors for xxh64 (seed 0): empty and "Hello, world!"
+    import ctypes
+    empty = np.empty(0, dtype=np.uint8)
+    h_empty = native._lib.dn_xxh64(
+        empty.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), 0, 0)
+    assert h_empty == 0xEF46DB3751D8E999
+    msg = np.frombuffer(b"Hello, world!", dtype=np.uint8)
+    h = native._lib.dn_xxh64(
+        msg.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(msg), 0)
+    assert h == 0xF58336A78B6F9476
+
+
+@requires_native
+def test_hash_var_and_fixed_consistency():
+    off = np.array([0, 3, 6, 9], dtype=np.int64)
+    data = np.frombuffer(b"abcxyzabc", dtype=np.uint8)
+    h = native.hash_var(off, data, None)
+    assert h[0] == h[2] and h[0] != h[1]
+    hf = native.hash_fixed(np.array([7, 8, 7], dtype=np.int64), None)
+    assert hf[0] == hf[2] and hf[0] != hf[1]
+    # null rows hash to the null marker regardless of payload
+    valid = np.array([1, 0, 1], dtype=np.uint8)
+    h2 = native.hash_var(off, data, valid)
+    assert h2[0] == h[0] and h2[1] != h[1]
+
+
+@requires_native
+def test_fanout_hash_covers_all_rows():
+    h = native.hash_fixed(np.arange(1000, dtype=np.int64), None)
+    counts, idx = native.fanout_hash(h, 7)
+    assert counts.sum() == 1000
+    assert sorted(idx.tolist()) == list(range(1000))
+    # same key -> same partition
+    h2 = native.hash_fixed(np.array([5, 5], dtype=np.int64), None)
+    c2, _ = native.fanout_hash(h2, 7)
+    assert (c2 > 0).sum() == 1
+
+
+def test_series_hash_groups_equal_values():
+    s = Series.from_pylist(["foo", "bar", "foo", None, None], "s")
+    h = s.hash().to_pylist()
+    assert h[0] == h[2] and h[0] != h[1]
+    assert h[3] == h[4]
+
+
+def test_partition_by_hash_recordbatch():
+    b = daft.RecordBatch.from_pydict(
+        {"k": ["a", "b", "a", "c", "b", "a"], "v": [1, 2, 3, 4, 5, 6]})
+    from daft_tpu import col
+    parts = b.partition_by_hash([col("k")], 4)
+    assert sum(len(p) for p in parts) == 6
+    # all rows of one key land in one partition
+    for key in ("a", "b", "c"):
+        holders = [i for i, p in enumerate(parts)
+                   if key in p.to_pydict()["k"]]
+        assert len(holders) == 1
+
+
+def test_minhash_series_and_expression():
+    s = Series.from_pylist(
+        ["the quick brown fox", "the quick brown fox", "lorem ipsum dolor",
+         None], "txt")
+    sig = s.minhash(num_hashes=16, ngram_size=2)
+    assert sig.datatype() == daft.DataType.fixed_size_list(
+        daft.DataType.uint32(), 16)
+    rows = sig.to_pylist()
+    assert rows[0] == rows[1]        # identical text -> identical signature
+    assert rows[0] != rows[2]
+    assert rows[3] is None           # null in -> null out
+    # expression surface
+    from daft_tpu import col
+    df = daft.from_pydict({"t": ["a b c", "a b c", "x y z"]})
+    out = df.select(col("t").minhash(num_hashes=8, ngram_size=1)).to_pydict()
+    assert out["t"][0] == out["t"][1]
+    assert out["t"][0] != out["t"][2]
+
+
+@requires_native
+def test_minhash_jaccard_correlation():
+    # signature agreement should track true jaccard similarity
+    a = "w1 w2 w3 w4 w5 w6 w7 w8"
+    b = "w1 w2 w3 w4 w5 w6 xx yy"   # high overlap
+    c = "z1 z2 z3 z4 z5 z6 z7 z8"   # no overlap
+    s = Series.from_pylist([a, b, c], "t")
+    m = np.array(s.minhash(num_hashes=128, ngram_size=1).to_pylist())
+    sim_ab = (m[0] == m[1]).mean()
+    sim_ac = (m[0] == m[2]).mean()
+    assert sim_ab > 0.4
+    assert sim_ac < 0.15
+
+
+@requires_native
+def test_hyperloglog_accuracy_and_merge():
+    h1 = native.hash_fixed(np.arange(0, 60000, dtype=np.int64), None)
+    h2 = native.hash_fixed(np.arange(40000, 100000, dtype=np.int64), None)
+    a = native.HyperLogLog().add_hashes(h1)
+    b = native.HyperLogLog().add_hashes(h2)
+    est_a = a.estimate()
+    assert abs(est_a - 60000) / 60000 < 0.03
+    a.merge(b)
+    est = a.estimate()
+    assert abs(est - 100000) / 100000 < 0.03
+
+
+def test_approx_count_distinct_agg():
+    import random
+    random.seed(0)
+    vals = [random.randrange(5000) for _ in range(20000)]
+    truth = len(set(vals))
+    df = daft.from_pydict({"x": vals})
+    from daft_tpu import col
+    out = df.agg(col("x").approx_count_distinct()).to_pydict()
+    est = out["x"][0]
+    assert abs(est - truth) / truth < 0.05
+
+
+@requires_native
+def test_probe_table_pairs():
+    build = np.array([1, 2, 3, 2], dtype=np.int64)
+    probe = np.array([2, 4, 1], dtype=np.int64)
+    pt = native.ProbeTable(native.hash_fixed(build, None))
+    pi, bi = pt.probe(native.hash_fixed(probe, None))
+    pairs = sorted(zip(pi.tolist(), bi.tolist()))
+    assert pairs == [(0, 1), (0, 3), (2, 0)]
+
+
+@requires_native
+def test_murmur3_known_vector():
+    import ctypes
+    msg = np.frombuffer(b"hello", dtype=np.uint8)
+    h = native._lib.dn_murmur3_32(
+        msg.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), 5, 0)
+    assert h == 0x248BFA47  # public murmur3_x86_32 test vector
