@@ -40,6 +40,10 @@ def __getattr__(name):
     if name in ("read_parquet", "read_csv", "read_json"):
         from . import io as _io
         return getattr(_io, name)
+    if name in ("IOConfig", "S3Config", "GCSConfig", "AzureConfig",
+                "HTTPConfig"):
+        from .io import object_io as _oio
+        return getattr(_oio, name)
     if name == "sql":
         from .sql import sql
         return sql

@@ -303,6 +303,18 @@ class Expression:
     def partitioning(self) -> "ExpressionPartitioningNamespace":
         return ExpressionPartitioningNamespace(self)
 
+    @property
+    def binary(self) -> "ExpressionBinaryNamespace":
+        return ExpressionBinaryNamespace(self)
+
+    @property
+    def json(self) -> "ExpressionJsonNamespace":
+        return ExpressionJsonNamespace(self)
+
+    @property
+    def url(self) -> "ExpressionUrlNamespace":
+        return ExpressionUrlNamespace(self)
+
     # -- schema ------------------------------------------------------------
     def to_field(self, schema: Schema) -> Field:
         from .typing import infer_field
@@ -462,6 +474,43 @@ class ExpressionImageNamespace(_Ns):
     def resize(self, w: int, h: int): return self._f("image.resize", (), (w, h))
     def crop(self, bbox): return self._f("image.crop", (Expression._to_expression(bbox),))
     def to_mode(self, mode: str): return self._f("image.to_mode", (), (mode,))
+
+
+class ExpressionBinaryNamespace(_Ns):
+    """Reference surface: ``src/daft-functions-binary`` (concat/slice/encode)."""
+
+    def concat(self, other): return self._f("binary.concat", (other,))
+    def length(self): return self._f("binary.length")
+    def slice(self, start, length=None):
+        return self._f("binary.slice", (start, length))
+    def encode(self, codec: str): return self._f("binary.encode", (), (codec,))
+    def decode(self, codec: str): return self._f("binary.decode", (), (codec,))
+    def try_encode(self, codec: str):
+        return self._f("binary.try_encode", (), (codec,))
+    def try_decode(self, codec: str):
+        return self._f("binary.try_decode", (), (codec,))
+
+
+class ExpressionJsonNamespace(_Ns):
+    """Reference surface: ``src/daft-functions-json`` (jq-style ``query``)."""
+
+    def query(self, jq: str): return self._f("json.query", (), (jq,))
+
+
+class ExpressionUrlNamespace(_Ns):
+    """Reference surface: ``src/daft-functions-uri`` (url.download / url.upload)."""
+
+    def download(self, max_connections: int = 32, on_error: str = "raise",
+                 io_config=None):
+        return self._f("url.download", (), (max_connections, on_error, io_config))
+
+    def upload(self, location, max_connections: int = 32, on_error: str = "raise",
+               io_config=None):
+        return self._f("url.upload", (Expression._to_expression(location),),
+                       (max_connections, on_error, io_config))
+
+    def parse(self):
+        return self._f("url.parse")
 
 
 class ExpressionPartitioningNamespace(_Ns):

@@ -80,7 +80,227 @@ def eval_function(op: str, e, kids: List[Series], b, out_field: Field) -> Series
         return eval_image_fn(fn, e, kids, out_field)
     if ns == "partitioning":
         return _partitioning_fn(fn, e, s, out_field)
+    if ns == "binary":
+        return _binary_fn(fn, e, kids, b, out_field)
+    if ns == "json":
+        return _json_fn(fn, e, s, out_field)
+    if ns == "url":
+        return _url_fn(fn, e, kids, b, out_field)
     raise NotImplementedError(f"host function {op}")
+
+
+def _binary_fn(fn, e, kids, b, out_field) -> Series:
+    """Reference: ``src/daft-functions-binary`` (concat/slice/encode/decode)."""
+    s = kids[0]
+    name = s.name()
+    arr = s.to_arrow().cast(pa.large_binary())
+    if fn == "concat":
+        other = b(kids[1]).to_arrow().cast(pa.large_binary())
+        return Series.from_arrow(
+            pc.binary_join_element_wise(
+                arr, other, pa.scalar(b"", type=pa.large_binary())), name)
+    if fn == "length":
+        return Series.from_arrow(pc.binary_length(arr).cast(pa.uint64()), name)
+    if fn == "slice":
+        start = b(kids[1]).to_pylist()
+        length = b(kids[2]).to_pylist() if len(kids) > 2 else [None] * len(s)
+        out = []
+        for v, st, ln in zip(s.to_pylist(), start, length):
+            if v is None or st is None:
+                out.append(None)
+            else:
+                end = None if ln is None else st + ln
+                out.append(bytes(v)[st:end])
+        return Series.from_pylist(out, name, dtype=out_field.dtype)
+    if fn in ("encode", "decode", "try_encode", "try_decode"):
+        codec = e.params[0]
+        lenient = fn.startswith("try_")
+        decode = "decode" in fn
+        out = []
+        for v in s.to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            try:
+                out.append(_codec_apply(bytes(v), codec, decode))
+            except Exception:
+                if lenient:
+                    out.append(None)
+                else:
+                    raise
+        return Series.from_pylist(out, name, dtype=out_field.dtype)
+    raise NotImplementedError(f"binary.{fn}")
+
+
+def _codec_apply(data: bytes, codec: str, decode: bool):
+    import base64
+    import gzip
+    import zlib
+    if codec == "base64":
+        return (base64.b64decode(data, validate=True) if decode
+                else base64.b64encode(data))
+    if codec == "hex":
+        return bytes.fromhex(data.decode()) if decode else data.hex().encode()
+    if codec == "gzip":
+        return gzip.decompress(data) if decode else gzip.compress(data)
+    if codec == "zlib":
+        return zlib.decompress(data) if decode else zlib.compress(data)
+    if codec == "deflate":
+        if decode:
+            return zlib.decompress(data, wbits=-zlib.MAX_WBITS)
+        c = zlib.compressobj(wbits=-zlib.MAX_WBITS)
+        return c.compress(data) + c.flush()
+    if codec in ("utf-8", "utf8"):
+        return data.decode("utf-8") if decode else data
+    raise ValueError(f"unsupported codec {codec!r}")
+
+
+def _json_fn(fn, e, s: Series, out_field) -> Series:
+    """jq-style path queries (reference: ``src/daft-functions-json`` via jaq).
+
+    Supported filter subset: ``.``, ``.field``, ``.field1.field2``,
+    ``.field[idx]``, ``.[idx]``, ``.field[]`` (array iteration → JSON array),
+    and pipes ``f1 | f2``.
+    """
+    import json as _json
+    if fn != "query":
+        raise NotImplementedError(f"json.{fn}")
+    query = e.params[0]
+    out = []
+    for v in s.to_pylist():
+        if v is None:
+            out.append(None)
+            continue
+        try:
+            doc = _json.loads(v)
+            results, iterated = _jq_apply(doc, query)
+        except Exception:
+            out.append(None)
+            continue
+        if iterated:
+            # array iteration contract: always a JSON array, even for 0/1 hits
+            out.append(_json.dumps(results) if results else None)
+        elif not results:
+            out.append(None)
+        else:
+            r = results[0]
+            out.append(r if isinstance(r, str)
+                       else (None if r is None else _json.dumps(r)))
+    return Series.from_pylist(out, s.name(), dtype=out_field.dtype)
+
+
+def _jq_apply(doc, query: str):
+    values = [doc]
+    iterated = "[]" in query
+    for stage in (p.strip() for p in query.split("|")):
+        if stage in (".", ""):
+            continue
+        next_vals = []
+        for val in values:
+            next_vals.extend(_jq_stage(val, stage))
+        values = next_vals
+    return values, iterated
+
+
+def _jq_stage(val, stage: str):
+    # tokenize a path like .a.b[0].c[] into steps
+    steps = re.findall(r"\.(?:[A-Za-z_][A-Za-z0-9_]*)?|\[-?\d*\]", stage)
+    cur = [val]
+    for step in steps:
+        nxt = []
+        for v in cur:
+            if v is None:
+                nxt.append(None)
+            elif step == ".":
+                nxt.append(v)
+            elif step.startswith("."):
+                key = step[1:]
+                nxt.append(v.get(key) if isinstance(v, dict) else None)
+            elif step == "[]":
+                if isinstance(v, list):
+                    nxt.extend(v)
+            else:
+                idx = int(step[1:-1])
+                nxt.append(v[idx] if isinstance(v, list)
+                           and -len(v) <= idx < len(v) else None)
+        cur = nxt
+    return cur
+
+
+def _url_fn(fn, e, kids, b, out_field) -> Series:
+    """Reference: ``src/daft-functions-uri`` — async multi-get through
+    daft-io inside expression eval. Host equivalent: IOClient + thread pool
+    bounded at ``max_connections``."""
+    import concurrent.futures as cf
+    import urllib.parse as _up
+
+    from ..io.object_io import get_io_client
+
+    s = kids[0]
+    name = s.name()
+    if fn == "parse":
+        out = []
+        for v in s.to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            try:
+                p = _up.urlparse(v)
+                out.append({"scheme": p.scheme, "host": p.hostname,
+                            "port": p.port, "path": p.path,
+                            "query": p.query, "fragment": p.fragment})
+            except ValueError:  # e.g. non-numeric port — null the row
+                out.append(None)
+        return Series.from_pylist(out, name, dtype=out_field.dtype)
+
+    max_conn, on_error, io_config = e.params[0], e.params[1], e.params[2]
+    client = get_io_client(io_config)
+
+    if fn == "download":
+        urls = s.to_pylist()
+
+        def fetch(u):
+            if u is None:
+                return None
+            try:
+                return client.get(u)
+            except Exception:
+                if on_error == "null":
+                    return None
+                raise
+
+        with cf.ThreadPoolExecutor(max_workers=max(1, max_conn)) as pool:
+            out = list(pool.map(fetch, urls))
+        return Series.from_pylist(out, name, dtype=out_field.dtype)
+
+    if fn == "upload":
+        data = s.to_pylist()
+        locations = b(kids[1]).to_pylist()
+
+        import uuid
+
+        def push(args):
+            i, (blob, loc) = args
+            if blob is None or loc is None:
+                return None
+            if isinstance(blob, str):
+                blob = blob.encode()
+            # uuid per row: unique across partitions/workers (the reference
+            # names uploaded objects the same way)
+            path = loc.rstrip("/") + f"/{uuid.uuid4().hex}"
+            try:
+                client.put(path, bytes(blob))
+            except Exception:
+                if on_error == "null":
+                    return None
+                raise
+            return path
+
+        with cf.ThreadPoolExecutor(max_workers=max(1, max_conn)) as pool:
+            out = list(pool.map(push, enumerate(zip(data, locations))))
+        return Series.from_pylist(out, name, dtype=out_field.dtype)
+
+    raise NotImplementedError(f"url.{fn}")
 
 
 def _str_fn(fn, e, kids, b, out_field) -> Series:

@@ -349,6 +349,30 @@ def _function_field(op: str, e, child_fields, schema: Schema) -> Field:
                 return Field(name, DataType.image(e.params[0]))
             return Field(name, DataType.image(f.dtype.image_mode
                                               if f.dtype.is_image() else None))
+    if ns == "binary":
+        if fn == "length":
+            return Field(name, DataType.uint64())
+        if fn in ("encode", "try_encode"):
+            # utf-8 "encodes" bytes→text in the reference's codec table
+            return Field(name, DataType.binary())
+        if fn in ("decode", "try_decode"):
+            codec = e.params[0]
+            return Field(name, DataType.string() if codec == "utf-8"
+                         else DataType.binary())
+        return Field(name, DataType.binary())
+    if ns == "json":
+        if fn == "query":
+            return Field(name, DataType.string())
+    if ns == "url":
+        if fn == "download":
+            return Field(name, DataType.binary())
+        if fn == "upload":
+            return Field(name, DataType.string())
+        if fn == "parse":
+            return Field(name, DataType.struct({
+                "scheme": DataType.string(), "host": DataType.string(),
+                "port": DataType.int32(), "path": DataType.string(),
+                "query": DataType.string(), "fragment": DataType.string()}))
     if ns == "partitioning":
         if fn in ("days",):
             return Field(name, DataType.date())

@@ -33,8 +33,23 @@ _lib = None
 
 
 def _src_hash() -> str:
+    """Staleness stamp = source sha256 + host ISA fingerprint. The ISA part
+    matters because we compile with -march=native: a .so carried to an older
+    CPU (image copy, shared home dir) would SIGILL, so it must be rebuilt."""
+    h = hashlib.sha256()
     with open(_SRC, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()
+        h.update(f.read())
+    import platform
+    h.update(platform.machine().encode())
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    h.update(line.encode())
+                    break
+    except OSError:
+        pass
+    return h.hexdigest()
 
 
 def _build(src_hash: str) -> bool:

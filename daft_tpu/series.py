@@ -370,41 +370,116 @@ def _hash_array(s: Series) -> np.ndarray:
     return out
 
 
+_XXH_P1 = 11400714785074694791
+_XXH_P2 = 14029467366897019727
+_XXH_P3 = 1609587929392839161
+_XXH_P4 = 9650029242287828579
+_XXH_P5 = 2870177450012600261
+_U64 = (1 << 64) - 1
+
+
+def _rotl64(x: int, r: int) -> int:
+    return ((x << r) | (x >> (64 - r))) & _U64
+
+
+def _xxh64_py(data: bytes, seed: int) -> int:
+    """Pure-python XXH64 — bit-identical to the native kernel's xxh64
+    (``daft_tpu/native/src/kernels.cpp``) so fallback and native minhash
+    signatures are comparable across a mixed fleet."""
+    n, i = len(data), 0
+    if n >= 32:
+        v1 = (seed + _XXH_P1 + _XXH_P2) & _U64
+        v2 = (seed + _XXH_P2) & _U64
+        v3 = seed & _U64
+        v4 = (seed - _XXH_P1) & _U64
+        while i <= n - 32:
+            v1 = (_rotl64((v1 + int.from_bytes(data[i:i+8], "little")
+                           * _XXH_P2) & _U64, 31) * _XXH_P1) & _U64
+            v2 = (_rotl64((v2 + int.from_bytes(data[i+8:i+16], "little")
+                           * _XXH_P2) & _U64, 31) * _XXH_P1) & _U64
+            v3 = (_rotl64((v3 + int.from_bytes(data[i+16:i+24], "little")
+                           * _XXH_P2) & _U64, 31) * _XXH_P1) & _U64
+            v4 = (_rotl64((v4 + int.from_bytes(data[i+24:i+32], "little")
+                           * _XXH_P2) & _U64, 31) * _XXH_P1) & _U64
+            i += 32
+        h = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12)
+             + _rotl64(v4, 18)) & _U64
+        for v in (v1, v2, v3, v4):
+            h ^= (_rotl64((v * _XXH_P2) & _U64, 31) * _XXH_P1) & _U64
+            h = ((h * _XXH_P1) + _XXH_P4) & _U64
+    else:
+        h = (seed + _XXH_P5) & _U64
+    h = (h + n) & _U64
+    while i + 8 <= n:
+        k = (_rotl64((int.from_bytes(data[i:i+8], "little") * _XXH_P2) & _U64,
+                     31) * _XXH_P1) & _U64
+        h = ((_rotl64(h ^ k, 27) * _XXH_P1) + _XXH_P4) & _U64
+        i += 8
+    if i + 4 <= n:
+        h ^= (int.from_bytes(data[i:i+4], "little") * _XXH_P1) & _U64
+        h = ((_rotl64(h, 23) * _XXH_P2) + _XXH_P3) & _U64
+        i += 4
+    while i < n:
+        h ^= (data[i] * _XXH_P5) & _U64
+        h = (_rotl64(h, 11) * _XXH_P1) & _U64
+        i += 1
+    h ^= h >> 33
+    h = (h * _XXH_P2) & _U64
+    h ^= h >> 29
+    h = (h * _XXH_P3) & _U64
+    h ^= h >> 32
+    return h
+
+
 def _minhash_fallback(values, num_hashes: int, ngram_size: int,
                       seed: int) -> np.ndarray:
-    """Pure-python minhash with the same shingle/permutation contract as the
-    native kernel. Shingles are hashed with FNV-1a (deterministic across
-    processes and runs — Python's builtin hash() is randomized per process
-    and would make signatures incomparable between workers)."""
+    """Pure-python minhash, bit-identical to the native ``dn_minhash`` kernel:
+    same xorshift permutation coefficients, same ASCII-whitespace word split,
+    and the same xxh64(seed=42) over the raw byte span of each shingle
+    (original separators included) — so signatures from native and fallback
+    workers compare correctly."""
     p = (1 << 61) - 1
     st = seed or 1
     def nxt():
         nonlocal st
-        st ^= (st << 13) & 0xFFFFFFFFFFFFFFFF
+        st ^= (st << 13) & _U64
         st ^= st >> 7
-        st ^= (st << 17) & 0xFFFFFFFFFFFFFFFF
+        st ^= (st << 17) & _U64
         return st
-    a = [nxt() % (p - 1) + 1 for _ in range(num_hashes)]
-    b = [nxt() % p for _ in range(num_hashes)]
-    def fnv1a(bs: bytes) -> int:
-        h = 14695981039346656037
-        for byte in bs:
-            h = ((h ^ byte) * 1099511628211) & 0xFFFFFFFFFFFFFFFF
-        return h
+    # interleaved draws, matching the native kernel's per-j (a, b) order
+    a, b = [], []
+    for _ in range(num_hashes):
+        a.append(nxt() % (p - 1) + 1)
+        b.append(nxt() % p)
+    ws = (0x20, 0x09, 0x0A, 0x0D)
     out = np.full((len(values), num_hashes), 0xFFFFFFFF, dtype=np.uint32)
     for i, v in enumerate(values):
         if v is None:
             continue
-        words = v.split()
-        if not words:
+        raw = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+        starts, ends = [], []
+        w = -1
+        for k, byte in enumerate(raw):
+            is_ws = byte in ws
+            if not is_ws and w < 0:
+                w = k
+            if is_ws and w >= 0:
+                starts.append(w)
+                ends.append(k)
+                w = -1
+        if w >= 0:
+            starts.append(w)
+            ends.append(len(raw))
+        nwords = len(starts)
+        if nwords == 0:
             continue
-        nsh = max(1, len(words) - ngram_size + 1)
+        nsh = max(1, nwords - ngram_size + 1)
         for s in range(nsh):
-            sh = " ".join(words[s:s + ngram_size])
-            hv = fnv1a(sh.encode("utf-8")) & p
+            last = min(s + ngram_size, nwords) - 1
+            hv = _xxh64_py(raw[starts[s]:ends[last]], 42) & p
             for j in range(num_hashes):
                 ph = (a[j] * hv + b[j]) % p
-                val = np.uint32(ph & 0xFFFFFFFF)
+                val = ph & 0xFFFFFFFF
                 if val < out[i, j]:
                     out[i, j] = val
     return out

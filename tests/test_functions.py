@@ -1,0 +1,171 @@
+"""Tests for .binary / .json / .url expression namespaces + object IO.
+
+Reference surfaces: src/daft-functions-binary, src/daft-functions-json,
+src/daft-functions-uri, src/daft-io.
+"""
+
+import base64
+
+import pytest
+
+import daft_tpu as daft
+from daft_tpu import col
+from daft_tpu.io.object_io import IOStatsContext, LocalSource, get_io_client
+
+
+def _one_col(df, name):
+    return df.to_pydict()[name]
+
+
+# -- binary -----------------------------------------------------------------
+
+def test_binary_concat_length_slice():
+    df = daft.from_pydict({"a": [b"hello", b"", None], "b": [b"!", b"x", b"y"]})
+    out = df.select(
+        col("a").binary.concat(col("b")).alias("cat"),
+        col("a").binary.length().alias("len"),
+        col("a").binary.slice(1, 3).alias("sl"),
+    ).to_pydict()
+    assert out["cat"] == [b"hello!", b"x", None]
+    assert out["len"] == [5, 0, None]
+    assert out["sl"] == [b"ell", b"", None]
+
+
+@pytest.mark.parametrize("codec,plain,coded", [
+    ("base64", b"daft", base64.b64encode(b"daft")),
+    ("hex", b"\x01\xff", b"01ff"),
+])
+def test_binary_encode_decode(codec, plain, coded):
+    df = daft.from_pydict({"a": [plain]})
+    enc = _one_col(df.select(col("a").binary.encode(codec)), "a")
+    assert enc == [coded]
+    df2 = daft.from_pydict({"a": enc})
+    dec = _one_col(df2.select(col("a").binary.decode(codec)), "a")
+    assert dec == [plain]
+
+
+def test_binary_roundtrip_compression():
+    data = b"a" * 1000
+    df = daft.from_pydict({"a": [data]})
+    for codec in ("gzip", "zlib", "deflate"):
+        enc = _one_col(df.select(col("a").binary.encode(codec)), "a")
+        assert len(enc[0]) < len(data)
+        dec = _one_col(daft.from_pydict({"a": enc})
+                       .select(col("a").binary.decode(codec)), "a")
+        assert dec == [data]
+
+
+def test_binary_try_decode_null_on_error():
+    df = daft.from_pydict({"a": [b"!!!not-base64!!!", base64.b64encode(b"ok")]})
+    out = _one_col(df.select(col("a").binary.try_decode("base64")), "a")
+    assert out[0] is None
+    assert out[1] == b"ok"
+
+
+# -- json -------------------------------------------------------------------
+
+def test_json_query_paths():
+    docs = ['{"a": {"b": 1}, "c": [10, 20, 30]}',
+            '{"a": {"b": "x"}, "c": []}',
+            None]
+    df = daft.from_pydict({"j": docs})
+    out = df.select(
+        col("j").json.query(".a.b").alias("ab"),
+        col("j").json.query(".c[1]").alias("c1"),
+        col("j").json.query(".c[]").alias("call"),
+    ).to_pydict()
+    assert out["ab"] == ["1", "x", None]
+    assert out["c1"] == ["20", None, None]
+    assert out["call"] == ["[10, 20, 30]", None, None]
+
+
+def test_json_query_iteration_always_array():
+    # array iteration must yield a JSON array even for 1-element arrays
+    df = daft.from_pydict({"j": ['{"c": [10]}', '{"c": [10, 20]}']})
+    out = _one_col(df.select(col("j").json.query(".c[]")), "j")
+    assert out == ["[10]", "[10, 20]"]
+
+
+def test_json_query_pipe():
+    df = daft.from_pydict({"j": ['{"a": [{"b": 5}]}']})
+    out = _one_col(df.select(col("j").json.query(".a[0] | .b")), "j")
+    assert out == ["5"]
+
+
+# -- url --------------------------------------------------------------------
+
+def test_url_download_local(tmp_path):
+    paths = []
+    for i in range(3):
+        p = tmp_path / f"f{i}.bin"
+        p.write_bytes(bytes([i]) * 4)
+        paths.append(str(p))
+    df = daft.from_pydict({"u": paths + [None]})
+    out = _one_col(df.select(col("u").url.download()), "u")
+    assert out == [b"\x00" * 4, b"\x01" * 4, b"\x02" * 4, None]
+
+
+def test_url_download_on_error_null(tmp_path):
+    df = daft.from_pydict({"u": [str(tmp_path / "missing.bin")]})
+    out = _one_col(df.select(col("u").url.download(on_error="null")), "u")
+    assert out == [None]
+    with pytest.raises(Exception):
+        df.select(col("u").url.download(on_error="raise")).collect()
+
+
+def test_url_upload_roundtrip(tmp_path):
+    df = daft.from_pydict({"data": [b"abc", b"def"]})
+    out = _one_col(df.select(col("data").url.upload(str(tmp_path))), "data")
+    assert all(p is not None for p in out)
+    files = sorted(tmp_path.iterdir())
+    assert len(files) == 2
+    assert sorted(f.read_bytes() for f in files) == [b"abc", b"def"]
+
+
+def test_url_parse():
+    df = daft.from_pydict({"u": ["https://example.com:8080/p/q?x=1#frag",
+                                 "http://host:notaport/x"]})
+    out = _one_col(df.select(col("u").url.parse()), "u")
+    assert out[0]["scheme"] == "https"
+    assert out[0]["host"] == "example.com"
+    assert out[0]["port"] == 8080
+    assert out[0]["path"] == "/p/q"
+    assert out[1] is None  # bad port nulls the row, not the query
+
+
+def test_url_upload_unique_across_partitions(tmp_path):
+    df = (daft.from_pydict({"data": [b"A", b"B", b"C", b"D"]})
+          .repartition(2)
+          .select(col("data").url.upload(str(tmp_path))))
+    df.collect()
+    files = list(tmp_path.iterdir())
+    assert len(files) == 4
+    assert sorted(f.read_bytes() for f in files) == [b"A", b"B", b"C", b"D"]
+
+
+def test_binary_decode_base64_strict():
+    df = daft.from_pydict({"a": [b"####"]})
+    with pytest.raises(Exception):
+        df.select(col("a").binary.decode("base64")).collect()
+    out = _one_col(df.select(col("a").binary.try_decode("base64")), "a")
+    assert out == [None]
+
+
+# -- object IO --------------------------------------------------------------
+
+def test_local_source_get_range_and_stats(tmp_path):
+    p = tmp_path / "x.bin"
+    p.write_bytes(b"0123456789")
+    src = LocalSource()
+    stats = IOStatsContext("t")
+    assert src.get(str(p), (2, 5), stats) == b"234"
+    assert src.get_size(str(p)) == 10
+    assert stats.num_gets == 1 and stats.bytes_read == 3
+
+
+def test_io_client_glob(tmp_path):
+    for n in ("a.parquet", "b.parquet", "c.csv"):
+        (tmp_path / n).write_bytes(b"")
+    client = get_io_client()
+    hits = client.glob(str(tmp_path / "*.parquet"))
+    assert [h.rsplit("/", 1)[1] for h in hits] == ["a.parquet", "b.parquet"]

@@ -152,3 +152,23 @@ def test_murmur3_known_vector():
     h = native._lib.dn_murmur3_32(
         msg.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), 5, 0)
     assert h == 0x248BFA47  # public murmur3_x86_32 test vector
+
+
+def test_minhash_fallback_matches_native():
+    """Mixed-fleet invariant: a worker without the native lib must produce
+    bit-identical signatures to one with it."""
+    import numpy as np
+    from daft_tpu import native
+    from daft_tpu.series import _minhash_fallback
+    if not native.AVAILABLE:
+        import pytest
+        pytest.skip("native lib unavailable")
+    vals = ["the quick brown fox", "a  b", "a b", "", None, "single",
+            "x " * 40 + "tail", "\tmulti\nline  text\r"]
+    bufs = [(v.encode("utf-8") if v is not None else b"") for v in vals]
+    offsets = np.cumsum([0] + [len(x) for x in bufs]).astype(np.int64)
+    data = np.frombuffer(b"".join(bufs), dtype=np.uint8)
+    valid = np.array([v is not None for v in vals])
+    for nh, ng, sd in [(16, 2, 7), (4, 1, 1), (8, 3, 42)]:
+        nat = np.asarray(native.minhash(offsets, data, valid, nh, ng, sd))
+        assert np.array_equal(nat, _minhash_fallback(vals, nh, ng, sd))
