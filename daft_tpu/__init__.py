@@ -37,7 +37,7 @@ def __getattr__(name):
                 "from_glob_path", "range"):
         from . import dataframe as _df
         return getattr(_df, name)
-    if name in ("read_parquet", "read_csv", "read_json"):
+    if name in ("read_parquet", "read_csv", "read_json", "read_warc"):
         from . import io as _io
         return getattr(_io, name)
     if name in ("IOConfig", "S3Config", "GCSConfig", "AzureConfig",

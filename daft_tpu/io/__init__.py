@@ -1,6 +1,6 @@
-from .read import read_parquet, read_csv, read_json
+from .read import read_parquet, read_csv, read_json, read_warc
 from .scan import Pushdowns, ScanOperator, ScanTask
 from .sink import DataSink, WriteResult
 
-__all__ = ["read_parquet", "read_csv", "read_json", "Pushdowns",
+__all__ = ["read_parquet", "read_csv", "read_json", "read_warc", "Pushdowns",
            "ScanOperator", "ScanTask", "DataSink", "WriteResult"]

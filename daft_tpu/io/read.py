@@ -54,3 +54,12 @@ def read_json(path: Union[str, List[str]],
     sch = Schema.from_pydict(schema) if isinstance(schema, dict) else schema
     return _df_from_scan(GlobScanOperator(
         path, "json", schema=sch, hive_partitioning=hive_partitioning))
+
+
+def read_warc(path: Union[str, List[str]],
+              io_config: Any = None,
+              **kwargs):
+    """Lazily read WARC / gzipped-WARC file(s) into a DataFrame with the
+    fixed 7-column WARC schema (reference: ``daft/io/_warc.py:20``)."""
+    from .warc import WARC_SCHEMA
+    return _df_from_scan(GlobScanOperator(path, "warc", schema=WARC_SCHEMA))

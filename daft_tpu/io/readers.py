@@ -37,6 +37,9 @@ def infer_schema(path: str, file_format: str,
     if file_format == "json":
         t = pajson.read_json(path)
         return Schema.from_arrow(t.schema)
+    if file_format == "warc":
+        from .warc import WARC_SCHEMA
+        return WARC_SCHEMA
     raise ValueError(f"unknown format {file_format}")
 
 
@@ -73,9 +76,11 @@ def make_scan_tasks(path: str, file_format: str, schema: Schema,
             size = sum(md.row_group(g).total_byte_size for g in groups) \
                 if groups is not None else \
                 sum(md.row_group(i).total_byte_size for i in range(md.num_row_groups))
-            return [ScanTask([path], "parquet", schema, pushdowns, nrows, size,
-                             [groups] if groups is not None else None,
-                             options, partition_values)]
+            task = ScanTask([path], "parquet", schema, pushdowns, nrows, size,
+                            [groups] if groups is not None else None,
+                            options, partition_values)
+            task.pq_metadata = md  # reused by split_scan_tasks: one footer read
+            return [task]
     size = os.path.getsize(path) if os.path.exists(path) else None
     return [ScanTask([path], file_format, schema, pushdowns, None, size, None,
                      options, partition_values)]
@@ -186,6 +191,15 @@ def read_scan_task(task: ScanTask) -> List[RecordBatch]:
                                convert_options=copts)
         elif task.file_format == "json":
             t = pajson.read_json(path)
+            if phys_cols is not None:
+                keep = [c for c in phys_cols if c in t.column_names]
+                t = t.select(keep)
+        elif task.file_format == "warc":
+            from .warc import read_warc_file
+            # limit can only pre-apply when no residual filter runs after
+            limit = task.pushdowns.limit if task.pushdowns.filters is None \
+                else None
+            t = read_warc_file(path, limit=limit)
             if phys_cols is not None:
                 keep = [c for c in phys_cols if c in t.column_names]
                 t = t.select(keep)

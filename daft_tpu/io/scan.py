@@ -206,6 +206,8 @@ class GlobScanOperator(ScanOperator):
             pv = _hive_values(p) if self._hive else {}
             tasks.extend(readers.make_scan_tasks(
                 p, self._format, self._schema, pushdowns, self._options, pv))
+        tasks = split_scan_tasks(tasks, cfg.scan_tasks_max_size_bytes,
+                                 cfg.parquet_split_row_groups_max_files)
         return merge_scan_tasks(tasks, cfg.scan_tasks_min_size_bytes,
                                 cfg.scan_tasks_max_size_bytes,
                                 cfg.max_sources_per_scan_task)
@@ -218,6 +220,51 @@ def _hive_values(path: str) -> Dict[str, Any]:
             k, _, v = part.partition("=")
             if k and v and "." not in v:
                 out[k] = v
+    return out
+
+
+def split_scan_tasks(tasks: List[ScanTask], max_size: int,
+                     max_files: int) -> List[ScanTask]:
+    """Split oversized single-file parquet tasks into per-row-group-range
+    tasks (reference: ``scan_task_iters/split_parquet``). Only the first
+    ``max_files`` oversized files pay the metadata fetch; a limit pushdown
+    disables splitting (the limit is served from the file head)."""
+    out: List[ScanTask] = []
+    split_budget = max_files
+    for t in tasks:
+        sz = t.size_bytes()
+        if (t.file_format != "parquet" or len(t.paths) != 1
+                or t.pushdowns.limit is not None or t.row_groups is not None
+                or sz is None or sz <= max_size or split_budget <= 0):
+            out.append(t)
+            continue
+        split_budget -= 1
+        md = getattr(t, "pq_metadata", None)
+        if md is None:
+            try:
+                md = pq.ParquetFile(t.paths[0]).metadata
+            except Exception:
+                out.append(t)
+                continue
+        if md.num_row_groups <= 1:
+            out.append(t)
+            continue
+        group: List[int] = []
+        gsize = grows = 0
+        for g in range(md.num_row_groups):
+            rg = md.row_group(g)
+            if group and gsize + rg.total_byte_size > max_size:
+                out.append(ScanTask(t.paths, "parquet", t.schema, t.pushdowns,
+                                    grows, gsize, [group], t.format_options,
+                                    t.partition_values))
+                group, gsize, grows = [], 0, 0
+            group.append(g)
+            gsize += rg.total_byte_size
+            grows += rg.num_rows
+        if group:
+            out.append(ScanTask(t.paths, "parquet", t.schema, t.pushdowns,
+                                grows, gsize, [group], t.format_options,
+                                t.partition_values))
     return out
 
 

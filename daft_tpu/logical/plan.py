@@ -123,6 +123,17 @@ class Source(LogicalPlan):
     def clustering_spec(self) -> ClusteringSpec:
         if self.partitions is not None:
             return ClusteringSpec("unknown", max(len(self.partitions), 1))
+        if self.scan_op is not None:
+            # partition count = materialized scan-task count, sharing the
+            # same cache execution/translate use so footers are read once
+            tasks = getattr(self, "materialized_tasks", None)
+            if tasks is None:
+                try:
+                    tasks = self.scan_op.to_scan_tasks(self.pushdowns)
+                    self.materialized_tasks = tasks
+                except Exception:
+                    return ClusteringSpec("unknown", self._num_partitions)
+            return ClusteringSpec("unknown", max(len(tasks), 1))
         return ClusteringSpec("unknown", self._num_partitions)
 
     def _params(self):

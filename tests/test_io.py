@@ -123,3 +123,77 @@ def test_csv_no_header(tmp_path):
     p.write_text("1,a\n2,b\n")
     df = dt.read_csv(str(p), has_headers=False)
     assert len(df.to_pydict()) == 2
+
+
+# -- WARC (reference: src/daft-warc) ----------------------------------------
+
+def _write_warc(path, gz=False):
+    import gzip as _gz
+    recs = []
+    for i, (rtype, body) in enumerate([
+            ("warcinfo", b"software: test\r\n"),
+            ("request", b"GET / HTTP/1.1\r\nHost: example.com\r\n"),
+            ("response", b"HTTP/1.1 200 OK\r\n\r\n<html>hello</html>")]):
+        hdr = (f"WARC/1.1\r\n"
+               f"WARC-Record-ID: <urn:uuid:0000-{i}>\r\n"
+               f"WARC-Type: {rtype}\r\n"
+               f"WARC-Date: 2024-01-0{i+1}T00:00:00Z\r\n"
+               f"WARC-Target-URI: http://example.com/{i}\r\n"
+               f"Content-Length: {len(body)}\r\n\r\n").encode()
+        recs.append(hdr + body + b"\r\n\r\n")
+    blob = b"".join(recs)
+    with open(path, "wb") as f:
+        f.write(_gz.compress(blob) if gz else blob)
+
+
+@pytest.mark.parametrize("gz", [False, True])
+def test_read_warc(tmp_path, gz):
+    import daft_tpu as daft
+    p = str(tmp_path / ("x.warc.gz" if gz else "x.warc"))
+    _write_warc(p, gz)
+    df = daft.read_warc(p)
+    out = df.to_pydict()
+    assert out["WARC-Type"] == ["warcinfo", "request", "response"]
+    assert out["WARC-Record-ID"] == [f"<urn:uuid:0000-{i}>" for i in range(3)]
+    assert out["warc_content"][2] == b"HTTP/1.1 200 OK\r\n\r\n<html>hello</html>"
+    assert out["Content-Length"] == [16, 35, 37]
+    import json as _json
+    hdrs = _json.loads(out["warc_headers"][1])
+    assert hdrs["WARC-Target-URI"] == "http://example.com/1"
+    assert out["WARC-Date"][0].year == 2024
+
+
+def test_read_warc_pushdowns(tmp_path):
+    import daft_tpu as daft
+    from daft_tpu import col
+    p = str(tmp_path / "x.warc")
+    _write_warc(p)
+    out = (daft.read_warc(p)
+           .where(col("WARC-Type") == "response")
+           .select("WARC-Record-ID")
+           .to_pydict())
+    assert out == {"WARC-Record-ID": ["<urn:uuid:0000-2>"]}
+
+
+def test_split_scan_tasks_by_row_group(tmp_path):
+    """Oversized parquet files split into per-row-group-range tasks
+    (reference: scan_task_iters/split_parquet)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import daft_tpu as daft
+    from daft_tpu.context import execution_config_ctx
+
+    p = str(tmp_path / "big.parquet")
+    t = pa.table({"x": list(range(10000)), "y": [float(i) for i in range(10000)]})
+    pq.write_table(t, p, row_group_size=1000)  # 10 row groups
+
+    with execution_config_ctx(scan_tasks_max_size_bytes=20_000,
+                              scan_tasks_min_size_bytes=10_000):
+        df = daft.read_parquet(p)
+        assert df.num_partitions() > 1
+        out = df.to_pydict()
+    assert out["x"] == list(range(10000))
+    # sum over split tasks must match
+    with execution_config_ctx(scan_tasks_max_size_bytes=20_000):
+        s = daft.read_parquet(p).sum("y").to_pydict()
+    assert s["y"] == [sum(float(i) for i in range(10000))]
