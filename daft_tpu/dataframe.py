@@ -30,6 +30,7 @@ class DataFrame:
     def __init__(self, builder: LogicalPlanBuilder):
         self._builder = builder
         self._result: Optional[PartitionSet] = None
+        self._stats = None  # RuntimeStatsContext captured at collect()
 
     # ---- meta ------------------------------------------------------------
     @property
@@ -59,7 +60,18 @@ class DataFrame:
             return col(self.column_names[key])
         raise TypeError(f"cannot index DataFrame with {key!r}")
 
-    def explain(self, show_all: bool = False) -> None:
+    def explain(self, show_all: bool = False, analyze: bool = False) -> None:
+        """Print query plans; ``analyze=True`` executes the query and prints
+        the physical plan annotated with per-operator rows/time (reference:
+        AQE ``explain_analyze``, ``physical_planner/planner.rs:614``)."""
+        if analyze:
+            self.collect()
+            print("== Physical Plan (analyzed) ==")
+            if self._stats is not None:
+                print(self._stats.render())
+            else:
+                print("(no runtime stats recorded for this query)")
+            return
         print("== Unoptimized Logical Plan ==")
         print(self._builder.repr_ascii())
         if show_all:
@@ -345,8 +357,10 @@ class DataFrame:
     # ---- execution -------------------------------------------------------
     def collect(self, num_preview_rows: Optional[int] = 8) -> "DataFrame":
         if self._result is None:
+            from . import observability as obs
             runner = get_context().get_or_create_runner()
             self._result = runner.run(self._builder)
+            self._stats = obs.last_query_stats()
             # downstream queries read from the materialized result
             self._builder = LogicalPlanBuilder.from_in_memory(
                 self._result.partitions, self._result.schema)

@@ -64,24 +64,49 @@ class LocalExecutor:
     """Interprets a physical plan into a stream of MicroPartitions."""
 
     def __init__(self):
+        from . import memory
         self.cfg = get_context().execution_config
+        self.stats = None
+        # bounds bytes of scan tasks materializing concurrently
+        self.mem = memory.MemoryManager()
 
     def run(self, plan: pp.PhysicalPlan) -> Iterator[MicroPartition]:
-        return self._exec(plan)
+        from .. import observability as obs
+        self.stats = obs.new_query_stats()
+        self.stats.plan = plan  # for explain_analyze rendering
+
+        def gen():
+            try:
+                yield from obs.wrap_progress(self._exec(plan))
+            finally:
+                self.stats.finish()
+                obs.set_last_stats(self.stats)
+                path = obs.chrome_trace_path()
+                if path and self.stats.tracer is not None:
+                    self.stats.tracer.dump(path)
+        return gen()
 
     # ------------------------------------------------------------------
     def _exec(self, node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         h = getattr(self, "_exec_" + type(node).__name__, None)
         if h is None:
             raise NotImplementedError(f"executor for {type(node).__name__}")
-        return h(node)
+        it = h(node)
+        if self.stats is not None:
+            it = self.stats.instrument(node, it)
+        return it
 
     # sources ----------------------------------------------------------
     def _exec_ScanSource(self, node: pp.ScanSource):
         def run(t):
-            mp = MicroPartition.from_scan_task(t)
-            mp._load()
-            return mp
+            est = t.size_bytes() or 0
+            self.mem.acquire(est)
+            try:
+                mp = MicroPartition.from_scan_task(t)
+                mp._load()
+                return mp
+            finally:
+                self.mem.release(est)
         if not node.tasks:
             yield MicroPartition.empty(node.schema())
             return
@@ -212,7 +237,8 @@ class LocalExecutor:
 
     # sort -------------------------------------------------------------
     def _exec_Sort(self, node: pp.Sort):
-        parts = list(self._exec(node.children[0]))
+        from . import memory
+        parts = memory.materialize(self._exec(node.children[0]))
         if len(parts) == 1:
             yield parts[0].sort(node.sort_by, node.descending, node.nulls_first)
             return
@@ -236,7 +262,8 @@ class LocalExecutor:
 
     # exchanges --------------------------------------------------------
     def _exec_Exchange(self, node: pp.Exchange):
-        parts = list(self._exec(node.children[0]))
+        from . import memory
+        parts = memory.materialize(self._exec(node.children[0]))
         kind, n = node.kind, node.num_partitions
         if kind == "gather" or (kind == "split" and n == 1):
             yield parts[0].concat(parts[1:]) if len(parts) > 1 else parts[0]
@@ -245,14 +272,14 @@ class LocalExecutor:
             yield from self._split(parts, n)
             return
         if kind == "random":
-            split = list(_ordered_parallel(
-                iter(list(enumerate(parts))),
+            split = self._materialize_split(_ordered_parallel(
+                enumerate(parts),
                 lambda ip: ip[1].partition_by_random(n, seed=ip[0])))
             yield from self._regroup(split, n)
             return
         if kind == "hash":
             by = list(node.by)
-            split = list(_ordered_parallel(
+            split = self._materialize_split(_ordered_parallel(
                 iter(parts), lambda p: p.partition_by_hash(by, n)))
             yield from self._regroup(split, n)
             return
@@ -264,7 +291,24 @@ class LocalExecutor:
             return
         raise NotImplementedError(f"exchange kind {kind}")
 
-    def _regroup(self, split: List[List[MicroPartition]], n: int):
+    def _materialize_split(self, rows):
+        """Fanout outputs → budgeted (possibly spilling) buffer, so the
+        exchange peak — every input's n split parts live at once — honors
+        the memory limit."""
+        from . import memory
+        split = memory.SplitSpillBuffer()
+        for outs in rows:
+            split.append_row(list(outs))
+        return split
+
+    def _regroup(self, split, n: int):
+        from . import memory
+        if isinstance(split, memory.SplitSpillBuffer):
+            for i in range(n):
+                subs = [split.get(s, i) for s in range(split.rows)]
+                yield subs[0].concat(subs[1:]) if len(subs) > 1 else subs[0]
+            split.close()
+            return
         for i in range(n):
             subs = [s[i] for s in split]
             yield subs[0].concat(subs[1:]) if len(subs) > 1 else subs[0]
@@ -311,10 +355,10 @@ class LocalExecutor:
                for i in range(n - 1)]
         idx = [min(i, len(merged_sorted) - 1) for i in idx]
         boundaries = merged_sorted.take(np.asarray(idx, dtype=np.int64))
-        split = list(_ordered_parallel(
+        split = self._materialize_split(_ordered_parallel(
             iter(parts),
             lambda p: p.partition_by_range(by, boundaries, descending)))
-        return list(self._regroup(split, n))
+        return self._regroup(split, n)
 
     # joins ------------------------------------------------------------
     def _exec_HashJoin(self, node: pp.HashJoin):
@@ -333,8 +377,9 @@ class LocalExecutor:
                 child, lambda p: left.hash_join(p, node.left_on,
                                                 node.right_on, how))
             return
-        lparts = list(self._exec(node.children[0]))
-        rparts = list(self._exec(node.children[1]))
+        from . import memory
+        lparts = memory.materialize(self._exec(node.children[0]))
+        rparts = memory.materialize(self._exec(node.children[1]))
         if len(lparts) != len(rparts):
             # co-partition by concat-gather fallback
             lparts = [_gather_all(iter(lparts))]

@@ -1,0 +1,242 @@
+"""Per-operator runtime stats, chrome tracing, and progress reporting.
+
+Capability mirror of the reference's observability stack:
+- per-operator rows/cpu counters (``daft-local-execution/src/runtime_stats.rs:23-75``)
+- chrome-trace layer gated by an env flag
+  (``DAFT_DEV_ENABLE_CHROME_TRACE``, ``src/common/tracing/src/lib.rs:16-17``)
+- progress bars (``progress_bar.rs`` / ``daft/runners/progress_bar.py``)
+- ``explain_analyze`` plan annotation
+  (``physical_planner/planner.rs:451-640``)
+
+Env flags (same spirit as the reference's ``DAFT_DEV_*``):
+- ``DAFT_TPU_CHROME_TRACE`` — ``1`` or a path; writes a chrome://tracing
+  JSON for the last execution (default ``/tmp/daft_tpu_trace_<pid>.json``)
+- ``DAFT_TPU_PROGRESS`` — ``1`` enables a tqdm partition-progress bar
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+_START_TS = time.perf_counter()
+
+
+def _now_us() -> int:
+    return int((time.perf_counter() - _START_TS) * 1_000_000)
+
+
+class OperatorStats:
+    """Counters for one physical operator (reference:
+    ``RuntimeStatsContext`` counters)."""
+
+    __slots__ = ("name", "rows_out", "batches_out", "inclusive_us", "lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.rows_out = 0
+        self.batches_out = 0
+        self.inclusive_us = 0
+        self.lock = threading.Lock()
+
+    def record(self, nrows: int, dur_us: int):
+        with self.lock:
+            self.rows_out += nrows
+            self.batches_out += 1
+            self.inclusive_us += dur_us
+
+    def record_time(self, dur_us: int):
+        with self.lock:
+            self.inclusive_us += dur_us
+
+
+class ChromeTracer:
+    """Collects chrome://tracing 'X' (complete) events; flushed per query."""
+
+    def __init__(self):
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+
+    def add(self, name: str, ts_us: int, dur_us: int):
+        tid = threading.get_ident() & 0xFFFF
+        with self._lock:
+            self._events.append({"name": name, "ph": "X", "ts": ts_us,
+                                 "dur": dur_us, "pid": os.getpid(), "tid": tid})
+
+    def dump(self, path: str):
+        with self._lock:
+            events = list(self._events)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+
+
+class RuntimeStatsContext:
+    """Per-query stats: one ``OperatorStats`` per physical-plan node.
+
+    Timing semantics: ``inclusive_us`` is wall time spent producing each
+    batch at that operator's output boundary (includes upstream pull in this
+    pull-based pipeline); ``exclusive_us`` subtracts the children's inclusive
+    time at render. With pipelined thread-pool ops this is an approximation —
+    the reference's push model has the same per-operator granularity.
+    """
+
+    def __init__(self, tracer: Optional[ChromeTracer] = None):
+        self._ops: Dict[int, OperatorStats] = {}
+        self._children: Dict[int, List[int]] = {}
+        self._lock = threading.Lock()
+        self.tracer = tracer
+        self.wall_us: Optional[int] = None
+        self.plan = None  # physical plan root, set by the executor
+        self._t0 = time.perf_counter()
+
+    def register(self, node) -> OperatorStats:
+        key = id(node)
+        with self._lock:
+            st = self._ops.get(key)
+            if st is None:
+                st = OperatorStats(type(node).__name__)
+                self._ops[key] = st
+                self._children[key] = [id(c) for c in node.children]
+            return st
+
+    def instrument(self, node, it):
+        """Wrap a node's output iterator with rows/time accounting."""
+        st = self.register(node)
+        tracer = self.tracer
+
+        def gen():
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+                dur = int((time.perf_counter() - t0) * 1_000_000)
+                st.record(len(item), dur)
+                if tracer is not None:
+                    tracer.add(st.name, _now_us() - dur, dur)
+                yield item
+        return gen()
+
+    def finish(self):
+        self.wall_us = int((time.perf_counter() - self._t0) * 1_000_000)
+
+    # ---- reporting ---------------------------------------------------
+    def exclusive_us(self, key: int) -> int:
+        st = self._ops[key]
+        child_incl = sum(self._ops[c].inclusive_us
+                         for c in self._children.get(key, [])
+                         if c in self._ops)
+        return max(st.inclusive_us - child_incl, 0)
+
+    def render(self, plan=None) -> str:
+        """ASCII explain-analyze tree (annotated like the reference's
+        ``explain_analyze``)."""
+        if plan is None:
+            plan = self.plan
+        lines = []
+        if self.wall_us is not None:
+            lines.append(f"query wall time: {self.wall_us / 1e6:.3f}s")
+
+        def walk(node, depth):
+            key = id(node)
+            st = self._ops.get(key)
+            pad = "  " * depth
+            if st is None:
+                lines.append(f"{pad}{type(node).__name__}")
+            else:
+                lines.append(
+                    f"{pad}{st.name}: rows_out={st.rows_out} "
+                    f"batches={st.batches_out} "
+                    f"total={st.inclusive_us / 1e6:.3f}s "
+                    f"self={self.exclusive_us(key) / 1e6:.3f}s")
+            for c in node.children:
+                walk(c, depth + 1)
+
+        if plan is not None:
+            walk(plan, 0)
+        else:
+            for st in self._ops.values():
+                lines.append(f"{st.name}: rows_out={st.rows_out} "
+                             f"batches={st.batches_out} "
+                             f"total={st.inclusive_us / 1e6:.3f}s")
+        return "\n".join(lines)
+
+    def as_dict(self) -> Dict[str, dict]:
+        out = {}
+        for key, st in self._ops.items():
+            name = st.name
+            i = 2
+            while name in out:
+                name = f"{st.name}#{i}"
+                i += 1
+            out[name] = {"rows_out": st.rows_out,
+                         "batches_out": st.batches_out,
+                         "inclusive_us": st.inclusive_us,
+                         "exclusive_us": self.exclusive_us(key)}
+        return out
+
+
+# ---------------------------------------------------------------------------
+# per-process "last query" registry
+
+
+_last_stats: Optional[RuntimeStatsContext] = None
+_last_lock = threading.Lock()
+
+
+def chrome_trace_path() -> Optional[str]:
+    v = os.environ.get("DAFT_TPU_CHROME_TRACE")
+    if not v:
+        return None
+    low = v.strip().lower()
+    if low in ("", "0", "false", "no", "off"):
+        return None
+    if low in ("1", "true", "yes", "on"):
+        return f"/tmp/daft_tpu_trace_{os.getpid()}.json"
+    return v
+
+
+def progress_enabled() -> bool:
+    return os.environ.get("DAFT_TPU_PROGRESS", "0") not in ("0", "false", "")
+
+
+def new_query_stats() -> RuntimeStatsContext:
+    tracer = ChromeTracer() if chrome_trace_path() else None
+    return RuntimeStatsContext(tracer)
+
+
+def set_last_stats(ctx: RuntimeStatsContext):
+    global _last_stats
+    with _last_lock:
+        _last_stats = ctx
+
+
+def last_query_stats() -> Optional[RuntimeStatsContext]:
+    """Stats of the most recent execution in this process."""
+    with _last_lock:
+        return _last_stats
+
+
+def wrap_progress(it, desc: str = "partitions"):
+    """tqdm progress over a partition stream when DAFT_TPU_PROGRESS=1."""
+    if not progress_enabled():
+        return it
+    try:
+        from tqdm import tqdm
+    except ImportError:
+        return it
+
+    def gen():
+        rows = 0
+        with tqdm(desc=desc, unit="part") as bar:
+            for p in it:
+                rows += len(p)
+                bar.set_postfix_str(f"{rows} rows")
+                bar.update(1)
+                yield p
+    return gen()

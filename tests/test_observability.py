@@ -1,0 +1,77 @@
+"""Runtime stats / chrome trace / explain-analyze tests
+(reference model: runtime_stats.rs, common/tracing, tests/observability/)."""
+
+import json
+import os
+
+import pytest
+
+import daft_tpu as daft
+from daft_tpu import col
+from daft_tpu import observability as obs
+
+
+def test_runtime_stats_collected():
+    df = (daft.from_pydict({"x": list(range(1000)), "g": [i % 10 for i in range(1000)]})
+          .where(col("x") > 99)
+          .groupby("g").agg(col("x").sum().alias("s")))
+    df.collect()
+    stats = obs.last_query_stats()
+    assert stats is not None
+    d = stats.as_dict()
+    assert stats.wall_us is not None and stats.wall_us > 0
+    # source emits all 1000 rows; final agg emits 10 groups
+    src = [v for k, v in d.items() if "Source" in k]
+    assert src and src[0]["rows_out"] == 1000
+    root = [v for k, v in d.items() if "Agg" in k]
+    assert any(v["rows_out"] == 10 for v in root)
+
+
+def test_runtime_stats_unfused_filter():
+    df = daft.from_pydict({"x": list(range(1000))}).where(col("x") > 99)
+    df.collect()
+    d = obs.last_query_stats().as_dict()
+    filters = [v for k, v in d.items() if k.startswith("Filter")]
+    assert filters and filters[0]["rows_out"] == 900
+
+
+def test_explain_analyze_renders(capsys):
+    df = daft.from_pydict({"x": [1, 2, 3]}).where(col("x") > 1)
+    df.explain(analyze=True)
+    out = capsys.readouterr().out
+    assert "rows_out=2" in out
+    assert "query wall time" in out
+
+
+def test_chrome_trace_written(tmp_path, monkeypatch):
+    path = str(tmp_path / "trace.json")
+    monkeypatch.setenv("DAFT_TPU_CHROME_TRACE", path)
+    df = daft.from_pydict({"x": list(range(100))}).where(col("x") % 2 == 0)
+    df.collect()
+    with open(path) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "Filter" in names
+    for e in trace["traceEvents"]:
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+
+
+def test_stats_exclusive_time_nonneg():
+    df = daft.from_pydict({"x": list(range(500))}).with_column(
+        "y", col("x") * 2).where(col("y") > 10)
+    df.collect()
+    stats = obs.last_query_stats()
+    for v in stats.as_dict().values():
+        assert v["exclusive_us"] >= 0
+        assert v["inclusive_us"] >= v["exclusive_us"]
+
+
+def test_explain_analyze_not_stale(capsys):
+    df1 = daft.from_pydict({"x": [1, 2, 3]}).where(col("x") > 1)
+    df1.collect()
+    # another query runs afterwards…
+    daft.from_pydict({"y": list(range(50))}).where(col("y") > 10).collect()
+    # …but df1's analysis must show df1's stats (2 rows), not the later query's
+    df1.explain(analyze=True)
+    out = capsys.readouterr().out
+    assert "rows_out=2" in out and "rows_out=39" not in out
