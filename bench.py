@@ -1,16 +1,21 @@
-"""Benchmark driver: TPC-H Q1 on the flagship TPU path.
+"""Benchmark driver: TPC-H Q1 through the daft_tpu engine.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-- workload: TPC-H Q1 at SF (default 1) through the full daft_tpu DataFrame
-  pipeline (parquet scan → device filter/project → device sort-segment
-  grouped aggregation → sort), on whatever backend jax picks (the real TPU
-  chip under the driver).
-- baseline: the same Q1 computed with Arrow C++ compute (pyarrow
-  TableGroupBy) on CPU — the reference engine's substrate (its native runner
-  is Arrow-kernel row-parallel C++/Rust), measured in-process on this machine.
-  vs_baseline = baseline_seconds / ours_seconds (>1 → we're faster).
+Structure (hang-proof by construction, round-1 postmortem):
+1. baseline: the same Q1 via Arrow C++ compute (pyarrow TableGroupBy) on CPU
+   — the reference engine's substrate — measured in-process.
+2. host tier: the full daft_tpu DataFrame pipeline with the device tier
+   disabled (DAFT_TPU_DEVICE=0), in-process. This never touches the JAX
+   backend, so it cannot hang; its number is always captured.
+3. device tier: the same query with the device tier enabled, in a CHILD
+   process under a timeout (BENCH_DEVICE_TIMEOUT, default 600 s). A wedged
+   TPU plugin (round-1 failure: lazy PJRT init hung forever) kills only the
+   child; the engine-side watchdog (daft_tpu/device/backend.py) additionally
+   pins the child to the host tier if backend init times out.
+The reported number is the best tier. vs_baseline = baseline_s / ours_s
+(>1 → we're faster). BENCH_SF / BENCH_PARTS control the dataset.
 """
 
 from __future__ import annotations
@@ -18,6 +23,7 @@ from __future__ import annotations
 import datetime
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -27,6 +33,7 @@ sys.path.insert(0, REPO)
 SF = float(os.environ.get("BENCH_SF", "1"))
 PARTS = int(os.environ.get("BENCH_PARTS", "8"))
 DATA = os.path.join(REPO, ".cache", f"tpch_sf{SF}")
+DEVICE_TIMEOUT = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "600"))
 
 
 def ensure_data():
@@ -73,6 +80,40 @@ def run_arrow_baseline():
     return g, time.time() - t0
 
 
+def _device_child():
+    """Child-process entry: run Q1 with the device tier on, print one JSON."""
+    os.environ["DAFT_TPU_DEVICE"] = "1"
+    out, warm, hot = run_daft_q1()
+    from daft_tpu.device import backend as dbackend
+    print(json.dumps({
+        "warm": warm, "hot": hot, "groups": len(out["l_returnflag"]),
+        "backend": dbackend.backend_name() or "host-fallback",
+    }), flush=True)
+
+
+def _try_device_tier():
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--device-child"],
+            capture_output=True, text=True, timeout=DEVICE_TIMEOUT,
+            cwd=REPO, env={**os.environ, "DAFT_TPU_DEVICE": "1"})
+    except subprocess.TimeoutExpired:
+        print("device tier: timed out; using host tier", file=sys.stderr)
+        return None
+    if proc.returncode != 0:
+        print(f"device tier: child failed rc={proc.returncode}\n"
+              f"{proc.stderr[-2000:]}", file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict):
+            return parsed
+    return None
+
+
 def main():
     ensure_data()
     import pyarrow.parquet as pq
@@ -80,28 +121,46 @@ def main():
     nrows = sum(pq.ParquetFile(p).metadata.num_rows
                 for p in g.glob(f"{DATA}/lineitem/*.parquet"))
 
-    out, warm, hot = run_daft_q1()
-    ours = min(warm, hot)
     base_tbl, base_s = run_arrow_baseline()
 
-    # sanity: same group count and close sums
+    # host tier first: hang-free, guarantees a number is always reported
+    os.environ["DAFT_TPU_DEVICE"] = "0"
+    out, host_warm, host_hot = run_daft_q1()
     assert len(out["l_returnflag"]) == base_tbl.num_rows, \
         (len(out["l_returnflag"]), base_tbl.num_rows)
 
-    import jax
+    detail = {
+        "host_warm_s": round(host_warm, 3), "host_hot_s": round(host_hot, 3),
+        "arrow_cpu_baseline_s": round(base_s, 3), "lineitem_rows": nrows,
+        "backend": "host",
+    }
+    ours = min(host_warm, host_hot)
+
+    dev = _try_device_tier()
+    if dev is not None and dev.get("backend") == "host-fallback":
+        # the child's watchdog pinned it to the host tier: there was no
+        # device measurement — don't report one.
+        detail["device_backend"] = "host-fallback"
+        dev = None
+    if dev is not None and dev.get("groups") == base_tbl.num_rows:
+        detail["device_warm_s"] = round(dev["warm"], 3)
+        detail["device_hot_s"] = round(dev["hot"], 3)
+        detail["device_backend"] = dev.get("backend")
+        if dev["hot"] < ours:
+            ours = dev["hot"]
+            detail["backend"] = dev.get("backend", "device")
+
     print(json.dumps({
         "metric": f"tpch_q1_sf{SF}_rows_per_sec_per_chip",
         "value": round(nrows / ours, 1),
         "unit": "rows/s",
         "vs_baseline": round(base_s / ours, 3),
-        "detail": {
-            "backend": jax.default_backend(),
-            "q1_warm_s": round(warm, 3), "q1_hot_s": round(hot, 3),
-            "arrow_cpu_baseline_s": round(base_s, 3),
-            "lineitem_rows": nrows,
-        },
+        "detail": detail,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if "--device-child" in sys.argv:
+        _device_child()
+    else:
+        main()

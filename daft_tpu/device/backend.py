@@ -1,0 +1,87 @@
+"""Watchdog-guarded JAX backend initialization.
+
+JAX initializes its PJRT client lazily on the first ``jax.default_backend()``
+/ ``jnp`` call, and a broken or slow device plugin (e.g. a remote-tunnel TPU
+plugin) can hang that call forever. The reference engine never has this
+problem because its backend is the CPU it is already running on; for a
+device-tiered engine the backend is a *fallible external resource* and must
+be probed exactly once, under a timeout, from a single thread — never raced
+from N scan workers (cf. the frozen-per-query config bootstrap discipline in
+the reference, ``src/common/daft-config/src/lib.rs:40-68``).
+
+Semantics:
+- :func:`probe` starts (once) a daemon thread that touches the backend.
+- :func:`backend_name` / :func:`device_ready` wait up to the configured
+  timeout for that probe; on timeout or error the device tier is marked
+  unavailable for the life of the process and the engine pins itself to the
+  host tier. The stuck thread is left to its fate (daemon).
+- ``DAFT_TPU_BACKEND_TIMEOUT`` (seconds, default 60) bounds the wait.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_probe_thread: Optional[threading.Thread] = None
+_done = threading.Event()
+_backend: Optional[str] = None
+_failed = False
+
+
+def _timeout() -> float:
+    return float(os.environ.get("DAFT_TPU_BACKEND_TIMEOUT", "60"))
+
+
+def _probe_body() -> None:
+    global _backend, _failed
+    try:
+        import jax
+
+        _backend = jax.default_backend()
+    except Exception:
+        _failed = True
+    finally:
+        _done.set()
+
+
+def probe() -> None:
+    """Kick off backend initialization in the background (idempotent)."""
+    global _probe_thread
+    with _lock:
+        if _probe_thread is None:
+            _probe_thread = threading.Thread(
+                target=_probe_body, name="daft-tpu-backend-probe", daemon=True)
+            _probe_thread.start()
+
+
+def backend_name(wait: bool = True) -> Optional[str]:
+    """The initialized backend name, or None if unavailable/timed out."""
+    global _failed
+    if _failed:
+        return None
+    probe()
+    if wait and not _done.is_set():
+        _done.wait(_timeout())
+    if not _done.is_set():
+        # timed out: permanently mark the device tier unusable so later
+        # callers don't re-block for another full timeout.
+        _failed = True
+        return None
+    return None if _failed else _backend
+
+
+def device_ready() -> bool:
+    """True once the JAX backend initialized successfully within timeout."""
+    return backend_name() is not None
+
+
+def reset_for_tests() -> None:
+    global _probe_thread, _backend, _failed
+    with _lock:
+        _probe_thread = None
+        _backend = None
+        _failed = False
+        _done.clear()

@@ -59,10 +59,8 @@ def bucket_capacity(n: int) -> int:
 
 
 def _backend() -> str:
-    try:
-        return jax.default_backend()
-    except Exception:
-        return "cpu"
+    from . import backend
+    return backend.backend_name() or "cpu"
 
 
 def supports_f64() -> bool:

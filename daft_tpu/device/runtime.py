@@ -33,7 +33,10 @@ _DEVICE_AGGS = {"sum", "mean", "min", "max", "count", "stddev", "var",
 
 
 def device_enabled() -> bool:
-    return os.environ.get("DAFT_TPU_DEVICE", "1") != "0"
+    if os.environ.get("DAFT_TPU_DEVICE", "1") == "0":
+        return False
+    from . import backend
+    return backend.device_ready()
 
 
 def _min_rows() -> int:
