@@ -65,11 +65,13 @@ def backend_name(wait: bool = True) -> Optional[str]:
     probe()
     if wait and not _done.is_set():
         _done.wait(_timeout())
+        if not _done.is_set():
+            # timed out: permanently mark the device tier unusable so later
+            # callers don't re-block for another full timeout.
+            _failed = True
+            return None
     if not _done.is_set():
-        # timed out: permanently mark the device tier unusable so later
-        # callers don't re-block for another full timeout.
-        _failed = True
-        return None
+        return None  # non-waiting peek while the probe is in flight
     return None if _failed else _backend
 
 

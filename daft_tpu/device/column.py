@@ -68,6 +68,23 @@ def supports_f64() -> bool:
     return _backend() not in ("tpu", "axon")
 
 
+def is_lossless_device_dtype(dtype: DataType) -> bool:
+    """True when the device encoding round-trips bit-exactly: required for
+    pure data-movement paths (mesh repartition) where the engine must not
+    perturb values. Decimals ride float64 (lossy); float64 itself downcasts
+    to float32 on backends without f64."""
+    if dtype.is_decimal():
+        return False
+    if dtype.is_string() or dtype.is_binary():
+        return False
+    phys = dtype.to_physical()
+    if phys.device_repr() is None:
+        return False
+    if phys.device_repr() == np.float64 and not supports_f64():
+        return False
+    return True
+
+
 @dataclass
 class DeviceColumn:
     data: jax.Array                  # [capacity]

@@ -39,8 +39,40 @@ def device_enabled() -> bool:
     return backend.device_ready()
 
 
+def _is_transfer_bound() -> bool:
+    """True when the device sits behind a slow host↔device link (real TPU,
+    possibly tunneled) rather than sharing host memory (CPU backend)."""
+    from . import backend
+    return (backend.backend_name() or "cpu") not in ("cpu",)
+
+
 def _min_rows() -> int:
-    return int(os.environ.get("DAFT_TPU_DEVICE_MIN_ROWS", "0"))
+    env = os.environ.get("DAFT_TPU_DEVICE_MIN_ROWS")
+    if env is not None:
+        return int(env)
+    # on a transfer-bound link, tiny batches are pure round-trip overhead
+    return 4096 if _is_transfer_bound() else 0
+
+
+def _row_output_profitable(n_rows: int) -> bool:
+    """Cost gate for ops whose OUTPUT is row-shaped (projection values, sort
+    permutations, filter masks): on a transfer-bound link the result must
+    come back over the slow device→host path (~30 MB/s measured on this
+    tunnel vs ~GB/s host kernel throughput), which the compute saving can
+    essentially never repay, so these ops default to host there.
+    Reduction-shaped ops (aggregations) are exempt — their outputs are group
+    blocks, transferred once in packed form. Overrides:
+    DAFT_TPU_DEVICE_FORCE=1 forces the device on; an explicit
+    DAFT_TPU_DEVICE_MIN_ROWS keeps its documented meaning (the device runs
+    at or above that many rows) on every backend."""
+    if os.environ.get("DAFT_TPU_DEVICE_FORCE") == "1":
+        return True
+    env = os.environ.get("DAFT_TPU_DEVICE_MIN_ROWS")
+    if env is not None:
+        return n_rows >= max(int(env), 1)
+    if _is_transfer_bound():
+        return False
+    return n_rows >= 1
 
 
 _projection_cache: Dict[Tuple, compiler.Compiled] = {}
@@ -112,7 +144,8 @@ def _run_compiled(c: compiler.Compiled, batch, exprs: List[Expression]):
 def try_eval_projection(batch, exprs: List[Expression]):
     """Full projection on device; None → host fallback."""
     from ..recordbatch import RecordBatch
-    if not device_enabled() or len(batch) < max(_min_rows(), 1):
+    if not device_enabled() \
+            or not _row_output_profitable(len(batch)):
         return None
     schema = batch.schema
     out_fields = []
@@ -148,7 +181,7 @@ def try_eval_projection(batch, exprs: List[Expression]):
 
 def try_eval_predicate(batch, predicate: Expression) -> Optional[np.ndarray]:
     """Predicate → host boolean mask (for arrow-side filtering)."""
-    if not device_enabled() or len(batch) < max(_min_rows(), 1):
+    if not device_enabled() or not _row_output_profitable(len(batch)):
         return None
     c = _get_compiled([predicate], batch.schema)
     if c is None:
@@ -167,7 +200,7 @@ def try_argsort(key_series: List[Series], descending: List[bool],
     if not device_enabled() or not key_series:
         return None
     n = len(key_series[0])
-    if n < max(_min_rows(), 2):
+    if n < 2 or not _row_output_profitable(n):
         return None
     for s in key_series:
         if s.is_pyobject():

@@ -69,8 +69,16 @@ class LocalExecutor:
         self.stats = None
         # bounds bytes of scan tasks materializing concurrently
         self.mem = memory.MemoryManager()
+        # stage-input bindings for distributed stage fragments
+        self.stage_inputs = {}
 
-    def run(self, plan: pp.PhysicalPlan) -> Iterator[MicroPartition]:
+    def run(self, plan: pp.PhysicalPlan,
+            stage_inputs=None) -> Iterator[MicroPartition]:
+        if stage_inputs:
+            self.stage_inputs = stage_inputs
+        return self._run(plan)
+
+    def _run(self, plan: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         from .. import observability as obs
         self.stats = obs.new_query_stats()
         self.stats.plan = plan  # for explain_analyze rendering
@@ -117,6 +125,13 @@ class LocalExecutor:
             yield MicroPartition.empty(node.schema())
             return
         yield from iter(node.partitions)
+
+    def _exec_StageInput(self, node: pp.StageInput):
+        parts = self.stage_inputs.get(node.stage_id)
+        if not parts:
+            yield MicroPartition.empty(node.schema())
+            return
+        yield from iter(parts)
 
     # pipelined maps ---------------------------------------------------
     def _exec_Project(self, node: pp.Project):
@@ -197,27 +212,252 @@ class LocalExecutor:
                        for i, (op, c, nm, pr) in enumerate(specs)]
         ops = tuple(s[0] for s in specs)
         agg_names = [s[2] for s in specs]
+        agg_cols = [col(nm) for nm in agg_names]
 
-        def run(p: MicroPartition) -> MicroPartition:
-            rb = p.combined()
-            if drt.device_enabled() and len(rb) >= max(drt._min_rows(), 1):
-                prog = fragment.get_fused_agg(node.group_by, child_exprs, ops,
-                                              node.predicate, rb.schema)
-                if prog is not None:
-                    out = fragment.run_fused_agg(
-                        prog, rb, node.group_by,
-                        [col(nm) for nm in agg_names], node.schema())
-                    if out is not None:
-                        return MicroPartition.from_recordbatch(
-                            out.cast_to_schema(node.schema()))
-            # host fallback: equivalent unfused chain
+        def host_agg(rb: RecordBatch) -> MicroPartition:
             if node.predicate is not None:
                 rb = rb.filter(node.predicate)
             return MicroPartition.from_recordbatch(
                 rb.agg(node.aggs, node.group_by).cast_to_schema(node.schema()))
 
+        def device_agg(rb: RecordBatch) -> Optional[MicroPartition]:
+            if not (drt.device_enabled()
+                    and len(rb) >= max(drt._min_rows(), 1)):
+                return None
+            prog = fragment.get_fused_agg(node.group_by, child_exprs, ops,
+                                          node.predicate, rb.schema)
+            if prog is None:
+                return None
+            out = fragment.run_fused_agg(prog, rb, node.group_by, agg_cols,
+                                         node.schema())
+            if out is None:
+                return None
+            return MicroPartition.from_recordbatch(
+                out.cast_to_schema(node.schema()))
+
+        src = node.children[0]
+        if isinstance(src, pp.ScanSource) and src.tasks \
+                and drt.device_enabled():
+            # task-level path: consult the HBM column cache per scan task —
+            # a hit runs the fused program on device-resident columns with
+            # zero file IO and zero host→device transfer. All tasks' packed
+            # results come back in ONE device→host transfer (the link is
+            # RTT-bound, so per-task gets would serialize ~40 ms each).
+            prog = fragment.get_fused_agg(node.group_by, child_exprs, ops,
+                                          node.predicate, src.schema())
+            if prog is not None:
+                yield from self._fragment_scan_tasks(
+                    node, prog, src, agg_cols, host_agg)
+                return
+
+        def run(p: MicroPartition) -> MicroPartition:
+            rb = p.combined()
+            out = device_agg(rb)
+            return out if out is not None else host_agg(rb)
+
         child = self._exec(node.children[0])
         yield from _ordered_parallel(child, run)
+
+    def _fragment_scan_tasks(self, node, prog, src, agg_cols, host_agg):
+        """Windowed streaming over scan tasks: resolve each task in the
+        window to an encoded DeviceTable (HBM cache hit, or load+encode+
+        insert) or a host batch, dispatch the window's fused programs, and
+        fetch ALL its packed results in one transfer. The window bounds
+        host RAM and non-cached HBM residency like the morsel pipeline's
+        in-flight limit; fallbacks re-read the pristine task (never decode
+        the lossy device encoding back)."""
+        import itertools
+        from ..device import cache as dcache, column as dcol, fragment
+        from ..device import runtime as drt
+
+        def load(t) -> RecordBatch:
+            est = t.size_bytes() or 0
+            self.mem.acquire(est)
+            try:
+                return MicroPartition.from_scan_task(t).combined()
+            finally:
+                self.mem.release(est)
+
+        def resolve(t):
+            fp = dcache.task_fingerprint(t)
+            if fp is not None:
+                dt = dcache.get_cache().get_table(fp, prog.compiled.needs_cols)
+                if dt is not None:
+                    return ("dev", dt, t)
+            rb = load(t)
+            if len(rb) < max(drt._min_rows(), 1):
+                return ("host", rb, t)
+            for nm in prog.compiled.needs_cols:
+                if rb.get_column(nm).is_pyobject():
+                    return ("host", rb, t)
+            try:
+                dt = dcol.encode_batch(rb, prog.compiled.needs_cols)
+            except (ValueError, TypeError):
+                return ("host", rb, t)
+            if fp is not None:
+                dcache.get_cache().put_table(fp, dt)
+            return ("dev", dt, t)
+
+        width = max((os.cpu_count() or 4), 4) * 2
+        it = iter(src.tasks)
+        while True:
+            window = list(itertools.islice(it, width))
+            if not window:
+                return
+            resolved = list(_ordered_parallel(iter(window), resolve))
+            outs = fragment.run_fused_agg_tables(
+                prog, [dt for kind, dt, _ in resolved if kind == "dev"],
+                src.schema(), node.group_by, agg_cols, node.schema())
+            di = 0
+            for kind, val, t in resolved:
+                if kind == "dev":
+                    out = outs[di]
+                    di += 1
+                    if out is None:  # device failure → pristine host re-read
+                        yield host_agg(load(t))
+                    else:
+                        yield MicroPartition.from_recordbatch(
+                            out.cast_to_schema(node.schema()))
+                else:
+                    yield host_agg(val)
+
+    def _exec_DeviceExchangeAgg(self, node: pp.DeviceExchangeAgg):
+        """Shuffle+final-merge as ONE mesh program: shard the partial group
+        blocks over the device mesh, all_to_all by key hash over ICI, merge,
+        and decode one disjoint group block per shard."""
+        from . import memory
+        parts = memory.materialize(self._exec(node.children[0]))
+        outs = self._mesh_exchange_agg(node, parts)
+        if outs is not None:
+            yield from outs
+            return
+        # host fallback: hash exchange + final aggregate (what translate
+        # would have emitted without the mesh, including its partition cap)
+        n = max(min(len(parts),
+                    self.cfg.shuffle_aggregation_default_partitions), 1)
+        split = self._materialize_split(_ordered_parallel(
+            iter(parts),
+            lambda p: p.partition_by_hash(list(node.group_by), n)))
+        regrouped = self._regroup(split, n)
+        yield from _ordered_parallel(
+            regrouped, lambda p: MicroPartition.from_recordbatch(
+                p.combined().agg(node.aggs, node.group_by)
+                .cast_to_schema(node.schema())))
+
+    def _mesh_exchange_agg(self, node, parts) -> Optional[List[MicroPartition]]:
+        import jax
+        import numpy as np
+        from ..aggs import split_agg_expr
+        from ..device import column as dcol, runtime as drt
+        from ..parallel import exchange, mesh as pmesh
+        if not drt.device_enabled():
+            return None
+        mesh = pmesh.get_mesh()
+        if mesh is None or pmesh.mesh_size() < 2:
+            return None
+        rb = RecordBatch.concat([p.combined() for p in parts]) \
+            if len(parts) > 1 else parts[0].combined()
+        if len(rb) == 0:
+            return [MicroPartition.from_recordbatch(
+                RecordBatch.empty(node.schema()))]
+        key_names = [g.name() for g in node.group_by]
+        specs = [split_agg_expr(a) for a in node.aggs]
+        ops = tuple(s[0] for s in specs)
+        val_names = [s[1]._unalias().params[0] for s in specs]
+        out_names = [s[2] for s in specs]
+        n = pmesh.mesh_size()
+        total = len(rb)
+        C = (total + n - 1) // n
+        cap = n * C
+
+        encode = _np_plane_encoder(rb, cap)
+        kplanes = _encode_plane_lists(encode, key_names)
+        vplanes = _encode_plane_lists(encode, val_names)
+        if kplanes is None or vplanes is None:
+            return None
+        keys, kvalids = kplanes
+        vals, vvalids = vplanes
+        mask = np.arange(cap) < total
+        try:
+            sb = lambda a: exchange.shard_blocks(mesh, a)
+            fk, fkv, fv, fvv, gmask = exchange.sharded_grouped_agg(
+                mesh, tuple(sb(k) for k in keys),
+                tuple(sb(k) for k in kvalids),
+                tuple(sb(v) for v in vals),
+                tuple(sb(v) for v in vvalids), sb(mask), ops)
+            host = jax.device_get((fk, fkv, fv, fvv, gmask))
+        except Exception:
+            return None
+        fk, fkv, fv, fvv, gmask = [
+            [np.asarray(a) for a in grp] if isinstance(grp, (list, tuple))
+            else np.asarray(grp) for grp in host]
+        spec = [(nm, node.schema()[nm].dtype, fk[i], fkv[i])
+                for i, nm in enumerate(key_names)]
+        spec += [(nm, node.schema()[nm].dtype, fv[j], fvv[j])
+                 for j, nm in enumerate(out_names)]
+        return _decode_mesh_shards(n, gmask, spec, node.schema())
+
+    def _mesh_hash_repartition(self, parts, by, n: int
+                               ) -> Optional[List[MicroPartition]]:
+        """Hash repartition as one all_to_all over the device mesh — chosen
+        when the target partition count equals the mesh width and every
+        column is plain device-representable (no variable-width payloads:
+        those ride the host exchange, SURVEY.md §7 hard-part #2)."""
+        import jax
+        from ..device import column as dcol, runtime as drt
+        from ..parallel import exchange, mesh as pmesh
+        if not drt.device_enabled():
+            return None
+        if pmesh.mesh_size() < 2 or n != pmesh.mesh_size():
+            return None
+        mesh = pmesh.get_mesh()
+        rb = RecordBatch.concat([p.combined() for p in parts]) \
+            if len(parts) > 1 else parts[0].combined()
+        schema = rb.schema
+        # pure data movement must be bit-exact: every column must round-trip
+        # the device encoding losslessly (no decimals-as-floats, no f64→f32)
+        for f in schema:
+            if not dcol.is_lossless_device_dtype(f.dtype):
+                return None
+        if len(rb) == 0:
+            return [MicroPartition.from_recordbatch(RecordBatch.empty(schema))
+                    for _ in range(n)]
+        total = len(rb)
+        C = (total + n - 1) // n
+        cap = n * C
+        # destination shard from the SAME xxh64 chain as the host exchange
+        # (partition_by_hash) so co-partitioned joins agree across tiers
+        try:
+            key_s = [rb.eval_expression(e) for e in by]
+            h = key_s[0].hash()
+            for k in key_s[1:]:
+                h = k.hash(seed=h)
+            pid = (h.to_numpy() % np.uint64(n)).astype(np.int32)
+        except Exception:
+            return None
+        pid = np.concatenate(
+            [pid, np.zeros(cap - total, dtype=np.int32)])
+        encode = _np_plane_encoder(rb, cap)
+        names = schema.column_names
+        enc = _encode_plane_lists(encode, names)
+        if enc is None:
+            return None
+        planes, valids = enc
+        mask = np.arange(cap) < total
+        try:
+            sb = lambda a: exchange.shard_blocks(mesh, a)
+            op, ov, om = exchange.sharded_hash_repartition(
+                mesh, tuple(sb(p) for p in planes),
+                tuple(sb(v) for v in valids), sb(mask), sb(pid))
+            host = jax.device_get((op, ov, om))
+        except Exception:
+            return None
+        op, ov, om = [[np.asarray(a) for a in grp]
+                      if isinstance(grp, (list, tuple)) else np.asarray(grp)
+                      for grp in host]
+        spec = [(nm, schema[nm].dtype, op[j], ov[j])
+                for j, nm in enumerate(names)]
+        return _decode_mesh_shards(n, om, spec, schema)
 
     def _exec_Dedup(self, node: pp.Dedup):
         child = self._exec(node.children[0])
@@ -279,6 +519,10 @@ class LocalExecutor:
             return
         if kind == "hash":
             by = list(node.by)
+            mesh_out = self._mesh_hash_repartition(parts, by, n)
+            if mesh_out is not None:
+                yield from mesh_out
+                return
             split = self._materialize_split(_ordered_parallel(
                 iter(parts), lambda p: p.partition_by_hash(by, n)))
             yield from self._regroup(split, n)
@@ -422,6 +666,62 @@ class LocalExecutor:
 def _lit_true() -> Expression:
     from ..expressions.expressions import lit
     return lit(True)
+
+
+def _encode_plane_lists(encode, names):
+    """Encode columns into parallel (values, valids) plane lists; None when
+    any column lacks a plain device representation."""
+    vals, valids = [], []
+    for nm in names:
+        enc = encode(nm)
+        if enc is None:
+            return None
+        vals.append(enc[0])
+        valids.append(enc[1])
+    return vals, valids
+
+
+def _decode_mesh_shards(n: int, live_mask: np.ndarray, cols_spec, schema
+                        ) -> List[MicroPartition]:
+    """Slice exchanged [n*C'] blocks into per-shard MicroPartitions.
+    cols_spec: ordered (name, dtype, values_plane, valids_plane) tuples."""
+    from ..device import column as dcol
+    shard_len = live_mask.shape[0] // n
+    outs = []
+    for i in range(n):
+        sl = slice(i * shard_len, (i + 1) * shard_len)
+        live = live_mask[sl]
+        cnt = int(live.sum())
+        cols = []
+        for nm, dtype, v, m in cols_spec:
+            dc = dcol.DeviceColumn(v[sl][live], m[sl][live], dtype, None)
+            cols.append(dcol.decode_column(nm, dc, cnt))
+        outs.append(MicroPartition.from_recordbatch(
+            RecordBatch.from_series(cols).cast_to_schema(schema)))
+    return outs
+
+
+def _np_plane_encoder(rb: RecordBatch, cap: int):
+    """Column name → (values, validity) numpy planes zero-padded to cap, or
+    None when the column has no plain device representation."""
+    import pyarrow as pa
+    from ..device import column as dcol
+
+    def encode(name):
+        try:
+            vals, valid, dictionary = dcol._np_encode(rb.get_column(name))
+        except (ValueError, TypeError, pa.ArrowInvalid):
+            return None
+        if dictionary is not None:
+            return None
+        if len(vals) < cap:
+            vals = np.concatenate(
+                [vals, np.zeros(cap - len(vals), dtype=vals.dtype)])
+            valid = np.concatenate(
+                [valid, np.zeros(cap - len(valid), dtype=np.bool_)])
+        return vals, valid
+
+    return encode
 
 
 def _gather_all(parts: Iterator[MicroPartition]) -> MicroPartition:

@@ -48,8 +48,20 @@ def all_to_all_by_hash(keys: jnp.ndarray, payload: Tuple[jnp.ndarray, ...],
     Returns (keys, payload..., row_mask) blocks of shape [n*cap_per_bucket]
     on each shard.
     """
-    C = keys.shape[0]
     pid = (_hash_u32(keys) % jnp.uint32(n_shards)).astype(jnp.int32)
+    k2, out, m2 = all_to_all_by_pid(pid, (keys,) + payload, row_mask,
+                                    n_shards, axis)
+    return out[0], out[1:], m2
+
+
+def all_to_all_by_pid(pid: jnp.ndarray, payload: Tuple[jnp.ndarray, ...],
+                      row_mask: jnp.ndarray, n_shards: int, axis: str):
+    """all_to_all routing by a precomputed destination-shard plane. Used when
+    the partition assignment must agree with the host tier's hash (join
+    co-partitioning: both sides of a hash join must route identically, so
+    the pid is computed once with the engine-wide xxh64 chain and the mesh
+    merely moves the rows)."""
+    C = pid.shape[0]
     pid = jnp.where(row_mask, pid, n_shards)  # dead rows bucket to the end
     # stable sort rows by destination bucket
     order = jnp.argsort(pid, stable=True)
@@ -60,10 +72,8 @@ def all_to_all_by_hash(keys: jnp.ndarray, payload: Tuple[jnp.ndarray, ...],
         sorted_pid, sorted_pid, side="left")
     slots = jnp.where(sorted_pid < n_shards,
                       sorted_pid * C + in_bucket_pos, n_shards * C)
-    frame = jnp.zeros((n_shards * C,), keys.dtype)
     live_sorted = jnp.take(row_mask, order)
     frame_mask = jnp.zeros((n_shards * C,), jnp.bool_)
-    frame = frame.at[slots].set(jnp.take(keys, order), mode="drop")
     frame_mask = frame_mask.at[slots].set(live_sorted, mode="drop")
     out_payload = []
     for p in payload:
@@ -71,15 +81,13 @@ def all_to_all_by_hash(keys: jnp.ndarray, payload: Tuple[jnp.ndarray, ...],
         fp = fp.at[slots].set(jnp.take(p, order), mode="drop")
         out_payload.append(fp)
     # [n_shards, C] frames → all_to_all over the mesh axis
-    k2 = frame.reshape(n_shards, C)
-    m2 = frame_mask.reshape(n_shards, C)
-    k2 = lax.all_to_all(k2, axis, 0, 0, tiled=False)
-    m2 = lax.all_to_all(m2, axis, 0, 0, tiled=False)
+    m2 = lax.all_to_all(frame_mask.reshape(n_shards, C), axis, 0, 0,
+                        tiled=False)
     out2 = []
     for fp in out_payload:
         out2.append(lax.all_to_all(fp.reshape(n_shards, C), axis, 0, 0,
                                    tiled=False).reshape(-1))
-    return k2.reshape(-1), tuple(out2), m2.reshape(-1)
+    return pid, tuple(out2), m2.reshape(-1)
 
 
 def sharded_grouped_sum(mesh: Mesh, keys_sharded, vals_sharded,
@@ -118,3 +126,118 @@ def shard_blocks(mesh: Mesh, arr: np.ndarray, axis: str = "data"):
     """Host ndarray → device array sharded along dim 0 of the mesh axis."""
     sharding = NamedSharding(mesh, P(axis))
     return jax.device_put(arr, sharding)
+
+
+def _combine_hashes(keys, kvalids) -> jnp.ndarray:
+    """Multi-key → one u32 hash plane (boost-style hash_combine)."""
+    h = jnp.zeros(keys[0].shape, jnp.uint32)
+    for k, kv in zip(keys, kvalids):
+        x = k
+        if x.dtype == jnp.bool_:
+            x = x.astype(jnp.uint32)
+        elif jnp.issubdtype(x.dtype, jnp.floating):
+            x = lax.bitcast_convert_type(
+                x.astype(jnp.float32), jnp.uint32)
+        elif x.dtype in (jnp.int64, jnp.uint64):
+            lo = (x & 0xFFFFFFFF).astype(jnp.uint32)
+            hi = ((x >> 32) & 0xFFFFFFFF).astype(jnp.uint32)
+            x = lo ^ (hi * jnp.uint32(0x9E3779B9))
+        else:
+            x = x.astype(jnp.uint32)
+        hk = _hash_u32(x ^ kv.astype(jnp.uint32))
+        h = h ^ (hk + jnp.uint32(0x9E3779B9) + (h << 6) + (h >> 2))
+    return h
+
+
+# final-merge ops that combine with themselves (x ⊕ x is the correct merge of
+# two partials): the partial/final agg split upstream reduces count/mean/var
+# to sums before this layer.
+MERGEABLE_OPS = ("sum", "min", "max", "any_value", "bool_and", "bool_or")
+
+
+def sharded_grouped_agg(mesh: Mesh, keys, kvalids, vals, vvalids, mask,
+                        ops: Tuple[str, ...], axis: str = "data"):
+    """Fused map→all_to_all→reduce grouped aggregation over the mesh, for any
+    number of key/value planes. The general engine path behind
+    ``DeviceExchangeAgg`` (reference seam: the ShuffleExchange strategy enum,
+    ``src/daft-physical-plan/src/ops/shuffle_exchange.rs:41-58`` — here the
+    strategy *is* an ICI collective inside one XLA program).
+
+    keys/vals: tuples of [n*C] arrays sharded on dim 0; ops must all be in
+    MERGEABLE_OPS. Returns (keys, kvalids, vals, vvalids, group_mask) blocks,
+    one [C']-sized group block per shard with disjoint key sets.
+    """
+    n = mesh.shape[axis]
+    nk, nv = len(keys), len(vals)
+    assert all(op in MERGEABLE_OPS for op in ops), ops
+
+    from jax import shard_map
+
+    spec_in = (P(axis),) * (2 * nk + 2 * nv + 1)
+    spec_out = (P(axis),) * (2 * nk + 2 * nv + 1)
+
+    @partial(shard_map, mesh=mesh, in_specs=spec_in, out_specs=spec_out,
+             check_vma=False)
+    def run(*args):
+        ks = tuple(a.reshape(-1) for a in args[:nk])
+        kvs = tuple(a.reshape(-1) for a in args[nk:2 * nk])
+        vs = tuple(a.reshape(-1) for a in args[2 * nk:2 * nk + nv])
+        vvs = tuple(a.reshape(-1) for a in args[2 * nk + nv:2 * nk + 2 * nv])
+        m = args[-1].reshape(-1)
+        # (1) local partial merge (shrinks data before the exchange)
+        ok, okv, ov, ovv, cnt = kernels.grouped_agg_impl(ks, kvs, vs, vvs,
+                                                         m, ops)
+        pmask = jnp.arange(ok[0].shape[0]) < cnt
+        # (2) exchange group blocks so equal keys land on one shard
+        h = _combine_hashes(ok, okv)
+        payload = tuple(ok) + tuple(okv) + tuple(ov) + tuple(ovv)
+        _, payload2, m2 = all_to_all_by_hash(h.astype(jnp.int32), payload,
+                                             pmask, n, axis)
+        ks2 = payload2[:nk]
+        kvs2 = payload2[nk:2 * nk]
+        vs2 = payload2[2 * nk:2 * nk + nv]
+        vvs2 = payload2[2 * nk + nv:]
+        # (3) final merge of received partials
+        fk, fkv, fv, fvv, fcnt = kernels.grouped_agg_impl(
+            ks2, kvs2, vs2, vvs2, m2, ops)
+        fmask = jnp.arange(fk[0].shape[0]) < fcnt
+        return fk + fkv + fv + fvv + (fmask,)
+
+    flat = run(*(tuple(keys) + tuple(kvalids) + tuple(vals) + tuple(vvalids)
+                 + (mask,)))
+    fk = flat[:nk]
+    fkv = flat[nk:2 * nk]
+    fv = flat[2 * nk:2 * nk + nv]
+    fvv = flat[2 * nk + nv:2 * nk + 2 * nv]
+    return fk, fkv, fv, fvv, flat[-1]
+
+
+def sharded_hash_repartition(mesh: Mesh, planes, valids, mask, pid,
+                             axis: str = "data"):
+    """Hash-repartition row blocks across the mesh with one all_to_all: shard
+    i ends up holding every row whose ``pid`` plane says i. The pid is
+    computed HOST-side with the engine-wide xxh64 chain
+    (``recordbatch.py partition_by_hash``) so mesh- and host-exchanged
+    partitions of the same key agree — a hash join may co-partition one side
+    on the mesh and the other on the host. planes: tuple of [n*C] column
+    arrays. Returns (planes, valids, row_mask) received blocks per shard."""
+    n = mesh.shape[axis]
+    np_ = len(planes)
+
+    from jax import shard_map
+
+    spec_in = (P(axis),) * (2 * np_ + 2)
+    spec_out = (P(axis),) * (2 * np_ + 1)
+
+    @partial(shard_map, mesh=mesh, in_specs=spec_in, out_specs=spec_out,
+             check_vma=False)
+    def run(*args):
+        ps = tuple(a.reshape(-1) for a in args[:np_])
+        vs = tuple(a.reshape(-1) for a in args[np_:2 * np_])
+        m = args[-2].reshape(-1)
+        p = args[-1].reshape(-1)
+        _, payload2, m2 = all_to_all_by_pid(p, ps + vs, m, n, axis)
+        return tuple(payload2) + (m2,)
+
+    flat = run(*(tuple(planes) + tuple(valids) + (mask, pid)))
+    return flat[:np_], flat[np_:2 * np_], flat[-1]

@@ -1,0 +1,57 @@
+"""Process-wide device mesh singleton.
+
+The engine's collective exchanges run over one 1-D ``data`` mesh spanning
+every visible device (virtual CPU devices under
+``xla_force_host_platform_device_count`` in tests, real chips on a pod).
+``DAFT_TPU_MESH_DEVICES`` caps the axis length; mesh construction is guarded
+behind the watchdog-probed backend (device/backend.py) so a wedged plugin
+can't hang planning.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_mesh = None
+_size: Optional[int] = None
+
+
+def mesh_size() -> int:
+    """Number of devices the exchange mesh would span (0 = no device)."""
+    global _size
+    if _size is not None:
+        return _size
+    from ..device import backend
+    if backend.backend_name() is None:
+        _size = 0
+        return 0
+    import jax
+
+    n = len(jax.devices())
+    cap = os.environ.get("DAFT_TPU_MESH_DEVICES")
+    if cap is not None:
+        n = min(n, int(cap))
+    _size = n
+    return n
+
+
+def get_mesh():
+    global _mesh
+    with _lock:
+        if _mesh is None:
+            from . import exchange
+            n = mesh_size()
+            if n < 1:
+                return None
+            _mesh = exchange.make_mesh(n)
+        return _mesh
+
+
+def reset_for_tests() -> None:
+    global _mesh, _size
+    with _lock:
+        _mesh = None
+        _size = None

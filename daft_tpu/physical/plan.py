@@ -115,6 +115,22 @@ class DeviceFragmentAgg(PhysicalPlan):
         self.mode = mode
 
 
+class DeviceExchangeAgg(PhysicalPlan):
+    """Mesh-collective shuffle+final-aggregate: the partial group blocks from
+    the child are sharded over the device mesh, exchanged by key hash with
+    ``lax.all_to_all`` over ICI, and final-merged — all inside one jit
+    program (parallel/exchange.py ``sharded_grouped_agg``). Replaces the
+    host Exchange(hash) + final Aggregate pair when key/value dtypes are
+    device-representable and every final op is mesh-mergeable. Yields one
+    partition per mesh shard (disjoint key sets). Falls back to the host
+    pair at runtime if encoding fails."""
+
+    def __init__(self, child, aggs, group_by, schema):
+        super().__init__([child], schema)
+        self.aggs = aggs          # final-merge aggs over partial columns
+        self.group_by = group_by
+
+
 class Dedup(PhysicalPlan):
     def __init__(self, child, on):
         super().__init__([child], child.schema())
@@ -173,6 +189,17 @@ class Exchange(PhysicalPlan):
         self.num_partitions = num_partitions
         self.by = by
         self.descending = descending
+
+
+class StageInput(PhysicalPlan):
+    """Leaf standing for another stage's exchanged output (flotilla's
+    PreviousStageScan / InMemory pipeline-node seam,
+    ``src/daft-physical-plan/src/plan.rs`` PreviousStageScan). The executor
+    resolves it from the stage-input bindings passed at run time."""
+
+    def __init__(self, stage_id: int, schema: Schema):
+        super().__init__([], schema)
+        self.stage_id = stage_id
 
 
 class Concat(PhysicalPlan):

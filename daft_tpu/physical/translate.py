@@ -197,19 +197,53 @@ def _translate_agg(node: lp.Aggregate, cfg) -> pp.PhysicalPlan:
     if p1 is None:
         p1 = pp.Aggregate(pchild, partial_aggs, node.group_by, p1_schema,
                           "partial")
-    if node.group_by:
-        ex = pp.Exchange(
-            p1, "hash",
-            min(max(nparts, 1), cfg.shuffle_aggregation_default_partitions)
-            if nparts > 1 else 1,
-            tuple(col(e.name()) for e in node.group_by))
-    else:
-        ex = pp.Exchange(p1, "gather", 1)
     gb2 = [col(e.name()) for e in node.group_by]
     f_schema = _agg_schema(gb2, final_aggs, p1_schema)
-    p2 = pp.Aggregate(ex, final_aggs, gb2, f_schema, "final")
+    mesh_ex = _try_mesh_exchange_agg(p1, final_aggs, gb2, f_schema, p1_schema)
+    if mesh_ex is not None:
+        p2 = mesh_ex
+    else:
+        if node.group_by:
+            ex = pp.Exchange(
+                p1, "hash",
+                min(max(nparts, 1), cfg.shuffle_aggregation_default_partitions)
+                if nparts > 1 else 1,
+                tuple(col(e.name()) for e in node.group_by))
+        else:
+            ex = pp.Exchange(p1, "gather", 1)
+        p2 = pp.Aggregate(ex, final_aggs, gb2, f_schema, "final")
     proj = [col(e.name()) for e in node.group_by] + final_proj
     return pp.Project(p2, proj, node.schema())
+
+
+def _try_mesh_exchange_agg(p1, final_aggs, gb2, f_schema: Schema,
+                           p1_schema: Schema) -> Optional[pp.PhysicalPlan]:
+    """Choose the ICI-collective shuffle+merge when statically sound: a
+    multi-device mesh is up, every group key / partial value is a plain
+    device-representable column (no dictionary columns — codes aren't
+    comparable across partitions), and every final op merges with itself."""
+    from ..aggs import split_agg_expr
+    from ..device import column as dcol, runtime as drt
+    from ..parallel import mesh as pmesh
+    from ..parallel.exchange import MERGEABLE_OPS
+    if not gb2:
+        return None  # global aggs gather a handful of scalars — host wins
+    if not drt.device_enabled() or pmesh.mesh_size() < 2:
+        return None
+    for g in gb2:
+        # keys must round-trip the device encoding bit-exactly
+        if not dcol.is_lossless_device_dtype(p1_schema[g.name()].dtype):
+            return None
+    for a in final_aggs:
+        op, child_e, name, params = split_agg_expr(a)
+        if op not in MERGEABLE_OPS:
+            return None
+        if child_e is None or child_e._unalias().op != "col":
+            return None
+        if not dcol.is_lossless_device_dtype(
+                p1_schema[child_e._unalias().params[0]].dtype):
+            return None
+    return pp.DeviceExchangeAgg(p1, final_aggs, gb2, f_schema)
 
 
 def _try_fuse_partial(pchild: pp.PhysicalPlan, partial_aggs, group_by,
