@@ -1,0 +1,18 @@
+"""Distributed execution: stage planning, scheduler, workers.
+
+The engine's flotilla-equivalent (reference: ``src/daft-distributed`` — stage
+split at data movement ``stage/mod.rs:54-80``, pluggable Scheduler trait
+``scheduling/scheduler/mod.rs:18-23``, Worker/WorkerManager abstractions
+``scheduling/worker.rs:13-25``, mock-worker tests ``scheduling/tests.rs``) —
+re-expressed for a TPU pod: workers are per-host local executors, exchanges
+between stages ride the mesh collectives or the driver's host exchange.
+"""
+
+from .stages import Stage, StagePlan
+from .worker import Worker, InProcessWorker, WorkerManager, StageTask
+from .scheduler import (Scheduler, RoundRobinScheduler, LeastLoadedScheduler,
+                        StageRunner)
+
+__all__ = ["Stage", "StagePlan", "Worker", "InProcessWorker",
+           "WorkerManager", "StageTask", "Scheduler", "RoundRobinScheduler",
+           "LeastLoadedScheduler", "StageRunner"]

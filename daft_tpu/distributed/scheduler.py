@@ -1,0 +1,139 @@
+"""Pluggable scheduling policies + the stage-driving runner.
+
+Reference: flotilla's ``Scheduler`` trait and scheduler actor
+(``src/daft-distributed/src/scheduling/scheduler/mod.rs:18-23``; default
+locality/spread policy ``scheduler/default.rs``, linear policy
+``scheduler/linear.rs``) — policies are pure functions over worker snapshots
+so they unit-test against mock workers with no hardware, exactly like the
+reference's ``scheduling/tests.rs``.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Iterator, List, Optional
+
+from ..micropartition import MicroPartition
+from ..physical import plan as pp
+from .stages import Boundary, Stage, StagePlan
+from .worker import StageTask, WorkerManager, WorkerState
+
+
+class Scheduler:
+    """Policy: pick a worker for a task given current worker states."""
+
+    def pick(self, task: StageTask, states: List[WorkerState]) -> str:
+        raise NotImplementedError
+
+
+class RoundRobinScheduler(Scheduler):
+    """Spread tasks evenly regardless of load (reference linear policy)."""
+
+    def __init__(self):
+        self._counter = itertools.count()
+
+    def pick(self, task: StageTask, states: List[WorkerState]) -> str:
+        if task.preferred_worker is not None:
+            for st in states:
+                if st.worker.id == task.preferred_worker:
+                    return st.worker.id
+        return states[next(self._counter) % len(states)].worker.id
+
+
+class LeastLoadedScheduler(Scheduler):
+    """Soft-affinity + least-active placement (reference default policy:
+    WorkerAffinity falls back to Spread)."""
+
+    def pick(self, task: StageTask, states: List[WorkerState]) -> str:
+        if task.preferred_worker is not None:
+            for st in states:
+                if st.worker.id == task.preferred_worker \
+                        and st.active < st.worker.num_slots:
+                    return st.worker.id
+        return min(states, key=lambda s: (s.active, s.worker.id)).worker.id
+
+
+class StageRunner:
+    """Drives a StagePlan: dispatches each stage's tasks through the
+    scheduler, executes exchange boundaries on the driver, feeds results
+    downstream. Failed tasks are retried once on a different worker
+    (reference: per-task retry semantics delegated to Ray in the original;
+    here the runner owns them)."""
+
+    def __init__(self, manager: WorkerManager,
+                 scheduler: Optional[Scheduler] = None, max_retries: int = 1):
+        self.manager = manager
+        self.scheduler = scheduler or LeastLoadedScheduler()
+        self.max_retries = max_retries
+
+    # ------------------------------------------------------------------
+    def run(self, stage_plan: StagePlan) -> Iterator[MicroPartition]:
+        outputs: Dict[int, List[MicroPartition]] = {}
+        for stage in stage_plan.stages:
+            stage_inputs: Dict[int, List[MicroPartition]] = {}
+            for b in stage.boundaries:
+                stage_inputs[b.upstream] = self._apply_exchange(
+                    b, outputs.pop(b.upstream))
+            outputs[stage.id] = self._run_stage(stage, stage_inputs)
+        yield from outputs[stage_plan.root.id]
+
+    # ------------------------------------------------------------------
+    def _make_tasks(self, stage: Stage,
+                    stage_inputs: Dict[int, List[MicroPartition]]
+                    ) -> List[StageTask]:
+        """Shard a map-like scan stage across workers (contiguous chunks —
+        preserves partition order); everything else is one task."""
+        n_workers = len(self.manager.worker_ids)
+        src = stage.scan_source()
+        if n_workers > 1 and src is not None and len(src.tasks) > 1 \
+                and stage.is_map_like():
+            k = min(n_workers, len(src.tasks))
+            per = (len(src.tasks) + k - 1) // k
+            tasks = []
+            for i in range(k):
+                chunk = src.tasks[i * per:(i + 1) * per]
+                if not chunk:
+                    continue
+                tasks.append(StageTask(stage.id, stage.with_scan_tasks(chunk),
+                                       stage_inputs, task_idx=i))
+            return tasks
+        return [StageTask(stage.id, stage.plan, stage_inputs)]
+
+    def _run_stage(self, stage: Stage,
+                   stage_inputs: Dict[int, List[MicroPartition]]
+                   ) -> List[MicroPartition]:
+        tasks = self._make_tasks(stage, stage_inputs)
+        futures = []
+        for t in tasks:
+            wid = self.scheduler.pick(t, self.manager.snapshot())
+            futures.append((t, wid, self.manager.dispatch(t, wid)))
+        parts: List[MicroPartition] = []
+        for t, wid, fut in futures:
+            try:
+                parts.extend(fut.result())
+            except Exception:
+                if self.max_retries < 1:
+                    raise
+                parts.extend(self._retry(t, exclude=wid))
+        return parts
+
+    def _retry(self, task: StageTask, exclude: str) -> List[MicroPartition]:
+        states = [s for s in self.manager.snapshot()
+                  if s.worker.id != exclude] or self.manager.snapshot()
+        wid = self.scheduler.pick(task, states)
+        return self.manager.dispatch(task, wid).result()
+
+    # ------------------------------------------------------------------
+    def _apply_exchange(self, b: Boundary, parts: List[MicroPartition]
+                        ) -> List[MicroPartition]:
+        """Execute one exchange boundary on the driver: the materializing
+        map/reduce transport between stages (mesh-collective exchanges run
+        inside stages as DeviceExchangeAgg programs instead)."""
+        from ..execution.executor import LocalExecutor
+        if not parts:
+            return parts
+        schema = parts[0].schema
+        node = pp.Exchange(pp.InMemorySource(parts, schema), b.kind,
+                           b.num_partitions, b.by, b.descending)
+        ex = LocalExecutor()
+        return list(ex.run(node))

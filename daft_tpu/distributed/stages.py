@@ -1,0 +1,134 @@
+"""Stage planning: split the physical plan at exchange boundaries.
+
+Reference model: flotilla's ``StagePlan::from_logical_plan`` splits at data
+movement (``src/daft-distributed/src/stage/mod.rs:54-80``). Here the split
+runs over the already-translated physical plan: every ``Exchange`` node
+becomes a stage boundary — its subtree is the upstream stage, and the
+downstream stage sees a ``StageInput`` leaf. The exchange itself is executed
+by the driver between stages (the classic fully-materializing map/reduce
+transport; the mesh-collective DeviceExchangeAgg stays *inside* a stage
+because it is one fused program, not a materialization point).
+
+A stage is therefore an exchange-free fragment whose leaves are scan tasks,
+in-memory partitions, or upstream stage outputs — exactly the shape of a
+dispatchable worker task (flotilla's SwordfishTask carries a LocalPhysicalPlan
+fragment the same way).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..physical import plan as pp
+
+
+@dataclass
+class Boundary:
+    """An exchange edge feeding a stage."""
+
+    upstream: int
+    kind: str
+    num_partitions: int
+    by: Tuple = ()
+    descending: Tuple = ()
+
+
+@dataclass
+class Stage:
+    id: int
+    plan: pp.PhysicalPlan
+    boundaries: List[Boundary] = field(default_factory=list)
+
+    def is_map_like(self) -> bool:
+        """True when the fragment is partition-parallel end-to-end, so its
+        scan tasks can shard across workers without changing semantics."""
+        ok = (pp.ScanSource, pp.InMemorySource, pp.StageInput, pp.Project,
+              pp.Filter, pp.UDFProject, pp.Explode, pp.Unpivot, pp.Sample,
+              pp.DeviceFragmentAgg)
+
+        def walk(n) -> bool:
+            if isinstance(n, pp.Aggregate):
+                return n.mode == "partial" and all(walk(c)
+                                                   for c in n.children)
+            if not isinstance(n, ok):
+                return False
+            return all(walk(c) for c in n.children)
+
+        return walk(self.plan)
+
+    def scan_source(self) -> Optional[pp.ScanSource]:
+        found = []
+
+        def walk(n):
+            if isinstance(n, pp.ScanSource):
+                found.append(n)
+            for c in n.children:
+                walk(c)
+
+        walk(self.plan)
+        return found[0] if len(found) == 1 else None
+
+    def with_scan_tasks(self, tasks) -> pp.PhysicalPlan:
+        """Shallow-clone the fragment with the (single) ScanSource's task
+        list replaced — used to shard a map-like stage across workers."""
+
+        def clone(n):
+            c = copy.copy(n)
+            if isinstance(c, pp.ScanSource):
+                c.tasks = list(tasks)
+            else:
+                c.children = [clone(ch) for ch in n.children]
+            return c
+
+        return clone(self.plan)
+
+
+class StagePlan:
+    """Topologically-ordered stages; the last stage is the query root."""
+
+    def __init__(self, stages: List[Stage]):
+        self.stages = stages
+
+    @property
+    def root(self) -> Stage:
+        return self.stages[-1]
+
+    @classmethod
+    def from_physical(cls, plan: pp.PhysicalPlan) -> "StagePlan":
+        stages: List[Stage] = []
+        counter = [0]
+
+        def cut(node: pp.PhysicalPlan, boundaries: List[Boundary]):
+            """Rewrite `node`'s subtree for the current stage, emitting
+            upstream stages at every Exchange."""
+            if isinstance(node, pp.Exchange):
+                up_boundaries: List[Boundary] = []
+                up_plan = cut(node.children[0], up_boundaries)
+                sid = counter[0]
+                counter[0] += 1
+                stages.append(Stage(sid, up_plan, up_boundaries))
+                boundaries.append(Boundary(sid, node.kind,
+                                           node.num_partitions,
+                                           tuple(node.by),
+                                           tuple(node.descending)))
+                return pp.StageInput(sid, node.schema())
+            n = copy.copy(node)
+            n.children = [cut(c, boundaries) for c in node.children]
+            return n
+
+        root_boundaries: List[Boundary] = []
+        root_plan = cut(plan, root_boundaries)
+        sid = counter[0]
+        stages.append(Stage(sid, root_plan, root_boundaries))
+        return cls(stages)
+
+    def repr_ascii(self) -> str:
+        lines = []
+        for s in self.stages:
+            ins = ", ".join(f"stage{b.upstream}→{b.kind}({b.num_partitions})"
+                            for b in s.boundaries) or "-"
+            lines.append(f"Stage {s.id}: root={s.plan.name()} inputs=[{ins}]"
+                         f" map_like={s.is_map_like()}")
+        return "\n".join(lines)

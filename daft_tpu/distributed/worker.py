@@ -1,0 +1,111 @@
+"""Worker abstraction for distributed stage execution.
+
+Reference: the flotilla Worker/WorkerManager traits
+(``src/daft-distributed/src/scheduling/worker.rs:13-25``) whose first
+implementation is a Ray actor per node; here the first implementation is an
+in-process worker (one per mesh device group / CPU slice), and the seam is
+identical: ``submit`` returns a future of materialized partitions, so a
+multi-host gRPC worker drops in without touching the scheduler.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..micropartition import MicroPartition
+from ..physical import plan as pp
+
+
+@dataclass
+class StageTask:
+    """One dispatchable unit: an exchange-free plan fragment plus its
+    stage-input bindings (flotilla's SwordfishTask shape,
+    ``scheduling/task.rs:80``)."""
+
+    stage_id: int
+    plan: pp.PhysicalPlan
+    stage_inputs: Dict[int, List[MicroPartition]]
+    task_idx: int = 0
+    preferred_worker: Optional[str] = None
+
+
+class Worker:
+    """Abstract worker: executes StageTasks, reports capacity."""
+
+    id: str
+    num_slots: int
+
+    def submit(self, task: StageTask) -> "cf.Future[List[MicroPartition]]":
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class InProcessWorker(Worker):
+    """Runs stage fragments on a local streaming executor (per-host worker
+    in a pod deployment; the only worker type on a single host)."""
+
+    def __init__(self, worker_id: str, num_slots: int = 2):
+        self.id = worker_id
+        self.num_slots = num_slots
+        self._pool = cf.ThreadPoolExecutor(
+            max_workers=num_slots, thread_name_prefix=f"daft-tpu-{worker_id}")
+
+    def submit(self, task: StageTask) -> "cf.Future[List[MicroPartition]]":
+        return self._pool.submit(self._run, task)
+
+    @staticmethod
+    def _run(task: StageTask) -> List[MicroPartition]:
+        from ..execution.executor import LocalExecutor
+        ex = LocalExecutor()
+        return list(ex.run(task.plan, stage_inputs=task.stage_inputs))
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+@dataclass
+class WorkerState:
+    worker: Worker
+    active: int = 0
+
+
+class WorkerManager:
+    """Tracks workers and in-flight load; routes submissions through a
+    scheduling policy (reference: ``scheduling/worker.rs`` WorkerManager +
+    dispatcher)."""
+
+    def __init__(self, workers: List[Worker]):
+        self._lock = threading.Lock()
+        self.states: Dict[str, WorkerState] = {
+            w.id: WorkerState(w) for w in workers}
+
+    @property
+    def worker_ids(self) -> List[str]:
+        return list(self.states)
+
+    def snapshot(self) -> List[WorkerState]:
+        with self._lock:
+            return list(self.states.values())
+
+    def dispatch(self, task: StageTask, worker_id: str
+                 ) -> "cf.Future[List[MicroPartition]]":
+        with self._lock:
+            st = self.states[worker_id]
+            st.active += 1
+        fut = st.worker.submit(task)
+
+        def _done(_):
+            with self._lock:
+                st.active -= 1
+
+        fut.add_done_callback(_done)
+        return fut
+
+    def shutdown(self) -> None:
+        for st in self.snapshot():
+            st.worker.shutdown()

@@ -1,40 +1,52 @@
-"""Distributed runner over a jax device Mesh (single- or multi-host SPMD).
+"""Distributed runner: stage plan → scheduler → workers.
 
-Reference architecture: the flotilla engine (``src/daft-distributed``) — a
-stage planner splitting at exchanges, per-worker local execution, a scheduler
-with pluggable policy. TPU mapping: partitions are sharded across mesh
-devices; exchange ops run as ICI collectives (``daft_tpu.parallel``); each
-host runs the local streaming executor for its shard of scan tasks.
+Reference architecture: the flotilla engine (``src/daft-distributed``): the
+logical/physical plan splits into exchange-free stages
+(``stage/mod.rs:54-80``), a scheduler actor places stage tasks on workers
+through a pluggable policy (``scheduling/scheduler/mod.rs:18-23``), and each
+worker runs the local streaming engine on its fragment. Here workers are
+in-process per-host executors (one per CPU slice / mesh device group; a
+multi-host deployment swaps in gRPC workers behind the same ``Worker``
+seam), exchanges between stages run on the driver, and mesh-collective
+exchanges (DeviceExchangeAgg) stay fused inside stages.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Iterator, Optional
 
-from ..execution.executor import LocalExecutor
+from ..distributed import (InProcessWorker, LeastLoadedScheduler, StagePlan,
+                           StageRunner, WorkerManager)
 from ..micropartition import MicroPartition
 from ..physical.translate import translate
 from .runner import Runner
 
 
 class DistributedRunner(Runner):
-    """Runs the physical plan with device-mesh-aware exchanges.
-
-    On one process this is the local executor plus mesh-collective exchange
-    kernels for repartitions (see ``daft_tpu.parallel.exchange``); stage
-    orchestration across hosts reuses the same plan splitting.
-    """
-
     name = "tpu_distributed"
 
-    def __init__(self, num_workers: Optional[int] = None):
+    def __init__(self, num_workers: Optional[int] = None, scheduler=None):
         super().__init__()
-        self.num_workers = num_workers
+        self.num_workers = num_workers or max(
+            int(os.environ.get("DAFT_TPU_NUM_WORKERS", "0"))
+            or min((os.cpu_count() or 4) // 2, 8), 2)
+        self._scheduler = scheduler
+        self._manager: Optional[WorkerManager] = None
+
+    def _get_manager(self) -> WorkerManager:
+        if self._manager is None:
+            slots = max((os.cpu_count() or 4) // self.num_workers, 1)
+            self._manager = WorkerManager(
+                [InProcessWorker(f"worker-{i}", num_slots=slots)
+                 for i in range(self.num_workers)])
+        return self._manager
 
     def run_iter(self, builder, results_buffer_size: Optional[int] = None
                  ) -> Iterator[MicroPartition]:
-        from ..parallel.stage_runner import MeshStageRunner
         optimized = builder.optimize()
         pplan = translate(optimized.plan)
-        runner = MeshStageRunner(self.num_workers)
-        yield from runner.run(pplan)
+        stage_plan = StagePlan.from_physical(pplan)
+        runner = StageRunner(self._get_manager(),
+                             self._scheduler or LeastLoadedScheduler())
+        yield from runner.run(stage_plan)

@@ -1,0 +1,199 @@
+"""Distributed layer: stage splitting, scheduler policies with mock workers
+(no hardware — the reference tests flotilla's scheduler the same way,
+``src/daft-distributed/src/scheduling/tests.rs``), and end-to-end parity of
+the distributed runner against the local runner on a multi-stage join+agg
+query (TPC-H Q5 shape)."""
+
+import concurrent.futures as cf
+from typing import List
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.distributed import (InProcessWorker, LeastLoadedScheduler,
+                                  RoundRobinScheduler, StagePlan, StageRunner,
+                                  StageTask, Worker, WorkerManager)
+from daft_tpu.distributed.worker import WorkerState
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.physical import plan as pp
+from daft_tpu.physical.translate import translate
+from daft_tpu.runners.distributed_runner import DistributedRunner
+
+
+# ---------------------------------------------------------------- mocks
+class MockWorker(Worker):
+    def __init__(self, worker_id, num_slots=2, fail_times=0):
+        self.id = worker_id
+        self.num_slots = num_slots
+        self.submitted: List[StageTask] = []
+        self.fail_times = fail_times
+
+    def submit(self, task):
+        self.submitted.append(task)
+        fut = cf.Future()
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            fut.set_exception(RuntimeError("mock worker down"))
+        else:
+            fut.set_result([MicroPartition.from_pydict({"x": [task.task_idx]})])
+        return fut
+
+
+def _mock_task(i=0, preferred=None):
+    plan = pp.InMemorySource([], None)
+    return StageTask(0, plan, {}, task_idx=i, preferred_worker=preferred)
+
+
+# ------------------------------------------------------------- policies
+def test_round_robin_spreads():
+    ws = [WorkerState(MockWorker(f"w{i}")) for i in range(3)]
+    s = RoundRobinScheduler()
+    picks = [s.pick(_mock_task(i), ws) for i in range(6)]
+    assert picks == ["w0", "w1", "w2", "w0", "w1", "w2"]
+
+
+def test_least_loaded_prefers_idle():
+    ws = [WorkerState(MockWorker("w0")), WorkerState(MockWorker("w1"))]
+    ws[0].active = 2
+    s = LeastLoadedScheduler()
+    assert s.pick(_mock_task(), ws) == "w1"
+
+
+def test_least_loaded_soft_affinity():
+    ws = [WorkerState(MockWorker("w0")), WorkerState(MockWorker("w1"))]
+    s = LeastLoadedScheduler()
+    assert s.pick(_mock_task(preferred="w1"), ws) == "w1"
+    ws[1].active = 99  # affinity target saturated → spill to least loaded
+    assert s.pick(_mock_task(preferred="w1"), ws) == "w0"
+
+
+def test_failed_task_retries_on_other_worker():
+    bad = MockWorker("bad", fail_times=1)
+    good = MockWorker("good")
+    mgr = WorkerManager([bad, good])
+
+    class PickBadFirst:
+        def __init__(self):
+            self.calls = 0
+
+        def pick(self, task, states):
+            self.calls += 1
+            ids = [s.worker.id for s in states]
+            return "bad" if "bad" in ids and self.calls == 1 else ids[0]
+
+    runner = StageRunner(mgr, PickBadFirst())
+    stage_plan = StagePlan.from_physical(
+        pp.InMemorySource([MicroPartition.from_pydict({"x": [1]})], None))
+    parts = list(runner.run(stage_plan))
+    assert len(bad.submitted) == 1
+    assert len(good.submitted) == 1  # retried away from the failed worker
+    assert parts and parts[0].to_pydict() == {"x": [0]}
+
+
+# -------------------------------------------------------- stage planning
+def _stage_plan_for(df) -> StagePlan:
+    return StagePlan.from_physical(translate(df._builder.optimize().plan))
+
+
+def test_stage_split_at_exchanges(tmp_path):
+    # a join between two scans hash-exchanges both sides → ≥3 stages
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    lp = str(tmp_path / "l.parquet")
+    rp = str(tmp_path / "r.parquet")
+    pq.write_table(pa.table({"k": list(range(100)),
+                             "a": list(range(100))}), lp)
+    pq.write_table(pa.table({"k": list(range(100)),
+                             "b": [i * 2 for i in range(100)]}), rp)
+    left = daft_tpu.read_parquet(lp).into_partitions(4)
+    right = daft_tpu.read_parquet(rp).into_partitions(4)
+    df = left.join(right, on="k")
+    sp = _stage_plan_for(df)
+    assert len(sp.stages) >= 3
+    # root stage consumes StageInputs, upstream stages are exchange-free
+    kinds = [b.kind for s in sp.stages for b in s.boundaries]
+    assert "hash" in kinds or "split" in kinds
+
+    def has_exchange(n):
+        return isinstance(n, pp.Exchange) or any(has_exchange(c)
+                                                 for c in n.children)
+
+    for s in sp.stages:
+        assert not has_exchange(s.plan)
+
+
+def test_map_like_scan_stage_shards_across_workers(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    d = tmp_path / "t"
+    d.mkdir()
+    for i in range(6):
+        pq.write_table(pa.table({"x": list(range(i * 10, i * 10 + 10))}),
+                       str(d / f"{i}.parquet"))
+    df = daft_tpu.read_parquet(str(d / "*.parquet")).where(col("x") % 2 == 0)
+    # force a downstream exchange so the scan becomes its own stage
+    df = df.repartition(2, col("x"))
+    from daft_tpu.context import execution_config_ctx
+    with execution_config_ctx(scan_tasks_min_size_bytes=1):
+        sp = _stage_plan_for(df)
+    scan_stage = next(s for s in sp.stages if s.scan_source() is not None)
+    assert scan_stage.is_map_like()
+
+    workers = [MockWorker("w0"), MockWorker("w1")]
+    mgr = WorkerManager(workers)
+    runner = StageRunner(mgr, RoundRobinScheduler())
+    tasks = runner._make_tasks(scan_stage, {})
+    assert len(tasks) == 2
+    seen = [len(t.plan.tasks) if isinstance(t.plan, pp.ScanSource)
+            else len(t.plan.children[0].tasks) for t in tasks]
+    assert sum(seen) == len(scan_stage.scan_source().tasks)
+
+
+# ------------------------------------------------------------ end-to-end
+def test_distributed_runner_matches_local_on_join_agg():
+    import numpy as np
+    rng = np.random.default_rng(5)
+    n = 2000
+    orders = daft_tpu.from_pydict({
+        "okey": list(range(n)),
+        "cust": rng.integers(0, 50, n).tolist(),
+        "price": rng.uniform(1, 100, n).round(2).tolist(),
+    }).into_partitions(4)
+    customers = daft_tpu.from_pydict({
+        "cust": list(range(50)),
+        "region": rng.integers(0, 5, 50).tolist(),
+    }).into_partitions(2)
+
+    def q(df_o, df_c):
+        return (df_o.join(df_c, on="cust")
+                .groupby("region").agg(col("price").sum().alias("rev"),
+                                       col("okey").count().alias("cnt"))
+                .sort("region").to_pydict())
+
+    local = q(orders, customers)
+
+    runner = DistributedRunner(num_workers=3)
+    import daft_tpu.context as ctx
+    old = ctx.get_context()._runner
+    ctx.get_context().set_runner(runner)
+    try:
+        dist = q(orders, customers)
+    finally:
+        ctx.get_context().set_runner(old)
+    assert dist["region"] == local["region"]
+    assert dist["cnt"] == local["cnt"]
+    for a, b in zip(dist["rev"], local["rev"]):
+        assert a == pytest.approx(b, rel=1e-9)
+
+
+def test_distributed_runner_multi_stage_count(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"k": [i % 7 for i in range(1000)],
+                             "v": [float(i) for i in range(1000)]}), p)
+    df = (daft_tpu.read_parquet(p).into_partitions(4)
+          .groupby("k").agg(col("v").sum().alias("s")).sort("k"))
+    sp = _stage_plan_for(df)
+    assert len(sp.stages) >= 2  # ≥2 stages through the shuffle

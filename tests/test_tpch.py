@@ -168,3 +168,28 @@ def test_q18_vs_pandas(tpch, pdf):
                         ascending=[False, True]).head(100)
     assert list(got.o_orderkey) == list(exp.o_orderkey)
     np.testing.assert_allclose(got.total_quantity, exp.total_quantity)
+
+
+def test_q5_distributed_runner_matches_local(tpch):
+    """TPC-H Q5 through the distributed runner (stage plan → scheduler →
+    workers) must match the local runner, and must actually cross ≥2 stage
+    boundaries (VERDICT r1 item 4 done-criterion)."""
+    from daft_tpu.distributed import StagePlan
+    from daft_tpu.physical.translate import translate
+    from daft_tpu.runners.distributed_runner import DistributedRunner
+    import daft_tpu.context as ctx
+
+    local = Q.q5(tpch).to_pydict()
+    df = Q.q5(tpch)
+    sp = StagePlan.from_physical(translate(df._builder.optimize().plan))
+    assert len(sp.stages) >= 2
+
+    runner = DistributedRunner(num_workers=2)
+    old = ctx.get_context()._runner
+    ctx.get_context().set_runner(runner)
+    try:
+        dist = Q.q5(tpch).to_pydict()
+    finally:
+        ctx.get_context().set_runner(old)
+    assert dist["n_name"] == local["n_name"]
+    np.testing.assert_allclose(dist["revenue"], local["revenue"], rtol=1e-9)
