@@ -132,7 +132,14 @@ def _binary_fn(fn, e, kids, b, out_field) -> Series:
     raise NotImplementedError(f"binary.{fn}")
 
 
+def norm_codec(codec) -> str:
+    """Canonical codec spelling — shared by typing (typing.py binary rules)
+    and evaluation so schema and execution never disagree."""
+    return str(codec).lower().replace("_", "-")
+
+
 def _codec_apply(data: bytes, codec: str, decode: bool):
+    codec = norm_codec(codec)
     import base64
     import gzip
     import zlib
@@ -179,7 +186,7 @@ def _json_fn(fn, e, s: Series, out_field) -> Series:
             continue
         if iterated:
             # array iteration contract: always a JSON array, even for 0/1 hits
-            out.append(_json.dumps(results) if results else None)
+            out.append(_json.dumps(results))
         elif not results:
             out.append(None)
         else:

@@ -356,8 +356,10 @@ def _function_field(op: str, e, child_fields, schema: Schema) -> Field:
             # utf-8 "encodes" bytes→text in the reference's codec table
             return Field(name, DataType.binary())
         if fn in ("decode", "try_decode"):
-            codec = e.params[0]
-            return Field(name, DataType.string() if codec == "utf-8"
+            # both aliases map to Codec::Utf8 → Utf8 in the reference
+            from .fn_host import norm_codec
+            codec = norm_codec(e.params[0])
+            return Field(name, DataType.string() if codec in ("utf-8", "utf8")
                          else DataType.binary())
         return Field(name, DataType.binary())
     if ns == "json":

@@ -61,5 +61,13 @@ def read_warc(path: Union[str, List[str]],
               **kwargs):
     """Lazily read WARC / gzipped-WARC file(s) into a DataFrame with the
     fixed 7-column WARC schema (reference: ``daft/io/_warc.py:20``)."""
+    import warnings
+    if io_config is not None or kwargs:
+        # remote WARC paths (e.g. Common Crawl on S3) are not wired yet —
+        # don't let an IOConfig silently degrade to local-glob behavior
+        warnings.warn(
+            "read_warc currently reads local paths only; io_config and "
+            f"extra options {sorted(kwargs) or ''} are ignored",
+            stacklevel=2)
     from .warc import WARC_SCHEMA
     return _df_from_scan(GlobScanOperator(path, "warc", schema=WARC_SCHEMA))

@@ -55,12 +55,20 @@ def _read_record(f: BinaryIO) -> Optional[Tuple[dict, bytes]]:
     if not line.startswith(b"WARC/"):
         raise ValueError(f"malformed WARC record header: {line[:40]!r}")
     headers = {}
+    last_key = None
     while True:
         line = f.readline()
         if line in (b"\r\n", b"\n", b""):
             break
-        k, _, v = line.decode("utf-8", errors="replace").partition(":")
-        headers[k.strip()] = v.strip()
+        text = line.decode("utf-8", errors="replace")
+        if text[:1] in (" ", "\t") and last_key is not None:
+            # folded (continuation) header line per the WARC/1.1 grammar:
+            # append to the previous header's value
+            headers[last_key] += " " + text.strip()
+            continue
+        k, _, v = text.partition(":")
+        last_key = k.strip()
+        headers[last_key] = v.strip()
     length = int(headers.get("Content-Length", 0))
     content = f.read(length)
     return headers, content

@@ -76,7 +76,9 @@ def test_json_query_paths():
     ).to_pydict()
     assert out["ab"] == ["1", "x", None]
     assert out["c1"] == ["20", None, None]
-    assert out["call"] == ["[10, 20, 30]", None, None]
+    # iteration always yields a JSON array — "[]" for zero hits (null doc
+    # still yields null)
+    assert out["call"] == ["[10, 20, 30]", "[]", None]
 
 
 def test_json_query_iteration_always_array():
