@@ -35,8 +35,13 @@ class Optimizer:
     def __init__(self, batches: Optional[List[Batch]] = None):
         self.batches = batches or [
             Batch("simplify", [SimplifyExpressions()], "fixed_point"),
-            Batch("pushdowns", [PushDownFilter(), PushDownProjection(),
-                                PushDownLimit(), DropRepartition()],
+            Batch("pushdowns", [EliminateCrossJoin(), PushDownFilter(),
+                                PushDownProjection(), PushDownLimit(),
+                                DropRepartition()],
+                  "fixed_point"),
+            Batch("joins", [ReorderJoins()], "once"),
+            Batch("post_join_pushdowns", [PushDownFilter(),
+                                          PushDownProjection()],
                   "fixed_point"),
             Batch("materialize", [MaterializeScans()], "once"),
         ]
@@ -370,8 +375,165 @@ class MaterializeScans(Rule):
 
     def apply(self, plan):
         def fn(node):
-            if isinstance(node, lp.Source) and node.scan_op is not None:
-                tasks = node.scan_op.to_scan_tasks(node.pushdowns)
-                node.materialized_tasks = tasks
+            if isinstance(node, lp.Source) and node.scan_op is not None \
+                    and getattr(node, "materialized_tasks", None) is None:
+                # rules never mutate pushdowns in place (they build new
+                # Source nodes), so a cached list — e.g. from the stats
+                # pass during join reordering — is still valid here
+                node.materialized_tasks = \
+                    node.scan_op.to_scan_tasks(node.pushdowns)
             return node
         return plan.transform_up(fn)
+
+
+class EliminateCrossJoin(Rule):
+    """Filter(CrossJoin) with equi-conjuncts spanning both sides → inner
+    Join (reference: ``optimization/rules/eliminate_cross_join.rs``). The
+    remaining conjuncts stay in a Filter above the new join."""
+
+    name = "eliminate_cross_join"
+
+    def apply(self, plan):
+        def fn(node):
+            if not isinstance(node, lp.Filter):
+                return node
+            child = node.children[0]
+            if not (isinstance(child, lp.Join) and child.how == "cross"):
+                return node
+            l_names = set(child.children[0].schema().column_names)
+            r_names = set(child.children[1].schema().column_names)
+            left_on, right_on, rest = [], [], []
+            for c in split_conjuncts(node.predicate):
+                if c.op == "eq":
+                    a, b = c.args
+                    if a.op == "col" and b.op == "col":
+                        an, bn = a.params[0], b.params[0]
+                        if an in l_names and bn in r_names:
+                            left_on.append(a)
+                            right_on.append(b)
+                            continue
+                        if bn in l_names and an in r_names:
+                            left_on.append(b)
+                            right_on.append(a)
+                            continue
+                rest.append(c)
+            if not left_on:
+                return node
+            join = lp.Join(child.children[0], child.children[1],
+                           left_on, right_on, "inner")
+            return lp.Filter(join, combine_conjuncts(rest)) if rest else join
+        return plan.transform_up(fn)
+
+
+class ReorderJoins(Rule):
+    """Greedy left-deep reordering of inner equi-join trees by estimated
+    cardinality (reference: brute-force DP + naive-left-deep in
+    ``optimization/rules/reorder_joins/``; here: greedy smallest-first over
+    the join graph using ``stats.estimate``, which is O(n²) and picks the
+    same orders on TPC-H shapes). Only applies when every key is a plain
+    column and relation column names are globally disjoint, so the output
+    column SET is order-independent; a final Project restores the original
+    column order."""
+
+    name = "reorder_joins"
+
+    def apply(self, plan):
+        # top-down, acting only at MAXIMAL inner-join roots: reordering an
+        # inner subtree first would wrap it in a Project that blocks
+        # flattening at every ancestor join, leaving 4+-relation chains
+        # only partially ordered.
+        def rec(node, parent_eligible: bool):
+            elig = self._eligible(node)
+            if elig and not parent_eligible:
+                out = self._try_reorder(node)
+                if out is not None:
+                    return out
+            return node.with_children(
+                [rec(c, elig) for c in node.children])
+
+        return rec(plan, False)
+
+    @staticmethod
+    def _eligible(node) -> bool:
+        return (isinstance(node, lp.Join) and node.how == "inner"
+                and node.strategy is None
+                and all(e.op == "col" for e in node.left_on)
+                and all(e.op == "col" for e in node.right_on))
+
+    # -- flatten a maximal inner-equi-join tree ------------------------
+    def _flatten(self, node, rels, edges):
+        if self._eligible(node):
+            self._flatten(node.children[0], rels, edges)
+            self._flatten(node.children[1], rels, edges)
+            for le, re_ in zip(node.left_on, node.right_on):
+                edges.append((le.params[0], re_.params[0]))
+        else:
+            rels.append(node)
+
+    def _try_reorder(self, node):
+        if not (isinstance(node, lp.Join) and node.how == "inner"
+                and node.strategy is None):
+            return None
+        rels: List[lp.LogicalPlan] = []
+        edges: List[tuple] = []
+        self._flatten(node, rels, edges)
+        if len(rels) < 3:
+            return None
+        # column ownership must be unambiguous and globally disjoint
+        owner: Dict[str, int] = {}
+        for i, r in enumerate(rels):
+            for nm in r.schema().column_names:
+                if nm in owner:
+                    return None
+                owner[nm] = i
+        for ln, rn in edges:
+            if ln not in owner or rn not in owner:
+                return None
+        from . import stats as lstats
+        sizes = []
+        for r in rels:
+            s = lstats.estimate(r)
+            if s.rows is None:
+                return None
+            sizes.append(s.rows)
+        # greedy: start from the smallest relation, repeatedly join the
+        # connected relation with the fewest estimated rows
+        n = len(rels)
+        adj: Dict[int, List[tuple]] = {i: [] for i in range(n)}
+        for ln, rn in edges:
+            a, b = owner[ln], owner[rn]
+            adj[a].append((b, ln, rn))
+            adj[b].append((a, rn, ln))
+        start = min(range(n), key=lambda i: sizes[i])
+        in_set = {start}
+        order = [start]
+        while len(in_set) < n:
+            candidates = set()
+            for i in in_set:
+                for j, _, _ in adj[i]:
+                    if j not in in_set:
+                        candidates.add(j)
+            if not candidates:
+                return None  # disconnected graph: leave as written
+            nxt = min(candidates, key=lambda i: sizes[i])
+            in_set.add(nxt)
+            order.append(nxt)
+        if order == list(range(n)):
+            return None  # already in this order
+        # rebuild left-deep (relations may hold nested join trees of their
+        # own, e.g. under aggregates — reorder those independently)
+        rels = [self.apply(r) for r in rels]
+        placed = {order[0]}
+        tree = rels[order[0]]
+        for idx in order[1:]:
+            lkeys, rkeys = [], []
+            for j, mine, theirs in adj[idx]:
+                if j in placed:
+                    lkeys.append(col(theirs))
+                    rkeys.append(col(mine))
+            placed.add(idx)
+            tree = lp.Join(tree, rels[idx], lkeys, rkeys, "inner")
+        out_names = node.schema().column_names
+        if set(out_names) != set(tree.schema().column_names):
+            return None  # safety: must be a pure permutation
+        return lp.Project(tree, [col(nm) for nm in out_names])

@@ -1,0 +1,150 @@
+"""Cardinality / size estimation over logical plans.
+
+The reference propagates ``ApproxStats`` bottom-up (EnrichWithStats,
+``src/daft-logical-plan/src/stats.rs``) to drive join reordering and
+broadcast decisions. This is the same idea with simpler per-op rules: scan
+stats come from parquet metadata via materialized scan tasks (cached on the
+Source node); everything else applies selectivity heuristics. Estimates are
+deliberately coarse — they only need to rank join orders and pick broadcast
+sides, not be exact.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from . import plan as lp
+
+# default selectivities (the reference hardcodes similar factors in its
+# ApproxStats arms)
+FILTER_SELECTIVITY = 0.2
+EQ_FILTER_SELECTIVITY = 0.05
+AGG_GROUP_FACTOR = 0.1
+
+
+@dataclass(frozen=True)
+class Stats:
+    rows: Optional[float]
+    size_bytes: Optional[float]
+
+    def scaled(self, f: float) -> "Stats":
+        return Stats(None if self.rows is None else max(self.rows * f, 1.0),
+                     None if self.size_bytes is None
+                     else max(self.size_bytes * f, 1.0))
+
+
+UNKNOWN = Stats(None, None)
+
+
+def _source_stats(node: lp.Source) -> Stats:
+    if node.partitions is not None:
+        try:
+            rows = sum(len(p) for p in node.partitions)
+            size = sum(p.size_bytes() or 0 for p in node.partitions)
+            return Stats(float(rows), float(size) or None)
+        except Exception:
+            return UNKNOWN
+    tasks = getattr(node, "materialized_tasks", None)
+    if tasks is None and node.scan_op is not None:
+        try:
+            tasks = node.scan_op.to_scan_tasks(node.pushdowns)
+            node.materialized_tasks = tasks
+        except Exception:
+            return UNKNOWN
+    if not tasks:
+        return Stats(0.0, 0.0)
+    rows = 0.0
+    size = 0.0
+    rows_known = True
+    for t in tasks:
+        r = t.num_rows()
+        if r is None:
+            rows_known = False
+        else:
+            rows += r
+        size += t.size_bytes() or 0
+    if not rows_known:
+        # filters pushed into the scan hide exact counts: estimate from
+        # bytes at ~100 B/row, times the filter selectivity
+        est = (size / 100.0) * FILTER_SELECTIVITY if size else None
+        return Stats(est, size * FILTER_SELECTIVITY if size else None)
+    if node.pushdowns.limit is not None:
+        rows = min(rows, node.pushdowns.limit)
+    return Stats(rows, size or None)
+
+
+def _filter_selectivity(pred) -> float:
+    # an equality against a literal is much more selective than a range
+    ops = set()
+
+    def walk(e):
+        ops.add(e.op)
+        for c in e.args:
+            walk(c)
+
+    walk(pred)
+    if "eq" in ops and not ({"or"} & ops):
+        return EQ_FILTER_SELECTIVITY
+    return FILTER_SELECTIVITY
+
+
+def estimate(node: lp.LogicalPlan) -> Stats:
+    """Bottom-up estimated (rows, bytes) for a plan subtree."""
+    if isinstance(node, lp.Source):
+        return _source_stats(node)
+    kids = [estimate(c) for c in node.children]
+    if isinstance(node, lp.Filter):
+        return kids[0].scaled(_filter_selectivity(node.predicate))
+    if isinstance(node, lp.Limit):
+        s = kids[0]
+        rows = node.limit if s.rows is None else min(s.rows, node.limit)
+        return Stats(float(rows), s.size_bytes)
+    if isinstance(node, lp.Sample):
+        if node.fraction is not None:
+            return kids[0].scaled(node.fraction)
+        return Stats(float(node.size), None)
+    if isinstance(node, lp.Aggregate):
+        if not node.group_by:
+            return Stats(1.0, 256.0)
+        return kids[0].scaled(AGG_GROUP_FACTOR)
+    if isinstance(node, lp.Distinct):
+        return kids[0].scaled(AGG_GROUP_FACTOR)
+    if isinstance(node, lp.Explode):
+        return kids[0].scaled(4.0)
+    if isinstance(node, lp.Concat):
+        l, r = kids
+        rows = None if l.rows is None or r.rows is None else l.rows + r.rows
+        size = None if l.size_bytes is None or r.size_bytes is None \
+            else l.size_bytes + r.size_bytes
+        return Stats(rows, size)
+    if isinstance(node, lp.Join):
+        l, r = kids
+        if node.how == "cross":
+            if l.rows is None or r.rows is None:
+                return UNKNOWN
+            return Stats(l.rows * r.rows,
+                         None if l.size_bytes is None or r.size_bytes is None
+                         else l.size_bytes * max(r.rows, 1.0)
+                         + r.size_bytes * max(l.rows, 1.0))
+        if node.how in ("semi", "anti"):
+            return l.scaled(0.5)
+        if node.how == "left":
+            return l
+        if node.how == "right":
+            return r
+        # inner equi-join: PK-FK assumption — output ≈ the larger (fact)
+        # side (reference stats.rs uses max-side heuristics similarly)
+        if l.rows is None or r.rows is None:
+            return UNKNOWN
+        rows = max(l.rows, r.rows)
+        size = None
+        if l.size_bytes is not None and r.size_bytes is not None:
+            lw = l.size_bytes / max(l.rows, 1.0)
+            rw = r.size_bytes / max(r.rows, 1.0)
+            size = rows * (lw + rw)
+        return Stats(rows, size)
+    # row-preserving ops (Project/Sort/Repartition/Window/…)
+    if kids:
+        return kids[0]
+    return UNKNOWN

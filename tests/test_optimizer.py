@@ -1,0 +1,127 @@
+"""Optimizer plan-shape tests: stats estimation, cross-join elimination,
+join reordering (reference rule set:
+``src/daft-logical-plan/src/optimization/optimizer.rs:94-215``,
+``rules/reorder_joins/``, ``stats.rs``)."""
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.logical import plan as lp, stats as lstats
+from daft_tpu.logical.optimizer import Optimizer
+
+
+def _optimized(df) -> lp.LogicalPlan:
+    return Optimizer().optimize(df._builder.plan)
+
+
+def _find_all(node, t):
+    out = []
+
+    def walk(n):
+        if isinstance(n, t):
+            out.append(n)
+        for c in n.children:
+            walk(c)
+
+    walk(node)
+    return out
+
+
+def _rel(n_rows, prefix):
+    return daft_tpu.from_pydict({
+        f"{prefix}_k": list(range(n_rows)),
+        f"{prefix}_v": [float(i) for i in range(n_rows)]})
+
+
+# ----------------------------------------------------------------- stats
+def test_stats_source_and_filter():
+    df = _rel(1000, "a")
+    s = lstats.estimate(df._builder.plan)
+    assert s.rows == 1000
+    filtered = df.where(col("a_v") > 10.0)
+    s2 = lstats.estimate(filtered._builder.plan)
+    assert s2.rows == pytest.approx(1000 * lstats.FILTER_SELECTIVITY)
+    eq = df.where(col("a_k") == 7)
+    s3 = lstats.estimate(eq._builder.plan)
+    assert s3.rows == pytest.approx(1000 * lstats.EQ_FILTER_SELECTIVITY)
+
+
+def test_stats_join_and_agg():
+    big = _rel(10000, "f")
+    small = _rel(100, "d")
+    j = big.join(small, left_on="f_k", right_on="d_k")
+    s = lstats.estimate(j._builder.plan)
+    assert s.rows == 10000  # PK-FK: fact-side cardinality
+    agg = j.groupby("d_k").agg(col("f_v").sum())
+    sa = lstats.estimate(agg._builder.plan)
+    assert sa.rows < 10000
+
+
+# ------------------------------------------------- cross join elimination
+def test_eliminate_cross_join():
+    a = _rel(100, "a")
+    b = _rel(100, "b")
+    crossed = a.join(b, how="cross").where(
+        (col("a_k") == col("b_k")) & (col("a_v") > 5.0))
+    plan = _optimized(crossed)
+    joins = _find_all(plan, lp.Join)
+    assert len(joins) == 1
+    assert joins[0].how == "inner"
+    assert [e.name() for e in joins[0].left_on] == ["a_k"]
+    assert [e.name() for e in joins[0].right_on] == ["b_k"]
+    # and the residual predicate must have been pushed toward the source
+    out = crossed.sort("a_k").to_pydict()
+    assert out["a_k"] == list(range(6, 100))
+
+
+# --------------------------------------------------------- join reorder
+def test_reorder_joins_smallest_first():
+    """fact ⋈ dim1 ⋈ dim2 written fact-first must reorder so the smallest
+    relation anchors the left-deep chain."""
+    fact = _rel(20000, "f")
+    dim_mid = _rel(500, "m")
+    dim_small = daft_tpu.from_pydict({
+        "s_k": list(range(50)), "s_v": [float(i) for i in range(50)]})
+    df = (fact
+          .join(dim_mid, left_on="f_k", right_on="m_k")
+          .join(dim_small, left_on="f_k", right_on="s_k"))
+    plan = _optimized(df)
+    joins = _find_all(plan, lp.Join)
+    assert len(joins) == 2
+    # innermost (deepest) join should start from the smallest relation
+    deepest = joins[-1]
+    rels = [c.schema().column_names for c in deepest.children]
+    anchored = {tuple(sorted(r)) for r in rels}
+    assert any("s_k" in r for r in rels), plan.repr_ascii()
+
+    # correctness is preserved under reordering
+    out = df.sort("f_k").to_pydict()
+    assert out["f_k"] == list(range(50))
+
+
+def test_reorder_preserves_column_order():
+    fact = _rel(5000, "f")
+    d1 = _rel(100, "x")
+    d2 = _rel(10, "y")
+    df = (fact.join(d1, left_on="f_k", right_on="x_k")
+          .join(d2, left_on="x_k", right_on="y_k"))
+    cols_before = df.column_names
+    plan = _optimized(df)
+    assert plan.schema().column_names == cols_before
+    out = df.sort("f_k").to_pydict()
+    assert list(out) == cols_before
+    assert out["f_k"] == list(range(10))
+
+
+def test_reorder_skips_name_collisions():
+    a = daft_tpu.from_pydict({"k": [1, 2], "v": [1.0, 2.0]})
+    b = daft_tpu.from_pydict({"k": [1, 2], "w": [3.0, 4.0]})
+    c = daft_tpu.from_pydict({"k": [1, 2], "z": [5.0, 6.0]})
+    df = a.join(b, on="k").join(c, on="k")
+    # shared key names → reorder must decline, plan still runs correctly
+    out = df.sort("k").to_pydict()
+    assert out["k"] == [1, 2]
+    assert out["w"] == [3.0, 4.0]
+    assert out["z"] == [5.0, 6.0]
