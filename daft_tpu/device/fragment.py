@@ -101,7 +101,7 @@ def get_fused_agg(group_exprs: List[Expression], child_exprs: List[Expression],
     has_pred = predicate is not None
     meta: dict = {}
 
-    def agg_outs(arrays, valids, row_mask, scalars):
+    def eval_inputs(arrays, valids, row_mask, scalars):
         outs = c.fn(arrays, valids, row_mask, scalars)
         if has_pred:
             pv, pm = outs[-1]
@@ -111,30 +111,23 @@ def get_fused_agg(group_exprs: List[Expression], child_exprs: List[Expression],
         kvalids = tuple(m for _, m in outs[:nk])
         vals = tuple(v for v, _ in outs[nk:nk + nv])
         vvalids = tuple(m for _, m in outs[nk:nk + nv])
-        if nk == 0:
-            return kernels.global_agg_impl(vals, vvalids, row_mask, ops)
-        return kernels.grouped_agg_impl(keys, kvalids, vals, vvalids,
-                                        row_mask, ops)
+        return keys, kvalids, vals, vvalids, row_mask
 
     def run_packed(arrays, valids, row_mask, scalars, out_cap: int):
+        keys, kvalids, vals, vvalids, row_mask = eval_inputs(
+            arrays, valids, row_mask, scalars)
         if nk == 0:
-            results = agg_outs(arrays, valids, row_mask, scalars)
+            results = kernels.global_agg_impl(vals, vvalids, row_mask, ops)
             flat = [v for v, _ in results] + [m for _, m in results]
             meta["global_dtypes"] = [x.dtype for x in flat]
             return jnp.stack([_pack_i64(x.reshape(())) for x in flat])
-        ok, okv, ov, ovv, g = agg_outs(arrays, valids, row_mask, scalars)
+        ok, okv, ov, ovv, g = kernels.grouped_agg_block_impl(
+            keys, kvalids, vals, vvalids, row_mask, ops, out_cap)
         flat = list(ok) + list(okv) + list(ov) + list(ovv)
         meta["grouped_dtypes"] = [x.dtype for x in flat]
-        cap = row_mask.shape[0]
         rows = [jnp.full((out_cap,), 0, jnp.int64).at[0]
                 .set(g.astype(jnp.int64))]
-        for x in flat:
-            p = _pack_i64(x)
-            if cap >= out_cap:
-                p = p[:out_cap]
-            else:
-                p = jnp.pad(p, (0, out_cap - cap))
-            rows.append(p)
+        rows += [_pack_i64(x) for x in flat]  # already [out_cap]-wide
         return jnp.stack(rows)
 
     prog = FusedAggProgram(

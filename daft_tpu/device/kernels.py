@@ -105,20 +105,19 @@ def grouped_agg_impl(keys, key_valids, vals, val_valids, row_mask,
         nr, x = _sort_key_plane(k, kv & row_mask, False, False)
         operands.append(nr)
         operands.append(x)
-    payload = list(keys) + [v & row_mask for v in key_valids] + list(vals) + \
-        [vv & row_mask for vv in val_valids] + [row_mask]
-    nk_ops = len(operands)
-    out = lax.sort(tuple(operands) + tuple(payload), num_keys=nk_ops,
+    # Sort ONLY key planes + a row index, then gather payloads through the
+    # permutation: TPU sort compile time and runtime grow steeply with
+    # operand count (a 21-operand sort took >5 min to compile where this
+    # shape compiles in seconds), while gathers are cheap single-fusion ops.
+    operands.append(jnp.arange(C, dtype=jnp.int32))
+    out = lax.sort(tuple(operands), num_keys=len(operands) - 1,
                    is_stable=True)
-    sorted_ops = out[:nk_ops]
-    p = list(out[nk_ops:])
-    nkeys = len(keys)
-    nvals = len(vals)
-    s_keys = p[:nkeys]
-    s_kvalids = p[nkeys:2 * nkeys]
-    s_vals = p[2 * nkeys:2 * nkeys + nvals]
-    s_vvalids = p[2 * nkeys + nvals:2 * nkeys + 2 * nvals]
-    s_live = p[-1]
+    perm = out[-1]
+    s_keys = [jnp.take(k, perm) for k in keys]
+    s_kvalids = [jnp.take(kv & row_mask, perm) for kv in key_valids]
+    s_vals = [jnp.take(v, perm) for v in vals]
+    s_vvalids = [jnp.take(vv & row_mask, perm) for vv in val_valids]
+    s_live = jnp.take(row_mask, perm)
 
     # boundary detection over (key value, key validity) among live rows
     idx = jnp.arange(C)
@@ -200,6 +199,163 @@ def grouped_agg_impl(keys, key_valids, vals, val_valids, row_mask,
 
 
 grouped_agg_kernel = partial(jax.jit, static_argnames=("ops",))(grouped_agg_impl)
+
+
+# ---------------------------------------------------------------------------
+# block-width grouped aggregation (the fused-fragment fast path)
+
+def grouped_agg_block_impl(keys, key_valids, vals, val_valids, row_mask,
+                           ops: Tuple[str, ...], out_cap: int):
+    """Grouped aggregation emitting [out_cap]-wide group blocks directly.
+
+    TPU-shaped replacement for the scatter-based ``grouped_agg_impl`` on the
+    hot path, built around two facts measured on a v5e: row-width GATHERS
+    are the enemy (~22 ms per 1M-row `take`, the dominant cost of the naive
+    sort+gather formulation), and one-hot matmuls ride the MXU for ~free.
+    So: (1) sort ONLY the key planes plus a row index; (2) invert the
+    permutation with a second tiny 2-operand sort, yielding each ORIGINAL
+    row's segment id — after which every reduction (one-hot matmul sums /
+    counts, block-width scatter min/max) runs over the original, un-gathered
+    value planes. The only gathers left are [out_cap]-sized.
+
+    Returns (out_keys, out_kvalids, out_vals, out_valids, group_count) with
+    every output [out_cap]; groups beyond out_cap are dropped (the caller
+    re-runs at a grown bucket when group_count > out_cap).
+    """
+    C = row_mask.shape[0]
+    dead = (~row_mask).astype(jnp.int8)
+    operands = [dead]
+    for k, kv in zip(keys, key_valids):
+        nr, x = _sort_key_plane(k, kv & row_mask, False, False)
+        operands.append(nr)
+        operands.append(x)
+    operands.append(jnp.arange(C, dtype=jnp.int32))
+    out = lax.sort(tuple(operands), num_keys=len(operands) - 1,
+                   is_stable=True)
+    perm = out[-1]
+    s_live = out[0] == 0  # dead flag sorts live rows first
+    s_nr = [out[1 + 2 * i] for i in range(len(keys))]
+    s_x = [out[2 + 2 * i] for i in range(len(keys))]
+
+    # group boundaries on the sorted (null_rank, transformed_value) planes —
+    # equivalent to (key, validity) boundaries, and they come free from the
+    # sort outputs (no payload gathers)
+    diff = jnp.zeros(C, dtype=jnp.bool_).at[0].set(True)
+    for nr, x in zip(s_nr, s_x):
+        diff = diff | (x != jnp.concatenate([x[:1], x[:-1]])) \
+            | (nr != jnp.concatenate([nr[:1], nr[:-1]]))
+    flags = diff & s_live
+    segf = jnp.cumsum(flags.astype(jnp.int32)) - 1
+    group_count = jnp.sum(flags.astype(jnp.int32))
+    seg_sorted = jnp.where(s_live, jnp.minimum(segf, out_cap),
+                           out_cap).astype(jnp.int32)
+    # invert the permutation with one more (cheap, 2-operand) sort: the
+    # segment id of every ORIGINAL row
+    seg = lax.sort((perm, seg_sorted), num_keys=1, is_stable=True)[1]
+
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    starts = jnp.searchsorted(seg_sorted, j, side="left")
+    starts_c = jnp.clip(starts, 0, C - 1)
+    live_group = j < group_count
+
+    # group keys: [out_cap]-sized gathers from the sorted key planes (the
+    # ascending transform is the identity on valid values)
+    out_keys = []
+    out_kvalids = []
+    for (nr, x), k in zip(zip(s_nr, s_x), keys):
+        kx = jnp.take(x, starts_c)
+        if k.dtype == jnp.bool_:
+            kx = kx.astype(jnp.bool_)
+        out_keys.append(kx.astype(k.dtype))
+        out_kvalids.append((jnp.take(nr, starts_c) == 0) & live_group)
+    out_keys = tuple(out_keys)
+    out_kvalids = tuple(out_kvalids)
+
+    # One-hot matmul rides the MXU but materializes [C, out_cap]; past a
+    # width threshold that escalates to HBM-exhausting sizes (overflow
+    # retries grow out_cap ×16), so wide group blocks fall back to the
+    # O(C)-memory scatter segment-sum. HIGHEST precision keeps the f32
+    # matmul in true f32 (TPU default would drop the operands to bf16).
+    f32_ok = all(v.dtype != jnp.float64 for v in vals)
+    acc_dt = jnp.float32 if f32_ok else jnp.float64
+    use_matmul = out_cap <= 2048
+    oh = jax.nn.one_hot(seg, out_cap, dtype=acc_dt) if use_matmul else None
+
+    def matmul_sum(x):
+        if use_matmul:
+            return jnp.matmul(x.astype(acc_dt), oh,
+                              precision=lax.Precision.HIGHEST)
+        # seg is in ORIGINAL row order (inverse-permuted) — not sorted
+        return jax.ops.segment_sum(x.astype(acc_dt), seg,
+                                   num_segments=out_cap + 1)[:out_cap]
+
+    idx = jnp.arange(C, dtype=jnp.int32)
+    out_vals = []
+    out_valids = []
+    for v, vv, op in zip(vals, val_valids, ops):
+        contrib = row_mask & vv  # ORIGINAL row order — no gathers
+        cnt = matmul_sum(contrib)  # counts < 2^24 → exact in f32
+        has = live_group & (cnt > 0)
+        if op == "count":
+            out_vals.append(cnt.astype(jnp.int64))
+            out_valids.append(live_group)
+            continue
+        if op in ("sum", "mean", "var", "stddev"):
+            if jnp.issubdtype(v.dtype, jnp.integer) or v.dtype == jnp.bool_:
+                # exact integer sums: scatter segment-add at block width
+                x = jnp.where(contrib, v, jnp.zeros((), v.dtype)) \
+                    .astype(jnp.int64)
+                s1 = jax.ops.segment_sum(x, seg,
+                                         num_segments=out_cap + 1)[:out_cap]
+            else:
+                s1 = matmul_sum(jnp.where(contrib, v,
+                                          jnp.zeros((), v.dtype)))
+            if op == "sum":
+                out_vals.append(s1)
+                out_valids.append(has)
+                continue
+            # widest float the backend supports (f64, or f32 under TPU x32)
+            # — mirrors grouped_agg_impl so int means don't round at f32
+            fdt = s1.astype(jnp.float64).dtype if s1.dtype != jnp.float32 \
+                else jnp.float32
+            safe = jnp.maximum(cnt, 1).astype(fdt)
+            mean = s1.astype(fdt) / safe
+            if op == "mean":
+                out_vals.append(mean)
+                out_valids.append(has)
+                continue
+            xf = jnp.where(contrib, v, jnp.zeros((), v.dtype)).astype(fdt)
+            if fdt == acc_dt:
+                s2 = matmul_sum(xf * xf)
+            else:  # keep the wide accumulator (matmul lanes run in acc_dt)
+                s2 = jax.ops.segment_sum(xf * xf, seg,
+                                         num_segments=out_cap + 1)[:out_cap]
+            var = jnp.maximum(s2 / safe - mean * mean, 0.0)
+            out_vals.append(jnp.sqrt(var) if op == "stddev" else var)
+            out_valids.append(has)
+            continue
+        if op in ("min", "max", "bool_and", "bool_or"):
+            base = v.astype(jnp.int8) if v.dtype == jnp.bool_ else v
+            red = "min" if op in ("min", "bool_and") else "max"
+            ident = _identity_for(base.dtype, red)
+            x = jnp.where(contrib, base, ident)
+            fn = jax.ops.segment_min if red == "min" else jax.ops.segment_max
+            r = fn(x, seg, num_segments=out_cap + 1)[:out_cap]
+            if v.dtype == jnp.bool_:
+                r = r.astype(jnp.bool_)
+            out_vals.append(r)
+            out_valids.append(has)
+            continue
+        if op == "any_value":
+            fi = jax.ops.segment_min(jnp.where(contrib, idx, C - 1), seg,
+                                     num_segments=out_cap + 1)[:out_cap]
+            out_vals.append(jnp.take(v, jnp.clip(fi, 0, C - 1)))
+            out_valids.append(has)
+            continue
+        raise ValueError(f"unsupported device agg {op}")
+
+    return out_keys, out_kvalids, tuple(out_vals), tuple(out_valids), \
+        group_count
 
 
 # ---------------------------------------------------------------------------

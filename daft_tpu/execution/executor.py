@@ -228,8 +228,11 @@ class LocalExecutor:
                                           node.predicate, rb.schema)
             if prog is None:
                 return None
-            out = fragment.run_fused_agg(prog, rb, node.group_by, agg_cols,
-                                         node.schema())
+            try:
+                out = fragment.run_fused_agg(prog, rb, node.group_by,
+                                             agg_cols, node.schema())
+            except Exception:  # device OOM / lowering failure → host tier
+                return None
             if out is None:
                 return None
             return MicroPartition.from_recordbatch(
