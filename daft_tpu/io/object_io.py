@@ -254,6 +254,8 @@ class IOClient:
         scheme = urllib.parse.urlparse(path).scheme or "file"
         if scheme in ("http", "https"):
             scheme = "http"
+        if scheme == "s3a":
+            scheme = "s3"
         with self._lock:
             src = self._sources.get(scheme)
             if src is None:
@@ -266,9 +268,9 @@ class IOClient:
             return LocalSource()
         if scheme == "http":
             return HTTPSource(self.config.http)
-        if scheme == "s3":
-            # no egress in this build; config-compatible stub
-            return _UnavailableSource("s3", "boto3")
+        if scheme in ("s3", "s3a"):
+            from .s3 import S3Source
+            return S3Source(self.config.s3)
         if scheme == "gs":
             return _UnavailableSource("gs", "gcsfs")
         if scheme in ("az", "abfs", "abfss"):

@@ -23,7 +23,8 @@ def read_parquet(path: Union[str, List[str]],
     (reference: ``daft/io/_parquet.py:20``)."""
     sch = Schema.from_pydict(schema) if isinstance(schema, dict) else schema
     return _df_from_scan(GlobScanOperator(
-        path, "parquet", schema=sch, hive_partitioning=hive_partitioning))
+        path, "parquet", schema=sch, hive_partitioning=hive_partitioning,
+        io_config=io_config))
 
 
 def read_csv(path: Union[str, List[str]],
@@ -43,7 +44,7 @@ def read_csv(path: Union[str, List[str]],
             "schema": sch}
     return _df_from_scan(GlobScanOperator(
         path, "csv", schema=sch, format_options=opts,
-        hive_partitioning=hive_partitioning))
+        hive_partitioning=hive_partitioning, io_config=io_config))
 
 
 def read_json(path: Union[str, List[str]],
@@ -53,7 +54,8 @@ def read_json(path: Union[str, List[str]],
               **kwargs):
     sch = Schema.from_pydict(schema) if isinstance(schema, dict) else schema
     return _df_from_scan(GlobScanOperator(
-        path, "json", schema=sch, hive_partitioning=hive_partitioning))
+        path, "json", schema=sch, hive_partitioning=hive_partitioning,
+        io_config=io_config))
 
 
 def read_warc(path: Union[str, List[str]],

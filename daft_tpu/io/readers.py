@@ -25,17 +25,44 @@ from ..series import Series
 from .scan import Pushdowns, ScanTask
 
 
+def _is_remote(path: str) -> bool:
+    return "://" in path and not path.startswith("file://")
+
+
+def _open_ranged(path: str, io_config=None):
+    """Path (local) or a seekable ranged reader (remote) — parquet footer /
+    row-group reads become range requests over the object store."""
+    if not _is_remote(path):
+        return path
+    from .object_io import get_io_client
+    from .s3 import S3ReadableFile
+    client = get_io_client(io_config)
+    return pa.PythonFile(S3ReadableFile(client.source_for(path), path),
+                         mode="r")
+
+
+def _open_full(path: str, io_config=None):
+    """Path (local) or an in-memory buffer of the whole object (remote) —
+    for single-pass formats (csv/json)."""
+    if not _is_remote(path):
+        return path
+    from .object_io import get_io_client
+    client = get_io_client(io_config)
+    return pa.BufferReader(client.get(path))
+
+
 def infer_schema(path: str, file_format: str,
-                 options: Dict[str, Any]) -> Schema:
+                 options: Dict[str, Any], io_config=None) -> Schema:
     if file_format == "parquet":
-        return Schema.from_arrow(pq.read_schema(path))
+        return Schema.from_arrow(pq.read_schema(_open_ranged(path, io_config)))
     if file_format == "csv":
         ropts, popts, copts = _csv_options(options)
-        with pacsv.open_csv(path, read_options=ropts, parse_options=popts,
+        with pacsv.open_csv(_open_full(path, io_config), read_options=ropts,
+                            parse_options=popts,
                             convert_options=copts) as rdr:
             return Schema.from_arrow(rdr.schema)
     if file_format == "json":
-        t = pajson.read_json(path)
+        t = pajson.read_json(_open_full(path, io_config))
         return Schema.from_arrow(t.schema)
     if file_format == "warc":
         from .warc import WARC_SCHEMA
@@ -62,11 +89,12 @@ def _csv_options(options: Dict[str, Any]):
 
 def make_scan_tasks(path: str, file_format: str, schema: Schema,
                     pushdowns: Pushdowns, options: Dict[str, Any],
-                    partition_values: Dict[str, Any]) -> List[ScanTask]:
+                    partition_values: Dict[str, Any],
+                    io_config=None) -> List[ScanTask]:
     """Per-file scan tasks, with parquet row-group pruning + split."""
     if file_format == "parquet":
         try:
-            md = pq.ParquetFile(path).metadata
+            md = pq.ParquetFile(_open_ranged(path, io_config)).metadata
         except Exception:
             md = None
         if md is not None:
@@ -78,12 +106,19 @@ def make_scan_tasks(path: str, file_format: str, schema: Schema,
                 sum(md.row_group(i).total_byte_size for i in range(md.num_row_groups))
             task = ScanTask([path], "parquet", schema, pushdowns, nrows, size,
                             [groups] if groups is not None else None,
-                            options, partition_values)
+                            options, partition_values, io_config=io_config)
             task.pq_metadata = md  # reused by split_scan_tasks: one footer read
             return [task]
-    size = os.path.getsize(path) if os.path.exists(path) else None
+    if _is_remote(path):
+        try:
+            from .object_io import get_io_client
+            size = get_io_client(io_config).source_for(path).get_size(path)
+        except Exception:
+            size = None
+    else:
+        size = os.path.getsize(path) if os.path.exists(path) else None
     return [ScanTask([path], file_format, schema, pushdowns, None, size, None,
-                     options, partition_values)]
+                     options, partition_values, io_config=io_config)]
 
 
 def _prune_row_groups(md, filters: Optional[Expression],
@@ -169,9 +204,15 @@ def read_scan_task(task: ScanTask) -> List[RecordBatch]:
     phys_cols = None
     if cols is not None:
         phys_cols = [c for c in cols if c not in task.partition_values]
+    io_config = getattr(task, "io_config", None)
+    cached_md = getattr(task, "pq_metadata", None)
     for i, path in enumerate(task.paths):
         if task.file_format == "parquet":
-            f = pq.ParquetFile(path)
+            # reuse the footer metadata fetched at scan-planning time — a
+            # remote file then needs only its row-group range requests
+            md = cached_md if (cached_md is not None and i == 0
+                               and len(task.paths) == 1) else None
+            f = pq.ParquetFile(_open_ranged(path, io_config), metadata=md)
             rg = task.row_groups[i] if task.row_groups else None
             file_cols = None
             if phys_cols is not None:
@@ -187,10 +228,10 @@ def read_scan_task(task: ScanTask) -> List[RecordBatch]:
             if phys_cols is not None:
                 copts.include_columns = phys_cols
                 copts.include_missing_columns = True
-            t = pacsv.read_csv(path, read_options=ropts, parse_options=popts,
-                               convert_options=copts)
+            t = pacsv.read_csv(_open_full(path, io_config), read_options=ropts,
+                               parse_options=popts, convert_options=copts)
         elif task.file_format == "json":
-            t = pajson.read_json(path)
+            t = pajson.read_json(_open_full(path, io_config))
             if phys_cols is not None:
                 keep = [c for c in phys_cols if c in t.column_names]
                 t = t.select(keep)

@@ -57,8 +57,10 @@ class ScanTask:
                  row_groups: Optional[List[Optional[List[int]]]] = None,
                  format_options: Optional[Dict[str, Any]] = None,
                  partition_values: Optional[Dict[str, Any]] = None,
-                 generator: Optional[Callable[[], Iterator[RecordBatch]]] = None):
+                 generator: Optional[Callable[[], Iterator[RecordBatch]]] = None,
+                 io_config: Any = None):
         self.paths = paths
+        self.io_config = io_config
         self.file_format = file_format
         self.schema = schema
         self.pushdowns = pushdowns
@@ -138,14 +140,23 @@ class ScanOperator:
         raise NotImplementedError
 
 
-def glob_paths(path_or_paths) -> List[str]:
-    """Local + file:// glob expansion (fanout-style, reference
-    ``object_store_glob.rs``). Directories expand to their files."""
+def glob_paths(path_or_paths, io_config=None) -> List[str]:
+    """Local / file:// / remote (s3://) glob expansion (fanout-style,
+    reference ``object_store_glob.rs``). Directories expand to their
+    files."""
     paths = [path_or_paths] if isinstance(path_or_paths, str) else list(path_or_paths)
     out: List[str] = []
     for p in paths:
         if p.startswith("file://"):
             p = p[7:]
+        if "://" in p and not p.startswith("file://"):
+            from .object_io import get_io_client
+            client = get_io_client(io_config)
+            if any(ch in p for ch in "*?[]"):
+                out.extend(client.glob(p))
+            else:
+                out.append(p)
+            continue
         if any(ch in p for ch in "*?[]"):
             matches = sorted(_glob.glob(p, recursive=True))
             out.extend(m for m in matches if os.path.isfile(m))
@@ -169,16 +180,18 @@ class GlobScanOperator(ScanOperator):
     def __init__(self, paths, file_format: str,
                  schema: Optional[Schema] = None,
                  format_options: Optional[Dict[str, Any]] = None,
-                 hive_partitioning: bool = False):
+                 hive_partitioning: bool = False,
+                 io_config: Any = None):
         from . import readers
-        self._paths = glob_paths(paths)
+        self._io_config = io_config
+        self._paths = glob_paths(paths, io_config)
         self._format = file_format
         self._options = format_options or {}
         self._hive = hive_partitioning
         self._hive_fields: Dict[str, DataType] = {}
         if schema is None:
             schema = readers.infer_schema(self._paths[0], file_format,
-                                          self._options)
+                                          self._options, io_config)
         if hive_partitioning:
             parts = _hive_values(self._paths[0])
             for k, v in parts.items():
@@ -205,7 +218,8 @@ class GlobScanOperator(ScanOperator):
         for p in self._paths:
             pv = _hive_values(p) if self._hive else {}
             tasks.extend(readers.make_scan_tasks(
-                p, self._format, self._schema, pushdowns, self._options, pv))
+                p, self._format, self._schema, pushdowns, self._options, pv,
+                self._io_config))
         tasks = split_scan_tasks(tasks, cfg.scan_tasks_max_size_bytes,
                                  cfg.parquet_split_row_groups_max_files)
         return merge_scan_tasks(tasks, cfg.scan_tasks_min_size_bytes,
