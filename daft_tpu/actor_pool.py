@@ -1,0 +1,193 @@
+"""Process actor pools for stateful UDFs.
+
+Reference mechanism: ``daft/execution/actor_pool_udf.py:22-114`` — each
+stateful UDF gets a pool of OS processes holding one instance each, with
+batches shipped over IPC, so N-way concurrency runs N real interpreters
+(no GIL sharing, true per-actor state). Here transport is Arrow IPC over
+``multiprocessing`` pipes; the UDF class and init args ship once at spawn.
+
+Falls back transparently to the in-process shared instance when the UDF
+isn't picklable (e.g. defined in a REPL closure) or when
+``DAFT_TPU_ACTOR_POOL=0``.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import queue
+import threading
+import traceback
+import weakref
+from typing import Any, List, Optional, Tuple
+
+import pyarrow as pa
+
+
+def _series_to_ipc(series_list) -> bytes:
+    import pyarrow.ipc as paipc
+    arrays = []
+    names = []
+    for i, s in enumerate(series_list):
+        arr = s.to_arrow()
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        arrays.append(arr)
+        names.append(f"c{i}")
+    # lengths can differ (scalar args); ship each column as its own batch
+    sink = io.BytesIO()
+    meta = []
+    for name, arr in zip(names, arrays):
+        t = pa.table({name: arr})
+        w = paipc.new_stream(sink, t.schema)
+        w.write_table(t)
+        w.close()
+        meta.append(sink.tell())
+    return pickle.dumps((meta, sink.getvalue()))
+
+
+def _series_from_ipc(blob: bytes):
+    import pyarrow.ipc as paipc
+    from .series import Series
+    meta, payload = pickle.loads(blob)
+    out = []
+    start = 0
+    for end in meta:
+        rdr = paipc.open_stream(pa.BufferReader(payload[start:end]))
+        t = rdr.read_all()
+        out.append(Series.from_arrow(t.column(0), t.column_names[0]))
+        start = end
+    return out
+
+
+def _loads_udf(blob: bytes):
+    try:
+        import cloudpickle
+        return cloudpickle.loads(blob)
+    except ImportError:
+        return pickle.loads(blob)
+
+
+def _dumps_udf(obj) -> bytes:
+    # classes decorated by @udf are shadowed by the UDF wrapper at module
+    # scope, so by-reference pickling can't resolve them — serialize by
+    # value (the reference vendors cloudpickle for exactly this)
+    try:
+        import cloudpickle
+        return cloudpickle.dumps(obj)
+    except ImportError:
+        return pickle.dumps(obj)
+
+
+def _actor_main(conn, udf_blob: bytes) -> None:
+    """Child process: instantiate once, serve call messages forever."""
+    try:
+        cls, init_args, return_dtype, batch_size, name = _loads_udf(udf_blob)
+        a, kw = init_args or ((), {})
+        instance = cls(*a, **kw)
+        conn.send(("ready", None))
+    except Exception:
+        conn.send(("err", traceback.format_exc()))
+        return
+    from .udf import run_udf_batches
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            return
+        if msg is None or msg[0] == "stop":
+            return
+        try:
+            _, ipc_in, arg_spec, kw_spec, length = msg
+            evaluated = _series_from_ipc(ipc_in)
+            out = run_udf_batches(instance, evaluated, arg_spec, kw_spec,
+                                  length, batch_size, return_dtype, name)
+            conn.send(("ok", _series_to_ipc([out])))
+        except Exception:
+            conn.send(("err", traceback.format_exc()))
+
+
+def _stop_actors(actors) -> None:
+    for a in actors:
+        a.stop()
+
+
+class _Actor:
+    def __init__(self, udf_blob: bytes):
+        import multiprocessing as mp
+        ctx = mp.get_context("spawn")
+        self._parent, child = ctx.Pipe()
+        self.process = ctx.Process(target=_actor_main, args=(child, udf_blob),
+                                   daemon=True)
+        self.process.start()
+        child.close()
+        kind, detail = self._parent.recv()
+        if kind != "ready":
+            raise RuntimeError(f"actor failed to initialize:\n{detail}")
+
+    def call(self, evaluated, arg_spec, kw_spec, length):
+        self._parent.send(("call", _series_to_ipc(evaluated), arg_spec,
+                           kw_spec, length))
+        kind, payload = self._parent.recv()
+        if kind != "ok":
+            raise RuntimeError(f"actor UDF raised:\n{payload}")
+        return _series_from_ipc(payload)[0]
+
+    def stop(self):
+        try:
+            self._parent.send(("stop",))
+        except (OSError, BrokenPipeError):
+            pass
+        self.process.join(timeout=2)
+        if self.process.is_alive():
+            self.process.terminate()
+
+
+class ActorPool:
+    """N OS-process actors; calls check out an idle actor (blocking when all
+    are busy), giving concurrency == pool size."""
+
+    def __init__(self, udf, size: int):
+        blob = _dumps_udf((udf.func, udf.init_args, udf.return_dtype,
+                           udf.batch_size, udf.name))
+        self._actors = [_Actor(blob) for _ in range(max(size, 1))]
+        self._idle: "queue.Queue[_Actor]" = queue.Queue()
+        for a in self._actors:
+            self._idle.put(a)
+        # finalize (not atexit): a discarded pool's workers stop when the
+        # pool is garbage-collected, not at process exit
+        self._finalizer = weakref.finalize(self, _stop_actors, self._actors)
+
+    @property
+    def size(self) -> int:
+        return len(self._actors)
+
+    def call(self, evaluated, arg_spec, kw_spec, length):
+        actor = self._idle.get()
+        try:
+            return actor.call(evaluated, arg_spec, kw_spec, length)
+        finally:
+            self._idle.put(actor)
+
+    def shutdown(self):
+        self._finalizer()
+
+
+def pool_enabled() -> bool:
+    return os.environ.get("DAFT_TPU_ACTOR_POOL", "1") != "0"
+
+
+def try_make_pool(udf) -> Optional[ActorPool]:
+    """Build a pool for a stateful UDF, or None when the UDF can't ship
+    across a process boundary (falls back to the shared instance)."""
+    if not pool_enabled():
+        return None
+    try:
+        _dumps_udf((udf.func, udf.init_args))
+    except Exception:
+        return None
+    try:
+        return ActorPool(udf, udf.concurrency or 1)
+    except Exception:
+        return None

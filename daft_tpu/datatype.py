@@ -146,6 +146,11 @@ class DataType:
     def __setattr__(self, k, v):
         raise AttributeError("DataType is immutable")
 
+    def __reduce__(self):
+        # __slots__ + blocked __setattr__ breaks default (cloud)pickle
+        # state restoration; rebuild through __init__ instead
+        return (DataType, (self._kind, self._params))
+
     # ---- constructors ----------------------------------------------------
     @classmethod
     def null(cls): return cls(_Kind.NULL)

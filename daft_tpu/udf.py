@@ -40,6 +40,7 @@ class UDF:
         self.init_args = init_args
         self.is_stateful = inspect.isclass(func)
         self._instance = None
+        self._pool: Any = "unset"
         self._instance_lock = threading.Lock()
         functools.update_wrapper(self, func) if not self.is_stateful else None
         self.name = getattr(func, "__name__", type(func).__name__)
@@ -91,28 +92,67 @@ class UDF:
                 self._instance = self.func(*a, **kw)
             return self._instance
 
+    def _get_pool(self):
+        """Process actor pool for stateful UDFs (reference:
+        ``daft/execution/actor_pool_udf.py`` OS-process actors). None →
+        the shared in-process instance (unpicklable UDF or pool disabled)."""
+        if not self.is_stateful:
+            return None
+        with self._instance_lock:
+            if self._pool == "unset":
+                from . import actor_pool
+                self._pool = actor_pool.try_make_pool(self)
+            return self._pool
+
     def run(self, evaluated: List[Series], arg_spec, kw_spec,
             length: int) -> Series:
         """Called per batch by the evaluator — slices into batch_size chunks,
-        broadcasts scalars, coerces output (reference: run_udf)."""
-        fn = self._callable()
-        chunks: List[Series] = []
-        bs = self.batch_size or length or 1
-        for start in range(0, max(length, 1), bs):
-            end = min(start + bs, length)
-            def materialize(spec):
-                kind, v = spec
-                if kind == "expr":
-                    s = evaluated[v]
-                    return s.slice(start, end) if len(s) == length else s
-                return v
-            call_args = [materialize(s) for s in arg_spec]
-            call_kwargs = {k: materialize(s) for k, s in kw_spec}
-            out = fn(*call_args, **call_kwargs)
-            chunks.append(coerce_udf_output(out, self.return_dtype, end - start))
-        if not chunks:
-            return Series.empty(self.name, self.return_dtype)
-        return Series.concat(chunks) if len(chunks) > 1 else chunks[0]
+        broadcasts scalars, coerces output (reference: run_udf). Stateful
+        UDFs route through the actor pool so concurrency=N runs N real
+        processes with independent instances."""
+        pool = self._get_pool()
+        if pool is not None:
+            # Python-object columns can't cross the Arrow IPC boundary —
+            # those batches (and python return dtypes) stay in-process
+            ipc_ok = self.return_dtype.kind != "python" and \
+                not any(s.is_pyobject() for s in evaluated)
+            if ipc_ok:
+                try:
+                    return pool.call(evaluated, arg_spec, kw_spec, length)
+                except RuntimeError:
+                    # actor-side failure (e.g. unserializable payload):
+                    # permanently fall back to the shared instance
+                    with self._instance_lock:
+                        self._pool = None
+        return run_udf_batches(self._callable(), evaluated, arg_spec,
+                               kw_spec, length, self.batch_size,
+                               self.return_dtype, self.name)
+
+
+def run_udf_batches(fn: Callable, evaluated: List[Series], arg_spec, kw_spec,
+                    length: int, batch_size: Optional[int],
+                    return_dtype: DataType, name: str) -> Series:
+    """Batch-slicing + scalar-broadcast + output-coercion loop — shared by
+    the in-process path and the actor-pool child (actor_pool._actor_main)."""
+    chunks: List[Series] = []
+    bs = batch_size or length or 1
+    for start in range(0, max(length, 1), bs):
+        end = min(start + bs, length)
+
+        def materialize(spec):
+            kind, v = spec
+            if kind == "expr":
+                s = evaluated[v]
+                return s.slice(start, end) if len(s) == length else s
+            return v
+
+        call_args = [materialize(s) for s in arg_spec]
+        call_kwargs = {k: materialize(s) for k, s in kw_spec}
+        out = fn(*call_args, **call_kwargs)
+        chunks.append(coerce_udf_output(out, return_dtype, end - start))
+    if not chunks:
+        return Series.empty(name, return_dtype)
+    return Series.concat(chunks) if len(chunks) > 1 else chunks[0]
 
 
 def coerce_udf_output(out: Any, dtype: DataType, length: int) -> Series:
