@@ -110,16 +110,16 @@ class DataFrame:
             b = self._builder
             if plain:
                 b = b.with_columns(plain)
-            w = window_exprs[0]._unalias().params[0]
-            for e in window_exprs[1:]:
-                w2 = e._unalias().params[0]
-                if repr(w2) != repr(w):
-                    raise ValueError(
-                        "multiple different window specs in one with_columns "
-                        "are not yet supported; chain with_column calls")
-            return DataFrame(b.window(
-                window_exprs, w._partition_by, w._order_by, w._descending,
-                w._nulls_first, w._frame))
+            # one Window plan node per distinct spec, chained (reference:
+            # ExtractWindowFunction groups by WindowSpec the same way)
+            by_spec = {}
+            for e in window_exprs:
+                by_spec.setdefault(repr(e._unalias().params[0]), []).append(e)
+            for group in by_spec.values():
+                w = group[0]._unalias().params[0]
+                b = b.window(group, w._partition_by, w._order_by,
+                             w._descending, w._nulls_first, w._frame)
+            return DataFrame(b)
         return DataFrame(self._builder.with_columns(exprs))
 
     def with_column_renamed(self, old: str, new: str) -> "DataFrame":

@@ -766,6 +766,8 @@ class SQLPlanner:
                 if not self._kw(","):
                     break
         self._expect(")")
+        if self._peek_kw("OVER"):
+            return self._window_call(fn, args, scope)
         try:
             return _apply_function(fn, args, distinct)
         except ValueError as e:
@@ -779,6 +781,101 @@ class SQLPlanner:
                         f"DISTINCT is not supported for attached UDF {fn!r}")
                 return self.session._functions[fn](*args)
             raise
+
+    # -- window functions --------------------------------------------------
+    _WINDOW_FNS = {"row_number", "rank", "dense_rank", "lag", "lead",
+                   "sum", "avg", "mean", "min", "max", "count", "ntile"}
+
+    def _window_call(self, fn: str, args: List[Expression],
+                     scope) -> Expression:
+        """``fn(args) OVER (PARTITION BY … ORDER BY … [frame])`` →
+        Expression.over(Window) on the DataFrame window path
+        (reference: ``src/daft-sql/src/modules/window.rs``)."""
+        from ..window import Window
+        self._kw("OVER")
+        self._expect("(")
+        w = Window()
+        if self._kw("PARTITION"):
+            self._expect("BY")
+            parts = []
+            while True:
+                parts.append(self._expr(scope))
+                if not self._kw(","):
+                    break
+            w = w.partition_by(*parts)
+        if self._kw("ORDER"):
+            self._expect("BY")
+            obs, descs = [], []
+            while True:
+                obs.append(self._expr(scope))
+                if self._kw("DESC"):
+                    descs.append(True)
+                else:
+                    self._kw("ASC")
+                    descs.append(False)
+                if not self._kw(","):
+                    break
+            w = w.order_by(*obs, desc=descs)
+        if self._peek_kw("ROWS") or self._peek_kw("RANGE"):
+            mode = self._next().text.lower()
+            w = self._window_frame(w, mode)
+        self._expect(")")
+
+        if fn not in self._WINDOW_FNS:
+            raise ValueError(f"unsupported window function {fn!r}")
+        if fn == "row_number":
+            from ..functions import row_number
+            return row_number().over(w)
+        if fn == "rank":
+            from ..functions import rank
+            return rank().over(w)
+        if fn == "dense_rank":
+            from ..functions import dense_rank
+            return dense_rank().over(w)
+        if fn in ("lag", "lead"):
+            if not args:
+                raise ValueError(f"{fn} requires an argument")
+            offset = 1
+            default = None
+            if len(args) >= 2:
+                if args[1].op != "lit":
+                    raise ValueError(f"{fn} offset must be a literal")
+                offset = int(args[1].params[0])
+            if len(args) >= 3:
+                default = args[2]
+            base = args[0]
+            e = base.lag(offset, default) if fn == "lag" \
+                else base.lead(offset, default)
+            return e.over(w)
+        # windowed aggregates
+        agg = _apply_function("avg" if fn == "mean" else fn, args, False)
+        return agg.over(w)
+
+    def _window_frame(self, w, mode: str):
+        from ..window import Window
+        self._expect("BETWEEN")
+
+        def bound():
+            if self._kw("UNBOUNDED"):
+                if self._kw("PRECEDING"):
+                    return Window.unbounded_preceding
+                self._expect("FOLLOWING")
+                return Window.unbounded_following
+            if self._kw("CURRENT"):
+                self._expect("ROW")
+                return 0
+            n = int(self._next().text)
+            if self._kw("PRECEDING"):
+                return -n
+            self._expect("FOLLOWING")
+            return n
+
+        lo = bound()
+        self._expect("AND")
+        hi = bound()
+        if mode == "rows":
+            return w.rows_between(lo, hi)
+        return w.range_between(lo, hi)
 
 
 class _LenientScope:
@@ -923,6 +1020,45 @@ def _apply_function(fn: str, args: List[Expression],
         return a.str.match(args[1].params[0])
     if fn in ("regexp_extract",):
         return a.str.extract(args[1], 0)
+    if fn in ("regexp_extract_all",):
+        return a.str.extract_all(args[1], 0)
+    if fn in ("regexp_replace",):
+        return a.str.replace(args[1], args[2], regex=True)
+    if fn in ("lpad", "rpad"):
+        length = args[1]
+        pad = args[2] if len(args) > 2 else Expression._lit(" ")
+        ns = a.str
+        return (ns.lpad if fn == "lpad" else ns.rpad)(length, pad)
+    if fn == "repeat":
+        return a.str.repeat(args[1])
+    if fn == "normalize":
+        return a.str.normalize()
+    if fn in ("starts_with", "startswith"):
+        return a.str.startswith(args[1])
+    if fn in ("ends_with", "endswith"):
+        return a.str.endswith(args[1])
+    if fn in ("ltrim",):
+        return a.str.lstrip()
+    if fn in ("rtrim",):
+        return a.str.rstrip()
+    if fn in ("trim",):
+        return a.str.strip()
+    if fn == "reverse":
+        return a.str.reverse()
+    if fn == "capitalize":
+        return a.str.capitalize()
+    if fn in ("left",):
+        return a.str.left(args[1])
+    if fn in ("right",):
+        return a.str.right(args[1])
+    if fn in ("find", "instr"):
+        return a.str.find(args[1])
+    if fn == "count_matches":
+        return a.str.count_matches(args[1].params[0])
+    if fn == "tokenize_encode":
+        return a.str.tokenize_encode(args[1].params[0])
+    if fn == "tokenize_decode":
+        return a.str.tokenize_decode(args[1].params[0])
     if fn in ("year", "month", "day", "hour", "minute", "second", "quarter"):
         return getattr(a.dt, fn)()
     if fn == "day_of_week" or fn == "dayofweek":
@@ -956,7 +1092,13 @@ def _apply_function(fn: str, args: List[Expression],
 
 
 def _has_agg(e: Expression) -> bool:
-    return e.has_agg()
+    # an aggregate INSIDE an OVER(...) window is not a groupby aggregate —
+    # it rides the Window plan node instead
+    if e.op == "window":
+        return False
+    if e.op.startswith("agg."):
+        return True
+    return any(_has_agg(c) for c in e.args)
 
 
 def _split_join_condition(cond: Expression, left_scope: Scope,

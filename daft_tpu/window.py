@@ -84,5 +84,10 @@ class Window:
         return w
 
     def __repr__(self):
+        # the full spec must round-trip into repr: DataFrame.with_columns
+        # groups window expressions by it, so omitting a field (e.g. sort
+        # direction) would silently merge distinct specs
         return (f"Window(partition_by={self._partition_by}, "
-                f"order_by={self._order_by}, frame={self._frame})")
+                f"order_by={self._order_by}, desc={self._descending}, "
+                f"nulls_first={self._nulls_first}, frame={self._frame}, "
+                f"min_periods={self._min_periods})")

@@ -140,3 +140,62 @@ def test_sql_tpch_q1_shape(catalog):
     out = sql(q, catalog)
     assert out.column_names == ["b", "weighted", "avg_c"]
     assert out.to_pydict()["b"] == ["x", "y", "z"]
+
+
+# -- window functions (reference: src/daft-sql/src/modules/window.rs) -------
+
+def test_sql_window_rank_family():
+    df = dt.from_pydict({"g": ["a", "a", "b", "b", "b"],
+                           "v": [3.0, 1.0, 5.0, 2.0, 4.0]})
+    out = dt.sql("""
+        SELECT g, v,
+               ROW_NUMBER() OVER (PARTITION BY g ORDER BY v) AS rn,
+               RANK() OVER (PARTITION BY g ORDER BY v DESC) AS rk,
+               DENSE_RANK() OVER (PARTITION BY g ORDER BY v) AS dr
+        FROM df ORDER BY g, v""", df=df).to_pydict()
+    assert out["rn"] == [1, 2, 1, 2, 3]
+    assert out["rk"] == [2, 1, 3, 2, 1]
+    assert out["dr"] == [1, 2, 1, 2, 3]
+
+
+def test_sql_window_aggregates_and_frames():
+    df = dt.from_pydict({"g": ["a", "a", "b", "b", "b"],
+                           "v": [3.0, 1.0, 5.0, 2.0, 4.0]})
+    out = dt.sql("""
+        SELECT g, v,
+               SUM(v) OVER (PARTITION BY g) AS total,
+               SUM(v) OVER (PARTITION BY g ORDER BY v
+                            ROWS BETWEEN UNBOUNDED PRECEDING
+                            AND CURRENT ROW) AS running,
+               AVG(v) OVER (PARTITION BY g) AS m
+        FROM df ORDER BY g, v""", df=df).to_pydict()
+    assert out["total"] == [4.0, 4.0, 11.0, 11.0, 11.0]
+    assert out["running"] == [1.0, 4.0, 2.0, 6.0, 11.0]
+    assert out["m"][0] == pytest.approx(2.0)
+
+
+def test_sql_window_lag_lead():
+    df = dt.from_pydict({"g": ["a", "a", "a"], "v": [1.0, 2.0, 3.0]})
+    out = dt.sql("""
+        SELECT v,
+               LAG(v, 1) OVER (PARTITION BY g ORDER BY v) AS prev,
+               LEAD(v, 1) OVER (PARTITION BY g ORDER BY v) AS nxt,
+               LAG(v, 1, 0.0) OVER (PARTITION BY g ORDER BY v) AS prev0
+        FROM df ORDER BY v""", df=df).to_pydict()
+    assert out["prev"] == [None, 1.0, 2.0]
+    assert out["nxt"] == [2.0, 3.0, None]
+    assert out["prev0"] == [0.0, 1.0, 2.0]
+
+
+def test_sql_string_function_breadth():
+    df = dt.from_pydict({"s": ["hello world"]})
+    out = dt.sql("""
+        SELECT regexp_extract(s, '(\\w+)') AS w, lpad(s, 13, '.') AS p,
+               reverse(s) AS r, left(s, 5) AS l,
+               starts_with(s, 'hello') AS sw
+        FROM df""", df=df).to_pydict()
+    assert out["w"] == ["hello"]
+    assert out["p"] == ["..hello world"]
+    assert out["r"] == ["dlrow olleh"]
+    assert out["l"] == ["hello"]
+    assert out["sw"] == [True]
