@@ -71,6 +71,13 @@ class LocalExecutor:
         self.mem = memory.MemoryManager()
         # stage-input bindings for distributed stage fragments
         self.stage_inputs = {}
+        self._aqe_planner = None
+
+    def _aqe(self):
+        if self._aqe_planner is None:
+            from ..physical import adaptive
+            self._aqe_planner = adaptive.new_planner(self.cfg)
+        return self._aqe_planner
 
     def run(self, plan: pp.PhysicalPlan,
             stage_inputs=None) -> Iterator[MicroPartition]:
@@ -508,6 +515,18 @@ class LocalExecutor:
         from . import memory
         parts = memory.materialize(self._exec(node.children[0]))
         kind, n = node.kind, node.num_partitions
+        if self.cfg.enable_aqe and getattr(node, "engine_inserted", False) \
+                and kind in ("hash", "random") and n > 1:
+            # AQE: the child is materialized — re-size the shuffle from
+            # ACTUAL bytes instead of the planner's estimate
+            planner = self._aqe()
+            total_bytes = sum(p.size_bytes() or 0 for p in parts)
+            total_rows = sum(len(p) for p in parts)
+            n = planner.adapt_partition_count(n, total_bytes, total_rows)
+            if n == 1:  # coalesced shuffle = plain concat, skip hashing
+                yield parts[0].concat(parts[1:]) if len(parts) > 1 \
+                    else parts[0]
+                return
         if kind == "gather" or (kind == "split" and n == 1):
             yield parts[0].concat(parts[1:]) if len(parts) > 1 else parts[0]
             return
@@ -628,13 +647,27 @@ class LocalExecutor:
         lparts = memory.materialize(self._exec(node.children[0]))
         rparts = memory.materialize(self._exec(node.children[1]))
         if len(lparts) != len(rparts):
-            # co-partition by concat-gather fallback
-            lparts = [_gather_all(iter(lparts))]
-            rparts = [_gather_all(iter(rparts))]
-        pairs = list(zip(lparts, rparts))
+            # partition-count mismatch: re-fan BOTH sides to the larger
+            # count by key hash (same xxh64 chain on both → co-partitioned)
+            # instead of collapsing to one gathered pair, which silently
+            # destroyed all join parallelism
+            n = max(len(lparts), len(rparts), 1)
+            lparts = self._refan(lparts, list(node.left_on), n)
+            rparts = self._refan(rparts, list(node.right_on), n)
+        # zip stays lazy: spilled partitions reload only inside the bounded
+        # in-flight window, keeping the join under the memory budget
         yield from _ordered_parallel(
-            iter(pairs),
+            zip(lparts, rparts),
             lambda lr: lr[0].hash_join(lr[1], node.left_on, node.right_on, how))
+
+    def _refan(self, parts, by: List[Expression], n: int):
+        from . import memory
+        split = self._materialize_split(_ordered_parallel(
+            iter(parts), lambda p: p.partition_by_hash(by, n)))
+        out = memory.materialize(self._regroup(split, n))
+        if isinstance(parts, memory.SpillBuffer):
+            parts.close()
+        return out
 
     def _exec_CrossJoin(self, node: pp.CrossJoin):
         right = _gather_all(self._exec(node.children[1]))

