@@ -37,7 +37,9 @@ def __getattr__(name):
                 "from_glob_path", "range"):
         from . import dataframe as _df
         return getattr(_df, name)
-    if name in ("read_parquet", "read_csv", "read_json", "read_warc"):
+    if name in ("read_parquet", "read_csv", "read_json", "read_warc",
+                "read_deltalake", "read_iceberg", "read_hudi", "read_lance",
+                "read_sql"):
         from . import io as _io
         return getattr(_io, name)
     if name in ("IOConfig", "S3Config", "GCSConfig", "AzureConfig",

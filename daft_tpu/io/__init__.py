@@ -1,6 +1,10 @@
-from .read import read_parquet, read_csv, read_json, read_warc
+from .read import (read_parquet, read_csv, read_json, read_warc,
+                   read_deltalake, read_iceberg, read_hudi, read_lance,
+                   read_sql)
 from .scan import Pushdowns, ScanOperator, ScanTask
 from .sink import DataSink, WriteResult
 
-__all__ = ["read_parquet", "read_csv", "read_json", "read_warc", "Pushdowns",
+__all__ = ["read_parquet", "read_csv", "read_json", "read_warc",
+           "read_deltalake", "read_iceberg", "read_hudi", "read_lance",
+           "read_sql", "Pushdowns",
            "ScanOperator", "ScanTask", "DataSink", "WriteResult"]

@@ -73,3 +73,102 @@ def read_warc(path: Union[str, List[str]],
             stacklevel=2)
     from .warc import WARC_SCHEMA
     return _df_from_scan(GlobScanOperator(path, "warc", schema=WARC_SCHEMA))
+
+
+def read_deltalake(table_uri, version=None, io_config: Any = None, **kwargs):
+    """Native Delta Lake snapshot read (see ``daft_tpu/io/delta.py``)."""
+    from .delta import read_deltalake as _impl
+    return _impl(table_uri, version, io_config, **kwargs)
+
+
+def _sdk_gated(name: str, sdk: str):
+    def entry(*args, **kwargs):
+        raise ImportError(
+            f"{name} requires the optional {sdk!r} package, which is not "
+            f"available in this environment. The reference engine gates "
+            f"this reader on the same SDK.")
+    entry.__name__ = name
+    return entry
+
+
+# Iceberg manifests are Avro and Hudi/Lance use their own SDKs — unlike
+# Delta (JSON log, implemented natively above) these need their packages
+# (reference: daft/io/_iceberg.py, _hudi.py, _lance.py, _sql.py).
+read_iceberg = _sdk_gated("read_iceberg", "pyiceberg")
+read_hudi = _sdk_gated("read_hudi", "hudi")
+read_lance = _sdk_gated("read_lance", "lance")
+
+
+def read_sql(sql: str, conn, partition_col: Optional[str] = None,
+             num_partitions: Optional[int] = None, **kwargs):
+    """Read from a SQL database via a user-supplied connection factory
+    (reference: ``daft/io/_sql.py`` over connectorx/sqlalchemy). ``conn``
+    is a zero-arg callable returning a DB-API connection. With
+    ``partition_col`` + ``num_partitions`` the read splits into range
+    predicates over the column, fetched lazily per scan task (the
+    reference's partitioned-read path)."""
+    from ..dataframe import DataFrame
+    from ..logical.builder import LogicalPlanBuilder
+    from ..recordbatch import RecordBatch
+    from .scan import ScanTask, ScanOperator, Pushdowns
+    import pyarrow as pa
+
+    if not callable(conn):
+        raise TypeError("conn must be a zero-arg callable returning a "
+                        "DB-API connection")
+
+    def fetch(query: str) -> "RecordBatch":
+        c = conn()
+        try:
+            cur = c.cursor()
+            cur.execute(query)
+            cols = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            c.close()
+        data = {nm: [r[i] for r in rows] for i, nm in enumerate(cols)}
+        return RecordBatch.from_arrow_table(pa.table(data))
+
+    # schema from a one-row probe (a zero-row probe would type every
+    # column null); full results stay lazy in the scan tasks
+    try:
+        probe = fetch(f"SELECT * FROM ({sql}) LIMIT 1")
+    except Exception:
+        probe = fetch(sql)
+    schema = probe.schema
+
+    def make_task(query: str, pushdowns: Pushdowns) -> ScanTask:
+        return ScanTask([], "sql", schema, pushdowns,
+                        generator=lambda q=query: iter([fetch(q)]))
+
+    class _SQLScan(ScanOperator):
+        def schema(self):
+            return schema
+
+        def multiline_display(self):
+            return [f"SQLScanOperator({sql[:40]!r})"]
+
+        def to_scan_tasks(self, pushdowns: Pushdowns):
+            if partition_col is None or not num_partitions \
+                    or num_partitions <= 1:
+                return [make_task(sql, pushdowns)]
+            bounds = fetch(f"SELECT MIN({partition_col}), "
+                           f"MAX({partition_col}) FROM ({sql})")
+            row = bounds.to_arrow_table().to_pylist()[0]
+            lo, hi = list(row.values())
+            if lo is None or hi is None or lo == hi:
+                return [make_task(sql, pushdowns)]
+            step = (hi - lo) / num_partitions
+            tasks = []
+            for i in range(num_partitions):
+                a = lo + step * i
+                b = lo + step * (i + 1)
+                last = i == num_partitions - 1
+                cond = (f"{partition_col} >= {a!r} AND "
+                        + (f"{partition_col} <= {hi!r}" if last
+                           else f"{partition_col} < {b!r}"))
+                tasks.append(make_task(
+                    f"SELECT * FROM ({sql}) WHERE {cond}", pushdowns))
+            return tasks
+
+    return DataFrame(LogicalPlanBuilder.from_scan(_SQLScan()))

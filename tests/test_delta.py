@@ -1,0 +1,133 @@
+"""Native Delta Lake round-trip: log replay (commits + checkpoint),
+time travel, overwrite semantics (reference surface:
+``daft/io/_deltalake.py`` + ``DataFrame.write_deltalake``)."""
+
+import json
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.io.delta import (DeltaScanOperator, read_deltalake,
+                               write_deltalake)
+
+
+def test_write_read_roundtrip(tmp_path):
+    uri = str(tmp_path / "tbl")
+    df = daft_tpu.from_pydict({"k": [1, 2, 3], "v": ["a", "b", "c"]})
+    res = write_deltalake(df, uri)
+    assert res["version"] == 0 and res["rows_written"] == 3
+    back = read_deltalake(uri).sort("k").to_pydict()
+    assert back == {"k": [1, 2, 3], "v": ["a", "b", "c"]}
+
+
+def test_append_and_overwrite(tmp_path):
+    uri = str(tmp_path / "tbl")
+    write_deltalake(daft_tpu.from_pydict({"x": [1, 2]}), uri)
+    write_deltalake(daft_tpu.from_pydict({"x": [3]}), uri, mode="append")
+    assert sorted(read_deltalake(uri).to_pydict()["x"]) == [1, 2, 3]
+    write_deltalake(daft_tpu.from_pydict({"x": [9]}), uri, mode="overwrite")
+    assert read_deltalake(uri).to_pydict()["x"] == [9]
+    # time travel to v1 still sees the pre-overwrite snapshot
+    assert sorted(read_deltalake(uri, version=1).to_pydict()["x"]) == \
+        [1, 2, 3]
+
+
+def test_query_pushdown_into_delta_scan(tmp_path):
+    uri = str(tmp_path / "tbl")
+    write_deltalake(daft_tpu.from_pydict(
+        {"k": list(range(100)), "v": [float(i) for i in range(100)]}), uri)
+    out = read_deltalake(uri).where(col("k") >= 95) \
+        .groupby(daft_tpu.lit(1).alias("g")) \
+        .agg(col("v").sum().alias("s")).to_pydict() \
+        if hasattr(daft_tpu, "lit") else None
+    got = read_deltalake(uri).where(col("k") >= 95).sort("k").to_pydict()
+    assert got["k"] == [95, 96, 97, 98, 99]
+
+
+def test_partitioned_table_reads_partition_values(tmp_path):
+    """Hand-built partitioned Delta table (partition col absent from the
+    data files, as the protocol requires)."""
+    uri = tmp_path / "ptbl"
+    (uri / "_delta_log").mkdir(parents=True)
+    (uri / "p=1").mkdir()
+    (uri / "p=2").mkdir()
+    pq.write_table(pa.table({"v": [10, 11]}), str(uri / "p=1" / "a.parquet"))
+    pq.write_table(pa.table({"v": [20]}), str(uri / "p=2" / "b.parquet"))
+    schema_string = json.dumps({"type": "struct", "fields": [
+        {"name": "v", "type": "long", "nullable": True, "metadata": {}},
+        {"name": "p", "type": "integer", "nullable": True, "metadata": {}}]})
+    actions = [
+        json.dumps({"protocol": {"minReaderVersion": 1,
+                                 "minWriterVersion": 2}}),
+        json.dumps({"metaData": {"id": "t", "format": {
+            "provider": "parquet", "options": {}},
+            "schemaString": schema_string, "partitionColumns": ["p"],
+            "configuration": {}}}),
+        json.dumps({"add": {"path": "p=1/a.parquet",
+                            "partitionValues": {"p": "1"}, "size": 1,
+                            "modificationTime": 0, "dataChange": True}}),
+        json.dumps({"add": {"path": "p=2/b.parquet",
+                            "partitionValues": {"p": "2"}, "size": 1,
+                            "modificationTime": 0, "dataChange": True}}),
+    ]
+    with open(uri / "_delta_log" / f"{0:020d}.json", "w") as f:
+        f.write("\n".join(actions))
+    out = read_deltalake(str(uri)).sort("v").to_pydict()
+    assert out == {"v": [10, 11, 20], "p": [1, 1, 2]}
+
+
+def test_checkpoint_replay(tmp_path):
+    """Snapshot state from a checkpoint parquet + newer JSON commits."""
+    uri = tmp_path / "ctbl"
+    (uri / "_delta_log").mkdir(parents=True)
+    pq.write_table(pa.table({"v": [1]}), str(uri / "f0.parquet"))
+    pq.write_table(pa.table({"v": [2]}), str(uri / "f1.parquet"))
+    schema_string = json.dumps({"type": "struct", "fields": [
+        {"name": "v", "type": "long", "nullable": True, "metadata": {}}]})
+    # checkpoint at v1 holds metaData + f0 (f_removed was added+removed)
+    cp = pa.table({
+        "metaData": [{"id": "t", "schemaString": schema_string,
+                      "partitionColumns": []}, None],
+        "add": [None, {"path": "f0.parquet", "size": 1}],
+        "remove": [{"path": "gone.parquet"}, None],
+    })
+    pq.write_table(cp, str(uri / "_delta_log" /
+                           f"{1:020d}.checkpoint.parquet"))
+    with open(uri / "_delta_log" / "_last_checkpoint", "w") as f:
+        f.write(json.dumps({"version": 1}))
+    # v2 commit adds f1
+    with open(uri / "_delta_log" / f"{2:020d}.json", "w") as f:
+        f.write(json.dumps({"add": {"path": "f1.parquet",
+                                    "partitionValues": {}, "size": 1,
+                                    "modificationTime": 0,
+                                    "dataChange": True}}) + "\n")
+    op = DeltaScanOperator(str(uri))
+    assert op.version == 2
+    out = read_deltalake(str(uri)).sort("v").to_pydict()
+    assert out == {"v": [1, 2]}
+
+
+def test_gated_readers_error_actionably():
+    with pytest.raises(ImportError, match="pyiceberg"):
+        daft_tpu.read_iceberg("whatever")
+    with pytest.raises(ImportError, match="hudi"):
+        daft_tpu.read_hudi("whatever")
+
+
+def test_read_sql_over_sqlite():
+    import sqlite3
+    import tempfile
+    path = tempfile.mktemp(suffix=".db")
+    c = sqlite3.connect(path)
+    c.execute("CREATE TABLE t (a INTEGER, b TEXT)")
+    c.executemany("INSERT INTO t VALUES (?, ?)", [(1, "x"), (2, "y")])
+    c.commit()
+    c.close()
+    df = daft_tpu.read_sql("SELECT * FROM t ORDER BY a",
+                           lambda: sqlite3.connect(path))
+    assert df.to_pydict() == {"a": [1, 2], "b": ["x", "y"]}
+    os.unlink(path)
