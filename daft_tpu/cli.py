@@ -1,0 +1,41 @@
+"""Command-line interface (reference: ``src/daft-cli`` — the ``daft
+dashboard`` subcommand, ``python.rs:11-41``; entry ``daft/cli.py``).
+
+Usage: ``python -m daft_tpu.cli dashboard [--port N]``
+       ``python -m daft_tpu.cli version``
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="daft-tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    dash = sub.add_parser("dashboard", help="serve the query dashboard")
+    dash.add_argument("--port", type=int, default=None)
+    sub.add_parser("version", help="print the version")
+    args = parser.parse_args(argv)
+
+    if args.cmd == "version":
+        from . import __version__
+        print(__version__)
+        return 0
+    if args.cmd == "dashboard":
+        from . import dashboard
+        port = dashboard.launch(args.port or dashboard.DEFAULT_PORT)
+        print(f"daft-tpu dashboard on http://127.0.0.1:{port}", flush=True)
+        try:
+            import time
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            dashboard.shutdown()
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

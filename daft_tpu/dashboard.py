@@ -1,0 +1,93 @@
+"""Embedded query dashboard.
+
+Reference: ``src/daft-dashboard`` — a localhost HTTP server receiving
+broadcast query plans + timings (``lib.rs:28-60``, launched via
+``daft.dashboard.launch()`` / DAFT_DASHBOARD). Here the server renders the
+engine's own runtime stats: recent queries with per-operator rows/timings
+(observability.RuntimeStatsContext) and HBM/IO counters, as plain HTML —
+no bundled frontend, same surface.
+"""
+
+from __future__ import annotations
+
+import html
+import http.server
+import json
+import threading
+import time
+from typing import List, Optional
+
+DEFAULT_PORT = 3238
+
+_history_lock = threading.Lock()
+_history: List[dict] = []
+_MAX_HISTORY = 50
+_server: Optional[http.server.ThreadingHTTPServer] = None
+
+
+def broadcast_query(stats) -> None:
+    """Record a finished query's stats for the dashboard (called by the
+    runner; reference hook: ``DataFrame._broadcast_query_plan``)."""
+    try:
+        entry = {
+            "ts": time.strftime("%H:%M:%S"),
+            "operators": stats.as_dict(),
+            "explain": stats.render(getattr(stats, "plan", None)),
+        }
+    except Exception:
+        return
+    with _history_lock:
+        _history.append(entry)
+        del _history[:-_MAX_HISTORY]
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if self.path.startswith("/api/queries"):
+            with _history_lock:
+                body = json.dumps(_history).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        rows = []
+        with _history_lock:
+            for i, q in enumerate(reversed(_history)):
+                rows.append(
+                    f"<h3>query {len(_history) - i} — {q['ts']}</h3>"
+                    f"<pre>{html.escape(q['explain'])}</pre>")
+        body = ("<html><head><title>daft-tpu dashboard</title></head><body>"
+                "<h1>daft-tpu queries</h1>"
+                + ("".join(rows) or "<p>no queries yet</p>")
+                + "</body></html>").encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def launch(port: int = DEFAULT_PORT, block: bool = False) -> int:
+    """Start the dashboard server; returns the bound port."""
+    global _server
+    if _server is not None:
+        return _server.server_port
+    _server = http.server.ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    t = threading.Thread(target=_server.serve_forever, daemon=True,
+                         name="daft-tpu-dashboard")
+    t.start()
+    if block:
+        t.join()
+    return _server.server_port
+
+
+def shutdown() -> None:
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server = None

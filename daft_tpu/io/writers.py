@@ -21,9 +21,32 @@ from ..schema import Schema
 from ..series import Series
 
 
-def _new_filename(fmt: str) -> str:
+def _new_filename(fmt: str, idx: int = 0) -> str:
     ext = {"parquet": "parquet", "csv": "csv", "json": "json"}[fmt]
-    return f"{uuid.uuid4().hex}-0.{ext}"
+    return f"{uuid.uuid4().hex}-{idx}.{ext}"
+
+
+def _target_row_chunks(rb: RecordBatch, fmt: str) -> List[RecordBatch]:
+    """Split a batch so each output file lands near the configured target
+    size (reference: ``src/daft-writers/src/batch.rs`` TargetBatchWriter —
+    estimated via in-memory bytes over the format's inflation factor)."""
+    from ..context import get_context
+    cfg = get_context().execution_config
+    # inflation factor = in-memory bytes / on-disk bytes for the format, so
+    # the in-memory chunk that lands near the file target is target × factor
+    if fmt == "parquet":
+        target = cfg.parquet_target_filesize * cfg.parquet_inflation_factor
+    elif fmt == "csv":
+        target = cfg.csv_target_filesize * cfg.csv_inflation_factor
+    else:
+        target = cfg.parquet_target_filesize
+    nbytes = rb.size_bytes() or 0
+    n = len(rb)
+    if n == 0 or nbytes <= target:
+        return [rb]
+    rows_per_file = max(int(n * target / nbytes), 1)
+    return [rb.slice(i, min(i + rows_per_file, n))
+            for i in range(0, n, rows_per_file)]
 
 
 def _write_table(t: pa.Table, fmt: str, path: str,
@@ -62,17 +85,19 @@ def write_micropartition(mp: MicroPartition, fmt: str, root_dir: str,
             subdir = os.path.join(
                 root_dir, *[f"{k}={_hive_str(v)}" for k, v in vals.items()])
             os.makedirs(subdir, exist_ok=True)
-            p = os.path.join(subdir, _new_filename(fmt))
             drop = [c for c in part.column_names() if c in vals]
-            t = part.to_arrow_table().drop_columns(drop)
-            _write_table(t, fmt, p, options)
-            paths.append(p)
-            part_values_rows.append(vals)
+            for j, chunk in enumerate(_target_row_chunks(part, fmt)):
+                p = os.path.join(subdir, _new_filename(fmt, j))
+                _write_table(chunk.to_arrow_table().drop_columns(drop),
+                             fmt, p, options)
+                paths.append(p)
+                part_values_rows.append(vals)
     else:
         if len(rb):
-            p = os.path.join(root_dir, _new_filename(fmt))
-            _write_table(rb.to_arrow_table(), fmt, p, options)
-            paths.append(p)
+            for j, chunk in enumerate(_target_row_chunks(rb, fmt)):
+                p = os.path.join(root_dir, _new_filename(fmt, j))
+                _write_table(chunk.to_arrow_table(), fmt, p, options)
+                paths.append(p)
     cols = [Series.from_pylist(paths, "path")]
     if partition_cols and part_values_rows:
         for n in part_values_rows[0]:

@@ -214,6 +214,10 @@ def set_last_stats(ctx: RuntimeStatsContext):
     global _last_stats
     with _last_lock:
         _last_stats = ctx
+    # feed the dashboard when it's up (reference: broadcast_query_plan hook)
+    from . import dashboard
+    if dashboard._server is not None:
+        dashboard.broadcast_query(ctx)
 
 
 def last_query_stats() -> Optional[RuntimeStatsContext]:

@@ -183,12 +183,17 @@ class Exchange(PhysicalPlan):
 
     def __init__(self, child, kind: str, num_partitions: int,
                  by: Tuple[Expression, ...] = (),
-                 descending: Tuple[bool, ...] = ()):
+                 descending: Tuple[bool, ...] = (),
+                 engine_inserted: bool = False):
         super().__init__([child], child.schema())
         self.kind = kind          # hash | random | range | split | gather
         self.num_partitions = num_partitions
         self.by = by
         self.descending = descending
+        # engine-inserted shuffles (agg/join co-partitioning) may be
+        # re-sized by AQE from ACTUAL materialized bytes; user-requested
+        # repartitions keep their exact count
+        self.engine_inserted = engine_inserted
 
 
 class StageInput(PhysicalPlan):

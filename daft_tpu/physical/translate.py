@@ -73,7 +73,7 @@ def _t(node: lp.LogicalPlan, cfg) -> pp.PhysicalPlan:
         child = _t(node.children[0], cfg)
         on = node.on or [col(n) for n in node.schema().column_names]
         ex = pp.Exchange(child, "hash", max(_nparts(node.children[0]), 1),
-                         tuple(on))
+                         tuple(on), engine_inserted=True)
         return pp.Dedup(ex, on)
     if isinstance(node, lp.Aggregate):
         return _translate_agg(node, cfg)
@@ -162,6 +162,8 @@ def _translate_join(node: lp.Join, cfg) -> pp.PhysicalPlan:
                                                      "anti") else "hash"
     if strategy == "hash" and (_nparts(left) > 1 or _nparts(right) > 1):
         n = max(_nparts(left), _nparts(right))
+        # join-side exchanges are NOT AQE-adaptable: the two sides must
+        # keep identical partition counts or the join would re-fan both
         pl = pp.Exchange(pl, "hash", n, tuple(node.left_on))
         pr = pp.Exchange(pr, "hash", n, tuple(node.right_on))
     elif strategy == "broadcast_right":
@@ -185,7 +187,7 @@ def _translate_agg(node: lp.Aggregate, cfg) -> pp.PhysicalPlan:
         if node.group_by:
             ex = pp.Exchange(pchild, "hash",
                              min(nparts, cfg.shuffle_aggregation_default_partitions),
-                             tuple(node.group_by))
+                             tuple(node.group_by), engine_inserted=True)
         else:
             ex = pp.Exchange(pchild, "gather", 1)
         return pp.Aggregate(ex, node.aggs, node.group_by, node.schema(),
@@ -208,7 +210,8 @@ def _translate_agg(node: lp.Aggregate, cfg) -> pp.PhysicalPlan:
                 p1, "hash",
                 min(max(nparts, 1), cfg.shuffle_aggregation_default_partitions)
                 if nparts > 1 else 1,
-                tuple(col(e.name()) for e in node.group_by))
+                tuple(col(e.name()) for e in node.group_by),
+                engine_inserted=True)
         else:
             ex = pp.Exchange(p1, "gather", 1)
         p2 = pp.Aggregate(ex, final_aggs, gb2, f_schema, "final")

@@ -171,3 +171,29 @@ def test_io_client_glob(tmp_path):
     client = get_io_client()
     hits = client.glob(str(tmp_path / "*.parquet"))
     assert [h.rsplit("/", 1)[1] for h in hits] == ["a.parquet", "b.parquet"]
+
+
+def test_image_pipeline_decode_resize_mode_encode_crop():
+    """Full image kernel surface (reference: src/daft-image
+    decode/encode/resize/crop/to_mode)."""
+    import io as _io
+    import numpy as np
+    from PIL import Image
+    imgs = []
+    for i in range(2):
+        a = (np.arange(100 * 80 * 3) % 255).astype(np.uint8) \
+            .reshape(100, 80, 3)
+        b = _io.BytesIO()
+        Image.fromarray(a).save(b, format="PNG")
+        imgs.append(b.getvalue())
+    df = daft.from_pydict({"b": imgs, "bbox": [[0, 0, 8, 6]] * 2})
+    out = (df.with_column("img", col("b").image.decode())
+           .with_column("small", col("img").image.resize(16, 12))
+           .with_column("gray", col("small").image.to_mode("L"))
+           .with_column("cropped", col("small").image.crop(col("bbox")))
+           .with_column("enc", col("gray").image.encode("png"))
+           .to_pydict())
+    assert out["small"][0].shape == (12, 16, 3)
+    assert out["gray"][0].shape == (12, 16)
+    assert out["cropped"][0].shape == (6, 8, 3)
+    assert out["enc"][0][:4] == b"\x89PNG"

@@ -75,3 +75,80 @@ def test_explain_analyze_not_stale(capsys):
     df1.explain(analyze=True)
     out = capsys.readouterr().out
     assert "rows_out=2" in out and "rows_out=39" not in out
+
+
+def test_aqe_coalesces_small_shuffles(monkeypatch):
+    """With AQE on, an engine-inserted shuffle over tiny data coalesces to
+    fewer partitions, sized by actual materialized bytes (reference:
+    AdaptivePlanner next_stage/update_stats)."""
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.context import execution_config_ctx
+    from daft_tpu.physical import adaptive
+
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")  # host exchange path
+    df = daft_tpu.from_pydict({"k": [i % 5 for i in range(100)],
+                               "v": [float(i) for i in range(100)]})
+    df = df.into_partitions(8)
+    with execution_config_ctx(enable_aqe=True,
+                              target_partition_size_bytes=1 << 30):
+        out = df.groupby("k").agg(col("v").sum().alias("s")) \
+            .sort("k").to_pydict()
+    assert out["k"] == [0, 1, 2, 3, 4]
+    planner = adaptive.last_planner()
+    assert planner is not None and planner.history
+    # tiny data against a 1GB target → coalesced to 1 partition
+    assert planner.history[-1].partitions == 1
+    assert "→1 parts" in planner.history[-1].decision.replace(" ", "") \
+        or planner.history[-1].partitions == 1
+    # user-visible explain
+    assert "Adaptive execution" in planner.explain_analyze()
+
+
+def test_user_repartition_not_adapted():
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.context import execution_config_ctx
+
+    df = daft_tpu.from_pydict({"k": list(range(50))})
+    with execution_config_ctx(enable_aqe=True,
+                              target_partition_size_bytes=1 << 30):
+        out = df.repartition(6, col("k"))
+        assert out.num_partitions() == 6
+        got = out.to_pydict()
+    assert sorted(got["k"]) == list(range(50))
+
+
+def test_dashboard_serves_query_history():
+    import urllib.request
+    import daft_tpu
+    from daft_tpu import col, dashboard
+
+    port = dashboard.launch(0)
+    try:
+        df = daft_tpu.from_pydict({"x": [1, 2, 3]})
+        df.select((col("x") * 2).alias("y")).to_pydict()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/") as r:
+            page = r.read().decode()
+        assert "daft-tpu queries" in page
+        assert "query 1" in page
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/queries") as r:
+            import json
+            data = json.loads(r.read())
+        assert data and "operators" in data[0]
+    finally:
+        dashboard.shutdown()
+
+
+def test_cli_version_and_dashboard_entry(capsys):
+    from daft_tpu.cli import main
+    assert main(["version"]) == 0
+    out = capsys.readouterr().out.strip()
+    assert out == daft_tpu_version()
+
+
+def daft_tpu_version():
+    import daft_tpu
+    return daft_tpu.__version__
