@@ -1,0 +1,69 @@
+"""Synthetic TPC-DS-shaped data for the window-function query subset.
+
+The reference ships full dsdgen + 99 queries (``benchmarking/tpcds``).
+This generator produces the four tables the rolling/window benchmark
+queries (Q47/Q63/Q89) touch — store_sales, item, date_dim, store — with the
+TPC-DS column names and realistic key relationships, vectorized numpy like
+the TPC-H datagen.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+
+def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(seed)
+
+    n_items = max(int(1000 * scale), 50)
+    n_stores = max(int(20 * scale), 4)
+    n_sales = max(int(500_000 * scale), 5000)
+
+    # date_dim: 3 years of days
+    n_days = 3 * 365
+    d_date_sk = np.arange(1, n_days + 1)
+    years = 1999 + (np.arange(n_days) // 365)
+    moy = ((np.arange(n_days) % 365) // 31) + 1
+    date_dim = pa.table({
+        "d_date_sk": d_date_sk,
+        "d_year": years,
+        "d_moy": np.minimum(moy, 12),
+    })
+
+    categories = ["Books", "Home", "Electronics", "Music", "Sports"]
+    classes = ["cls%02d" % i for i in range(10)]
+    brands = ["brand%03d" % i for i in range(50)]
+    item = pa.table({
+        "i_item_sk": np.arange(1, n_items + 1),
+        "i_category": rng.choice(categories, n_items),
+        "i_class": rng.choice(classes, n_items),
+        "i_brand": rng.choice(brands, n_items),
+        "i_manager_id": rng.integers(1, 100, n_items),
+        "i_manufact_id": rng.integers(1, 200, n_items),
+    })
+
+    store = pa.table({
+        "s_store_sk": np.arange(1, n_stores + 1),
+        "s_store_name": ["store%d" % i for i in range(n_stores)],
+        "s_company_name": ["company%d" % (i % 3) for i in range(n_stores)],
+    })
+
+    store_sales = pa.table({
+        "ss_sold_date_sk": rng.integers(1, n_days + 1, n_sales),
+        "ss_item_sk": rng.integers(1, n_items + 1, n_sales),
+        "ss_store_sk": rng.integers(1, n_stores + 1, n_sales),
+        "ss_sales_price": rng.uniform(1, 300, n_sales).round(2),
+        "ss_quantity": rng.integers(1, 100, n_sales),
+        "ss_ext_sales_price": rng.uniform(1, 3000, n_sales).round(2),
+    })
+
+    for name, t in (("date_dim", date_dim), ("item", item),
+                    ("store", store), ("store_sales", store_sales)):
+        d = os.path.join(root, name)
+        os.makedirs(d, exist_ok=True)
+        pq.write_table(t, os.path.join(d, "part-0.parquet"))

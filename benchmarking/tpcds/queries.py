@@ -1,0 +1,86 @@
+"""TPC-DS window-function queries (the BASELINE configs' rolling subset):
+Q47, Q63, Q89 as SQL against the engine's SQL frontend (reference ships
+them in ``benchmarking/tpcds/queries``; shapes preserved — monthly
+aggregates joined over date_dim/item/store with OVER(PARTITION BY …)
+windows — sized to the synthetic datagen)."""
+
+Q47 = """
+WITH monthly AS (
+  SELECT i_category, i_brand, s_store_name, s_company_name,
+         d_year, d_moy,
+         SUM(ss_sales_price) AS sum_sales
+  FROM store_sales, item, date_dim, store
+  WHERE ss_item_sk = i_item_sk
+    AND ss_sold_date_sk = d_date_sk
+    AND ss_store_sk = s_store_sk
+    AND d_year = 2000
+  GROUP BY i_category, i_brand, s_store_name, s_company_name,
+           d_year, d_moy
+), v1 AS (
+  SELECT i_category, i_brand, s_store_name, s_company_name, d_year, d_moy,
+         sum_sales,
+         AVG(sum_sales) OVER (
+             PARTITION BY i_category, i_brand, s_store_name,
+                          s_company_name, d_year) AS avg_monthly_sales,
+         RANK() OVER (
+             PARTITION BY i_category, i_brand, s_store_name,
+                          s_company_name
+             ORDER BY d_year, d_moy) AS rn
+  FROM monthly
+)
+SELECT i_category, i_brand, s_store_name, d_year, d_moy, sum_sales,
+       avg_monthly_sales
+FROM v1
+WHERE avg_monthly_sales > 0
+  AND sum_sales - avg_monthly_sales > 0.1 * avg_monthly_sales
+ORDER BY sum_sales DESC, i_category, i_brand, s_store_name, d_moy
+LIMIT 100
+"""
+
+Q63 = """
+WITH monthly AS (
+  SELECT i_manager_id, d_moy, SUM(ss_sales_price) AS sum_sales
+  FROM store_sales, item, date_dim
+  WHERE ss_item_sk = i_item_sk
+    AND ss_sold_date_sk = d_date_sk
+    AND d_year = 2000
+  GROUP BY i_manager_id, d_moy
+)
+SELECT i_manager_id, sum_sales,
+       AVG(sum_sales) OVER (PARTITION BY i_manager_id) AS avg_monthly_sales
+FROM monthly
+ORDER BY i_manager_id, avg_monthly_sales, sum_sales
+LIMIT 100
+"""
+
+Q89 = """
+WITH monthly AS (
+  SELECT i_category, i_class, i_brand, s_store_name, s_company_name,
+         d_moy, SUM(ss_sales_price) AS sum_sales
+  FROM store_sales, item, date_dim, store
+  WHERE ss_item_sk = i_item_sk
+    AND ss_sold_date_sk = d_date_sk
+    AND ss_store_sk = s_store_sk
+    AND d_year = 2000
+  GROUP BY i_category, i_class, i_brand, s_store_name, s_company_name,
+           d_moy
+)
+SELECT i_category, i_class, i_brand, s_store_name, s_company_name, d_moy,
+       sum_sales,
+       AVG(sum_sales) OVER (
+           PARTITION BY i_category, i_brand, s_store_name,
+                        s_company_name) AS avg_monthly_sales
+FROM monthly
+ORDER BY sum_sales - avg_monthly_sales, s_store_name
+LIMIT 100
+"""
+
+ALL = {47: Q47, 63: Q63, 89: Q89}
+
+
+def run(qnum: int, get_df):
+    """Execute a query with tables bound from ``get_df(name)``."""
+    import daft_tpu as dt
+    tables = {name: get_df(name)
+              for name in ("store_sales", "item", "date_dim", "store")}
+    return dt.sql(ALL[qnum], **tables)

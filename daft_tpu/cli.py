@@ -25,7 +25,8 @@ def main(argv=None) -> int:
         return 0
     if args.cmd == "dashboard":
         from . import dashboard
-        port = dashboard.launch(args.port or dashboard.DEFAULT_PORT)
+        port = dashboard.launch(args.port if args.port is not None
+                                else dashboard.DEFAULT_PORT)
         print(f"daft-tpu dashboard on http://127.0.0.1:{port}", flush=True)
         try:
             import time

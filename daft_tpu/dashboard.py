@@ -72,22 +72,33 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         self.wfile.write(body)
 
 
+_server_lock = threading.Lock()
+
+
 def launch(port: int = DEFAULT_PORT, block: bool = False) -> int:
     """Start the dashboard server; returns the bound port."""
     global _server
-    if _server is not None:
-        return _server.server_port
-    _server = http.server.ThreadingHTTPServer(("127.0.0.1", port), _Handler)
-    t = threading.Thread(target=_server.serve_forever, daemon=True,
-                         name="daft-tpu-dashboard")
-    t.start()
+    with _server_lock:
+        if _server is None:
+            _server = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                      _Handler)
+            t = threading.Thread(target=_server.serve_forever, daemon=True,
+                                 name="daft-tpu-dashboard")
+            t.start()
+        srv = _server
     if block:
-        t.join()
-    return _server.server_port
+        try:
+            while _server is srv:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+    return srv.server_port
 
 
 def shutdown() -> None:
     global _server
-    if _server is not None:
-        _server.shutdown()
-        _server = None
+    with _server_lock:
+        if _server is not None:
+            _server.shutdown()
+            _server.server_close()  # release the listening socket
+            _server = None

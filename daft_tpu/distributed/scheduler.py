@@ -134,6 +134,7 @@ class StageRunner:
             return parts
         schema = parts[0].schema
         node = pp.Exchange(pp.InMemorySource(parts, schema), b.kind,
-                           b.num_partitions, b.by, b.descending)
+                           b.num_partitions, b.by, b.descending,
+                           engine_inserted=b.engine_inserted)
         ex = LocalExecutor()
         return list(ex.run(node))

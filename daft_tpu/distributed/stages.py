@@ -33,6 +33,7 @@ class Boundary:
     num_partitions: int
     by: Tuple = ()
     descending: Tuple = ()
+    engine_inserted: bool = False  # preserves the AQE-adaptability flag
 
 
 @dataclass
@@ -109,10 +110,10 @@ class StagePlan:
                 sid = counter[0]
                 counter[0] += 1
                 stages.append(Stage(sid, up_plan, up_boundaries))
-                boundaries.append(Boundary(sid, node.kind,
-                                           node.num_partitions,
-                                           tuple(node.by),
-                                           tuple(node.descending)))
+                boundaries.append(Boundary(
+                    sid, node.kind, node.num_partitions, tuple(node.by),
+                    tuple(node.descending),
+                    getattr(node, "engine_inserted", False)))
                 return pp.StageInput(sid, node.schema())
             n = copy.copy(node)
             n.children = [cut(c, boundaries) for c in node.children]

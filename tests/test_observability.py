@@ -99,8 +99,7 @@ def test_aqe_coalesces_small_shuffles(monkeypatch):
     assert planner is not None and planner.history
     # tiny data against a 1GB target → coalesced to 1 partition
     assert planner.history[-1].partitions == 1
-    assert "→1 parts" in planner.history[-1].decision.replace(" ", "") \
-        or planner.history[-1].partitions == 1
+    assert "→1 parts" in planner.history[-1].decision
     # user-visible explain
     assert "Adaptive execution" in planner.explain_analyze()
 

@@ -1,0 +1,49 @@
+"""TPC-DS window-query subset runs end-to-end through the SQL frontend
+(VERDICT r1 item 9 done-criterion: Q47/Q63/Q89 parse and run), with Q63
+cross-checked against pandas."""
+
+import pandas as pd
+import pytest
+
+import daft_tpu as dt
+from benchmarking.tpcds import queries as Q
+from benchmarking.tpcds.datagen import generate_tpcds
+
+
+@pytest.fixture(scope="module")
+def tpcds(tmp_path_factory):
+    root = tmp_path_factory.mktemp("tpcds")
+    generate_tpcds(str(root), scale=0.01)
+
+    def get_df(name):
+        return dt.read_parquet(f"{root}/{name}/*.parquet")
+
+    return get_df
+
+
+@pytest.mark.parametrize("qnum", [47, 63, 89])
+def test_queries_run(tpcds, qnum):
+    out = Q.run(qnum, tpcds).to_pydict()
+    assert out and all(len(v) <= 100 for v in out.values())
+    assert "sum_sales" in out and "avg_monthly_sales" in out
+
+
+def test_q63_vs_pandas(tpcds):
+    got = Q.run(63, tpcds).to_pandas()
+    ss = tpcds("store_sales").to_pandas()
+    it = tpcds("item").to_pandas()
+    dd = tpcds("date_dim").to_pandas()
+    j = (ss.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+         .merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk"))
+    j = j[j.d_year == 2000]
+    monthly = (j.groupby(["i_manager_id", "d_moy"], as_index=False)
+               .agg(sum_sales=("ss_sales_price", "sum")))
+    monthly["avg_monthly_sales"] = monthly.groupby("i_manager_id")[
+        "sum_sales"].transform("mean")
+    exp = monthly.sort_values(
+        ["i_manager_id", "avg_monthly_sales", "sum_sales"]).head(100)
+    assert list(got.i_manager_id) == list(exp.i_manager_id)
+    for a, b in zip(got.sum_sales, exp.sum_sales):
+        assert a == pytest.approx(b, rel=1e-9)
+    for a, b in zip(got.avg_monthly_sales, exp.avg_monthly_sales):
+        assert a == pytest.approx(b, rel=1e-9)
