@@ -1,0 +1,169 @@
+"""Multi-host worker: stage tasks over the wire.
+
+Reference: flotilla's RaySwordfishActor — one worker process per node
+receiving whole LocalPhysicalPlan fragments and streaming MicroPartitions
+back (``daft/runners/flotilla.py:53``, ``scheduling/worker.rs``). Here the
+transport is HTTP + cloudpickle for the plan fragment and Arrow IPC for the
+result partitions; ``RemoteWorker`` plugs into the same ``Worker`` seam the
+in-process workers use, so the scheduler/stage runner is transport-blind.
+A worker process is started with ``python -m
+daft_tpu.distributed.remote_worker --port N`` on each host."""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import http.server
+import io
+import pickle
+import threading
+import urllib.request
+from typing import Dict, List
+
+import pyarrow as pa
+import pyarrow.ipc as paipc
+
+from ..micropartition import MicroPartition
+from ..recordbatch import RecordBatch
+from .worker import StageTask, Worker
+
+
+def _dumps(obj) -> bytes:
+    try:
+        import cloudpickle
+        return cloudpickle.dumps(obj)
+    except ImportError:
+        return pickle.dumps(obj)
+
+
+def _parts_to_ipc(parts: List[MicroPartition]) -> bytes:
+    sink = io.BytesIO()
+    offsets = []
+    for p in parts:
+        t = p.combined().to_arrow_table()
+        w = paipc.new_stream(sink, t.schema)
+        w.write_table(t)
+        w.close()
+        offsets.append(sink.tell())
+    return pickle.dumps((offsets, sink.getvalue()))
+
+
+def _parts_from_ipc(blob: bytes) -> List[MicroPartition]:
+    offsets, payload = pickle.loads(blob)
+    out = []
+    start = 0
+    for end in offsets:
+        with paipc.open_stream(pa.BufferReader(payload[start:end])) as r:
+            out.append(MicroPartition.from_recordbatch(
+                RecordBatch.from_arrow_table(r.read_all())))
+        start = end
+    return out
+
+
+class WorkerServer:
+    """Executes posted stage fragments on a local streaming executor."""
+
+    def __init__(self, port: int = 0, num_slots: int = 2):
+        self.num_slots = num_slots
+        pool = cf.ThreadPoolExecutor(max_workers=num_slots)
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                blob = self.rfile.read(n)
+                try:
+                    task_plan, stage_inputs_blob = pickle.loads(blob)
+                    # plain pickle.loads decodes cloudpickle output too, so
+                    # a worker host without cloudpickle still serves
+                    plan = pickle.loads(task_plan)
+                    stage_inputs = {
+                        k: _parts_from_ipc(v)
+                        for k, v in stage_inputs_blob.items()}
+
+                    def run():
+                        from ..execution.executor import LocalExecutor
+                        ex = LocalExecutor()
+                        return list(ex.run(plan, stage_inputs=stage_inputs))
+
+                    parts = pool.submit(run).result()
+                    body = _parts_to_ipc(parts)
+                    status = 200
+                except Exception:
+                    import traceback
+                    body = traceback.format_exc().encode()
+                    status = 500
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                       Handler)
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="daft-tpu-worker").start()
+
+    @property
+    def address(self) -> str:
+        return f"http://127.0.0.1:{self._server.server_port}"
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RemoteWorker(Worker):
+    """Worker-seam client for a WorkerServer on another process/host."""
+
+    def __init__(self, worker_id: str, address: str, num_slots: int = 2):
+        self.id = worker_id
+        self.address = address
+        self.num_slots = num_slots
+        self._pool = cf.ThreadPoolExecutor(
+            max_workers=num_slots, thread_name_prefix=f"daft-tpu-{worker_id}")
+
+    def submit(self, task: StageTask):
+        return self._pool.submit(self._post, task)
+
+    def _post(self, task: StageTask) -> List[MicroPartition]:
+        import os
+        import urllib.error
+        stage_inputs_blob = {k: _parts_to_ipc(v)
+                             for k, v in task.stage_inputs.items()}
+        blob = pickle.dumps((_dumps(task.plan), stage_inputs_blob))
+        req = urllib.request.Request(self.address, data=blob, method="POST")
+        timeout = float(os.environ.get("DAFT_TPU_WORKER_TIMEOUT", "3600"))
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                body = r.read()
+        except urllib.error.HTTPError as exc:
+            # surface the remote traceback the server sent in the body
+            detail = exc.read().decode(errors="replace")
+            raise RuntimeError(f"remote worker failed:\n{detail}") from exc
+        return _parts_from_ipc(body)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import time
+    p = argparse.ArgumentParser(prog="daft-tpu-worker")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--slots", type=int, default=2)
+    args = p.parse_args(argv)
+    srv = WorkerServer(args.port, args.slots)
+    print(f"daft-tpu worker on {srv.address}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

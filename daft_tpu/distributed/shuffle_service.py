@@ -1,0 +1,170 @@
+"""Inter-host shuffle transport: spill-backed partition server + client.
+
+Reference: the flight shuffle (``src/daft-shuffles``) — the map side
+partitions morsels and spills per-partition Arrow IPC files
+(``shuffle_cache.rs:14-80``); each node runs an Arrow Flight gRPC server
+serving ``do_get(partition_idx)`` (``server/flight_server.rs:17-170``) and
+the reduce side fetches over the network. Here the same design rides plain
+HTTP (stdlib server, Arrow IPC payloads): a ``ShuffleCache`` accumulates
+map outputs into per-partition spill files, a ``ShuffleServer`` exposes
+``GET /shuffle/<id>/<partition>`` streaming the concatenated IPC bytes, and
+``fetch_partition`` pulls a partition from any host. On a TPU pod this is
+the DCN tier — intra-pod exchanges ride ICI collectives instead
+(``parallel/exchange.py``)."""
+
+from __future__ import annotations
+
+import http.server
+import io
+import os
+import threading
+import urllib.request
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+import pyarrow as pa
+import pyarrow.ipc as paipc
+
+
+class ShuffleCache:
+    """Map-side output accumulator: morsels are hash-partitioned by the
+    caller; each partition's batches append to one Arrow IPC spill file
+    (reference: InProgressShuffleCache → per-partition writer tasks)."""
+
+    def __init__(self, shuffle_id: Optional[str] = None,
+                 dirs: Optional[List[str]] = None):
+        from ..execution.memory import spill_dir
+        self.shuffle_id = shuffle_id or uuid.uuid4().hex
+        self._root = os.path.join((dirs or [spill_dir()])[0],
+                                  f"shuffle_{self.shuffle_id}")
+        os.makedirs(self._root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._writers: Dict[int, Tuple[object, object]] = {}
+        self._rows: Dict[int, int] = {}
+
+    def _writer(self, partition: int, schema: pa.Schema):
+        w = self._writers.get(partition)
+        if w is None:
+            # append: a straggler push after close() adds a new IPC stream
+            # after the sealed one instead of truncating it (fetch reads
+            # all concatenated streams)
+            f = open(self._path(partition), "ab")
+            w = (paipc.new_stream(f, schema), f)
+            self._writers[partition] = w
+        return w[0]
+
+    def _path(self, partition: int) -> str:
+        return os.path.join(self._root, f"part-{partition}.arrow")
+
+    def push(self, partition: int, table: pa.Table) -> None:
+        with self._lock:
+            self._writer(partition, table.schema).write_table(table)
+            self._rows[partition] = self._rows.get(partition, 0) + len(table)
+
+    def close(self) -> None:
+        with self._lock:
+            for w, f in self._writers.values():
+                w.close()
+                f.close()
+            self._writers = {}
+
+    def partition_bytes(self, partition: int) -> bytes:
+        p = self._path(partition)
+        if not os.path.exists(p):
+            return b""
+        with open(p, "rb") as f:
+            return f.read()
+
+    def partitions(self) -> List[int]:
+        return sorted(self._rows)
+
+    def cleanup(self) -> None:
+        self.close()
+        for f in os.listdir(self._root):
+            try:
+                os.unlink(os.path.join(self._root, f))
+            except OSError:
+                pass
+        try:
+            os.rmdir(self._root)
+        except OSError:
+            pass
+
+
+class ShuffleServer:
+    """Per-host partition server (reference: per-node Flight server)."""
+
+    def __init__(self, port: int = 0):
+        self._caches: Dict[str, ShuffleCache] = {}
+        self._lock = threading.Lock()
+        caches = self._caches
+        lock = self._lock
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) != 3 or parts[0] != "shuffle":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                sid, pidx = parts[1], int(parts[2])
+                with lock:
+                    cache = caches.get(sid)
+                if cache is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = cache.partition_bytes(pidx)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/vnd.apache.arrow.stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                       Handler)
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="daft-tpu-shuffle").start()
+
+    @property
+    def address(self) -> str:
+        return f"http://127.0.0.1:{self._server.server_port}"
+
+    def register(self, cache: ShuffleCache) -> None:
+        cache.close()  # seal files before serving
+        with self._lock:
+            self._caches[cache.shuffle_id] = cache
+
+    def unregister(self, shuffle_id: str) -> None:
+        with self._lock:
+            cache = self._caches.pop(shuffle_id, None)
+        if cache is not None:
+            cache.cleanup()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def fetch_partition(address: str, shuffle_id: str, partition: int
+                    ) -> Optional[pa.Table]:
+    """Reduce-side fetch: partition bytes → Arrow table (reference:
+    flight_client do_get)."""
+    url = f"{address}/shuffle/{shuffle_id}/{partition}"
+    timeout = float(os.environ.get("DAFT_TPU_SHUFFLE_TIMEOUT", "600"))
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        body = r.read()
+    if not body:
+        return None
+    tables = []
+    buf = pa.BufferReader(body)
+    # the spill file may hold several concatenated IPC streams (one per
+    # writer reopen); read them all
+    while buf.tell() < buf.size():
+        with paipc.open_stream(buf) as rd:
+            tables.append(rd.read_all())
+    return pa.concat_tables(tables) if tables else None

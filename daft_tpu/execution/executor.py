@@ -117,9 +117,7 @@ class LocalExecutor:
             est = t.size_bytes() or 0
             self.mem.acquire(est)
             try:
-                mp = MicroPartition.from_scan_task(t)
-                mp._load()
-                return mp
+                return _load_with_retry(t)
             finally:
                 self.mem.release(est)
         if not node.tasks:
@@ -284,7 +282,7 @@ class LocalExecutor:
             est = t.size_bytes() or 0
             self.mem.acquire(est)
             try:
-                return MicroPartition.from_scan_task(t).combined()
+                return _load_with_retry(t).combined()
             finally:
                 self.mem.release(est)
 
@@ -735,6 +733,25 @@ def _decode_mesh_shards(n: int, live_mask: np.ndarray, cols_spec, schema
         outs.append(MicroPartition.from_recordbatch(
             RecordBatch.from_series(cols).cast_to_schema(schema)))
     return outs
+
+
+def _load_with_retry(task, tries: int = 2) -> MicroPartition:
+    """Scan-task load with transient-IO retry (reference analogue: per-task
+    lineage retry in the classic runner / flotilla max_task_retries —
+    inputs are re-scannable from storage, so retrying the load is safe)."""
+    tries = max(tries, 1)
+    last = None
+    for attempt in range(tries):
+        mp = MicroPartition.from_scan_task(task)
+        try:
+            mp._load()
+            return mp
+        except OSError as exc:
+            last = exc
+            if attempt + 1 < tries:
+                import time
+                time.sleep(min(0.2 * (2 ** attempt), 2.0))
+    raise last
 
 
 def _np_plane_encoder(rb: RecordBatch, cap: int):

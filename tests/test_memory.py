@@ -134,3 +134,30 @@ def test_hash_join_refans_mismatched_partition_counts():
     assert len(out) == 4  # parallelism preserved (max of the two counts)
     rows = sorted(v for p in out for v in p.to_pydict()["k"])
     assert rows == list(range(0, 40, 2))
+
+
+def test_scan_load_retries_transient_io(monkeypatch, tmp_path):
+    """A transient IO failure during scan-task load retries instead of
+    failing the query (reference: per-task retry semantics)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import daft_tpu
+    from daft_tpu.io.scan import ScanTask
+
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"x": [1, 2, 3]}), p)
+    df = daft_tpu.read_parquet(p)
+
+    from daft_tpu.io import readers
+    calls = {"n": 0}
+    orig = readers.read_scan_task
+
+    def flaky(task):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("transient read failure")
+        return orig(task)
+
+    monkeypatch.setattr(readers, "read_scan_task", flaky)
+    assert df.to_pydict() == {"x": [1, 2, 3]}
+    assert calls["n"] >= 2

@@ -1,0 +1,64 @@
+"""Flight-like shuffle service: map-side spill cache → per-host HTTP server
+→ reduce-side fetch (reference: ``src/daft-shuffles`` map/serve/fetch
+pipeline)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from daft_tpu.distributed.shuffle_service import (ShuffleCache,
+                                                  ShuffleServer,
+                                                  fetch_partition)
+
+
+@pytest.fixture
+def server():
+    s = ShuffleServer()
+    yield s
+    s.shutdown()
+
+
+def test_map_serve_fetch_roundtrip(server):
+    cache = ShuffleCache()
+    n_parts = 4
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1000, 10_000)
+    vals = rng.random(10_000)
+    pids = keys % n_parts
+    # map side: morsel-wise pushes (two morsels)
+    for lo, hi in ((0, 5000), (5000, 10_000)):
+        for p in range(n_parts):
+            m = pids[lo:hi] == p
+            if m.any():
+                cache.push(p, pa.table({"k": keys[lo:hi][m],
+                                        "v": vals[lo:hi][m]}))
+    server.register(cache)
+
+    # reduce side: every row arrives exactly once, routed correctly
+    seen = 0
+    for p in range(n_parts):
+        t = fetch_partition(server.address, cache.shuffle_id, p)
+        assert t is not None
+        assert (t.column("k").to_numpy() % n_parts == p).all()
+        seen += len(t)
+    assert seen == 10_000
+
+
+def test_empty_partition_and_unknown_shuffle(server):
+    cache = ShuffleCache()
+    cache.push(0, pa.table({"x": [1]}))
+    server.register(cache)
+    assert fetch_partition(server.address, cache.shuffle_id, 3) is None
+    with pytest.raises(Exception):
+        fetch_partition(server.address, "nope", 0)
+
+
+def test_unregister_cleans_spill_files(server):
+    import os
+    cache = ShuffleCache()
+    cache.push(0, pa.table({"x": list(range(10))}))
+    root = cache._root
+    server.register(cache)
+    assert os.path.isdir(root)
+    server.unregister(cache.shuffle_id)
+    assert not os.path.isdir(root)
