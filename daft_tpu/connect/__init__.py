@@ -1,0 +1,11 @@
+"""Spark Connect frontend: daft_tpu as a Spark Connect endpoint.
+
+Reference capability: ``src/daft-connect`` (tonic gRPC SparkConnectService
+translating Spark relation protos into the engine's plans) + the
+``daft/pyspark`` SparkSession shim. This package re-creates that surface on
+grpc + a hand-written wire-compatible protocol subset
+(``spark_connect_subset.proto``)."""
+
+from .server import SparkConnectServer, start_server
+
+__all__ = ["SparkConnectServer", "start_server"]

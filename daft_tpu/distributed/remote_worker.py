@@ -62,8 +62,11 @@ def _parts_from_ipc(blob: bytes) -> List[MicroPartition]:
 class WorkerServer:
     """Executes posted stage fragments on a local streaming executor."""
 
-    def __init__(self, port: int = 0, num_slots: int = 2):
+    def __init__(self, port: int = 0, num_slots: int = 2,
+                 host: str = "127.0.0.1", advertise_host: str = ""):
         self.num_slots = num_slots
+        self._advertise = advertise_host or (
+            "127.0.0.1" if host == "0.0.0.0" else host)
         pool = cf.ThreadPoolExecutor(max_workers=num_slots)
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -75,8 +78,9 @@ class WorkerServer:
                 blob = self.rfile.read(n)
                 try:
                     task_plan, stage_inputs_blob = pickle.loads(blob)
-                    # plain pickle.loads decodes cloudpickle output too, so
-                    # a worker host without cloudpickle still serves
+                    # cloudpickle-serialized closures need cloudpickle's
+                    # reducers importable on this host; plan fragments
+                    # without closure UDFs decode with plain pickle
                     plan = pickle.loads(task_plan)
                     stage_inputs = {
                         k: _parts_from_ipc(v)
@@ -99,14 +103,14 @@ class WorkerServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+        self._server = http.server.ThreadingHTTPServer((host, port),
                                                        Handler)
         threading.Thread(target=self._server.serve_forever, daemon=True,
                          name="daft-tpu-worker").start()
 
     @property
     def address(self) -> str:
-        return f"http://127.0.0.1:{self._server.server_port}"
+        return f"http://{self._advertise}:{self._server.server_port}"
 
     def shutdown(self) -> None:
         self._server.shutdown()
@@ -153,8 +157,13 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="daft-tpu-worker")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--slots", type=int, default=2)
+    p.add_argument("--host", default="0.0.0.0",
+                   help="bind address (default all interfaces)")
+    p.add_argument("--advertise-host", default="",
+                   help="hostname peers should use to reach this worker")
     args = p.parse_args(argv)
-    srv = WorkerServer(args.port, args.slots)
+    srv = WorkerServer(args.port, args.slots, host=args.host,
+                       advertise_host=args.advertise_host)
     print(f"daft-tpu worker on {srv.address}", flush=True)
     try:
         while True:

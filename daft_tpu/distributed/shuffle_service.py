@@ -4,11 +4,15 @@ Reference: the flight shuffle (``src/daft-shuffles``) — the map side
 partitions morsels and spills per-partition Arrow IPC files
 (``shuffle_cache.rs:14-80``); each node runs an Arrow Flight gRPC server
 serving ``do_get(partition_idx)`` (``server/flight_server.rs:17-170``) and
-the reduce side fetches over the network. Here the same design rides plain
-HTTP (stdlib server, Arrow IPC payloads): a ``ShuffleCache`` accumulates
-map outputs into per-partition spill files, a ``ShuffleServer`` exposes
-``GET /shuffle/<id>/<partition>`` streaming the concatenated IPC bytes, and
-``fetch_partition`` pulls a partition from any host. On a TPU pod this is
+the reduce side fetches over the network. Here the same design has two
+transports behind one seam: a ``ShuffleCache`` accumulates map outputs into
+per-partition spill files, and a per-host server exposes them — an actual
+**Arrow Flight** gRPC server (``FlightShuffleServer``, default when
+``pyarrow.flight`` is importable: ``do_get(<shuffle_id>/<partition>)``
+streams record batches straight off the spill files) or a stdlib-HTTP
+fallback (``ShuffleServer``: ``GET /shuffle/<id>/<partition>``).
+``fetch_partition`` dispatches on the address scheme (``grpc://`` vs
+``http://``), so the reduce side is transport-blind. On a TPU pod this is
 the DCN tier — intra-pod exchanges ride ICI collectives instead
 (``parallel/exchange.py``)."""
 
@@ -24,6 +28,11 @@ from typing import Dict, List, Optional, Tuple
 
 import pyarrow as pa
 import pyarrow.ipc as paipc
+
+try:
+    import pyarrow.flight as paflight
+except ImportError:  # pragma: no cover - flight is baked into this image
+    paflight = None
 
 
 class ShuffleCache:
@@ -41,13 +50,11 @@ class ShuffleCache:
         self._lock = threading.Lock()
         self._writers: Dict[int, Tuple[object, object]] = {}
         self._rows: Dict[int, int] = {}
+        self._sealed = False
 
     def _writer(self, partition: int, schema: pa.Schema):
         w = self._writers.get(partition)
         if w is None:
-            # append: a straggler push after close() adds a new IPC stream
-            # after the sealed one instead of truncating it (fetch reads
-            # all concatenated streams)
             f = open(self._path(partition), "ab")
             w = (paipc.new_stream(f, schema), f)
             self._writers[partition] = w
@@ -58,7 +65,20 @@ class ShuffleCache:
 
     def push(self, partition: int, table: pa.Table) -> None:
         with self._lock:
-            self._writer(partition, table.schema).write_table(table)
+            if self._sealed:
+                # straggler after seal: append one complete, flushed IPC
+                # stream in a single write so a concurrent fetch never sees
+                # a torn header mid-stream (fetch also tolerates a
+                # truncated tail — see _spill_streams)
+                buf = io.BytesIO()
+                with paipc.new_stream(buf, table.schema) as w:
+                    w.write_table(table)
+                with open(self._path(partition), "ab") as f:
+                    f.write(buf.getvalue())
+                    f.flush()
+                    os.fsync(f.fileno())
+            else:
+                self._writer(partition, table.schema).write_table(table)
             self._rows[partition] = self._rows.get(partition, 0) + len(table)
 
     def close(self) -> None:
@@ -67,6 +87,7 @@ class ShuffleCache:
                 w.close()
                 f.close()
             self._writers = {}
+            self._sealed = True
 
     def partition_bytes(self, partition: int) -> bytes:
         p = self._path(partition)
@@ -92,9 +113,17 @@ class ShuffleCache:
 
 
 class ShuffleServer:
-    """Per-host partition server (reference: per-node Flight server)."""
+    """Per-host partition server (reference: per-node Flight server).
+    ``host`` is the bind address — pass ``0.0.0.0`` (or set
+    ``DAFT_TPU_SHUFFLE_HOST``) to serve other hosts; ``advertise_host`` is
+    what ``address`` reports to peers (defaults to the bind host)."""
 
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, host: Optional[str] = None,
+                 advertise_host: Optional[str] = None):
+        self._host = host or os.environ.get("DAFT_TPU_SHUFFLE_HOST",
+                                            "127.0.0.1")
+        self._advertise = advertise_host or (
+            "127.0.0.1" if self._host == "0.0.0.0" else self._host)
         self._caches: Dict[str, ShuffleCache] = {}
         self._lock = threading.Lock()
         caches = self._caches
@@ -125,14 +154,14 @@ class ShuffleServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+        self._server = http.server.ThreadingHTTPServer((self._host, port),
                                                        Handler)
         threading.Thread(target=self._server.serve_forever, daemon=True,
                          name="daft-tpu-shuffle").start()
 
     @property
     def address(self) -> str:
-        return f"http://127.0.0.1:{self._server.server_port}"
+        return f"http://{self._advertise}:{self._server.server_port}"
 
     def register(self, cache: ShuffleCache) -> None:
         cache.close()  # seal files before serving
@@ -150,21 +179,149 @@ class ShuffleServer:
         self._server.server_close()
 
 
+class FlightShuffleServer:
+    """Per-host Arrow Flight partition server (the reference's actual
+    transport: ``server/flight_server.rs:17-170`` serves ``do_get``; clients
+    fetch with ``flight_client.rs``). Tickets are ``<shuffle_id>/<part>``;
+    batches stream straight off the spill files, never materializing a
+    partition in server memory."""
+
+    def __init__(self, port: int = 0, host: Optional[str] = None,
+                 advertise_host: Optional[str] = None):
+        if paflight is None:
+            raise RuntimeError("pyarrow.flight not available; "
+                               "use ShuffleServer (HTTP)")
+        self._host = host or os.environ.get("DAFT_TPU_SHUFFLE_HOST",
+                                            "127.0.0.1")
+        self._advertise = advertise_host or (
+            "127.0.0.1" if self._host == "0.0.0.0" else self._host)
+        self._caches: Dict[str, ShuffleCache] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class _Server(paflight.FlightServerBase):
+            def do_get(self, context, ticket):
+                sid, _, pidx = ticket.ticket.decode().partition("/")
+                with outer._lock:
+                    cache = outer._caches.get(sid)
+                if cache is None:
+                    raise paflight.FlightServerError(
+                        f"unknown shuffle {sid!r}")
+                path = cache._path(int(pidx))
+                gen = _spill_file_batches(path)
+                first = next(gen, None)
+                if first is None:
+                    # empty partition: zero-column empty stream sentinel
+                    empty = pa.schema([])
+                    return paflight.GeneratorStream(empty, iter(()))
+                schema, batch0 = first
+
+                def batches():
+                    yield batch0
+                    for _, b in gen:
+                        yield b
+
+                return paflight.GeneratorStream(schema, batches())
+
+        # the port is bound in __init__ (so .port is valid immediately);
+        # serve() blocks until shutdown() — run it on a daemon thread
+        self._server = _Server(f"grpc://{self._host}:{port}")
+        threading.Thread(target=self._server.serve, daemon=True,
+                         name="daft-tpu-flight-shuffle").start()
+
+    @property
+    def address(self) -> str:
+        return f"grpc://{self._advertise}:{self._server.port}"
+
+    def register(self, cache: ShuffleCache) -> None:
+        cache.close()  # seal files before serving
+        with self._lock:
+            self._caches[cache.shuffle_id] = cache
+
+    def unregister(self, shuffle_id: str) -> None:
+        with self._lock:
+            cache = self._caches.pop(shuffle_id, None)
+        if cache is not None:
+            cache.cleanup()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+
+
+def make_shuffle_server(port: int = 0, host: Optional[str] = None):
+    """Transport factory: Arrow Flight when available (the reference's
+    design), stdlib HTTP otherwise; ``DAFT_TPU_SHUFFLE_TRANSPORT=http``
+    forces the fallback."""
+    pref = os.environ.get("DAFT_TPU_SHUFFLE_TRANSPORT", "flight")
+    if pref != "http" and paflight is not None:
+        return FlightShuffleServer(port, host=host)
+    return ShuffleServer(port, host=host)
+
+
+def _spill_streams(body: bytes):
+    """Yield (schema, batch-list) per concatenated IPC stream in a spill
+    file (one stream per writer reopen). A truncated trailing stream — a
+    straggler append caught mid-write — is skipped rather than fatal."""
+    if not body:
+        return
+    buf = pa.BufferReader(body)
+    while buf.tell() < buf.size():
+        try:
+            with paipc.open_stream(buf) as rd:
+                batches = list(rd)
+        except pa.ArrowInvalid:
+            return
+        yield rd.schema, batches
+
+
+def _spill_file_batches(path: str):
+    """Lazily yield (schema, batch) straight off a spill file, one record
+    batch at a time (never materializes the partition in memory). Tolerates
+    a truncated trailing stream like _spill_streams."""
+    if not os.path.exists(path):
+        return
+    size = os.path.getsize(path)
+    with pa.OSFile(path, "rb") as f:
+        while f.tell() < size:
+            try:
+                rd = paipc.open_stream(f)
+            except pa.ArrowInvalid:
+                return
+            schema = rd.schema
+            while True:
+                try:
+                    batch = rd.read_next_batch()
+                except StopIteration:
+                    break
+                except pa.ArrowInvalid:
+                    return
+                yield schema, batch
+
+
 def fetch_partition(address: str, shuffle_id: str, partition: int
                     ) -> Optional[pa.Table]:
     """Reduce-side fetch: partition bytes → Arrow table (reference:
-    flight_client do_get)."""
+    flight_client do_get). Dispatches on the address scheme."""
+    if address.startswith("grpc://"):
+        if paflight is None:
+            raise RuntimeError(
+                f"shuffle peer advertises Flight ({address}) but "
+                "pyarrow.flight is unavailable on this host; set "
+                "DAFT_TPU_SHUFFLE_TRANSPORT=http on the serving hosts")
+        client = paflight.connect(address)
+        try:
+            ticket = paflight.Ticket(f"{shuffle_id}/{partition}".encode())
+            reader = client.do_get(ticket)
+            t = reader.read_all()
+        finally:
+            client.close()
+        return None if t.num_columns == 0 else t
     url = f"{address}/shuffle/{shuffle_id}/{partition}"
     timeout = float(os.environ.get("DAFT_TPU_SHUFFLE_TIMEOUT", "600"))
     with urllib.request.urlopen(url, timeout=timeout) as r:
         body = r.read()
     if not body:
         return None
-    tables = []
-    buf = pa.BufferReader(body)
-    # the spill file may hold several concatenated IPC streams (one per
-    # writer reopen); read them all
-    while buf.tell() < buf.size():
-        with paipc.open_stream(buf) as rd:
-            tables.append(rd.read_all())
+    tables = [pa.Table.from_batches(batches, schema=schema)
+              for schema, batches in _spill_streams(body)]
     return pa.concat_tables(tables) if tables else None

@@ -1,21 +1,43 @@
-"""Flight-like shuffle service: map-side spill cache → per-host HTTP server
-→ reduce-side fetch (reference: ``src/daft-shuffles`` map/serve/fetch
-pipeline)."""
+"""Shuffle service: map-side spill cache → per-host server (Arrow Flight
+gRPC, with a stdlib-HTTP fallback) → reduce-side fetch (reference:
+``src/daft-shuffles`` map/serve/fetch pipeline)."""
 
 import numpy as np
 import pyarrow as pa
 import pytest
 
-from daft_tpu.distributed.shuffle_service import (ShuffleCache,
+from daft_tpu.distributed.shuffle_service import (FlightShuffleServer,
+                                                  ShuffleCache,
                                                   ShuffleServer,
-                                                  fetch_partition)
+                                                  fetch_partition,
+                                                  make_shuffle_server,
+                                                  paflight)
+
+TRANSPORTS = ["http"] + (["flight"] if paflight is not None else [])
 
 
-@pytest.fixture
-def server():
-    s = ShuffleServer()
+@pytest.fixture(params=TRANSPORTS)
+def server(request):
+    s = (FlightShuffleServer() if request.param == "flight"
+         else ShuffleServer())
     yield s
     s.shutdown()
+
+
+def test_make_shuffle_server_prefers_flight(monkeypatch):
+    monkeypatch.delenv("DAFT_TPU_SHUFFLE_TRANSPORT", raising=False)
+    s = make_shuffle_server()
+    try:
+        expected = ShuffleServer if paflight is None else FlightShuffleServer
+        assert isinstance(s, expected)
+    finally:
+        s.shutdown()
+    monkeypatch.setenv("DAFT_TPU_SHUFFLE_TRANSPORT", "http")
+    s = make_shuffle_server()
+    try:
+        assert isinstance(s, ShuffleServer)
+    finally:
+        s.shutdown()
 
 
 def test_map_serve_fetch_roundtrip(server):
@@ -51,6 +73,15 @@ def test_empty_partition_and_unknown_shuffle(server):
     assert fetch_partition(server.address, cache.shuffle_id, 3) is None
     with pytest.raises(Exception):
         fetch_partition(server.address, "nope", 0)
+
+
+def test_straggler_push_after_seal(server):
+    cache = ShuffleCache()
+    cache.push(0, pa.table({"x": [1, 2, 3]}))
+    server.register(cache)  # seals
+    cache.push(0, pa.table({"x": [4, 5]}))  # straggler appends a new stream
+    t = fetch_partition(server.address, cache.shuffle_id, 0)
+    assert sorted(t.column("x").to_pylist()) == [1, 2, 3, 4, 5]
 
 
 def test_unregister_cleans_spill_files(server):
