@@ -4,9 +4,9 @@ Capability mirror of the reference's ``src/daft-io`` crate: an
 ``ObjectSource`` trait (get/put/get_size/glob/ls — ``object_io.rs:177-210``)
 with per-scheme implementations, an ``IOClient`` cache keyed by
 (scheme, config) and ``IOStatsContext`` byte/request counters
-(``src/daft-io/src/stats.rs``). Cloud sources (S3/GCS/Azure) are gated on
-their optional SDKs; this environment is local-only, so they surface a
-helpful error instead of a hard import failure.
+(``src/daft-io/src/stats.rs``). Cloud sources are native no-SDK clients:
+S3 (``s3.py``, SigV4), GCS (``gcs.py``, JSON API), Azure Blob
+(``azure.py``, SharedKey/SAS).
 """
 
 from __future__ import annotations
@@ -40,13 +40,23 @@ class S3Config:
 class GCSConfig:
     project_id: Optional[str] = None
     anonymous: bool = False
+    # static OAuth2 bearer token (service-account flows need a token broker;
+    # the reference reads credentials the same lazily-pluggable way)
+    access_token: Optional[str] = None
+    endpoint_url: Optional[str] = None  # override for emulators/tests
+    max_connections: int = 32
+    num_tries: int = 5
 
 
 @dataclasses.dataclass(frozen=True)
 class AzureConfig:
     storage_account: Optional[str] = None
     access_key: Optional[str] = None
+    sas_token: Optional[str] = None
     anonymous: bool = False
+    endpoint_url: Optional[str] = None  # override for Azurite/tests
+    max_connections: int = 32
+    num_tries: int = 5
 
 
 @dataclasses.dataclass(frozen=True)
@@ -213,30 +223,6 @@ class HTTPSource(ObjectSource):
             return int(r.headers.get("Content-Length", 0))
 
 
-class _UnavailableSource(ObjectSource):
-    """Placeholder for cloud schemes whose SDK isn't installed.
-
-    The reference ships native S3/Azure/GCS clients (``s3_like.rs`` etc.);
-    in this zero-egress build they are config-compatible stubs that fail
-    with an actionable message on first use.
-    """
-
-    def __init__(self, scheme: str, sdk: str):
-        self.scheme = scheme
-        self._sdk = sdk
-
-    def _fail(self):
-        raise RuntimeError(
-            f"{self.scheme}:// object source requires the optional "
-            f"'{self._sdk}' SDK, which is not available in this environment")
-
-    def get(self, path, byte_range=None, stats=None): self._fail()
-    def put(self, path, data, stats=None): self._fail()
-    def get_size(self, path): self._fail()
-    def glob(self, pattern, stats=None): self._fail()
-    def ls(self, path): self._fail()
-
-
 # ---------------------------------------------------------------------------
 # client
 
@@ -272,9 +258,11 @@ class IOClient:
             from .s3 import S3Source
             return S3Source(self.config.s3)
         if scheme == "gs":
-            return _UnavailableSource("gs", "gcsfs")
+            from .gcs import GCSSource
+            return GCSSource(self.config.gcs)
         if scheme in ("az", "abfs", "abfss"):
-            return _UnavailableSource(scheme, "adlfs")
+            from .azure import AzureBlobSource
+            return AzureBlobSource(self.config.azure)
         raise ValueError(f"unsupported URL scheme {scheme!r}")
 
     # convenience passthroughs
