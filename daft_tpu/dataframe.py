@@ -354,6 +354,22 @@ class DataFrame:
         out = DataFrame(self._builder.write_sink(sink))
         return out.collect()
 
+    def write_deltalake(self, table_uri: str, mode: str = "append",
+                        io_config=None) -> "DataFrame":
+        """Commit as a Delta Lake transaction (reference:
+        ``DataFrame.write_deltalake``; native log writer in io/delta.py)."""
+        from .io.delta import write_deltalake as _w
+        _w(self, table_uri, mode=mode, io_config=io_config)
+        return self
+
+    def write_iceberg(self, table_uri: str, mode: str = "append",
+                      io_config=None) -> "DataFrame":
+        """Commit as an Apache Iceberg snapshot (reference:
+        ``DataFrame.write_iceberg``; native v1 writer in io/iceberg.py)."""
+        from .io.iceberg import write_iceberg as _w
+        _w(self, table_uri, mode=mode, io_config=io_config)
+        return self
+
     # ---- execution -------------------------------------------------------
     def collect(self, num_preview_rows: Optional[int] = 8) -> "DataFrame":
         if self._result is None:

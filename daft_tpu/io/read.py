@@ -91,10 +91,23 @@ def _sdk_gated(name: str, sdk: str):
     return entry
 
 
-# Iceberg manifests are Avro and Hudi/Lance use their own SDKs — unlike
-# Delta (JSON log, implemented natively above) these need their packages
-# (reference: daft/io/_iceberg.py, _hudi.py, _lance.py, _sql.py).
-read_iceberg = _sdk_gated("read_iceberg", "pyiceberg")
+def read_iceberg(table, snapshot_id: Optional[int] = None,
+                 io_config: Any = None, **kwargs):
+    """Read an Apache Iceberg table (reference: ``daft/io/_iceberg.py``
+    over pyiceberg scan tasks). Natively implemented — ``table`` is a
+    warehouse path / metadata JSON URI, or a pyiceberg-style object
+    exposing ``metadata_location``."""
+    from .iceberg import read_iceberg as _impl
+    uri = getattr(table, "metadata_location", table)
+    if not isinstance(uri, str):
+        raise TypeError(f"read_iceberg expects a table path or an object "
+                        f"with .metadata_location, got {type(table)!r}")
+    return _impl(uri, snapshot_id=snapshot_id, io_config=io_config)
+
+
+# Hudi/Lance use their own storage SDKs — unlike Delta (JSON log) and
+# Iceberg (Avro manifests), both implemented natively above, these need
+# their packages (reference: daft/io/_hudi.py, _lance.py).
 read_hudi = _sdk_gated("read_hudi", "hudi")
 read_lance = _sdk_gated("read_lance", "lance")
 

@@ -112,10 +112,13 @@ def test_checkpoint_replay(tmp_path):
 
 
 def test_gated_readers_error_actionably():
-    with pytest.raises(ImportError, match="pyiceberg"):
+    # iceberg is native now (io/iceberg.py): missing table → clear error
+    with pytest.raises(FileNotFoundError, match="Iceberg metadata"):
         daft_tpu.read_iceberg("whatever")
     with pytest.raises(ImportError, match="hudi"):
         daft_tpu.read_hudi("whatever")
+    with pytest.raises(ImportError, match="lance"):
+        daft_tpu.read_lance("whatever")
 
 
 def test_read_sql_over_sqlite():
