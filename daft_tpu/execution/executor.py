@@ -627,6 +627,13 @@ class LocalExecutor:
     # joins ------------------------------------------------------------
     def _exec_HashJoin(self, node: pp.HashJoin):
         how = node.how
+        if node.strategy == "hash" and self.cfg.enable_aqe:
+            lnode, rnode = node.children
+            if getattr(lnode, "join_side", False) \
+                    and getattr(rnode, "join_side", False):
+                yield from self._adaptive_hash_join(node, lnode.children[0],
+                                                    rnode.children[0])
+                return
         if node.strategy == "broadcast_right":
             right = _gather_all(self._exec(node.children[1]))
             child = self._exec(node.children[0])
@@ -657,6 +664,47 @@ class LocalExecutor:
         yield from _ordered_parallel(
             zip(lparts, rparts),
             lambda lr: lr[0].hash_join(lr[1], node.left_on, node.right_on, how))
+
+    def _adaptive_hash_join(self, node: pp.HashJoin, li, ri):
+        """AQE join-strategy demotion (reference: AdaptivePlanner re-plans
+        the remaining query from materialized stats, ``physical_planner/
+        planner.rs:451-640``): materialize each join input BELOW its
+        planned hash exchange, and if the measured bytes of an eligible
+        side fit the broadcast threshold, skip both shuffles and broadcast
+        it; otherwise fan both materialized sides out as planned."""
+        from . import memory
+        how = node.how
+        threshold = self.cfg.broadcast_join_size_bytes_threshold
+        lparts = memory.materialize(self._exec(li))
+        if lparts.total_bytes <= threshold and how in ("inner", "right"):
+            self._aqe().record_join("hash→broadcast_left",
+                                    lparts.total_bytes)
+            left = _gather_all(iter(lparts))
+            lparts.close()
+            yield from _ordered_parallel(
+                self._exec(ri), lambda p: left.hash_join(
+                    p, node.left_on, node.right_on, how))
+            return
+        rparts = memory.materialize(self._exec(ri))
+        if rparts.total_bytes <= threshold and how in ("inner", "left",
+                                                       "semi", "anti"):
+            self._aqe().record_join("hash→broadcast_right",
+                                    rparts.total_bytes)
+            right = _gather_all(iter(rparts))
+            rparts.close()
+            yield from _ordered_parallel(
+                iter(lparts), lambda p: p.hash_join(
+                    right, node.left_on, node.right_on, how))
+            return
+        n = node.children[0].num_partitions
+        self._aqe().record_join("hash",
+                                lparts.total_bytes + rparts.total_bytes)
+        lparts = self._refan(lparts, list(node.left_on), n)
+        rparts = self._refan(rparts, list(node.right_on), n)
+        yield from _ordered_parallel(
+            zip(lparts, rparts),
+            lambda lr: lr[0].hash_join(lr[1], node.left_on, node.right_on,
+                                       how))
 
     def _refan(self, parts, by: List[Expression], n: int):
         from . import memory

@@ -141,6 +141,11 @@ class SpillBuffer:
         return MicroPartition.from_recordbatch(
             RecordBatch.from_arrow_table(table))
 
+    @property
+    def total_bytes(self) -> int:
+        """Materialized size across memory + spill (AQE's stage actuals)."""
+        return self._mem_bytes + self.bytes_spilled
+
     def __len__(self) -> int:
         return len(self._entries)
 

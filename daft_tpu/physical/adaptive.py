@@ -52,6 +52,15 @@ class AdaptivePlanner:
                           f"({total_bytes} bytes materialized)")))
         return adapted
 
+    def record_join(self, decision: str, measured_bytes: int) -> None:
+        """Join-strategy adaptation from measured input sizes (hash ↔
+        broadcast demotion)."""
+        with self._lock:
+            self.history.append(StageStats(
+                rows=0, size_bytes=measured_bytes, partitions=0,
+                decision=f"join {decision} "
+                         f"({measured_bytes} bytes measured)"))
+
     def explain_analyze(self) -> str:
         lines = ["== Adaptive execution =="]
         with self._lock:

@@ -162,10 +162,16 @@ def _translate_join(node: lp.Join, cfg) -> pp.PhysicalPlan:
                                                      "anti") else "hash"
     if strategy == "hash" and (_nparts(left) > 1 or _nparts(right) > 1):
         n = max(_nparts(left), _nparts(right))
-        # join-side exchanges are NOT AQE-adaptable: the two sides must
-        # keep identical partition counts or the join would re-fan both
+        # join-side exchanges are NOT count-adaptable (the two sides must
+        # keep identical partition counts), but they ARE strategy-adaptable:
+        # the executor's AQE path may demote the pair to a broadcast join
+        # from measured sizes (reference: AdaptivePlanner re-planning joins
+        # from materialized stats, planner.rs:451-640) — join_side marks
+        # them as elidable.
         pl = pp.Exchange(pl, "hash", n, tuple(node.left_on))
         pr = pp.Exchange(pr, "hash", n, tuple(node.right_on))
+        pl.join_side = True
+        pr.join_side = True
     elif strategy == "broadcast_right":
         pr = pp.Exchange(pr, "gather", 1)
     elif strategy == "broadcast_left":

@@ -104,6 +104,52 @@ def test_aqe_coalesces_small_shuffles(monkeypatch):
     assert "Adaptive execution" in planner.explain_analyze()
 
 
+def test_aqe_demotes_hash_join_to_broadcast(monkeypatch):
+    """With AQE on, a planned hash-hash join whose measured build side fits
+    the broadcast threshold skips both shuffles and broadcasts it
+    (reference: AdaptivePlanner re-planning joins from materialized
+    stats)."""
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.context import execution_config_ctx
+    from daft_tpu.physical import adaptive
+
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+    big = daft_tpu.from_pydict(
+        {"k": [i % 10 for i in range(20_000)],
+         "v": list(range(20_000))}).into_partitions(4)
+    # a highly selective filter: the static planner's 20%-of-input size
+    # heuristic (~tens of KB) exceeds the threshold so it plans hash-hash,
+    # but the MEASURED bytes (a handful of rows) fit — exactly the
+    # mis-estimate AQE corrects by demoting to broadcast
+    small = daft_tpu.from_pydict(
+        {"k": [i % 1000 for i in range(10_000)],
+         "w": [f"n{i % 1000}" for i in range(10_000)]}) \
+        .into_partitions(4).where(col("k") == 0)
+    with execution_config_ctx(enable_aqe=True,
+                              broadcast_join_size_bytes_threshold=4096):
+        out = big.join(small, on="k").groupby("w") \
+            .agg(col("v").sum().alias("s")).sort("w").to_pydict()
+    # k==0 survives the filter 10 times; each match contributes big's v
+    # sum over k==0
+    assert out["w"] == ["n0"]
+    assert out["s"] == [sum(range(0, 20_000, 10)) * 10]
+    planner = adaptive.last_planner()
+    assert planner is not None
+    decisions = [h.decision for h in planner.history if "join" in h.decision]
+    assert decisions and "broadcast" in decisions[0]
+
+    # same query with a zero threshold keeps the hash-hash plan
+    with execution_config_ctx(enable_aqe=True,
+                              broadcast_join_size_bytes_threshold=0):
+        out2 = big.join(small, on="k").groupby("w") \
+            .agg(col("v").sum().alias("s")).sort("w").to_pydict()
+    assert out2 == out
+    planner = adaptive.last_planner()
+    decisions = [h.decision for h in planner.history if "join" in h.decision]
+    assert decisions and "join hash " in decisions[0]
+
+
 def test_user_repartition_not_adapted():
     import daft_tpu
     from daft_tpu import col
