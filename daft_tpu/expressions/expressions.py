@@ -386,10 +386,12 @@ class ExpressionStringNamespace(_Ns):
     def count_matches(self, patterns, whole_words=False, case_sensitive=True):
         pats = tuple(patterns) if isinstance(patterns, (list, tuple)) else (patterns,)
         return self._f("str.count_matches", (), (pats, whole_words, case_sensitive))
-    def tokenize_encode(self, tokens_path: str):
-        return self._f("str.tokenize_encode", (), (tokens_path,))
-    def tokenize_decode(self, tokens_path: str):
-        return self._f("str.tokenize_decode", (), (tokens_path,))
+    def tokenize_encode(self, tokens_path: Optional[str] = None,
+                        pattern: Optional[str] = None):
+        return self._f("str.tokenize_encode", (), (tokens_path, pattern))
+    def tokenize_decode(self, tokens_path: Optional[str] = None,
+                        pattern: Optional[str] = None):
+        return self._f("str.tokenize_decode", (), (tokens_path, pattern))
 
 
 class ExpressionDatetimeNamespace(_Ns):

@@ -313,6 +313,10 @@ def _url_fn(fn, e, kids, b, out_field) -> Series:
 def _str_fn(fn, e, kids, b, out_field) -> Series:
     s = kids[0]
     name = s.name()
+    if fn in ("tokenize_encode", "tokenize_decode"):
+        # decode's input is a token-id list column, not a string array
+        from ..functions.tokenize import eval_tokenize
+        return eval_tokenize(fn, e, kids, out_field)
     arr = _sa(s)
     if fn == "contains":
         pat = kids[1].to_pylist()[0]

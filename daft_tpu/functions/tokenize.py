@@ -1,0 +1,134 @@
+"""Tokenization kernels: byte-pair encoding over tiktoken-format vocabs.
+
+Capability mirror of the reference's tokenize crate
+(``src/daft-functions-tokenize``: tiktoken-based ``tokenize_encode`` /
+``tokenize_decode`` expressions) implemented as a dependency-free BPE.
+Vocabularies load from local tiktoken-format files (one
+``base64(token) rank`` pair per line — the public format of cl100k_base
+etc.); the builtin ``"bytes"`` tokenizer (ids = raw utf-8 bytes) works with
+no vocab file, keeping the surface usable in zero-egress environments.
+"""
+
+from __future__ import annotations
+
+import base64
+import functools
+import threading
+from typing import Dict, List, Optional
+
+try:
+    import regex as _re  # \p{L} classes like the reference's pretokenizer
+except ImportError:  # pragma: no cover
+    import re as _re
+
+# GPT-2-family pretokenization pattern (the published tiktoken pattern for
+# r50k/p50k vocabs; pure interop constant)
+_DEFAULT_PATTERN = (
+    r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+"
+    r"|\s+(?!\S)|\s+")
+if _re.__name__ == "re":  # pragma: no cover - ascii approximation
+    _DEFAULT_PATTERN = (
+        r"'s|'t|'re|'ve|'m|'ll|'d| ?[A-Za-z]+| ?[0-9]+| ?[^\sA-Za-z0-9]+"
+        r"|\s+(?!\S)|\s+")
+
+
+class BPETokenizer:
+    """Greedy lowest-rank byte-pair merges (the tiktoken algorithm)."""
+
+    def __init__(self, ranks: Dict[bytes, int],
+                 pattern: Optional[str] = None):
+        self.ranks = ranks
+        self.decoder = {v: k for k, v in ranks.items()}
+        self._rx = _re.compile(pattern or _DEFAULT_PATTERN)
+
+    # ------------------------------------------------------------ encode
+    def _bpe(self, piece: bytes) -> List[int]:
+        if piece in self.ranks:
+            return [self.ranks[piece]]
+        parts = [piece[i:i + 1] for i in range(len(piece))]
+        while len(parts) > 1:
+            best_rank = None
+            best_i = -1
+            for i in range(len(parts) - 1):
+                r = self.ranks.get(parts[i] + parts[i + 1])
+                if r is not None and (best_rank is None or r < best_rank):
+                    best_rank = r
+                    best_i = i
+            if best_rank is None:
+                break
+            parts[best_i:best_i + 2] = [parts[best_i] + parts[best_i + 1]]
+        out = []
+        for p in parts:
+            r = self.ranks.get(p)
+            if r is None:
+                raise ValueError(
+                    f"byte sequence {p!r} not in vocabulary (vocab lacks "
+                    f"single-byte tokens?)")
+            out.append(r)
+        return out
+
+    def encode(self, text: str) -> List[int]:
+        out: List[int] = []
+        for m in self._rx.finditer(text):
+            out.extend(self._bpe(m.group().encode("utf-8")))
+        return out
+
+    def decode(self, ids: List[int]) -> str:
+        buf = bytearray()
+        for i in ids:
+            tok = self.decoder.get(int(i))
+            if tok is None:
+                raise ValueError(f"token id {i} not in vocabulary")
+            buf += tok
+        return buf.decode("utf-8", errors="replace")
+
+
+def _load_tiktoken_file(path: str) -> Dict[bytes, int]:
+    ranks: Dict[bytes, int] = {}
+    with open(path, "rb") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            tok_b64, rank = line.split()
+            ranks[base64.b64decode(tok_b64)] = int(rank)
+    return ranks
+
+
+_cache: Dict[str, BPETokenizer] = {}
+_cache_lock = threading.Lock()
+
+
+def get_tokenizer(tokens_path: Optional[str],
+                  pattern: Optional[str] = None) -> BPETokenizer:
+    """``None``/``"bytes"`` → builtin byte-level tokenizer; otherwise a
+    local tiktoken-format vocab file path."""
+    key = f"{tokens_path}\x00{pattern}"
+    with _cache_lock:
+        tk = _cache.get(key)
+        if tk is None:
+            if tokens_path in (None, "bytes"):
+                ranks = {bytes([i]): i for i in range(256)}
+            else:
+                ranks = _load_tiktoken_file(tokens_path)
+            tk = BPETokenizer(ranks, pattern)
+            _cache[key] = tk
+    return tk
+
+
+def eval_tokenize(fn: str, e, kids, out_field):
+    """Expression entry: ``str.tokenize_encode`` / ``str.tokenize_decode``."""
+    from ..datatype import DataType
+    from ..series import Series
+    s = kids[0]
+    name = s.name()
+    tokens_path, pattern = e.params
+    tk = get_tokenizer(tokens_path, pattern)
+    if fn == "tokenize_encode":
+        out = [None if v is None else tk.encode(v) for v in s.to_pylist()]
+        return Series.from_pylist(out, name,
+                                  dtype=DataType.list(DataType.uint32()))
+    if fn == "tokenize_decode":
+        out = [None if v is None else tk.decode(v) for v in s.to_pylist()]
+        return Series.from_pylist(out, name, dtype=DataType.string())
+    raise NotImplementedError(f"str.{fn}")

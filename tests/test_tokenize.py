@@ -1,0 +1,72 @@
+"""Tokenize expressions: BPE over tiktoken-format vocabs + builtin
+byte-level fallback (reference: ``src/daft-functions-tokenize``)."""
+
+import base64
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.functions.tokenize import BPETokenizer, get_tokenizer
+
+
+def _vocab_file(tmp_path):
+    """Tiny tiktoken-format vocab: 256 byte tokens + merges for 'he',
+    'll', 'hell', 'hello'."""
+    ranks = {bytes([i]): i for i in range(256)}
+    for i, tok in enumerate([b"he", b"ll", b"hell", b"hello", b" wo",
+                             b"rld", b" world"]):
+        ranks[tok] = 256 + i
+    p = tmp_path / "vocab.tiktoken"
+    lines = [base64.b64encode(t).decode() + " " + str(r)
+             for t, r in ranks.items()]
+    p.write_text("\n".join(lines))
+    return str(p), ranks
+
+
+def test_bpe_merges_greedily_by_rank(tmp_path):
+    path, ranks = _vocab_file(tmp_path)
+    tk = get_tokenizer(path)
+    # 'hello' merges all the way to the single token
+    assert tk.encode("hello") == [ranks[b"hello"]]
+    assert tk.encode("hello world") == [ranks[b"hello"], ranks[b" world"]]
+    # unseen text falls back to byte tokens
+    assert tk.encode("xy") == [ord("x"), ord("y")]
+
+
+def test_encode_decode_roundtrip(tmp_path):
+    path, _ = _vocab_file(tmp_path)
+    tk = get_tokenizer(path)
+    for text in ("hello world", "héllo wörld", "a\nb\tc", ""):
+        assert tk.decode(tk.encode(text)) == text
+
+
+def test_bytes_builtin_roundtrip():
+    tk = get_tokenizer("bytes")
+    text = "daft🚀"
+    ids = tk.encode(text)
+    assert ids == list(text.encode("utf-8"))
+    assert tk.decode(ids) == text
+
+
+def test_expression_encode_decode(tmp_path):
+    path, ranks = _vocab_file(tmp_path)
+    df = daft_tpu.from_pydict({"t": ["hello", "hello world", None]})
+    out = df.with_column("ids", col("t").str.tokenize_encode(path)) \
+            .with_column("back", col("ids").str.tokenize_decode(path)) \
+            .to_pydict()
+    assert out["ids"][0] == [ranks[b"hello"]]
+    assert out["ids"][2] is None
+    assert out["back"] == ["hello", "hello world", None]
+
+
+def test_expression_default_bytes_tokenizer():
+    df = daft_tpu.from_pydict({"t": ["ab"]})
+    out = df.select(col("t").str.tokenize_encode()).to_pydict()
+    assert out["t"] == [[97, 98]]
+
+
+def test_unknown_token_id_raises():
+    tk = BPETokenizer({b"a": 0})
+    with pytest.raises(ValueError):
+        tk.decode([5])
