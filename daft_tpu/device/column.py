@@ -115,6 +115,9 @@ def _np_encode(s: Series) -> "tuple[np.ndarray, np.ndarray, Optional[pa.Array]]"
     n = len(arr)
     validity = np.asarray(pc.is_valid(arr).to_numpy(zero_copy_only=False),
                           dtype=np.bool_)
+    if dt.is_null():
+        # all-null column: zero payload plane, validity already all-False
+        return np.zeros(n, dtype=np.int32), validity, None
     if dt.is_string() or dt.is_binary():
         enc = arr.dictionary_encode()
         d = enc.dictionary
@@ -176,6 +179,8 @@ def decode_column(name: str, col: DeviceColumn, count: int) -> Series:
     vals = np.asarray(jax.device_get(col.data))[:count]
     validity = np.asarray(jax.device_get(col.validity))[:count]
     dt = col.dtype
+    if dt.is_null():
+        return Series(name, dt, arrow=pa.nulls(count))
     if col.dictionary is not None:
         codes = np.where(validity, vals.astype(np.int64), 0)
         arr = col.dictionary.take(pa.array(codes, type=pa.int64()))

@@ -244,6 +244,11 @@ class Series:
             return Series.from_pyobjects(self.to_pylist(), self._name)
         if self._pyobjs is not None:
             return Series.from_pylist(list(self._pyobjs), self._name, dtype=dtype)
+        if dtype.is_null():
+            # any → null: only null values can occupy a null column
+            # (pyarrow has no cast kernel for this direction)
+            return Series(self._name, dtype,
+                          arrow=pa.nulls(len(self._arrow)))
         target = dtype.to_arrow()
         try:
             out = self._arrow.cast(target)
@@ -327,6 +332,10 @@ def _hash_array(s: Series) -> np.ndarray:
     arr = s.to_arrow()
     dt = s.dtype
     valid = np.asarray(pc.is_valid(arr).to_numpy(zero_copy_only=False), dtype=np.bool_)
+    if dt.is_null():
+        # every row is null → the null sentinel directly (the generic path
+        # would try to reinterpret an object-dtype numpy array)
+        return np.full(n, np.uint64(0x6E756C6C), dtype=np.uint64)
     if dt.is_string() or dt.is_binary():
         enc = arr.cast(pa.large_binary())
         buffers = enc.buffers()
@@ -357,6 +366,11 @@ def _hash_array(s: Series) -> np.ndarray:
                              for v in arr.to_pylist()], dtype=np.uint64)
         vals = (s if phys == dt else s.cast(phys)).to_numpy()
         vals = np.ascontiguousarray(np.nan_to_num(vals) if vals.dtype.kind == "f" else vals)
+        if vals.dtype.kind == "O":  # mixed/null-laden → repr-hash rows
+            out = np.array([np.uint64(hash(repr(v)) & 0xFFFFFFFFFFFFFFFF)
+                            for v in vals], dtype=np.uint64)
+            out[~valid] = np.uint64(0x6E756C6C)
+            return out
         if vals.dtype.itemsize <= 8:
             as_u64 = np.zeros(n, dtype=np.uint64)
             as_u64[:] = vals.view(

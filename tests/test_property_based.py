@@ -1,0 +1,129 @@
+"""Property-based invariants over random dataframes (reference:
+``tests/property_based_testing/`` — hypothesis strategies over dtypes and
+sort-correctness invariants, run in their own CI workflow)."""
+
+import math
+
+import hypothesis.strategies as st
+import pytest
+from hypothesis import HealthCheck, given, settings
+
+import daft_tpu
+from daft_tpu import col
+
+SETTINGS = dict(max_examples=25, deadline=None,
+                suppress_health_check=[HealthCheck.too_slow])
+
+# columns: int64 with nulls, float64 with nan/inf, strings with nulls, bools
+_ints = st.lists(st.one_of(st.integers(-2**40, 2**40), st.none()),
+                 min_size=1, max_size=60)
+_floats = st.lists(st.one_of(st.floats(allow_nan=False), st.none()),
+                   min_size=1, max_size=60)
+_strs = st.lists(st.one_of(st.text(max_size=8), st.none()),
+                 min_size=1, max_size=60)
+
+
+@st.composite
+def frames(draw):
+    n = draw(st.integers(1, 50))
+    ints = draw(st.lists(st.one_of(st.integers(-2**40, 2**40), st.none()),
+                         min_size=n, max_size=n))
+    floats = draw(st.lists(
+        st.one_of(st.floats(allow_nan=False, allow_infinity=False),
+                  st.none()), min_size=n, max_size=n))
+    strs = draw(st.lists(st.one_of(st.text(max_size=8), st.none()),
+                         min_size=n, max_size=n))
+    return {"i": ints, "f": floats, "s": strs}
+
+
+def _null_last_key(v):
+    return (v is None, v)
+
+
+@settings(**SETTINGS)
+@given(data=frames())
+def test_sort_matches_python_sorted(data):
+    df = daft_tpu.from_pydict(data).sort("i")
+    got = df.to_pydict()["i"]
+    assert got == sorted(data["i"], key=_null_last_key)
+
+
+@settings(**SETTINGS)
+@given(data=frames(), desc=st.booleans())
+def test_sort_permutes_rows_together(data, desc):
+    df = daft_tpu.from_pydict(data).sort("i", desc=desc)
+    out = df.to_pydict()
+    orig = set(zip(data["i"], data["s"]))
+    assert set(zip(out["i"], out["s"])) == orig
+
+
+@settings(**SETTINGS)
+@given(data=frames(), n=st.integers(1, 8))
+def test_hash_partitions_form_a_disjoint_cover(data, n):
+    df = daft_tpu.from_pydict(data).repartition(n, col("i"))
+    parts = [p.combined().to_arrow_table().to_pydict()
+             for p in df.iter_partitions()]
+    rows = []
+    for p in parts:
+        rows.extend(zip(p["i"], p["s"]))
+    assert sorted(rows, key=lambda t: (t[0] is None, t[0] or 0,
+                                       t[1] is None, t[1] or "")) == \
+        sorted(zip(data["i"], data["s"]),
+               key=lambda t: (t[0] is None, t[0] or 0,
+                              t[1] is None, t[1] or ""))
+    # same key → same partition
+    seen = {}
+    for idx, p in enumerate(parts):
+        for k in p["i"]:
+            assert seen.setdefault(k, idx) == idx
+
+
+@settings(**SETTINGS)
+@given(data=frames())
+def test_filter_then_count_consistent(data):
+    df = daft_tpu.from_pydict(data)
+    kept = df.where(col("i") > 0)
+    expect = [v for v in data["i"] if v is not None and v > 0]
+    assert sorted(kept.to_pydict()["i"]) == sorted(expect)
+
+
+@settings(**{**SETTINGS, "max_examples": 10})  # device compiles are slow
+@given(data=frames())
+def test_groupby_sum_matches_python(data):
+    df = daft_tpu.from_pydict(data)
+    mod = df.with_column("g", col("i") % 3)
+    out = mod.groupby("g").agg(col("f").sum().alias("s")).to_pydict()
+    expect = {}
+    for i, f in zip(data["i"], data["f"]):
+        g = None if i is None else i % 3
+        if f is not None:
+            expect[g] = expect.get(g, 0.0) + f
+    got = dict(zip(out["g"], out["s"]))
+    assert set(got) == {None if i is None else i % 3 for i in data["i"]}
+    for g, s in expect.items():
+        assert got[g] == pytest.approx(s, rel=1e-9, abs=1e-9)
+
+
+@settings(**SETTINGS)
+@given(data=frames())
+def test_arrow_roundtrip_identity(data):
+    df = daft_tpu.from_pydict(data)
+    back = daft_tpu.from_arrow(df.to_arrow()).to_pydict()
+    assert back == df.to_pydict()
+
+
+@settings(**SETTINGS)
+@given(data=frames(), k=st.integers(0, 60))
+def test_limit_is_prefix(data, k):
+    df = daft_tpu.from_pydict(data)
+    got = df.limit(k).to_pydict()["i"]
+    assert got == data["i"][:k]
+
+
+@settings(**SETTINGS)
+@given(data=frames())
+def test_distinct_is_set_of_rows(data):
+    df = daft_tpu.from_pydict(data).select("i").distinct()
+    got = df.to_pydict()["i"]
+    assert sorted(got, key=_null_last_key) == \
+        sorted(set(data["i"]), key=_null_last_key)
