@@ -233,10 +233,13 @@ class SparkAnalyzer:
 
     def _rel_with_columns(self, r: pb.WithColumns):
         df = self.relation_to_df(r.input)
+        cols = {}
         for a in r.aliases:
             _require(len(a.name) == 1, "multi-name alias in withColumns")
-            df = df.with_column(a.name[0], self.expr(a.expr))
-        return df
+            cols[a.name[0]] = self.expr(a.expr)
+        # one simultaneous with_columns: every expression binds against the
+        # INPUT schema (Spark's withColumns semantics), not left-to-right
+        return df.with_columns(cols)
 
     def _rel_drop(self, r: pb.Drop):
         df = self.relation_to_df(r.input)
@@ -327,12 +330,13 @@ class SparkAnalyzer:
 
     def _expr_unresolved_function(self,
                                   f: pb.Expression.UnresolvedFunction):
-        args = [self.expr(a) for a in f.arguments]
         name = f.function_name
-        # count(*) / count(1) → count rows
+        # count(*) / count(1) → count rows; must short-circuit BEFORE
+        # translating arguments (a bare star has no expression form)
         if name == "count" and (not f.arguments or _is_star_or_one(
                 f.arguments[0])):
             return _count_all()
+        args = [self.expr(a) for a in f.arguments]
         if f.is_distinct:
             _require(name in ("count",), f"DISTINCT {name}")
             return args[0].count_distinct()
@@ -551,9 +555,10 @@ def _parse_spark_type_str(s: str):
 def parse_ddl(ddl: str) -> pb.DataType:
     """`a INT, b STRING` (or a single type string) → DataType proto."""
     ddl = ddl.strip()
-    if "," not in ddl and " " not in ddl:
-        from . import analyzer  # self-import for symmetry
+    try:  # a bare type string first — "decimal(10,2)" contains a comma
         return dtype_to_proto(_parse_spark_type_str(ddl))
+    except Unsupported:
+        pass
     out = pb.DataType()
     for part in _split_top_level(ddl):
         toks = part.strip().split(None, 1)

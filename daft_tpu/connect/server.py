@@ -182,15 +182,25 @@ class SparkConnectServer:
         raise Unsupported(f"command {which!r}")
 
     def _write(self, w: pb.WriteOperation, st: _SessionState) -> None:
+        import os
+
         df = st.analyzer.relation_to_df(w.input)
         fmt = (w.source or "parquet").lower()
         if w.WhichOneof("save_type") != "path":
             raise Unsupported("write without path (saveAsTable)")
-        mode = {pb.WriteOperation.SAVE_MODE_APPEND: "append",
-                pb.WriteOperation.SAVE_MODE_OVERWRITE: "overwrite",
-                pb.WriteOperation.SAVE_MODE_UNSPECIFIED: "append",
-                pb.WriteOperation.SAVE_MODE_ERROR_IF_EXISTS: "append",
-                pb.WriteOperation.SAVE_MODE_IGNORE: "append"}[w.mode]
+        M = pb.WriteOperation
+        exists = os.path.exists(w.path) and bool(os.listdir(w.path)) \
+            if os.path.isdir(w.path) else os.path.exists(w.path)
+        # Spark's default mode is errorifexists; honor it and IGNORE
+        # rather than silently appending
+        if w.mode in (M.SAVE_MODE_ERROR_IF_EXISTS,
+                      M.SAVE_MODE_UNSPECIFIED) and exists:
+            raise FileExistsError(
+                f"path {w.path!r} already exists (write mode errorifexists)")
+        if w.mode == M.SAVE_MODE_IGNORE and exists:
+            return
+        mode = ("overwrite" if w.mode == M.SAVE_MODE_OVERWRITE
+                else "append")
         part_cols = list(w.partitioning_columns)
         if fmt == "parquet":
             df.write_parquet(w.path, write_mode=mode,

@@ -261,31 +261,45 @@ def make_shuffle_server(port: int = 0, host: Optional[str] = None):
 def _spill_streams(body: bytes):
     """Yield (schema, batch-list) per concatenated IPC stream in a spill
     file (one stream per writer reopen). A truncated trailing stream — a
-    straggler append caught mid-write — is skipped rather than fatal."""
+    straggler append caught mid-write — is skipped; the dropped tail is
+    logged so mid-file corruption (which also truncates everything after
+    it) is never silent."""
     if not body:
         return
     buf = pa.BufferReader(body)
     while buf.tell() < buf.size():
+        start = buf.tell()
         try:
             with paipc.open_stream(buf) as rd:
                 batches = list(rd)
         except pa.ArrowInvalid:
+            _log_truncated_tail(start, buf.size())
             return
         yield rd.schema, batches
+
+
+def _log_truncated_tail(pos: int, size: int) -> None:
+    import logging
+    logging.getLogger(__name__).warning(
+        "shuffle spill file: unreadable IPC stream at byte %d; dropping "
+        "%d trailing bytes (torn straggler append, or corruption if not "
+        "at the tail)", pos, size - pos)
 
 
 def _spill_file_batches(path: str):
     """Lazily yield (schema, batch) straight off a spill file, one record
     batch at a time (never materializes the partition in memory). Tolerates
-    a truncated trailing stream like _spill_streams."""
+    (and logs) a truncated trailing stream like _spill_streams."""
     if not os.path.exists(path):
         return
     size = os.path.getsize(path)
     with pa.OSFile(path, "rb") as f:
         while f.tell() < size:
+            start = f.tell()
             try:
                 rd = paipc.open_stream(f)
             except pa.ArrowInvalid:
+                _log_truncated_tail(start, size)
                 return
             schema = rd.schema
             while True:
@@ -294,6 +308,7 @@ def _spill_file_batches(path: str):
                 except StopIteration:
                     break
                 except pa.ArrowInvalid:
+                    _log_truncated_tail(start, size)
                     return
                 yield schema, batch
 

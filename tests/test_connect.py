@@ -112,6 +112,16 @@ def test_aggregate_groupby(channel):
     assert t.column("s").to_pylist() == [20, 25]  # 0+2+4+6+8 / 1+3+5+7+9
 
 
+def test_count_star(channel):
+    rng = pb.Relation(range=pb.Range(end=7, step=1))
+    star = pb.Expression(unresolved_star=pb.Expression.UnresolvedStar())
+    agg = pb.Relation(aggregate=pb.Aggregate(
+        input=rng, group_type=pb.Aggregate.GROUP_TYPE_GROUPBY,
+        aggregate_expressions=[_fn("count", star)]))
+    t = _execute(channel, agg)
+    assert t.column("count").to_pylist() == [7]
+
+
 def test_local_relation_and_join(channel):
     def ipc(table):
         sink = io.BytesIO()
