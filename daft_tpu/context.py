@@ -47,6 +47,13 @@ class ExecutionConfig:
     min_cpu_per_task: float = 1.0
     enable_ray_tracing: bool = False
     flight_shuffle_dirs: tuple = ("/tmp",)
+    # local hash-exchange strategy (reference: the 4 ShuffleExchange
+    # strategies, ops/shuffle_exchange.rs:41-58): "naive" materializes the
+    # child then fans out; "spill_cache" streams morsels through a
+    # per-partition spill cache (the FlightShuffle/pre-merge design — map
+    # outputs accumulate merged per partition, never holding the child);
+    # "auto" picks spill_cache when a memory limit is set
+    shuffle_algorithm: str = "auto"
     # TPU-specific knobs
     device_min_rows: int = 0
     device_enabled: bool = True

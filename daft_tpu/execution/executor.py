@@ -511,8 +511,11 @@ class LocalExecutor:
     # exchanges --------------------------------------------------------
     def _exec_Exchange(self, node: pp.Exchange):
         from . import memory
-        parts = memory.materialize(self._exec(node.children[0]))
         kind, n = node.kind, node.num_partitions
+        if kind == "hash" and n > 1 and self._use_spill_cache_shuffle(node):
+            yield from self._spill_cache_hash_exchange(node, n)
+            return
+        parts = memory.materialize(self._exec(node.children[0]))
         if self.cfg.enable_aqe and getattr(node, "engine_inserted", False) \
                 and kind in ("hash", "random") and n > 1:
             # AQE: the child is materialized — re-size the shuffle from
@@ -554,6 +557,62 @@ class LocalExecutor:
                                              None, n)
             return
         raise NotImplementedError(f"exchange kind {kind}")
+
+    def _use_spill_cache_shuffle(self, node) -> bool:
+        """Strategy pick (reference: ShuffleExchange strategy enum,
+        ``ops/shuffle_exchange.rs:41-58``): the streaming spill-cache path
+        skips materializing the exchange child entirely, but cedes to the
+        AQE partition-resizing path and the device-mesh collective path."""
+        from . import memory
+        from ..device import runtime as drt
+        from ..parallel import mesh as pmesh
+        algo = getattr(self.cfg, "shuffle_algorithm", "auto")
+        if algo not in ("auto", "naive", "spill_cache"):
+            raise ValueError(
+                f"shuffle_algorithm {algo!r}: expected 'auto', 'naive' or "
+                f"'spill_cache'")
+        if algo == "naive":
+            return False
+        if self.cfg.enable_aqe and getattr(node, "engine_inserted", False):
+            return False  # AQE resizes from materialized bytes
+        if drt.device_enabled() and pmesh.mesh_size() >= 2 \
+                and node.num_partitions == pmesh.mesh_size():
+            return False  # the mesh collective repartition may apply
+        if algo == "spill_cache":
+            return True
+        # auto: bounded-memory mode prefers the streaming cache (one
+        # partition in memory at a time)
+        return memory.memory_limit_bytes() is not None
+
+    def _spill_cache_hash_exchange(self, node, n: int):
+        """Streaming map-side shuffle: every incoming morsel is hash-
+        partitioned and appended to a per-partition spill file; the reduce
+        side then streams one partition at a time (reference:
+        ``shuffle_cache.rs:14-80`` map/partition/spill → fetch)."""
+        import pyarrow as pa
+
+        from ..distributed.shuffle_service import (ShuffleCache,
+                                                   _spill_file_batches)
+        by = list(node.by)
+        cache = ShuffleCache(dirs=list(self.cfg.flight_shuffle_dirs) or None)
+        try:
+            for mp in self._exec(node.children[0]):
+                for i, piece in enumerate(mp.partition_by_hash(by, n)):
+                    if len(piece):
+                        cache.push(i, piece.combined().to_arrow_table())
+            cache.close()
+            schema = node.schema().to_arrow()
+            for i in range(n):
+                # lazy per-batch read off the spill file: one partition's
+                # batches in memory at a time, never the raw bytes too
+                batches = [b for _, b in
+                           _spill_file_batches(cache._path(i))]
+                t = (pa.Table.from_batches(batches) if batches
+                     else schema.empty_table())
+                yield MicroPartition.from_recordbatch(
+                    RecordBatch.from_arrow_table(t))
+        finally:
+            cache.cleanup()
 
     def _materialize_split(self, rows):
         """Fanout outputs → budgeted (possibly spilling) buffer, so the
