@@ -29,20 +29,32 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
     d_date_sk = np.arange(1, n_days + 1)
     years = 1999 + (np.arange(n_days) // 365)
     moy = ((np.arange(n_days) % 365) // 31) + 1
+    moy_clip = np.minimum(moy, 12)
     date_dim = pa.table({
         "d_date_sk": d_date_sk,
         "d_year": years,
-        "d_moy": np.minimum(moy, 12),
+        "d_moy": moy_clip,
+        "d_qoy": (moy_clip - 1) // 3 + 1,
+        "d_dom": (np.arange(n_days) % 31) + 1,
     })
 
     categories = ["Books", "Home", "Electronics", "Music", "Sports"]
     classes = ["cls%02d" % i for i in range(10)]
     brands = ["brand%03d" % i for i in range(50)]
+    cat = rng.choice(len(categories), n_items)
+    cls = rng.choice(len(classes), n_items)
+    brd = rng.choice(len(brands), n_items)
     item = pa.table({
         "i_item_sk": np.arange(1, n_items + 1),
-        "i_category": rng.choice(categories, n_items),
-        "i_class": rng.choice(classes, n_items),
-        "i_brand": rng.choice(brands, n_items),
+        "i_item_id": ["AAAA%08d" % i for i in range(n_items)],
+        "i_item_desc": ["item description %d" % i for i in range(n_items)],
+        "i_current_price": rng.uniform(0.5, 100, n_items).round(2),
+        "i_category": np.array(categories)[cat],
+        "i_category_id": cat + 1,
+        "i_class": np.array(classes)[cls],
+        "i_class_id": cls + 1,
+        "i_brand": np.array(brands)[brd],
+        "i_brand_id": brd + 1,
         "i_manager_id": rng.integers(1, 100, n_items),
         "i_manufact_id": rng.integers(1, 200, n_items),
     })

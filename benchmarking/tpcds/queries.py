@@ -1,8 +1,9 @@
-"""TPC-DS window-function queries (the BASELINE configs' rolling subset):
-Q47, Q63, Q89 as SQL against the engine's SQL frontend (reference ships
-them in ``benchmarking/tpcds/queries``; shapes preserved — monthly
-aggregates joined over date_dim/item/store with OVER(PARTITION BY …)
-windows — sized to the synthetic datagen)."""
+"""TPC-DS query subset as SQL against the engine's SQL frontend
+(reference ships the full 99 in ``benchmarking/tpcds/queries``). Shapes
+preserved and sized to the synthetic datagen: the BASELINE configs'
+rolling/window trio (Q47/Q63/Q89), the dimensional-aggregate family
+(Q3/Q42/Q52/Q55), quarterly windows (Q53), and the class-revenue-ratio
+window (Q98)."""
 
 Q47 = """
 WITH monthly AS (
@@ -75,7 +76,98 @@ ORDER BY sum_sales - avg_monthly_sales, s_store_name
 LIMIT 100
 """
 
-ALL = {47: Q47, 63: Q63, 89: Q89}
+Q3 = """
+SELECT d_year, i_brand_id, i_brand, SUM(ss_ext_sales_price) AS sum_agg
+FROM date_dim, store_sales, item
+WHERE d_date_sk = ss_sold_date_sk
+  AND ss_item_sk = i_item_sk
+  AND i_manufact_id = 128
+  AND d_moy = 11
+GROUP BY d_year, i_brand_id, i_brand
+ORDER BY d_year, sum_agg DESC, i_brand_id
+LIMIT 100
+"""
+
+Q42 = """
+SELECT d_year, i_category_id, i_category,
+       SUM(ss_ext_sales_price) AS sum_sales
+FROM date_dim, store_sales, item
+WHERE d_date_sk = ss_sold_date_sk
+  AND ss_item_sk = i_item_sk
+  AND i_manager_id = 1
+  AND d_moy = 11
+  AND d_year = 2000
+GROUP BY d_year, i_category_id, i_category
+ORDER BY sum_sales DESC, d_year, i_category_id, i_category
+LIMIT 100
+"""
+
+Q52 = """
+SELECT d_year, i_brand_id, i_brand, SUM(ss_ext_sales_price) AS ext_price
+FROM date_dim, store_sales, item
+WHERE d_date_sk = ss_sold_date_sk
+  AND ss_item_sk = i_item_sk
+  AND i_manager_id = 1
+  AND d_moy = 11
+  AND d_year = 2000
+GROUP BY d_year, i_brand_id, i_brand
+ORDER BY d_year, ext_price DESC, i_brand_id
+LIMIT 100
+"""
+
+Q53 = """
+WITH quarterly AS (
+  SELECT i_manufact_id, d_qoy, SUM(ss_sales_price) AS sum_sales
+  FROM store_sales, item, date_dim
+  WHERE ss_item_sk = i_item_sk
+    AND ss_sold_date_sk = d_date_sk
+    AND d_year = 2000
+    AND i_category IN ('Books', 'Home', 'Electronics')
+  GROUP BY i_manufact_id, d_qoy
+)
+SELECT i_manufact_id, sum_sales,
+       AVG(sum_sales) OVER (PARTITION BY i_manufact_id)
+           AS avg_quarterly_sales
+FROM quarterly
+ORDER BY avg_quarterly_sales DESC, sum_sales, i_manufact_id
+LIMIT 100
+"""
+
+Q55 = """
+SELECT i_brand_id, i_brand, SUM(ss_ext_sales_price) AS ext_price
+FROM date_dim, store_sales, item
+WHERE d_date_sk = ss_sold_date_sk
+  AND ss_item_sk = i_item_sk
+  AND i_manager_id = 28
+  AND d_moy = 11
+  AND d_year = 1999
+GROUP BY i_brand_id, i_brand
+ORDER BY ext_price DESC, i_brand_id
+LIMIT 100
+"""
+
+Q98 = """
+WITH revenue AS (
+  SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
+         SUM(ss_ext_sales_price) AS itemrevenue
+  FROM store_sales, item, date_dim
+  WHERE ss_item_sk = i_item_sk
+    AND ss_sold_date_sk = d_date_sk
+    AND i_category IN ('Sports', 'Books', 'Home')
+    AND d_year = 2000
+    AND d_moy BETWEEN 2 AND 4
+  GROUP BY i_item_id, i_item_desc, i_category, i_class, i_current_price
+)
+SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       itemrevenue,
+       itemrevenue * 100.0 / SUM(itemrevenue) OVER (PARTITION BY i_class)
+           AS revenueratio
+FROM revenue
+ORDER BY i_category, i_class, i_item_id, i_item_desc, revenueratio
+"""
+
+ALL = {3: Q3, 42: Q42, 47: Q47, 52: Q52, 53: Q53, 55: Q55, 63: Q63,
+       89: Q89, 98: Q98}
 
 
 def run(qnum: int, get_df):

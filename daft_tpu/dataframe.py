@@ -88,6 +88,14 @@ class DataFrame:
 
     # ---- transformations -------------------------------------------------
     def select(self, *columns: ColumnInput) -> "DataFrame":
+        rewritten, hoisted = _hoist_nested_windows(columns)
+        if hoisted:
+            # a window nested inside a scalar expression (e.g.
+            # ``x * 100 / SUM(x) OVER (...)``) computes in its own Window
+            # plan node first, then the outer expression reads the temp
+            # column (reference: ExtractWindowFunction optimizer rule)
+            wdf = self.with_columns(hoisted)
+            return DataFrame(wdf.select(*rewritten)._builder)
         win = [c for c in columns if isinstance(c, Expression)
                and c._unalias().op == "window"]
         if win:
@@ -529,6 +537,32 @@ def _flatten_exprs(to_agg) -> List[Expression]:
 
 # ---------------------------------------------------------------------------
 # constructors (daft.from_* family)
+
+def _hoist_nested_windows(columns):
+    """Hoist OVER() subtrees buried inside scalar expressions into temp
+    columns (reference: ``ExtractWindowFunction`` rule). Top-level window
+    expressions are left alone — select's existing Window routing handles
+    them. → (rewritten columns, {temp name: window expr})."""
+    hoisted: Dict[str, Expression] = {}
+
+    def walk(e: Expression, top: bool) -> Expression:
+        inner = e._unalias()
+        if inner.op == "window":
+            if top:
+                return e
+            name = f"__win_h{len(hoisted)}"
+            hoisted[name] = inner
+            return col(name)
+        new_args = tuple(walk(c, False) for c in e.args)
+        # identity compare: Expression.__eq__ builds an eq-expression
+        if all(a is b for a, b in zip(new_args, e.args)):
+            return e
+        return e.with_children(new_args)
+
+    out = [walk(c, True) if isinstance(c, Expression) else c
+           for c in columns]
+    return out, hoisted
+
 
 def from_pydict(data: Dict[str, Any]) -> DataFrame:
     mp = MicroPartition.from_pydict(data)
