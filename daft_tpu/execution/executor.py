@@ -650,6 +650,40 @@ class LocalExecutor:
             start = end
             out += 1
 
+    def _sample_boundaries(self, sampled_keys: List[RecordBatch],
+                           key_names: List[str], descending: List[bool],
+                           nulls_first: List[bool], n: int
+                           ) -> Optional[RecordBatch]:
+        """Concatenated key samples → n-1 range boundaries (sorted,
+        null-free), or None when there is nothing to sample."""
+        merged = RecordBatch.concat(sampled_keys)
+        by = [col(nm) for nm in key_names]
+        merged = merged.filter(~_any_null(by, merged)) if len(merged) \
+            else merged
+        if len(merged) == 0:
+            return None
+        merged_sorted = merged.sort(by, descending, nulls_first)
+        idx = [min(int(len(merged_sorted) * (i + 1) / n),
+                   len(merged_sorted) - 1) for i in range(n - 1)]
+        return merged_sorted.take(np.asarray(idx, dtype=np.int64))
+
+    def _sample_keys(self, parts, by: List[Expression]) -> List[RecordBatch]:
+        k = self.cfg.sample_size_for_sort
+        out = []
+        for p in parts:
+            rb = p.combined()
+            s = rb.sample(size=min(k, len(rb))) if len(rb) else rb
+            out.append(s.eval_expression_list(by))
+        return out
+
+    def _range_fanout(self, parts, by: List[Expression],
+                      boundaries: RecordBatch, descending: List[bool],
+                      n: int):
+        split = self._materialize_split(_ordered_parallel(
+            iter(parts),
+            lambda p: p.partition_by_range(by, boundaries, descending)))
+        return self._regroup(split, n)
+
     def _range_partition(self, parts: List[MicroPartition],
                          by: List[Expression], descending: List[bool],
                          nulls_first: Optional[List[bool]] = None,
@@ -660,32 +694,60 @@ class LocalExecutor:
         if n == 1:
             combined = parts[0].concat(parts[1:]) if len(parts) > 1 else parts[0]
             return [combined]
-        k = self.cfg.sample_size_for_sort
-        samples = []
-        for p in parts:
-            rb = p.combined()
-            s = rb.sample(size=min(k, len(rb))) if len(rb) else rb
-            samples.append(s.eval_expression_list(by))
-        merged = RecordBatch.concat(samples)
-        merged = merged.filter(~_any_null(by, merged)) if len(merged) else merged
-        if len(merged) == 0:
+        boundaries = self._sample_boundaries(
+            self._sample_keys(parts, by), [e.name() for e in by],
+            descending, nulls_first, n)
+        if boundaries is None:
             combined = parts[0].concat(parts[1:]) if len(parts) > 1 else parts[0]
             return [combined] + [MicroPartition.empty(parts[0].schema)
                                  for _ in range(n - 1)]
-        skeys = [col(e.name()) for e in by]
-        merged_sorted = merged.sort(skeys, descending, nulls_first)
-        idx = [int(len(merged_sorted) * (i + 1) / n)
-               for i in range(n - 1)]
-        idx = [min(i, len(merged_sorted) - 1) for i in idx]
-        boundaries = merged_sorted.take(np.asarray(idx, dtype=np.int64))
-        split = self._materialize_split(_ordered_parallel(
-            iter(parts),
-            lambda p: p.partition_by_range(by, boundaries, descending)))
-        return self._regroup(split, n)
+        return self._range_fanout(parts, by, boundaries, descending, n)
 
     # joins ------------------------------------------------------------
+    def _sort_merge_join(self, node: pp.HashJoin):
+        """Distributed sort-merge join (reference: SortMergeJoin physical
+        op with ``sort_merge_join_sort_with_aligned_boundaries``): sample
+        BOTH sides' keys once, derive one shared set of range boundaries,
+        range-partition both sides with them (co-ranged, not co-hashed),
+        then merge-join pairwise. Output comes out range-clustered by key."""
+        from . import memory
+        how = node.how
+        left_on, right_on = list(node.left_on), list(node.right_on)
+        lparts = memory.materialize(self._exec(node.children[0]))
+        rparts = memory.materialize(self._exec(node.children[1]))
+        n = max(len(lparts), len(rparts), 1)
+        if n == 1:
+            lall = _gather_all(iter(lparts))
+            rall = _gather_all(iter(rparts))
+            yield lall.hash_join(rall, left_on, right_on, how)
+            return
+        names = [e.name() for e in left_on]
+        # right-side key names normalize to the left's so samples concat
+        # into one boundary table (boundary comparison is positional)
+        samples = self._sample_keys(lparts, left_on) + [
+            RecordBatch.from_series([c.rename(nm) for c, nm in
+                                     zip(rb.columns(), names)])
+            for rb in self._sample_keys(rparts, right_on)]
+        desc = [False] * len(left_on)
+        boundaries = self._sample_boundaries(samples, names, desc, desc, n)
+        if boundaries is None:
+            lall = _gather_all(iter(lparts))
+            rall = _gather_all(iter(rparts))
+            yield lall.hash_join(rall, left_on, right_on, how)
+            return
+        lregrouped = memory.materialize(
+            self._range_fanout(lparts, left_on, boundaries, desc, n))
+        rregrouped = memory.materialize(
+            self._range_fanout(rparts, right_on, boundaries, desc, n))
+        yield from _ordered_parallel(
+            zip(lregrouped, rregrouped),
+            lambda lr: lr[0].hash_join(lr[1], left_on, right_on, how))
+
     def _exec_HashJoin(self, node: pp.HashJoin):
         how = node.how
+        if node.strategy == "sort_merge":
+            yield from self._sort_merge_join(node)
+            return
         if node.strategy == "hash" and self.cfg.enable_aqe:
             lnode, rnode = node.children
             if getattr(lnode, "join_side", False) \

@@ -160,6 +160,12 @@ def _translate_join(node: lp.Join, cfg) -> pp.PhysicalPlan:
     elif strategy == "broadcast":
         strategy = "broadcast_right" if node.how in ("inner", "left", "semi",
                                                      "anti") else "hash"
+    if strategy == "sort_merge":
+        # no exchanges here: the executor samples both sides and range-
+        # partitions them with one shared boundary set (aligned-boundary
+        # sort-merge, reference SortMergeJoin)
+        return pp.HashJoin(pl, pr, node.left_on, node.right_on, node.how,
+                           node.schema(), "sort_merge")
     if strategy == "hash" and (_nparts(left) > 1 or _nparts(right) > 1):
         n = max(_nparts(left), _nparts(right))
         # join-side exchanges are NOT count-adaptable (the two sides must
