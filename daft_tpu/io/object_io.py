@@ -263,6 +263,9 @@ class IOClient:
         if scheme in ("az", "abfs", "abfss"):
             from .azure import AzureBlobSource
             return AzureBlobSource(self.config.azure)
+        if scheme == "hf":
+            from .hf import HFSource
+            return HFSource(self.config.http)
         raise ValueError(f"unsupported URL scheme {scheme!r}")
 
     # convenience passthroughs
