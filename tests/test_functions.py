@@ -197,3 +197,44 @@ def test_image_pipeline_decode_resize_mode_encode_crop():
     assert out["gray"][0].shape == (12, 16)
     assert out["cropped"][0].shape == (6, 8, 3)
     assert out["enc"][0][:4] == b"\x89PNG"
+
+
+def test_image_resize_batched_device_path_matches_pil(monkeypatch):
+    """A uniform-shape batch ≥ the batching floor takes the single-program
+    device resize (jax.image.resize over (N,H,W,C)); values stay close to
+    the per-image PIL result and null slots survive. The device path is
+    spied on so a silent fallback to PIL fails the test."""
+    import numpy as np
+    from PIL import Image
+
+    from daft_tpu.functions import image as img_mod
+    calls = []
+    orig = img_mod._device_batch_resize
+
+    def spy(imgs, w, h):
+        out = orig(imgs, w, h)
+        calls.append(out is not None)
+        return out
+
+    monkeypatch.setattr(img_mod, "_device_batch_resize", spy)
+    base = (np.arange(24 * 32 * 3) % 255).astype(np.uint8).reshape(24, 32, 3)
+    imgs = [base.copy() for _ in range(9)] + [None]
+    out = daft.from_pydict({"img": imgs}) \
+        .select(col("img").image.resize(16, 12)).to_pydict()["img"]
+    assert calls == [True], "device batch path did not run"
+    assert out[-1] is None
+    assert all(o.shape == (12, 16, 3) for o in out[:-1])
+    ref = np.asarray(Image.fromarray(base).resize((16, 12)))
+    assert np.abs(out[0].astype(int) - ref.astype(int)).mean() < 12
+
+
+def test_image_resize_uint16_values_preserved():
+    """Integer dtypes clamp to their OWN range on the device path — 16-bit
+    pixels above 255 survive (regression: an unconditional 0–255 clip)."""
+    import numpy as np
+    base = np.full((8, 8), 40_000, dtype=np.uint16)
+    imgs = [base.copy() for _ in range(10)]
+    out = daft.from_pydict({"img": imgs}) \
+        .select(col("img").image.resize(4, 4)).to_pydict()["img"]
+    assert all(o.dtype == np.uint16 for o in out)
+    assert all((o == 40_000).all() for o in out)
