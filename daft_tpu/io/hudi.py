@@ -1,0 +1,128 @@
+"""Native Apache Hudi Copy-on-Write snapshot reader.
+
+The reference reads Hudi through its Python SDK
+(``/root/reference/daft/io/_hudi.py`` + ``daft/hudi``). This is SDK-free:
+the ``.hoodie`` timeline (completed ``*.commit`` / ``*.replacecommit``
+instants, JSON) and ``hoodie.properties`` are parsed directly, base files
+are grouped into file slices by ``{fileId}_{writeToken}_{instantTime}``
+naming, and the snapshot is the newest committed base file per live file
+group — honoring replacecommits that retire file groups (clustering).
+
+Unsupported (raises): Merge-on-Read tables (log files need the Hudi
+merger), incremental queries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from .iceberg import _get, _is_remote  # shared URI helpers
+from .object_io import IOConfig, get_io_client
+
+_BASE_FILE_RE = re.compile(
+    r"^(?P<file_id>.+?)_(?P<token>[0-9\-]+)_(?P<instant>\d+)\.parquet$")
+
+
+def _strip(uri: str) -> str:
+    return uri[7:] if uri.startswith("file://") else uri
+
+
+def _list_files(table_uri: str, io_config) -> List[str]:
+    if _is_remote(table_uri):
+        return get_io_client(io_config).glob(table_uri.rstrip("/") + "/**")
+    root = _strip(table_uri)
+    out = []
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            out.append(os.path.join(dirpath, f))
+    return sorted(out)
+
+
+def _load_properties(table_uri: str, io_config) -> Dict[str, str]:
+    raw = _get(f"{table_uri.rstrip('/')}/.hoodie/hoodie.properties",
+               io_config).decode()
+    props = {}
+    for line in raw.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        k, _, v = line.partition("=")
+        props[k.strip()] = v.strip()
+    return props
+
+
+def _timeline(files: List[str]) -> Tuple[Dict[str, str], List[str]]:
+    """→ ({instant: action} for completed instants, replacecommit uris)."""
+    completed: Dict[str, str] = {}
+    replaces: List[str] = []
+    for f in files:
+        name = f.replace("\\", "/").rsplit("/", 1)[-1]
+        parent = f.replace("\\", "/").rsplit("/", 2)[-2]
+        if parent != ".hoodie":
+            continue
+        m = re.match(r"^(\d+)\.(commit|replacecommit)$", name)
+        if m:
+            completed[m.group(1)] = m.group(2)
+            if m.group(2) == "replacecommit":
+                replaces.append(f)
+    return completed, replaces
+
+
+def snapshot_files(table_uri: str,
+                   io_config: Optional[IOConfig] = None
+                   ) -> List[Dict[str, Any]]:
+    """Live base files of the latest snapshot:
+    [{path, partition, file_id, instant}]."""
+    props = _load_properties(table_uri, io_config)
+    ttype = props.get("hoodie.table.type", "COPY_ON_WRITE").upper()
+    if ttype != "COPY_ON_WRITE":
+        raise NotImplementedError(
+            f"hudi table type {ttype}: only Copy-on-Write snapshots are "
+            f"supported (Merge-on-Read needs log-file merging)")
+    all_files = _list_files(table_uri, io_config)
+    completed, replace_uris = _timeline(all_files)
+    replaced: set = set()
+    for uri in replace_uris:
+        try:
+            doc = json.loads(_get(uri, io_config))
+        except ValueError:
+            continue
+        for part, ids in (doc.get("partitionToReplaceFileIds") or {}).items():
+            for fid in ids:
+                replaced.add((part, fid))
+    root = table_uri.rstrip("/")
+    groups: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    root_local = _strip(root).replace("\\", "/")
+    for f in all_files:
+        norm = f.replace("\\", "/")
+        rel = norm[len(root_local):].lstrip("/") if not _is_remote(root) \
+            else norm.split(root.split("://", 1)[1], 1)[-1].lstrip("/")
+        if rel.startswith(".hoodie"):
+            continue
+        parts = rel.rsplit("/", 1)
+        partition = parts[0] if len(parts) == 2 else ""
+        m = _BASE_FILE_RE.match(parts[-1])
+        if not m or m.group("instant") not in completed:
+            continue
+        if (partition, m.group("file_id")) in replaced:
+            continue
+        key = (partition, m.group("file_id"))
+        cur = groups.get(key)
+        if cur is None or m.group("instant") > cur["instant"]:
+            groups[key] = {"path": f, "partition": partition,
+                           "file_id": m.group("file_id"),
+                           "instant": m.group("instant")}
+    return sorted(groups.values(), key=lambda g: g["path"])
+
+
+def read_hudi(table_uri: str, io_config: Optional[IOConfig] = None):
+    """Hudi CoW table → DataFrame of its latest snapshot."""
+    import daft_tpu as dt
+    files = snapshot_files(table_uri, io_config)
+    if not files:
+        raise ValueError(f"hudi table {table_uri!r} has no committed "
+                         f"base files")
+    return dt.read_parquet([f["path"] for f in files], io_config=io_config)

@@ -105,10 +105,20 @@ def read_iceberg(table, snapshot_id: Optional[int] = None,
     return _impl(uri, snapshot_id=snapshot_id, io_config=io_config)
 
 
-# Hudi/Lance use their own storage SDKs — unlike Delta (JSON log) and
-# Iceberg (Avro manifests), both implemented natively above, these need
-# their packages (reference: daft/io/_hudi.py, _lance.py).
-read_hudi = _sdk_gated("read_hudi", "hudi")
+def read_hudi(table_uri: str, io_config: Any = None, **kwargs):
+    """Read an Apache Hudi Copy-on-Write table's latest snapshot
+    (reference: ``daft/io/_hudi.py`` over the Hudi SDK; natively
+    implemented — timeline + file-slice resolution in io/hudi.py)."""
+    if kwargs:
+        raise TypeError(f"read_hudi: unsupported options {sorted(kwargs)} "
+                        f"(snapshot/incremental options are not implemented)")
+    from .hudi import read_hudi as _impl
+    return _impl(table_uri, io_config=io_config)
+
+
+# Lance uses its own columnar format SDK — unlike Delta (JSON log),
+# Iceberg (Avro manifests) and Hudi (timeline + parquet), all implemented
+# natively above (reference: daft/io/_lance.py).
 read_lance = _sdk_gated("read_lance", "lance")
 
 

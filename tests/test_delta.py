@@ -112,10 +112,11 @@ def test_checkpoint_replay(tmp_path):
 
 
 def test_gated_readers_error_actionably():
-    # iceberg is native now (io/iceberg.py): missing table → clear error
+    # iceberg + hudi are native now (io/iceberg.py, io/hudi.py):
+    # missing tables → clear errors
     with pytest.raises(FileNotFoundError, match="Iceberg metadata"):
         daft_tpu.read_iceberg("whatever")
-    with pytest.raises(ImportError, match="hudi"):
+    with pytest.raises(FileNotFoundError):
         daft_tpu.read_hudi("whatever")
     with pytest.raises(ImportError, match="lance"):
         daft_tpu.read_lance("whatever")
