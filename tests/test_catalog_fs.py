@@ -1,0 +1,81 @@
+"""Filesystem lake catalog: auto-detected Iceberg/Delta/Hudi/parquet tables
+under a warehouse root, attached to a Session and queryable by SQL name
+(reference capability: the catalog adapters in ``daft/catalog/``)."""
+
+import pytest
+
+import daft_tpu
+from daft_tpu import Session, col
+from daft_tpu.catalog import NotFoundError
+from daft_tpu.catalog_fs import FilesystemCatalog
+
+
+@pytest.fixture
+def warehouse(tmp_path):
+    root = tmp_path / "wh"
+    (root / "sales").mkdir(parents=True)
+    daft_tpu.from_pydict({"k": [1, 2], "v": [10.0, 20.0]}) \
+        .write_iceberg(str(root / "sales" / "orders"))
+    from daft_tpu.io.delta import write_deltalake
+    write_deltalake(daft_tpu.from_pydict({"c": ["a", "b"]}),
+                    str(root / "sales" / "customers"))
+    daft_tpu.from_pydict({"p": [7]}) \
+        .write_parquet(str(root / "raw_events"))
+    return root
+
+
+def test_list_and_detect_formats(warehouse):
+    from daft_tpu.catalog import Identifier
+    cat = FilesystemCatalog(str(warehouse), name="lake")
+    tables = {str(t) for t in cat._list_tables()}
+    assert tables == {"sales.orders", "sales.customers", "raw_events"}
+    t = cat._get_table(Identifier("sales", "orders"))
+    assert t.format == "iceberg"
+
+
+def test_read_through_catalog(warehouse):
+    from daft_tpu.catalog import Identifier
+    cat = FilesystemCatalog(str(warehouse))
+    t = cat._get_table(Identifier("sales", "orders"))
+    assert t.read().sort("k").to_pydict() == {"k": [1, 2], "v": [10.0, 20.0]}
+    t2 = cat._get_table(Identifier("sales", "customers"))
+    assert t2.format == "delta"
+    assert sorted(t2.read().to_pydict()["c"]) == ["a", "b"]
+    t3 = cat._get_table(Identifier("raw_events"))
+    assert t3.format == "parquet"
+    assert t3.read().to_pydict() == {"p": [7]}
+
+
+def test_sql_over_attached_catalog(warehouse):
+    sess = Session()
+    sess.attach(FilesystemCatalog(str(warehouse), name="lake"))
+    out = sess.sql("SELECT k, v * 2 AS v2 FROM lake.sales.orders "
+                   "ORDER BY k").to_pydict()
+    assert out == {"k": [1, 2], "v2": [20.0, 40.0]}
+
+
+def test_create_append_drop_roundtrip(warehouse):
+    from daft_tpu.catalog import Identifier
+    from daft_tpu.schema import Field, Schema
+    from daft_tpu.datatype import DataType
+    cat = FilesystemCatalog(str(warehouse))
+    ident = Identifier("sales", "new_tbl")
+    t = cat._create_table(ident, Schema([Field("x", DataType.int64())]))
+    assert t.format == "iceberg"
+    t.append(daft_tpu.from_pydict({"x": [5, 6]}))
+    assert sorted(cat._get_table(ident).read().to_pydict()["x"]) == [5, 6]
+    t.overwrite(daft_tpu.from_pydict({"x": [9]}))
+    assert cat._get_table(ident).read().to_pydict()["x"] == [9]
+    cat._drop_table(ident)
+    with pytest.raises(NotFoundError):
+        cat._get_table(ident)
+
+
+def test_namespaces(warehouse):
+    from daft_tpu.catalog import Identifier
+    cat = FilesystemCatalog(str(warehouse))
+    assert Identifier("sales") in cat._list_namespaces()
+    cat._create_namespace(Identifier("marketing"))
+    assert cat._has_namespace(Identifier("marketing"))
+    cat._drop_namespace(Identifier("marketing"))
+    assert not cat._has_namespace(Identifier("marketing"))
