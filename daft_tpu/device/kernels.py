@@ -454,6 +454,9 @@ def join_phase_expand(counts, starts, r_perm, out_capacity: int):
     cum0 = cum - counts  # exclusive prefix
     offset = j - jnp.take(cum0, owner)
     r_slot = jnp.take(starts, owner) + offset
-    r_idx = jnp.take(r_perm, jnp.clip(r_slot, 0, C - 1))
+    # clip against the RIGHT side's capacity — the two sides' buckets can
+    # differ, and clipping to C (the left capacity) would remap legitimate
+    # high right slots onto wrong rows
+    r_idx = jnp.take(r_perm, jnp.clip(r_slot, 0, r_perm.shape[0] - 1))
     valid = j < total
     return owner.astype(jnp.int32), r_idx.astype(jnp.int32), valid

@@ -1,0 +1,84 @@
+"""Device-tier join index generation (the three-phase
+sort/searchsorted/expand kernels) produces the same pair sets and counts
+as the host merge, end-to-end through DataFrame joins (opt-in via
+DAFT_TPU_DEVICE_JOIN)."""
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu.joins import _device_match_indices, match_indices
+
+
+@pytest.fixture
+def keys():
+    rng = np.random.default_rng(3)
+    lk = rng.integers(0, 50, 400)
+    rk = rng.integers(0, 50, 150)
+    lv = rng.random(400) > 0.1  # some null keys
+    rv = rng.random(150) > 0.1
+    return lk, rk, lv, rv
+
+
+def _pairs(li, ri):
+    return sorted(zip(li.tolist(), ri.tolist()))
+
+
+def test_device_indices_match_host(keys):
+    lk, rk, lv, rv = keys
+    hli, hri, hcnt = match_indices(lk, rk, lv, rv)
+    out = _device_match_indices(lk, rk, lv, rv)
+    assert out is not None
+    dli, dri, dcnt = out
+    assert _pairs(dli, dri) == _pairs(hli, hri)
+    assert np.array_equal(dcnt, hcnt)
+
+
+def test_right_side_larger_than_left_capacity():
+    """Regression: the expand phase must clip right slots against the
+    RIGHT capacity — a tiny left side joined to a big right side used to
+    remap high right rows onto wrong indices."""
+    lk = np.array([180, 5], dtype=np.int64)
+    rk = np.arange(200, dtype=np.int64)
+    lv = np.ones(2, bool)
+    rv = np.ones(200, bool)
+    hli, hri, hcnt = match_indices(lk, rk, lv, rv)
+    dli, dri, dcnt = _device_match_indices(lk, rk, lv, rv)
+    assert _pairs(dli, dri) == _pairs(hli, hri) == [(0, 180), (1, 5)]
+
+
+def test_empty_sides():
+    e = np.array([], dtype=np.int64)
+    eb = np.array([], dtype=bool)
+    out = _device_match_indices(e, e, eb, eb)
+    assert out is not None
+    li, ri, cnt = out
+    assert len(li) == 0 and len(cnt) == 0
+    lk = np.array([1, 2], dtype=np.int64)
+    lv = np.ones(2, bool)
+    li, ri, cnt = _device_match_indices(lk, e, lv, eb)
+    assert len(li) == 0 and list(cnt) == [0, 0]
+
+
+def test_dataframe_join_through_device_path(keys, monkeypatch):
+    monkeypatch.setenv("DAFT_TPU_DEVICE_JOIN", "1")
+    lk, rk, _, _ = keys
+    left = daft_tpu.from_pydict({"k": lk.tolist(), "lv": list(range(400))})
+    right = daft_tpu.from_pydict({"k": rk.tolist(), "rv": list(range(150))})
+    dev = left.join(right, on="k").to_pydict()
+    monkeypatch.delenv("DAFT_TPU_DEVICE_JOIN")
+    host = left.join(right, on="k").to_pydict()
+    assert sorted(zip(dev["k"], dev["lv"], dev["rv"])) == \
+        sorted(zip(host["k"], host["lv"], host["rv"]))
+
+
+def test_outer_join_counts_drive_unmatched_rows(monkeypatch):
+    monkeypatch.setenv("DAFT_TPU_DEVICE_JOIN", "1")
+    left = daft_tpu.from_pydict({"k": [1, 2, 3], "lv": [10, 20, 30]})
+    right = daft_tpu.from_pydict({"k": [2, 4], "rv": ["b", "d"]})
+    out = left.join(right, on="k", how="outer").to_pydict()
+    rows = sorted(zip(out["k"], out["lv"], out["rv"]),
+                  key=lambda t: (t[0] is None, t[0] or 0))
+    assert (2, 20, "b") in rows
+    assert any(k == 1 and rv is None for k, lv, rv in rows)
+    assert any(k == 4 and lv is None for k, lv, rv in rows)
