@@ -76,23 +76,38 @@ class WorkerServer:
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
                 blob = self.rfile.read(n)
+                if self.path.startswith("/unregister/"):
+                    from . import shuffle_service
+                    server = shuffle_service._local_server
+                    if server is not None:
+                        server.unregister(self.path.rsplit("/", 1)[-1])
+                    self.send_response(200)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
                 try:
-                    task_plan, stage_inputs_blob = pickle.loads(blob)
+                    task_plan, inputs_wire, shuffle_out = pickle.loads(blob)
                     # cloudpickle-serialized closures need cloudpickle's
                     # reducers importable on this host; plan fragments
                     # without closure UDFs decode with plain pickle
                     plan = pickle.loads(task_plan)
+                    from .worker import StageTask, run_task
                     stage_inputs = {
-                        k: _parts_from_ipc(v)
-                        for k, v in stage_inputs_blob.items()}
+                        k: (v[1] if v[0] == "fetch"
+                            else _parts_from_ipc(v[1]))
+                        for k, v in inputs_wire.items()}
 
                     def run():
-                        from ..execution.executor import LocalExecutor
-                        ex = LocalExecutor()
-                        return list(ex.run(plan, stage_inputs=stage_inputs))
+                        return run_task(StageTask(
+                            -1, plan, stage_inputs,
+                            shuffle_out=shuffle_out))
 
-                    parts = pool.submit(run).result()
-                    body = _parts_to_ipc(parts)
+                    res = pool.submit(run).result()
+                    from .worker import ShuffleResult
+                    if isinstance(res, ShuffleResult):
+                        body = pickle.dumps(("shuffle", res))
+                    else:
+                        body = pickle.dumps(("parts", _parts_to_ipc(res)))
                     status = 200
                 except Exception:
                     import traceback
@@ -130,12 +145,19 @@ class RemoteWorker(Worker):
     def submit(self, task: StageTask):
         return self._pool.submit(self._post, task)
 
-    def _post(self, task: StageTask) -> List[MicroPartition]:
+    def _post(self, task: StageTask):
         import os
         import urllib.error
-        stage_inputs_blob = {k: _parts_to_ipc(v)
-                             for k, v in task.stage_inputs.items()}
-        blob = pickle.dumps((_dumps(task.plan), stage_inputs_blob))
+
+        from .worker import FetchSpec
+        inputs_wire = {}
+        for k, v in task.stage_inputs.items():
+            if isinstance(v, FetchSpec):
+                inputs_wire[k] = ("fetch", v)
+            else:
+                inputs_wire[k] = ("parts", _parts_to_ipc(v))
+        blob = pickle.dumps((_dumps(task.plan), inputs_wire,
+                             task.shuffle_out))
         req = urllib.request.Request(self.address, data=blob, method="POST")
         timeout = float(os.environ.get("DAFT_TPU_WORKER_TIMEOUT", "3600"))
         try:
@@ -145,7 +167,17 @@ class RemoteWorker(Worker):
             # surface the remote traceback the server sent in the body
             detail = exc.read().decode(errors="replace")
             raise RuntimeError(f"remote worker failed:\n{detail}") from exc
-        return _parts_from_ipc(body)
+        kind, payload = pickle.loads(body)
+        if kind == "shuffle":
+            return payload
+        return _parts_from_ipc(payload)
+
+    def unregister_shuffle(self, shuffle_id: str) -> None:
+        req = urllib.request.Request(
+            f"{self.address}/unregister/{shuffle_id}", data=b"",
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30):
+            pass
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)

@@ -16,7 +16,8 @@ from typing import Dict, Iterator, List, Optional
 from ..micropartition import MicroPartition
 from ..physical import plan as pp
 from .stages import Boundary, Stage, StagePlan
-from .worker import StageTask, WorkerManager, WorkerState
+from .worker import (FetchSpec, ShuffleOutSpec, ShuffleResult, StageTask,
+                     WorkerManager, WorkerState)
 
 
 class Scheduler:
@@ -55,10 +56,14 @@ class LeastLoadedScheduler(Scheduler):
 
 class StageRunner:
     """Drives a StagePlan: dispatches each stage's tasks through the
-    scheduler, executes exchange boundaries on the driver, feeds results
-    downstream. Failed tasks are retried once on a different worker
-    (reference: per-task retry semantics delegated to Ray in the original;
-    here the runner owns them)."""
+    scheduler, feeds results downstream. Hash boundaries whose consumer
+    fragment is partition-local execute through the SHUFFLE SERVICE — map
+    tasks spill hash-partitioned output into their worker's cache, reduce
+    tasks fan out one-per-partition and fetch their slice from every map
+    worker (the reference's flight-shuffle map/serve/fetch pipeline);
+    every other boundary materializes through the driver. Failed tasks
+    retry once on a different worker. ``DAFT_TPU_DISTRIBUTED_SHUFFLE=
+    driver`` forces the materializing path."""
 
     def __init__(self, manager: WorkerManager,
                  scheduler: Optional[Scheduler] = None, max_retries: int = 1):
@@ -67,19 +72,87 @@ class StageRunner:
         self.max_retries = max_retries
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _shuffle_enabled() -> bool:
+        import os
+        return os.environ.get("DAFT_TPU_DISTRIBUTED_SHUFFLE",
+                              "flight") != "driver"
+
     def run(self, stage_plan: StagePlan) -> Iterator[MicroPartition]:
-        outputs: Dict[int, List[MicroPartition]] = {}
+        consumer: Dict[int, tuple] = {}
+        for s in stage_plan.stages:
+            for b in s.boundaries:
+                consumer[b.upstream] = (s, b)
+        outputs: Dict[int, list] = {}
+        shuffled: Dict[int, bool] = {}
+        use_shuffle = self._shuffle_enabled()
         for stage in stage_plan.stages:
-            stage_inputs: Dict[int, List[MicroPartition]] = {}
+            # this stage's output mode: shuffle out when its consumer can
+            # fan out over the hash partitions
+            shuffle_out = None
+            cons = consumer.get(stage.id)
+            if use_shuffle and cons is not None:
+                cstage, b = cons
+                if b.num_partitions > 1 and b.kind == "hash" \
+                        and all(ob.kind in ("hash", "gather")
+                                for ob in cstage.boundaries) \
+                        and (stage_plan.fanout_safe(cstage, b)
+                             or stage_plan.split_for_fanout(cstage, b)
+                             is not None):
+                    shuffle_out = ShuffleOutSpec(b.num_partitions,
+                                                 tuple(b.by))
+            fetch_srcs: Dict[int, list] = {}
+            fetch_n: Dict[int, int] = {}
+            mat_inputs: Dict[int, List[MicroPartition]] = {}
+            first_shuffled: Optional[Boundary] = None
             for b in stage.boundaries:
-                stage_inputs[b.upstream] = self._apply_exchange(
-                    b, outputs.pop(b.upstream))
-            outputs[stage.id] = self._run_stage(stage, stage_inputs)
+                up_out = outputs.pop(b.upstream)
+                if shuffled.get(b.upstream):
+                    fetch_srcs[b.upstream] = [(r.address, r.shuffle_id)
+                                              for r in up_out]
+                    fetch_n[b.upstream] = b.num_partitions
+                    first_shuffled = first_shuffled or b
+                else:
+                    mat_inputs[b.upstream] = self._apply_exchange(b, up_out)
+            if fetch_srcs:
+                if len(set(fetch_n.values())) > 1:
+                    # boundaries disagree on partition count — no shared
+                    # fan-out exists; materialize driver-side instead
+                    for up, srcs in fetch_srcs.items():
+                        mat_inputs[up] = self._driver_fetch(srcs,
+                                                            fetch_n[up])
+                    outputs[stage.id] = self._run_stage(stage, mat_inputs,
+                                                        shuffle_out)
+                else:
+                    outputs[stage.id] = self._run_shuffled_stage(
+                        stage_plan, stage, fetch_srcs, mat_inputs,
+                        next(iter(fetch_n.values())), first_shuffled,
+                        shuffle_out)
+                self._cleanup_shuffles(fetch_srcs)
+            else:
+                outputs[stage.id] = self._run_stage(stage, mat_inputs,
+                                                    shuffle_out)
+            shuffled[stage.id] = shuffle_out is not None
         yield from outputs[stage_plan.root.id]
+
+    def _cleanup_shuffles(self, fetch_srcs: Dict[int, list]) -> None:
+        """Best-effort release of consumed map outputs: every worker is
+        asked to unregister every consumed shuffle id (unknown ids no-op,
+        so ownership needn't be tracked); remote workers relay to their
+        own host's server."""
+        ids = [shuffle_id for srcs in fetch_srcs.values()
+               for _, shuffle_id in srcs]
+        for st in self.manager.snapshot():
+            for sid in ids:
+                try:
+                    st.worker.unregister_shuffle(sid)
+                except Exception:
+                    pass
 
     # ------------------------------------------------------------------
     def _make_tasks(self, stage: Stage,
-                    stage_inputs: Dict[int, List[MicroPartition]]
+                    stage_inputs: Dict[int, List[MicroPartition]],
+                    shuffle_out: Optional[ShuffleOutSpec] = None
                     ) -> List[StageTask]:
         """Shard a map-like scan stage across workers (contiguous chunks —
         preserves partition order); everything else is one task."""
@@ -95,29 +168,90 @@ class StageRunner:
                 if not chunk:
                     continue
                 tasks.append(StageTask(stage.id, stage.with_scan_tasks(chunk),
-                                       stage_inputs, task_idx=i))
+                                       stage_inputs, task_idx=i,
+                                       shuffle_out=shuffle_out))
             return tasks
-        return [StageTask(stage.id, stage.plan, stage_inputs)]
+        return [StageTask(stage.id, stage.plan, stage_inputs,
+                          shuffle_out=shuffle_out)]
 
     def _run_stage(self, stage: Stage,
-                   stage_inputs: Dict[int, List[MicroPartition]]
-                   ) -> List[MicroPartition]:
-        tasks = self._make_tasks(stage, stage_inputs)
+                   stage_inputs: Dict[int, List[MicroPartition]],
+                   shuffle_out: Optional[ShuffleOutSpec] = None) -> list:
+        tasks = self._make_tasks(stage, stage_inputs, shuffle_out)
+        return self._collect(tasks)
+
+    def _run_shuffled_stage(self, stage_plan: StagePlan, stage: Stage,
+                            fetch_srcs: Dict[int, list],
+                            mat_inputs: Dict[int, List[MicroPartition]],
+                            n: int, b: Boundary,
+                            shuffle_out: Optional[ShuffleOutSpec]) -> list:
+        """Stage with shuffle-backed inputs: fan the whole fragment out
+        when it is partition-local; otherwise fan out its safe frontier
+        (e.g. the merge-agg under a Sort) and run the global remainder as
+        one task; if neither applies, fetch partitions onto the driver."""
+        if stage_plan.fanout_safe(stage, b) and all(
+                stage_plan.fanout_safe(stage, ob)
+                for ob in stage.boundaries if ob.upstream in fetch_srcs):
+            return self._run_reduce_fanout(stage, fetch_srcs, mat_inputs,
+                                           n, shuffle_out)
+        split = stage_plan.split_for_fanout(stage, b)
+        if split is not None:
+            sub, remainder, pid = split
+            if all(StagePlan._contains_input(sub, up)
+                   for up in fetch_srcs):
+                sub_stage = Stage(stage.id, sub, [])
+                parts = self._run_reduce_fanout(sub_stage, fetch_srcs,
+                                                mat_inputs, n, None)
+                rest = Stage(stage.id, remainder, [])
+                bindings: Dict[int, object] = {pid: parts}
+                bindings.update(mat_inputs)
+                return self._run_stage(rest, bindings, shuffle_out)
+        # defensive fallback: materialize the shuffled inputs driver-side
+        for up, srcs in fetch_srcs.items():
+            mat_inputs[up] = self._driver_fetch(srcs, n)
+        return self._run_stage(stage, mat_inputs, shuffle_out)
+
+    @staticmethod
+    def _driver_fetch(srcs: list, n: int) -> List[MicroPartition]:
+        from .worker import resolve_stage_inputs
+        out: List[MicroPartition] = []
+        for i in range(n):
+            out.extend(resolve_stage_inputs({0: FetchSpec(srcs, i)})[0])
+        return out
+
+    def _run_reduce_fanout(self, stage: Stage, fetch_srcs: Dict[int, list],
+                           mat_inputs: Dict[int, List[MicroPartition]],
+                           n: int, shuffle_out: Optional[ShuffleOutSpec]
+                           ) -> list:
+        """One reduce task per hash partition: task i binds each shuffled
+        input to FetchSpec(partition=i); driver-materialized bindings
+        (broadcast/gather sides) replicate to every task."""
+        tasks = []
+        for i in range(n):
+            si: Dict[int, object] = {up: FetchSpec(srcs, i)
+                                     for up, srcs in fetch_srcs.items()}
+            si.update(mat_inputs)
+            tasks.append(StageTask(stage.id, stage.plan, si, task_idx=i,
+                                   shuffle_out=shuffle_out))
+        return self._collect(tasks)
+
+    def _collect(self, tasks: List[StageTask]) -> list:
         futures = []
         for t in tasks:
             wid = self.scheduler.pick(t, self.manager.snapshot())
             futures.append((t, wid, self.manager.dispatch(t, wid)))
-        parts: List[MicroPartition] = []
+        out: list = []
         for t, wid, fut in futures:
             try:
-                parts.extend(fut.result())
+                res = fut.result()
             except Exception:
                 if self.max_retries < 1:
                     raise
-                parts.extend(self._retry(t, exclude=wid))
-        return parts
+                res = self._retry(t, exclude=wid)
+            out.extend(res if isinstance(res, list) else [res])
+        return out
 
-    def _retry(self, task: StageTask, exclude: str) -> List[MicroPartition]:
+    def _retry(self, task: StageTask, exclude: str):
         states = [s for s in self.manager.snapshot()
                   if s.worker.id != exclude] or self.manager.snapshot()
         wid = self.scheduler.pick(task, states)

@@ -258,6 +258,20 @@ def make_shuffle_server(port: int = 0, host: Optional[str] = None):
     return ShuffleServer(port, host=host)
 
 
+_local_server = None
+_local_server_lock = threading.Lock()
+
+
+def get_local_shuffle_server():
+    """One lazily-started shuffle server per process (each worker host runs
+    one, like the reference's per-node flight server)."""
+    global _local_server
+    with _local_server_lock:
+        if _local_server is None:
+            _local_server = make_shuffle_server()
+        return _local_server
+
+
 def _spill_streams(body: bytes):
     """Yield (schema, batch-list) per concatenated IPC stream in a spill
     file (one stream per writer reopen). A truncated trailing stream — a

@@ -125,6 +125,99 @@ class StagePlan:
         stages.append(Stage(sid, root_plan, root_boundaries))
         return cls(stages)
 
+    @staticmethod
+    def _contains_input(node, upstream: int) -> bool:
+        if isinstance(node, pp.StageInput):
+            return node.stage_id == upstream
+        return any(StagePlan._contains_input(c, upstream)
+                   for c in node.children)
+
+    @staticmethod
+    def _subtree_safe(node, b: Boundary) -> bool:
+        """True when ``node``'s subtree consumes the boundary's StageInput
+        only through partition-local operators — rows sharing the exchange
+        keys never need to meet rows from other partitions. Global
+        operators (sort, limit, monotonic ids, windows) disqualify."""
+        by_names = {e.name() for e in b.by}
+
+        def walk(n) -> tuple:
+            """→ (subtree references this boundary, safe so far)."""
+            if isinstance(n, pp.StageInput):
+                return n.stage_id == b.upstream, True
+            has_any = False
+            for c in n.children:
+                has, safe = walk(c)
+                if has and not safe:
+                    return True, False
+                has_any = has_any or has
+            if not has_any:
+                return False, True
+            if isinstance(n, (pp.Project, pp.Filter, pp.UDFProject,
+                              pp.Explode, pp.Unpivot)):
+                return True, True
+            if isinstance(n, pp.HashJoin):
+                # hash strategy: both sides are engine-inserted hash
+                # boundaries on the join keys (co-partitioned); broadcast:
+                # the build side is a replicated gather boundary and the
+                # probe is row-local. sort_merge inserts NO exchanges —
+                # fanning it out would re-run the embedded side per task
+                # and duplicate outer-side unmatched rows.
+                return True, n.strategy != "sort_merge"
+            if isinstance(n, pp.Aggregate):
+                group_names = {e.name() for e in n.group_by}
+                return True, by_names <= group_names
+            if isinstance(n, pp.Dedup):
+                on_names = {e.name() for e in (n.on or [])} \
+                    if n.on else None
+                return True, on_names is None or by_names <= on_names
+            return True, False
+
+        has, safe = walk(node)
+        return has and safe
+
+    def fanout_safe(self, stage: Stage, b: Boundary) -> bool:
+        """The whole consumer fragment can run one task per hash
+        partition."""
+        if b.kind != "hash" or not b.by:
+            return False
+        return self._subtree_safe(stage.plan, b)
+
+    def split_for_fanout(self, stage: Stage, b: Boundary):
+        """Cut the consumer fragment at its SAFE FRONTIER: the highest node
+        on the StageInput's path whose subtree is partition-local. →
+        (sub_plan to fan out per partition, remainder plan reading the
+        fan-out's output through StageInput(placeholder_id),
+        placeholder_id) or None when no useful split exists (reference:
+        flotilla keeps per-partition pipeline nodes below the global op
+        and materializes between — the same seam)."""
+        if b.kind != "hash" or not b.by:
+            return None
+
+        def descend(n):
+            if self._subtree_safe(n, b):
+                return n
+            kids = [c for c in n.children
+                    if self._contains_input(c, b.upstream)]
+            if len(kids) != 1:
+                return None
+            return descend(kids[0])
+
+        cut = descend(stage.plan)
+        if cut is None or cut is stage.plan \
+                or isinstance(cut, pp.StageInput):
+            return None  # whole-stage fanout, nothing local, or no split
+        placeholder_id = -(stage.id + 1) * 1000 - b.upstream
+        placeholder = pp.StageInput(placeholder_id, cut.schema())
+
+        def clone(n):
+            if n is cut:
+                return placeholder
+            c = copy.copy(n)
+            c.children = [clone(ch) for ch in n.children]
+            return c
+
+        return cut, clone(stage.plan), placeholder_id
+
     def repr_ascii(self) -> str:
         lines = []
         for s in self.stages:

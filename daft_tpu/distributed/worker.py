@@ -20,16 +20,99 @@ from ..physical import plan as pp
 
 
 @dataclass
+class ShuffleOutSpec:
+    """Map-side instruction: hash-partition this task's output into the
+    worker-local shuffle cache instead of returning rows."""
+
+    num_partitions: int
+    by: tuple  # key Expressions
+
+
+@dataclass
+class ShuffleResult:
+    """Map-side receipt: where a task's shuffled output is served from
+    (flotilla: the shuffle cache registration a reduce task fetches by)."""
+
+    address: str
+    shuffle_id: str
+    num_partitions: int
+    rows: int
+
+
+@dataclass
+class FetchSpec:
+    """Reduce-side stage input: pull partition ``partition`` from every
+    listed (address, shuffle_id) map output and concat."""
+
+    sources: List  # [(address, shuffle_id)]
+    partition: int
+
+
+@dataclass
 class StageTask:
     """One dispatchable unit: an exchange-free plan fragment plus its
     stage-input bindings (flotilla's SwordfishTask shape,
-    ``scheduling/task.rs:80``)."""
+    ``scheduling/task.rs:80``). ``stage_inputs`` values are either
+    materialized partition lists or a ``FetchSpec`` the worker resolves
+    through the shuffle service."""
 
     stage_id: int
     plan: pp.PhysicalPlan
-    stage_inputs: Dict[int, List[MicroPartition]]
+    stage_inputs: Dict[int, object]
     task_idx: int = 0
     preferred_worker: Optional[str] = None
+    shuffle_out: Optional[ShuffleOutSpec] = None
+
+
+def resolve_stage_inputs(stage_inputs: Dict[int, object]
+                         ) -> Dict[int, List[MicroPartition]]:
+    """Materialize any FetchSpec bindings via the shuffle service."""
+    from ..recordbatch import RecordBatch
+    from .shuffle_service import fetch_partition
+    out: Dict[int, List[MicroPartition]] = {}
+    for sid, binding in stage_inputs.items():
+        if isinstance(binding, FetchSpec):
+            tables = []
+            for address, shuffle_id in binding.sources:
+                t = fetch_partition(address, shuffle_id, binding.partition)
+                if t is not None and t.num_rows:
+                    tables.append(t)
+            if tables:
+                import pyarrow as pa
+                merged = pa.concat_tables(tables)
+                out[sid] = [MicroPartition.from_recordbatch(
+                    RecordBatch.from_arrow_table(merged))]
+            else:
+                out[sid] = []
+        else:
+            out[sid] = binding
+    return out
+
+
+def run_task(task: StageTask) -> object:
+    """Execute one stage task on the local streaming executor. Returns a
+    partition list, or a ShuffleResult when the task shuffles out."""
+    from ..execution.executor import LocalExecutor
+    ex = LocalExecutor()
+    inputs = resolve_stage_inputs(task.stage_inputs)
+    stream = ex.run(task.plan, stage_inputs=inputs)
+    if task.shuffle_out is None:
+        return list(stream)
+    from .shuffle_service import ShuffleCache, get_local_shuffle_server
+    spec = task.shuffle_out
+    by = list(spec.by)
+    cache = ShuffleCache()
+    rows = 0
+    for mp in stream:
+        rows += len(mp)
+        for i, piece in enumerate(
+                mp.partition_by_hash(by, spec.num_partitions)):
+            if len(piece):
+                cache.push(i, piece.combined().to_arrow_table())
+    server = get_local_shuffle_server()
+    server.register(cache)
+    return ShuffleResult(server.address, cache.shuffle_id,
+                         spec.num_partitions, rows)
 
 
 class Worker:
@@ -38,8 +121,16 @@ class Worker:
     id: str
     num_slots: int
 
-    def submit(self, task: StageTask) -> "cf.Future[List[MicroPartition]]":
+    def submit(self, task: StageTask) -> "cf.Future":
         raise NotImplementedError
+
+    def unregister_shuffle(self, shuffle_id: str) -> None:
+        # only touch an ALREADY-RUNNING local server — never boot one
+        # just to clean up (remote workers override to relay the call)
+        from . import shuffle_service
+        server = shuffle_service._local_server
+        if server is not None:
+            server.unregister(shuffle_id)
 
     def shutdown(self) -> None:
         pass
@@ -55,14 +146,8 @@ class InProcessWorker(Worker):
         self._pool = cf.ThreadPoolExecutor(
             max_workers=num_slots, thread_name_prefix=f"daft-tpu-{worker_id}")
 
-    def submit(self, task: StageTask) -> "cf.Future[List[MicroPartition]]":
-        return self._pool.submit(self._run, task)
-
-    @staticmethod
-    def _run(task: StageTask) -> List[MicroPartition]:
-        from ..execution.executor import LocalExecutor
-        ex = LocalExecutor()
-        return list(ex.run(task.plan, stage_inputs=task.stage_inputs))
+    def submit(self, task: StageTask) -> "cf.Future":
+        return self._pool.submit(run_task, task)
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)

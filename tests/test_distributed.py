@@ -228,3 +228,123 @@ def test_remote_worker_runs_stage_over_http():
         assert got == dict(zip(local["k"], local["s"]))
     finally:
         srv.shutdown()
+
+
+def test_flight_shuffle_backed_boundaries(monkeypatch):
+    """Hash boundaries route through the shuffle service: map tasks return
+    ShuffleResults, reduce tasks fan out per partition — and the answers
+    match the driver-materializing mode exactly."""
+    import numpy as np
+    from daft_tpu.distributed import StagePlan, StageRunner, WorkerManager
+    from daft_tpu.distributed.worker import InProcessWorker, ShuffleResult
+    from daft_tpu.physical.translate import translate
+
+    # host exchange path: with the device tier on, this groupby would ride
+    # the mesh-collective DeviceExchangeAgg instead of a hash Exchange
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+    rng = np.random.default_rng(11)
+    df = (daft_tpu.from_pydict({"k": rng.integers(0, 9, 3000).tolist(),
+                                "v": [float(i) for i in range(3000)]})
+          .into_partitions(4)
+          .groupby("k").agg(col("v").sum().alias("s")).sort("k"))
+    sp = StagePlan.from_physical(translate(df._builder.optimize().plan))
+
+    shuffle_results = []
+    orig_collect = StageRunner._collect
+
+    def spy_collect(self, tasks):
+        out = orig_collect(self, tasks)
+        shuffle_results.extend(r for r in out
+                               if isinstance(r, ShuffleResult))
+        return out
+
+    monkeypatch.setattr(StageRunner, "_collect", spy_collect)
+
+    def run_mode(mode):
+        monkeypatch.setenv("DAFT_TPU_DISTRIBUTED_SHUFFLE", mode)
+        mgr = WorkerManager([InProcessWorker(f"w{i}") for i in range(3)])
+        runner = StageRunner(mgr)
+        rows = {}
+        for p in runner.run(sp):
+            d = p.to_pydict()
+            for k, s in zip(d.get("k", []), d.get("s", [])):
+                rows[k] = s
+        return rows
+
+    flight = run_mode("flight")
+    assert shuffle_results, "no map task produced a ShuffleResult"
+    driver = run_mode("driver")
+    assert flight == driver and len(flight) == 9
+
+
+def test_fanout_guard_keeps_global_ops_correct(monkeypatch):
+    """A Limit above a user hash-repartition must NOT fan out per
+    partition (it would multiply the limit); the fanout_safe guard keeps
+    it on the driver path and the row count stays exact."""
+    monkeypatch.setenv("DAFT_TPU_DISTRIBUTED_SHUFFLE", "flight")
+    runner = DistributedRunner(num_workers=3)
+    import daft_tpu.context as ctx
+    old = ctx.get_context()._runner
+    ctx.get_context().set_runner(runner)
+    try:
+        df = daft_tpu.from_pydict({"k": list(range(100))}) \
+            .repartition(4, col("k")).limit(5)
+        out = df.to_pydict()
+    finally:
+        ctx.get_context().set_runner(old)
+    assert len(out["k"]) == 5
+
+
+def test_remote_worker_shuffles_over_flight(monkeypatch):
+    """Map-side shuffle on a REMOTE worker: the reduce fetch crosses the
+    process boundary through the worker's shuffle server."""
+    import numpy as np
+    from daft_tpu.distributed.remote_worker import RemoteWorker, WorkerServer
+    from daft_tpu.distributed import (LeastLoadedScheduler, StagePlan,
+                                      StageRunner, WorkerManager)
+    from daft_tpu.physical.translate import translate
+
+    monkeypatch.setenv("DAFT_TPU_DISTRIBUTED_SHUFFLE", "flight")
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")  # host hash exchange
+    srv = WorkerServer()
+    try:
+        df = (daft_tpu.from_pydict({"k": [i % 5 for i in range(800)],
+                                    "v": [float(i) for i in range(800)]})
+              .into_partitions(3)
+              .groupby("k").agg(col("v").sum().alias("s")).sort("k"))
+        local = df.to_pydict()
+        sp = StagePlan.from_physical(translate(df._builder.optimize().plan))
+        mgr = WorkerManager([RemoteWorker("remote-0", srv.address)])
+        runner = StageRunner(mgr, LeastLoadedScheduler())
+        got = {}
+        for p in runner.run(sp):
+            d = p.to_pydict()
+            for k, s in zip(d.get("k", []), d.get("s", [])):
+                got[k] = s
+        assert got == dict(zip(local["k"], local["s"]))
+    finally:
+        srv.shutdown()
+
+
+def test_sort_merge_join_not_fanned_out(monkeypatch):
+    """Regression: a sort_merge-strategy join has NO co-partitioning
+    exchanges, so fanning its stage out per hash partition would re-run
+    the embedded side per task and duplicate outer unmatched rows — the
+    safety rule must route it through the driver."""
+    monkeypatch.setenv("DAFT_TPU_DISTRIBUTED_SHUFFLE", "flight")
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+    left = daft_tpu.from_pydict({"k": [1, 2, 3], "lv": [10, 20, 30]})
+    right = daft_tpu.from_pydict({"k": [2, 9], "rv": ["b", "z"]})
+    runner = DistributedRunner(num_workers=3)
+    import daft_tpu.context as ctx
+    old = ctx.get_context()._runner
+    ctx.get_context().set_runner(runner)
+    try:
+        out = left.repartition(2, col("k")) \
+            .join(right, on="k", how="outer",
+                  strategy="sort_merge").to_pydict()
+    finally:
+        ctx.get_context().set_runner(old)
+    # exactly one row for right's unmatched k=9, not one per partition
+    assert sum(1 for k in out["k"] if k == 9) == 1
+    assert len(out["k"]) == 4  # 1,2,3 plus unmatched 9
