@@ -65,17 +65,57 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
         "s_company_name": ["company%d" % (i % 3) for i in range(n_stores)],
     })
 
+    n_custs = max(int(2000 * scale), 100)
+    n_cd = 200  # demographic combinations
+    customer = pa.table({
+        "c_customer_sk": np.arange(1, n_custs + 1),
+        "c_current_cdemo_sk": rng.integers(1, n_cd + 1, n_custs),
+        "c_current_addr_sk": np.arange(1, n_custs + 1),
+        "c_first_name": ["first%d" % i for i in range(n_custs)],
+        "c_last_name": ["last%d" % i for i in range(n_custs)],
+        "c_birth_year": rng.integers(1930, 2005, n_custs),
+    })
+    customer_address = pa.table({
+        "ca_address_sk": np.arange(1, n_custs + 1),
+        "ca_city": rng.choice(["rivertown", "lakeside", "hilltop",
+                               "meadow", "brookfield"], n_custs),
+        "ca_state": rng.choice(["CA", "NY", "TX", "WA", "OR"], n_custs),
+        "ca_zip": ["%05d" % z for z in rng.integers(10000, 99999, n_custs)],
+    })
+    customer_demographics = pa.table({
+        "cd_demo_sk": np.arange(1, n_cd + 1),
+        "cd_gender": rng.choice(["M", "F"], n_cd),
+        "cd_marital_status": rng.choice(["S", "M", "D", "W"], n_cd),
+        "cd_education_status": rng.choice(
+            ["Primary", "Secondary", "College", "Advanced Degree"], n_cd),
+    })
+    n_promos = 30
+    promotion = pa.table({
+        "p_promo_sk": np.arange(1, n_promos + 1),
+        "p_channel_email": rng.choice(["Y", "N"], n_promos),
+        "p_channel_event": rng.choice(["Y", "N"], n_promos),
+    })
+
     store_sales = pa.table({
         "ss_sold_date_sk": rng.integers(1, n_days + 1, n_sales),
         "ss_item_sk": rng.integers(1, n_items + 1, n_sales),
         "ss_store_sk": rng.integers(1, n_stores + 1, n_sales),
+        "ss_customer_sk": rng.integers(1, n_custs + 1, n_sales),
+        "ss_cdemo_sk": rng.integers(1, n_cd + 1, n_sales),
+        "ss_promo_sk": rng.integers(1, n_promos + 1, n_sales),
         "ss_sales_price": rng.uniform(1, 300, n_sales).round(2),
         "ss_quantity": rng.integers(1, 100, n_sales),
+        "ss_list_price": rng.uniform(1, 300, n_sales).round(2),
+        "ss_coupon_amt": rng.uniform(0, 50, n_sales).round(2),
         "ss_ext_sales_price": rng.uniform(1, 3000, n_sales).round(2),
     })
 
     for name, t in (("date_dim", date_dim), ("item", item),
-                    ("store", store), ("store_sales", store_sales)):
+                    ("store", store), ("store_sales", store_sales),
+                    ("customer", customer),
+                    ("customer_address", customer_address),
+                    ("customer_demographics", customer_demographics),
+                    ("promotion", promotion)):
         d = os.path.join(root, name)
         os.makedirs(d, exist_ok=True)
         pq.write_table(t, os.path.join(d, "part-0.parquet"))

@@ -55,6 +55,32 @@ def test_q98_revenue_ratio_sums_to_100_per_class(tpcds):
         assert v == pytest.approx(100.0, rel=1e-6)
 
 
+def test_q7_vs_pandas(tpcds):
+    got = Q.run(7, tpcds).to_pandas()
+    ss = tpcds("store_sales").to_pandas()
+    it = tpcds("item").to_pandas()
+    dd = tpcds("date_dim").to_pandas()
+    cd = tpcds("customer_demographics").to_pandas()
+    pr = tpcds("promotion").to_pandas()
+    j = (ss.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+         .merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+         .merge(pr, left_on="ss_promo_sk", right_on="p_promo_sk"))
+    j = j[(j.cd_gender == "M") & (j.cd_marital_status == "S")
+          & (j.cd_education_status == "College")
+          & ((j.p_channel_email == "N") | (j.p_channel_event == "N"))
+          & (j.d_year == 2000)]
+    exp = (j.groupby("i_item_id", as_index=False)
+           .agg(agg1=("ss_quantity", "mean"),
+                agg4=("ss_sales_price", "mean"))
+           .sort_values("i_item_id").head(100))
+    assert list(got.i_item_id) == list(exp.i_item_id)
+    for a, b in zip(got.agg1, exp.agg1):
+        assert a == pytest.approx(b, rel=1e-9)
+    for a, b in zip(got.agg4, exp.agg4):
+        assert a == pytest.approx(b, rel=1e-9)
+
+
 def test_q63_vs_pandas(tpcds):
     got = Q.run(63, tpcds).to_pandas()
     ss = tpcds("store_sales").to_pandas()
