@@ -64,9 +64,16 @@ class WorkerServer:
 
     def __init__(self, port: int = 0, num_slots: int = 2,
                  host: str = "127.0.0.1", advertise_host: str = ""):
+        import os
         self.num_slots = num_slots
         self._advertise = advertise_host or (
             "127.0.0.1" if host == "0.0.0.0" else host)
+        # the worker's shuffle server must be reachable by the same route
+        # as the worker itself — reduce tasks on OTHER hosts fetch from it
+        if host != "127.0.0.1":
+            os.environ.setdefault("DAFT_TPU_SHUFFLE_HOST", host)
+            os.environ.setdefault("DAFT_TPU_SHUFFLE_ADVERTISE",
+                                  self._advertise)
         pool = cf.ThreadPoolExecutor(max_workers=num_slots)
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -76,15 +83,6 @@ class WorkerServer:
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
                 blob = self.rfile.read(n)
-                if self.path.startswith("/unregister/"):
-                    from . import shuffle_service
-                    server = shuffle_service._local_server
-                    if server is not None:
-                        server.unregister(self.path.rsplit("/", 1)[-1])
-                    self.send_response(200)
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
-                    return
                 try:
                     task_plan, inputs_wire, shuffle_out = pickle.loads(blob)
                     # cloudpickle-serialized closures need cloudpickle's
@@ -171,13 +169,6 @@ class RemoteWorker(Worker):
         if kind == "shuffle":
             return payload
         return _parts_from_ipc(payload)
-
-    def unregister_shuffle(self, shuffle_id: str) -> None:
-        req = urllib.request.Request(
-            f"{self.address}/unregister/{shuffle_id}", data=b"",
-            method="POST")
-        with urllib.request.urlopen(req, timeout=30):
-            pass
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)

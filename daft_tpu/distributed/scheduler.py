@@ -136,16 +136,14 @@ class StageRunner:
         yield from outputs[stage_plan.root.id]
 
     def _cleanup_shuffles(self, fetch_srcs: Dict[int, list]) -> None:
-        """Best-effort release of consumed map outputs: every worker is
-        asked to unregister every consumed shuffle id (unknown ids no-op,
-        so ownership needn't be tracked); remote workers relay to their
-        own host's server."""
-        ids = [shuffle_id for srcs in fetch_srcs.values()
-               for _, shuffle_id in srcs]
-        for st in self.manager.snapshot():
-            for sid in ids:
+        """Best-effort release of consumed map outputs, addressed straight
+        to each serving host through the shuffle transport (the address is
+        part of the map receipt — one call per shuffle id)."""
+        from .shuffle_service import unregister_remote
+        for srcs in fetch_srcs.values():
+            for address, shuffle_id in srcs:
                 try:
-                    st.worker.unregister_shuffle(sid)
+                    unregister_remote(address, shuffle_id)
                 except Exception:
                     pass
 
@@ -189,12 +187,21 @@ class StageRunner:
         when it is partition-local; otherwise fan out its safe frontier
         (e.g. the merge-agg under a Sort) and run the global remainder as
         one task; if neither applies, fetch partitions onto the driver."""
-        if stage_plan.fanout_safe(stage, b) and all(
+        # replicating a driver-materialized input to every reduce task is
+        # only sound for GATHER boundaries (broadcast-by-design, join-type
+        # gated at translate time). A materialized hash/range/split input
+        # replicated beside a partitioned side would duplicate non-inner
+        # join results — fall back to the driver for the whole stage.
+        replication_ok = all(
+            ob.kind == "gather" for ob in stage.boundaries
+            if ob.upstream in mat_inputs)
+        if replication_ok and stage_plan.fanout_safe(stage, b) and all(
                 stage_plan.fanout_safe(stage, ob)
                 for ob in stage.boundaries if ob.upstream in fetch_srcs):
             return self._run_reduce_fanout(stage, fetch_srcs, mat_inputs,
                                            n, shuffle_out)
-        split = stage_plan.split_for_fanout(stage, b)
+        split = stage_plan.split_for_fanout(stage, b) if replication_ok \
+            else None
         if split is not None:
             sub, remainder, pid = split
             if all(StagePlan._contains_input(sub, up)

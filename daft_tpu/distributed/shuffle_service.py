@@ -122,8 +122,9 @@ class ShuffleServer:
                  advertise_host: Optional[str] = None):
         self._host = host or os.environ.get("DAFT_TPU_SHUFFLE_HOST",
                                             "127.0.0.1")
-        self._advertise = advertise_host or (
-            "127.0.0.1" if self._host == "0.0.0.0" else self._host)
+        self._advertise = advertise_host \
+            or os.environ.get("DAFT_TPU_SHUFFLE_ADVERTISE") \
+            or ("127.0.0.1" if self._host == "0.0.0.0" else self._host)
         self._caches: Dict[str, ShuffleCache] = {}
         self._lock = threading.Lock()
         caches = self._caches
@@ -132,6 +133,18 @@ class ShuffleServer:
         class Handler(http.server.BaseHTTPRequestHandler):
             def log_message(self, *a):
                 pass
+
+            def do_DELETE(self):
+                # reduce-side release of a consumed map output
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 2 and parts[0] == "shuffle":
+                    with lock:
+                        cache = caches.pop(parts[1], None)
+                    if cache is not None:
+                        cache.cleanup()
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
 
             def do_GET(self):
                 parts = self.path.strip("/").split("/")
@@ -193,13 +206,21 @@ class FlightShuffleServer:
                                "use ShuffleServer (HTTP)")
         self._host = host or os.environ.get("DAFT_TPU_SHUFFLE_HOST",
                                             "127.0.0.1")
-        self._advertise = advertise_host or (
-            "127.0.0.1" if self._host == "0.0.0.0" else self._host)
+        self._advertise = advertise_host \
+            or os.environ.get("DAFT_TPU_SHUFFLE_ADVERTISE") \
+            or ("127.0.0.1" if self._host == "0.0.0.0" else self._host)
         self._caches: Dict[str, ShuffleCache] = {}
         self._lock = threading.Lock()
         outer = self
 
         class _Server(paflight.FlightServerBase):
+            def do_action(self, context, action):
+                if action.type == "unregister":
+                    outer.unregister(action.body.to_pybytes().decode())
+                    return iter(())
+                raise paflight.FlightServerError(
+                    f"unknown action {action.type!r}")
+
             def do_get(self, context, ticket):
                 sid, _, pidx = ticket.ticket.decode().partition("/")
                 with outer._lock:
@@ -325,6 +346,25 @@ def _spill_file_batches(path: str):
                     _log_truncated_tail(start, size)
                     return
                 yield schema, batch
+
+
+def unregister_remote(address: str, shuffle_id: str) -> None:
+    """Release a consumed map output on its serving host (reduce-side
+    cleanup; dispatches on the address scheme like fetch_partition)."""
+    if address.startswith("grpc://"):
+        if paflight is None:
+            return
+        client = paflight.connect(address)
+        try:
+            list(client.do_action(
+                paflight.Action("unregister", shuffle_id.encode())))
+        finally:
+            client.close()
+        return
+    req = urllib.request.Request(f"{address}/shuffle/{shuffle_id}",
+                                 method="DELETE")
+    with urllib.request.urlopen(req, timeout=30):
+        pass
 
 
 def fetch_partition(address: str, shuffle_id: str, partition: int

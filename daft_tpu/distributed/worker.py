@@ -124,14 +124,6 @@ class Worker:
     def submit(self, task: StageTask) -> "cf.Future":
         raise NotImplementedError
 
-    def unregister_shuffle(self, shuffle_id: str) -> None:
-        # only touch an ALREADY-RUNNING local server — never boot one
-        # just to clean up (remote workers override to relay the call)
-        from . import shuffle_service
-        server = shuffle_service._local_server
-        if server is not None:
-            server.unregister(shuffle_id)
-
     def shutdown(self) -> None:
         pass
 

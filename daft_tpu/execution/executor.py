@@ -89,11 +89,15 @@ class LocalExecutor:
         from .. import observability as obs
         self.stats = obs.new_query_stats()
         self.stats.plan = plan  # for explain_analyze rendering
+        xdir = obs.xplane_trace_dir()
 
         def gen():
+            xtrace = obs._XplaneTrace(xdir) if xdir else None
             try:
                 yield from obs.wrap_progress(self._exec(plan))
             finally:
+                if xtrace is not None:
+                    xtrace.stop()
                 self.stats.finish()
                 obs.set_last_stats(self.stats)
                 path = obs.chrome_trace_path()

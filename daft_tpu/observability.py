@@ -189,6 +189,55 @@ _last_stats: Optional[RuntimeStatsContext] = None
 _last_lock = threading.Lock()
 
 
+def xplane_trace_dir() -> Optional[str]:
+    """``DAFT_TPU_XPLANE_DIR=<dir>`` captures a jax profiler (xplane/
+    TensorBoard) trace per query — the TPU-native analogue of the
+    reference's chrome-trace layer (``src/common/tracing``): device kernel
+    timelines, HBM transfers and XLA compilation spans land in
+    ``<dir>/plugins/profile``."""
+    return os.environ.get("DAFT_TPU_XPLANE_DIR") or None
+
+
+_xplane_lock = threading.Lock()
+_xplane_owner: Optional[object] = None
+
+
+class _XplaneTrace:
+    """Per-query jax profiler session. The jax profiler is process-global,
+    so only the OUTERMOST executor owns the capture — nested/concurrent
+    executors (exchanges, worker tasks) no-op instead of truncating the
+    query-level trace. Never takes the query down on failure."""
+
+    def __init__(self, out_dir: str):
+        global _xplane_owner
+        self._active = False
+        with _xplane_lock:
+            if _xplane_owner is not None:
+                return  # someone else is tracing this process
+            _xplane_owner = self
+        try:
+            import jax
+            jax.profiler.start_trace(out_dir)
+            self._active = True
+        except Exception:
+            with _xplane_lock:
+                _xplane_owner = None
+
+    def stop(self) -> None:
+        global _xplane_owner
+        if not self._active:
+            return
+        self._active = False
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        with _xplane_lock:
+            if _xplane_owner is self:
+                _xplane_owner = None
+
+
 def chrome_trace_path() -> Optional[str]:
     v = os.environ.get("DAFT_TPU_CHROME_TRACE")
     if not v:

@@ -131,6 +131,23 @@ def test_spill_cache_shuffle_preserves_empty_partitions():
         assert t.schema.names == ["k", "v"]
 
 
+def test_remote_unregister_over_transport(server):
+    """Reduce-side cleanup addresses the serving host directly through the
+    shuffle transport (HTTP DELETE / Flight do_action)."""
+    import os
+
+    from daft_tpu.distributed.shuffle_service import unregister_remote
+    cache = ShuffleCache()
+    cache.push(0, pa.table({"x": [1, 2]}))
+    root = cache._root
+    server.register(cache)
+    assert fetch_partition(server.address, cache.shuffle_id, 0) is not None
+    unregister_remote(server.address, cache.shuffle_id)
+    assert not os.path.isdir(root)  # spill files released
+    with pytest.raises(Exception):
+        fetch_partition(server.address, cache.shuffle_id, 0)
+
+
 def test_unregister_cleans_spill_files(server):
     import os
     cache = ShuffleCache()
