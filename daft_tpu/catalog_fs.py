@@ -65,27 +65,18 @@ class LakeTable(Table):
         return dt.read_parquet(os.path.join(self.path, "*.parquet"),
                                **options)
 
-    def append(self, df, **options: Any) -> None:
+    def write(self, df, mode: str = "append", **options: Any) -> None:
+        if mode not in ("append", "overwrite"):
+            raise ValueError(f"unsupported write mode {mode!r}")
         if self.format == "iceberg":
-            df.write_iceberg(self.path, mode="append")
+            df.write_iceberg(self.path, mode=mode)
         elif self.format == "delta":
-            df.write_deltalake(self.path, mode="append")
+            df.write_deltalake(self.path, mode=mode)
         elif self.format == "parquet":
-            df.write_parquet(self.path, write_mode="append")
+            df.write_parquet(self.path, write_mode=mode)
         else:
             raise NotImplementedError(
-                f"append to {self.format} tables is not supported")
-
-    def overwrite(self, df, **options: Any) -> None:
-        if self.format == "iceberg":
-            df.write_iceberg(self.path, mode="overwrite")
-        elif self.format == "delta":
-            df.write_deltalake(self.path, mode="overwrite")
-        elif self.format == "parquet":
-            df.write_parquet(self.path, write_mode="overwrite")
-        else:
-            raise NotImplementedError(
-                f"overwrite of {self.format} tables is not supported")
+                f"writes to {self.format} tables are not supported")
 
 
 class FilesystemCatalog(Catalog):

@@ -64,16 +64,15 @@ class WorkerServer:
 
     def __init__(self, port: int = 0, num_slots: int = 2,
                  host: str = "127.0.0.1", advertise_host: str = ""):
-        import os
         self.num_slots = num_slots
         self._advertise = advertise_host or (
             "127.0.0.1" if host == "0.0.0.0" else host)
         # the worker's shuffle server must be reachable by the same route
-        # as the worker itself — reduce tasks on OTHER hosts fetch from it
+        # as the worker itself — reduce tasks on OTHER hosts fetch from it;
+        # configure it eagerly so no map task lazily boots a loopback one
         if host != "127.0.0.1":
-            os.environ.setdefault("DAFT_TPU_SHUFFLE_HOST", host)
-            os.environ.setdefault("DAFT_TPU_SHUFFLE_ADVERTISE",
-                                  self._advertise)
+            from .shuffle_service import configure_local_shuffle_server
+            configure_local_shuffle_server(host, self._advertise)
         pool = cf.ThreadPoolExecutor(max_workers=num_slots)
 
         class Handler(http.server.BaseHTTPRequestHandler):

@@ -293,6 +293,27 @@ def get_local_shuffle_server():
         return _local_server
 
 
+def configure_local_shuffle_server(host: str, advertise_host: str):
+    """Eagerly create the process shuffle server with explicit networking
+    (worker startup calls this BEFORE any map task can lazily boot a
+    loopback-bound one). Conflicting reconfiguration is an error — the
+    advertised address is baked into outstanding map receipts."""
+    global _local_server
+    with _local_server_lock:
+        if _local_server is not None:
+            current = _local_server.address
+            want_host = advertise_host or host
+            if want_host not in current:
+                raise RuntimeError(
+                    f"shuffle server already running at {current}; cannot "
+                    f"re-advertise as {want_host}")
+            return _local_server
+        _local_server = make_shuffle_server(host=host)
+        if advertise_host:
+            _local_server._advertise = advertise_host
+        return _local_server
+
+
 def _spill_streams(body: bytes):
     """Yield (schema, batch-list) per concatenated IPC stream in a spill
     file (one stream per writer reopen). A truncated trailing stream — a

@@ -39,7 +39,7 @@ class Session:
         catalog/namespace — reference ``src/daft-session`` semantics).
         """
         from .sql.planner import SQLPlanner
-        return SQLPlanner({}, session=self).plan_query(sql)
+        return SQLPlanner({}, session=self).plan_statement(sql)
 
     # -- attach / detach ---------------------------------------------------
     def attach(self, object: Any, alias: Optional[str] = None):
@@ -127,6 +127,10 @@ class Session:
         ident = _to_ident(identifier)
         if len(ident) == 1 and str(ident) in self._tables:
             del self._tables[str(ident)]
+            return
+        # catalog-qualified names resolve like get_table does
+        if len(ident) > 1 and ident[0] in self._catalogs:
+            self._catalogs[ident[0]].drop_table(ident.drop(1))
             return
         self._default_catalog().drop_table(identifier)
 

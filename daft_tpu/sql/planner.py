@@ -130,6 +130,155 @@ class SQLPlanner:
         self._expect_eof()
         return df
 
+    def plan_statement(self, query: str):
+        """Statement router (reference: ``src/daft-sql``'s statement layer
+        + ``exec.rs``): DDL/DML — CREATE [TEMP] TABLE … AS, INSERT INTO,
+        DROP TABLE, SHOW TABLES, DESCRIBE, USE — execute against the bound
+        session; anything else plans as a query."""
+        self.toks = tokenize(query)
+        self.i = 0
+        if self._peek_kw("CREATE"):
+            return self._create_stmt()
+        if self._peek_kw("INSERT", "INTO"):
+            return self._insert_stmt()
+        if self._peek_kw("DROP", "TABLE"):
+            return self._drop_stmt()
+        if self._peek_kw("SHOW", "TABLES"):
+            return self._show_tables_stmt()
+        if self._peek_kw("DESCRIBE"):
+            return self._describe_stmt()
+        if self._peek_kw("USE"):
+            return self._use_stmt()
+        return self.plan_query(query)
+
+    # -- statements --------------------------------------------------------
+    def _need_session(self, what: str):
+        if self.session is None:
+            raise ValueError(f"{what} needs a session (daft_tpu.Session)")
+        return self.session
+
+    def _ident_chain(self) -> List[str]:
+        parts = [self._next().text]
+        while self._kw("."):
+            parts.append(self._next().text)
+        return parts
+
+    def _create_stmt(self):
+        self._expect("CREATE")
+        replace = self._kw("OR", "REPLACE")
+        temp = self._kw("TEMP") or self._kw("TEMPORARY")
+        self._expect("TABLE")
+        if_not_exists = self._kw("IF", "NOT", "EXISTS")
+        parts = self._ident_chain()
+        self._expect("AS")
+        rest = self.toks[self.i:]
+        self.toks = rest
+        self.i = 0
+        df = self._query(dict(self.tables))
+        self._expect_eof()
+        sess = self._need_session("CREATE TABLE")
+        from ..catalog import Identifier
+        if temp:
+            if len(parts) != 1:
+                raise ValueError("temp table names are unqualified")
+            # only the TEMP namespace matters here: temp tables shadow
+            # catalog tables by design, so a catalog name never blocks one
+            exists = parts[0] in sess._tables
+            if exists and if_not_exists:
+                return df  # no-op, existing table preserved
+            if exists and not replace:
+                raise ValueError(f"table {parts[0]!r} already exists")
+            sess.create_temp_table(parts[0], df)
+            return df
+        # a leading part naming an attached catalog addresses that catalog
+        # (same resolution as Session.get_table)
+        if len(parts) > 1 and sess.has_catalog(parts[0]):
+            target = sess.get_catalog(parts[0])
+            ident = Identifier(*parts[1:])
+        else:
+            target = sess
+            ident = Identifier(*parts)
+        if if_not_exists:
+            target.create_table_if_not_exists(ident, df)
+        elif replace:
+            try:
+                target.drop_table(ident)
+            except Exception:
+                pass
+            target.create_table(ident, df)
+        else:
+            target.create_table(ident, df)
+        return df
+
+    def _insert_stmt(self):
+        self._expect("INSERT")
+        self._expect("INTO")
+        parts = self._ident_chain()
+        mode = "append"
+        if self._kw("OVERWRITE"):
+            mode = "overwrite"
+        rest = self.toks[self.i:]
+        self.toks = rest
+        self.i = 0
+        df = self._query(dict(self.tables))
+        self._expect_eof()
+        sess = self._need_session("INSERT INTO")
+        sess.get_table(".".join(parts)).write(df, mode=mode)
+        return df
+
+    def _drop_stmt(self):
+        from ..catalog import NotFoundError
+        self._expect("DROP")
+        self._expect("TABLE")
+        if_exists = self._kw("IF", "EXISTS")
+        parts = self._ident_chain()
+        self._expect_eof()
+        sess = self._need_session("DROP TABLE")
+        try:
+            sess.drop_table(".".join(parts))
+        except NotFoundError:
+            # IF EXISTS only forgives absence — IO/permission failures
+            # still surface
+            if not if_exists:
+                raise
+        return None
+
+    def _show_tables_stmt(self):
+        import fnmatch
+
+        from .. import dataframe as _df
+        self._expect("SHOW")
+        self._expect("TABLES")
+        pattern = None
+        if self._kw("LIKE"):
+            # SQL LIKE wildcards → fnmatch (%→*, _→?)
+            raw = self._next().text.strip("'\"")
+            pattern = raw.replace("%", "*").replace("_", "?")
+        self._expect_eof()
+        sess = self._need_session("SHOW TABLES")
+        names = [str(t) for t in sess.list_tables(None)]
+        if pattern is not None:
+            names = [n for n in names if fnmatch.fnmatchcase(n, pattern)]
+        return _df.from_pydict({"table": names})
+
+    def _describe_stmt(self):
+        from .. import dataframe as _df
+        self._expect("DESCRIBE")
+        parts = self._ident_chain()
+        self._expect_eof()
+        sess = self._need_session("DESCRIBE")
+        schema = sess.get_table(".".join(parts)).schema()
+        return _df.from_pydict({
+            "column": [f.name for f in schema],
+            "type": [str(f.dtype) for f in schema]})
+
+    def _use_stmt(self):
+        self._expect("USE")
+        parts = self._ident_chain()
+        self._expect_eof()
+        self._need_session("USE").use(".".join(parts))
+        return None
+
     def plan_expression(self, text: str) -> Expression:
         self.toks = tokenize(text)
         self.i = 0

@@ -36,7 +36,7 @@ def sql(query: str, catalog: Optional[SQLCatalog] = None, **kwargs):
     tables.update({k: v for k, v in kwargs.items()
                    if isinstance(v, DataFrame)})
     from .. import session as _sess
-    return SQLPlanner(tables, session=_sess._SESSION).plan_query(query)
+    return SQLPlanner(tables, session=_sess._session()).plan_statement(query)
 
 
 def sql_expr(expr: str):

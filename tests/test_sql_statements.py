@@ -1,0 +1,101 @@
+"""SQL DDL/DML statements through the session (reference:
+``src/daft-sql``'s statement layer + ``exec.rs``: CREATE TABLE AS,
+INSERT INTO, DROP TABLE, SHOW TABLES, DESCRIBE, USE)."""
+
+import pytest
+
+import daft_tpu
+from daft_tpu import Session, col
+from daft_tpu.catalog_fs import FilesystemCatalog
+
+
+@pytest.fixture
+def sess():
+    return Session()
+
+
+def test_create_temp_table_as_and_query(sess):
+    sess.create_temp_table("src", daft_tpu.from_pydict(
+        {"x": [1, 2, 3, 4]}))
+    sess.sql("CREATE TEMP TABLE doubled AS SELECT x * 2 AS y FROM src")
+    out = sess.sql("SELECT SUM(y) AS s FROM doubled").to_pydict()
+    assert out["s"] == [20]
+    # plain CREATE TEMP TABLE on an existing name errors; OR REPLACE works
+    with pytest.raises(ValueError, match="already exists"):
+        sess.sql("CREATE TEMP TABLE doubled AS SELECT 1 AS a")
+    sess.sql("CREATE OR REPLACE TEMP TABLE doubled AS SELECT 1 AS a")
+    assert sess.sql("SELECT * FROM doubled").to_pydict() == {"a": [1]}
+
+
+def test_create_temp_if_not_exists_is_noop(sess):
+    sess.sql("CREATE TEMP TABLE t AS SELECT 1 AS x UNION ALL SELECT 2 AS x")
+    # IF NOT EXISTS preserves the existing table (regression: it used to
+    # silently overwrite)
+    sess.sql("CREATE TEMP TABLE IF NOT EXISTS t AS SELECT 99 AS x")
+    out = sess.sql("SELECT x FROM t ORDER BY x").to_pydict()
+    assert out["x"] == [1, 2]
+
+
+def test_show_tables_like_wildcards(sess):
+    sess.sql("CREATE TEMP TABLE foo_log AS SELECT 1 AS x")
+    sess.sql("CREATE TEMP TABLE bar AS SELECT 1 AS x")
+    got = sess.sql("SHOW TABLES LIKE '%log'").to_pydict()["table"]
+    assert got == ["foo_log"]
+
+
+def test_insert_into_temp_table(sess):
+    sess.sql("CREATE TEMP TABLE t AS SELECT 1 AS x")
+    sess.sql("INSERT INTO t SELECT 2 AS x")
+    out = sess.sql("SELECT x FROM t ORDER BY x").to_pydict()
+    assert out["x"] == [1, 2]
+
+
+def test_drop_and_show_tables(sess):
+    sess.sql("CREATE TEMP TABLE a AS SELECT 1 AS x")
+    sess.sql("CREATE TEMP TABLE b AS SELECT 2 AS x")
+    names = sess.sql("SHOW TABLES").to_pydict()["table"]
+    assert set(names) >= {"a", "b"}
+    sess.sql("DROP TABLE a")
+    assert "a" not in sess.sql("SHOW TABLES").to_pydict()["table"]
+    with pytest.raises(Exception):
+        sess.sql("DROP TABLE a")
+    sess.sql("DROP TABLE IF EXISTS a")  # no error
+
+
+def test_describe(sess):
+    sess.sql("CREATE TEMP TABLE t AS SELECT 1 AS x, 'a' AS s")
+    out = sess.sql("DESCRIBE t").to_pydict()
+    assert out["column"] == ["x", "s"]
+    assert "int" in out["type"][0].lower()
+
+
+def test_catalog_create_insert_roundtrip(tmp_path, sess):
+    (tmp_path / "wh").mkdir()
+    sess.attach(FilesystemCatalog(str(tmp_path / "wh"), name="lake"))
+    sess.create_temp_table("src", daft_tpu.from_pydict(
+        {"k": [1, 2], "v": [10.0, 20.0]}))
+    sess.sql("CREATE TABLE lake.sales AS SELECT * FROM src")
+    sess.sql("INSERT INTO lake.sales SELECT 3 AS k, 30.0 AS v")
+    out = sess.sql("SELECT k, v FROM lake.sales ORDER BY k").to_pydict()
+    assert out == {"k": [1, 2, 3], "v": [10.0, 20.0, 30.0]}
+    # it is a real iceberg table on disk
+    assert (tmp_path / "wh" / "sales" / "metadata").is_dir()
+
+
+def test_use_statement(tmp_path, sess):
+    (tmp_path / "wh").mkdir()
+    sess.attach(FilesystemCatalog(str(tmp_path / "wh"), name="lake"))
+    sess.sql("CREATE TABLE lake.t AS SELECT 5 AS x")
+    sess.sql("USE lake")
+    out = sess.sql("SELECT x FROM t").to_pydict()
+    assert out["x"] == [5]
+
+
+def test_module_level_sql_statements():
+    """daft_tpu.sql routes statements through the ambient session."""
+    import uuid
+    name = f"tmp_{uuid.uuid4().hex[:8]}"
+    daft_tpu.sql(f"CREATE TEMP TABLE {name} AS SELECT 42 AS answer")
+    out = daft_tpu.sql(f"SELECT answer FROM {name}").to_pydict()
+    assert out["answer"] == [42]
+    daft_tpu.sql(f"DROP TABLE {name}")
