@@ -139,6 +139,10 @@ class DataFrame:
     def exclude(self, *names: str) -> "DataFrame":
         return DataFrame(self._builder.exclude(list(names)))
 
+    def filter(self, predicate: Union[Expression, str]) -> "DataFrame":
+        """Alias of :meth:`where` (reference has both)."""
+        return self.where(predicate)
+
     def where(self, predicate: Union[Expression, str]) -> "DataFrame":
         if isinstance(predicate, str):
             from .sql import sql_expr
@@ -179,6 +183,31 @@ class DataFrame:
 
     unique = distinct
 
+    def _drop_where(self, cols, default_names, term_of) -> "DataFrame":
+        names = [c.name() for c in _flatten_cols(cols)] or default_names
+        pred = None
+        for n in names:
+            term = term_of(n)
+            pred = term if pred is None else pred & term
+        return self if pred is None else self.where(pred)
+
+    def drop_nan(self, *cols: ColumnInput) -> "DataFrame":
+        """Drop rows where any of ``cols`` (default: all float columns) is
+        NaN — nulls survive (reference: ``DataFrame.drop_nan``)."""
+        return self._drop_where(
+            cols, [f.name for f in self.schema() if f.dtype.is_floating()],
+            lambda n: ~col(n).float.is_nan() | col(n).is_null())
+
+    def drop_null(self, *cols: ColumnInput) -> "DataFrame":
+        """Drop rows where any of ``cols`` (default: all columns) is null
+        (reference: ``DataFrame.drop_null``)."""
+        return self._drop_where(cols, self.column_names,
+                                lambda n: col(n).not_null())
+
+    def pipe(self, func, *args, **kwargs):
+        """``df.pipe(f, ...)`` → ``f(df, ...)`` (reference parity)."""
+        return func(self, *args, **kwargs)
+
     def drop_duplicates(self, *on) -> "DataFrame":
         return self.distinct(*on)
 
@@ -204,6 +233,22 @@ class DataFrame:
 
     def union_all(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(self._builder.union(other._builder, all=True))
+
+    def _aligned_by_name(self, other: "DataFrame") -> "DataFrame":
+        mine, theirs = self.column_names, other.column_names
+        if set(mine) != set(theirs):
+            raise ValueError(
+                f"union_by_name: column sets differ "
+                f"({sorted(set(mine) ^ set(theirs))})")
+        return other.select(*[col(n) for n in mine])
+
+    def union_by_name(self, other: "DataFrame") -> "DataFrame":
+        """Set union matching columns BY NAME, order-independent
+        (reference: ``DataFrame.union_by_name``)."""
+        return self.union(self._aligned_by_name(other))
+
+    def union_all_by_name(self, other: "DataFrame") -> "DataFrame":
+        return self.union_all(self._aligned_by_name(other))
 
     def intersect(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(self._builder.intersect(other._builder))
@@ -305,6 +350,9 @@ class DataFrame:
 
     def agg_concat(self, *cols):
         return self.agg(*[_c(c).agg_concat() for c in _flatten_cols(cols)])
+
+    def agg_set(self, *cols):
+        return self.agg(*[_c(c).agg_set() for c in _flatten_cols(cols)])
 
     def stddev(self, *cols):
         return self.agg(*[_c(c).stddev() for c in _flatten_cols(cols)])
@@ -441,6 +489,46 @@ class DataFrame:
         from .to_torch import TorchIterDataset
         return TorchIterDataset(self)
 
+    def to_arrow_iter(self) -> Iterator[pa.RecordBatch]:
+        """Stream results as Arrow record batches without materializing a
+        combined copy per partition (reference:
+        ``DataFrame.to_arrow_iter``)."""
+        for p in self.iter_partitions():
+            for rb in p.batches():
+                yield from rb.to_arrow_table().to_batches()
+
+    def to_ray_dataset(self):
+        """Bridge to a Ray Dataset (reference: RayRunnerIO.to_ray_dataset;
+        needs the optional 'ray' package)."""
+        try:
+            import ray.data
+        except ImportError as exc:
+            raise ImportError("to_ray_dataset requires the optional 'ray' "
+                              "package") from exc
+        return ray.data.from_arrow(self.to_arrow())
+
+    def to_dask_dataframe(self):
+        """Bridge to a Dask DataFrame (reference: RayRunnerIO
+        .to_dask_dataframe; needs the optional 'dask' package)."""
+        try:
+            import dask.dataframe as dd
+        except ImportError as exc:
+            raise ImportError("to_dask_dataframe requires the optional "
+                              "'dask' package") from exc
+        return dd.from_pandas(self.to_pandas(),
+                              npartitions=max(self.num_partitions(), 1))
+
+    def write_lance(self, uri: str, **kwargs):
+        """Write as a Lance dataset (reference: ``DataFrame.write_lance``;
+        needs the optional 'lance' package)."""
+        try:
+            import lance
+        except ImportError as exc:
+            raise ImportError("write_lance requires the optional 'lance' "
+                              "package") from exc
+        lance.write_dataset(self.to_arrow(), uri, **kwargs)
+        return self
+
 
 class GroupedDataFrame:
     """Reference: ``daft/dataframe/dataframe.py`` GroupedDataFrame."""
@@ -503,6 +591,9 @@ class GroupedDataFrame:
 
     def agg_concat(self, *cols):
         return self.agg(*[_c(c).agg_concat() for c in _flatten_cols(cols)])
+
+    def agg_set(self, *cols):
+        return self.agg(*[_c(c).agg_set() for c in _flatten_cols(cols)])
 
     def stddev(self, *cols):
         return self.agg(*[_c(c).stddev() for c in _flatten_cols(cols)])

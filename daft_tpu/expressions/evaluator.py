@@ -226,13 +226,19 @@ def _eval(e: Expression, cols: Dict[str, Series], n: int) -> Series:
                                  kids[0].name()).cast(out_field.dtype)
     if op in ("sqrt", "cbrt", "exp", "log2", "log10", "ln", "sin", "cos", "tan",
               "arcsin", "arccos", "arctan", "sinh", "cosh", "tanh", "degrees",
-              "radians", "log"):
+              "radians", "log", "arcsinh", "arccosh", "arctanh", "cot", "csc",
+              "sec", "expm1", "log1p"):
         v = kids[0].to_numpy().astype(np.float64)
         npfn = {"sqrt": np.sqrt, "cbrt": np.cbrt, "exp": np.exp, "log2": np.log2,
                 "log10": np.log10, "ln": np.log, "sin": np.sin, "cos": np.cos,
                 "tan": np.tan, "arcsin": np.arcsin, "arccos": np.arccos,
                 "arctan": np.arctan, "sinh": np.sinh, "cosh": np.cosh,
-                "tanh": np.tanh, "degrees": np.degrees, "radians": np.radians}
+                "tanh": np.tanh, "degrees": np.degrees, "radians": np.radians,
+                "arcsinh": np.arcsinh, "arccosh": np.arccosh,
+                "arctanh": np.arctanh, "expm1": np.expm1, "log1p": np.log1p,
+                "cot": lambda x: 1.0 / np.tan(x),
+                "csc": lambda x: 1.0 / np.sin(x),
+                "sec": lambda x: 1.0 / np.cos(x)}
         with np.errstate(all="ignore"):
             if op == "log":
                 out = np.log(v) / math.log(e.params[0])
@@ -248,6 +254,47 @@ def _eval(e: Expression, cols: Dict[str, Series], n: int) -> Series:
         fn = pc.shift_left if op == "shift_left" else pc.shift_right
         return Series.from_arrow(fn(b(kids[0]).to_arrow(), b(kids[1]).to_arrow()),
                                  kids[0].name())
+    if op in ("bitwise_and", "bitwise_or", "bitwise_xor"):
+        fn = {"bitwise_and": pc.bit_wise_and, "bitwise_or": pc.bit_wise_or,
+              "bitwise_xor": pc.bit_wise_xor}[op]
+        return Series.from_arrow(fn(b(kids[0]).to_arrow(), b(kids[1]).to_arrow()),
+                                 kids[0].name())
+    if op in ("deserialize", "try_deserialize"):
+        import json as _json
+        fmt, dtype = e.params
+        if fmt != "json":
+            raise ValueError(f"deserialize format {fmt!r} (only 'json')")
+        strict = op == "deserialize"
+        out = []
+        for v in kids[0].to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            try:
+                out.append(_json.loads(v))
+            except ValueError:
+                if strict:
+                    raise
+                out.append(None)
+        # enforce the DECLARED dtype: parsed-but-mismatched values must not
+        # leak through as python objects under a typed schema
+        target = dtype.to_arrow()
+        try:
+            arr = pa.array(out, type=target)
+        except (pa.ArrowInvalid, pa.ArrowTypeError, TypeError,
+                OverflowError):
+            if strict:
+                raise
+            coerced = []
+            for v in out:
+                try:
+                    pa.array([v], type=target)
+                    coerced.append(v)
+                except (pa.ArrowInvalid, pa.ArrowTypeError, TypeError,
+                        OverflowError):
+                    coerced.append(None)
+            arr = pa.array(coerced, type=target)
+        return Series.from_arrow(arr, kids[0].name()).cast(dtype)
     if op == "hash":
         return kids[0].hash(kids[1] if len(kids) > 1 else None)
     if op == "minhash":

@@ -231,8 +231,42 @@ class Expression:
     def sinh(self): return Expression("sinh", (self,))
     def cosh(self): return Expression("cosh", (self,))
     def tanh(self): return Expression("tanh", (self,))
+    def arcsinh(self): return Expression("arcsinh", (self,))
+    def arccosh(self): return Expression("arccosh", (self,))
+    def arctanh(self): return Expression("arctanh", (self,))
+    def cot(self): return Expression("cot", (self,))
+    def csc(self): return Expression("csc", (self,))
+    def sec(self): return Expression("sec", (self,))
+    def expm1(self): return Expression("expm1", (self,))
+    def log1p(self): return Expression("log1p", (self,))
+    def signum(self): return Expression("sign", (self,))
+    def negate(self): return -self
+    def negative(self): return -self
     def degrees(self): return Expression("degrees", (self,))
     def radians(self): return Expression("radians", (self,))
+    def bitwise_and(self, other):
+        return Expression("bitwise_and", (self, Expression._to_expression(other)))
+    def bitwise_or(self, other):
+        return Expression("bitwise_or", (self, Expression._to_expression(other)))
+    def bitwise_xor(self, other):
+        return Expression("bitwise_xor", (self, Expression._to_expression(other)))
+
+    # top-level codec / serde surface (reference: Expression.encode/decode/
+    # try_* + deserialize; rides the binary-namespace codec machinery)
+    def encode(self, codec: str): return Expression("binary.encode", (self,), (codec,))
+    def decode(self, codec: str): return Expression("binary.decode", (self,), (codec,))
+    def try_encode(self, codec: str):
+        return Expression("binary.try_encode", (self,), (codec,))
+    def try_decode(self, codec: str):
+        return Expression("binary.try_decode", (self,), (codec,))
+    def deserialize(self, format: str, dtype):
+        return Expression("deserialize", (self,), (format, dtype))
+    def try_deserialize(self, format: str, dtype):
+        return Expression("try_deserialize", (self,), (format, dtype))
+    def jq(self, filter: str):
+        """jq-style JSON query (reference: Expression.jq over the jaq
+        crate; same surface as ``.json.query``)."""
+        return Expression("json.query", (self,), (filter,))
     def clip(self, min=None, max=None):
         return Expression("clip", (self, Expression._to_expression(min),
                                    Expression._to_expression(max)))

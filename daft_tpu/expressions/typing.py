@@ -139,12 +139,16 @@ def infer_field(e, schema: Schema) -> Field:
         return Field(name, child_fields[0].dtype)
     if op in ("sqrt", "cbrt", "exp", "log", "log2", "log10", "ln", "sin", "cos",
               "tan", "arcsin", "arccos", "arctan", "arctan2", "sinh", "cosh",
-              "tanh", "degrees", "radians"):
+              "tanh", "degrees", "radians", "arcsinh", "arccosh", "arctanh",
+              "cot", "csc", "sec", "expm1", "log1p"):
         d = child_fields[0].dtype
         return Field(name, DataType.float32() if d.kind == "float32"
                      else DataType.float64())
-    if op in ("shift_left", "shift_right"):
+    if op in ("shift_left", "shift_right", "bitwise_and", "bitwise_or",
+              "bitwise_xor"):
         return Field(name, child_fields[0].dtype)
+    if op in ("deserialize", "try_deserialize"):
+        return Field(name, e.params[1])
     if op == "fill_null":
         base = child_fields[0].dtype
         if base.is_null():
