@@ -1,10 +1,11 @@
 """Synthetic TPC-DS-shaped data for the window-function query subset.
 
 The reference ships full dsdgen + 99 queries (``benchmarking/tpcds``).
-This generator produces the four tables the rolling/window benchmark
-queries (Q47/Q63/Q89) touch — store_sales, item, date_dim, store — with the
-TPC-DS column names and realistic key relationships, vectorized numpy like
-the TPC-H datagen.
+This generator produces the ten tables the query subset touches —
+store_sales (ticket-coherent baskets), item, date_dim, time_dim, store,
+customer, customer_address, customer_demographics, household_demographics,
+promotion — with the TPC-DS column names and realistic key relationships,
+vectorized numpy like the TPC-H datagen.
 """
 
 from __future__ import annotations
@@ -96,13 +97,43 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
         "p_channel_event": rng.choice(["Y", "N"], n_promos),
     })
 
+    n_hd = 100
+    household_demographics = pa.table({
+        "hd_demo_sk": np.arange(1, n_hd + 1),
+        "hd_dep_count": rng.integers(0, 10, n_hd),
+        "hd_vehicle_count": rng.integers(0, 5, n_hd),
+        "hd_buy_potential": rng.choice(
+            [">10000", "5001-10000", "1001-5000", "501-1000", "0-500",
+             "Unknown"], n_hd),
+    })
+    n_times = 24 * 60  # one row per minute of day
+    time_dim = pa.table({
+        "t_time_sk": np.arange(1, n_times + 1),
+        "t_hour": np.arange(n_times) // 60,
+        "t_minute": np.arange(n_times) % 60,
+    })
+
+    # tickets are coherent baskets: every line item of a ticket shares its
+    # date/time/store/customer/demographics (like real receipts — the
+    # Q34/Q73 per-ticket line counts depend on this); ~12 lines per ticket
+    n_tickets = max(n_sales // 12, 1)
+    ticket = rng.integers(1, n_tickets + 1, n_sales)
+    t_date = rng.integers(1, n_days + 1, n_tickets + 1)
+    t_time = rng.integers(1, n_times + 1, n_tickets + 1)
+    t_store = rng.integers(1, n_stores + 1, n_tickets + 1)
+    t_cust = rng.integers(1, n_custs + 1, n_tickets + 1)
+    t_cd = rng.integers(1, n_cd + 1, n_tickets + 1)
+    t_hd = rng.integers(1, n_hd + 1, n_tickets + 1)
     store_sales = pa.table({
-        "ss_sold_date_sk": rng.integers(1, n_days + 1, n_sales),
+        "ss_sold_date_sk": t_date[ticket],
+        "ss_sold_time_sk": t_time[ticket],
         "ss_item_sk": rng.integers(1, n_items + 1, n_sales),
-        "ss_store_sk": rng.integers(1, n_stores + 1, n_sales),
-        "ss_customer_sk": rng.integers(1, n_custs + 1, n_sales),
-        "ss_cdemo_sk": rng.integers(1, n_cd + 1, n_sales),
+        "ss_store_sk": t_store[ticket],
+        "ss_customer_sk": t_cust[ticket],
+        "ss_cdemo_sk": t_cd[ticket],
+        "ss_hdemo_sk": t_hd[ticket],
         "ss_promo_sk": rng.integers(1, n_promos + 1, n_sales),
+        "ss_ticket_number": ticket,
         "ss_sales_price": rng.uniform(1, 300, n_sales).round(2),
         "ss_quantity": rng.integers(1, 100, n_sales),
         "ss_list_price": rng.uniform(1, 300, n_sales).round(2),
@@ -115,7 +146,9 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
                     ("customer", customer),
                     ("customer_address", customer_address),
                     ("customer_demographics", customer_demographics),
-                    ("promotion", promotion)):
+                    ("promotion", promotion),
+                    ("household_demographics", household_demographics),
+                    ("time_dim", time_dim)):
         d = os.path.join(root, name)
         os.makedirs(d, exist_ok=True)
         pq.write_table(t, os.path.join(d, "part-0.parquet"))

@@ -3,8 +3,9 @@
 preserved and sized to the synthetic datagen: the BASELINE configs'
 rolling/window trio (Q47/Q63/Q89), the dimensional-aggregate family
 (Q3/Q42/Q52/Q55), the demographics/promotion family (Q7/Q26), the
-customer-address brand query (Q19), quarterly windows (Q53), and the
-class-revenue-ratio window (Q98)."""
+customer-address brand query (Q19), the store-hours/ticket family
+(Q34/Q73/Q96), quarterly windows (Q53), and the class-revenue-ratio
+window (Q98)."""
 
 Q47 = """
 WITH monthly AS (
@@ -220,12 +221,65 @@ ORDER BY i_item_id
 LIMIT 100
 """
 
-ALL = {3: Q3, 7: Q7, 19: Q19, 26: Q26, 42: Q42, 47: Q47, 52: Q52, 53: Q53,
-       55: Q55, 63: Q63, 89: Q89, 98: Q98}
+Q34 = """
+WITH tickets AS (
+  SELECT ss_ticket_number, ss_customer_sk, COUNT(*) AS cnt
+  FROM store_sales, date_dim, store, household_demographics
+  WHERE ss_sold_date_sk = d_date_sk
+    AND ss_store_sk = s_store_sk
+    AND ss_hdemo_sk = hd_demo_sk
+    AND d_dom BETWEEN 1 AND 3
+    AND hd_vehicle_count > 0
+    AND d_year = 2000
+  GROUP BY ss_ticket_number, ss_customer_sk
+)
+SELECT c_last_name, c_first_name, ss_ticket_number, cnt
+FROM tickets, customer
+WHERE ss_customer_sk = c_customer_sk
+  AND cnt BETWEEN 15 AND 20
+ORDER BY c_last_name, c_first_name, ss_ticket_number DESC
+"""
+
+Q73 = """
+WITH tickets AS (
+  SELECT ss_ticket_number, ss_customer_sk, COUNT(*) AS cnt
+  FROM store_sales, date_dim, store, household_demographics
+  WHERE ss_sold_date_sk = d_date_sk
+    AND ss_store_sk = s_store_sk
+    AND ss_hdemo_sk = hd_demo_sk
+    AND d_dom BETWEEN 1 AND 2
+    AND hd_buy_potential IN ('>10000', 'Unknown')
+    AND hd_vehicle_count > 0
+    AND d_year = 2000
+  GROUP BY ss_ticket_number, ss_customer_sk
+)
+SELECT c_last_name, c_first_name, ss_ticket_number, cnt
+FROM tickets, customer
+WHERE ss_customer_sk = c_customer_sk
+  AND cnt BETWEEN 1 AND 5
+ORDER BY cnt DESC, c_last_name
+"""
+
+Q96 = """
+SELECT COUNT(1) AS cnt
+FROM store_sales, household_demographics, time_dim, store
+WHERE ss_sold_time_sk = t_time_sk
+  AND ss_hdemo_sk = hd_demo_sk
+  AND ss_store_sk = s_store_sk
+  AND t_hour = 20
+  AND t_minute >= 30
+  AND hd_dep_count = 7
+ORDER BY cnt
+LIMIT 100
+"""
+
+ALL = {3: Q3, 7: Q7, 19: Q19, 26: Q26, 34: Q34, 42: Q42, 47: Q47, 52: Q52,
+       53: Q53, 55: Q55, 63: Q63, 73: Q73, 89: Q89, 96: Q96, 98: Q98}
 
 
 TABLES = ("store_sales", "item", "date_dim", "store", "customer",
-          "customer_address", "customer_demographics", "promotion")
+          "customer_address", "customer_demographics", "promotion",
+          "household_demographics", "time_dim")
 
 
 def tables_of(qnum: int):
@@ -238,8 +292,8 @@ def tables_of(qnum: int):
 
 def run(qnum: int, get_df):
     """Execute a query with only its referenced tables bound from
-    ``get_df(name)`` — datasets generated before the 8-table datagen keep
-    working for the queries they cover."""
+    ``get_df(name)`` — datasets generated before newer tables were added
+    keep working for the queries they cover."""
     import daft_tpu as dt
     tables = {name: get_df(name) for name in tables_of(qnum)}
     return dt.sql(ALL[qnum], **tables)

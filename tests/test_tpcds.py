@@ -25,7 +25,7 @@ def tpcds(tmp_path_factory):
 def test_queries_run(tpcds, qnum):
     out = Q.run(qnum, tpcds).to_pydict()
     assert out
-    if qnum != 98:  # 98 has no LIMIT
+    if qnum not in (34, 73, 98):  # these have no LIMIT clause
         assert all(len(v) <= 100 for v in out.values())
 
 
@@ -53,6 +53,36 @@ def test_q98_revenue_ratio_sums_to_100_per_class(tpcds):
     by_class = got.groupby("i_class")["revenueratio"].sum()
     for v in by_class:
         assert v == pytest.approx(100.0, rel=1e-6)
+
+
+def test_q34_vs_pandas(tpcds):
+    """Per-ticket line-count banding (relies on ticket-coherent datagen)."""
+    got = Q.run(34, tpcds).to_pandas()
+    ss = tpcds("store_sales").to_pandas()
+    dd = tpcds("date_dim").to_pandas()
+    hd = tpcds("household_demographics").to_pandas()
+    j = (ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk"))
+    j = j[(j.d_dom.between(1, 3)) & (j.hd_vehicle_count > 0)
+          & (j.d_year == 2000)]
+    t = (j.groupby(["ss_ticket_number", "ss_customer_sk"], as_index=False)
+         .size().rename(columns={"size": "cnt"}))
+    exp = t[t.cnt.between(15, 20)]
+    assert len(got) == len(exp)
+    assert sorted(got.ss_ticket_number) == sorted(exp.ss_ticket_number)
+    assert len(got) > 0, "datagen should produce 15-20-line tickets"
+
+
+def test_q96_vs_pandas(tpcds):
+    got = Q.run(96, tpcds).to_pydict()["cnt"]
+    ss = tpcds("store_sales").to_pandas()
+    hd = tpcds("household_demographics").to_pandas()
+    td = tpcds("time_dim").to_pandas()
+    j = (ss.merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+         .merge(td, left_on="ss_sold_time_sk", right_on="t_time_sk"))
+    exp = len(j[(j.t_hour == 20) & (j.t_minute >= 30)
+                & (j.hd_dep_count == 7)])
+    assert got == [exp]
 
 
 def test_q7_vs_pandas(tpcds):
