@@ -33,13 +33,22 @@ if _re.__name__ == "re":  # pragma: no cover - ascii approximation
 
 
 class BPETokenizer:
-    """Greedy lowest-rank byte-pair merges (the tiktoken algorithm)."""
+    """Greedy lowest-rank byte-pair merges (the tiktoken algorithm). The
+    merge loop runs in the native C++ kernel library when available
+    (``native/src/kernels.cpp`` dn_bpe_*), with this module's pure-python
+    implementation as the fallback — both produce identical ids."""
 
     def __init__(self, ranks: Dict[bytes, int],
                  pattern: Optional[str] = None):
         self.ranks = ranks
         self.decoder = {v: k for k, v in ranks.items()}
         self._rx = _re.compile(pattern or _DEFAULT_PATTERN)
+        self._native = None
+        from .. import native
+        if native.AVAILABLE:
+            toks = list(ranks)
+            self._native = native.BpeVocab(toks,
+                                           [ranks[t] for t in toks])
 
     # ------------------------------------------------------------ encode
     def _bpe(self, piece: bytes) -> List[int]:
@@ -68,9 +77,22 @@ class BPETokenizer:
         return out
 
     def encode(self, text: str) -> List[int]:
-        out: List[int] = []
-        for m in self._rx.finditer(text):
-            out.extend(self._bpe(m.group().encode("utf-8")))
+        pieces = [m.group().encode("utf-8")
+                  for m in self._rx.finditer(text)]
+        if self._native is not None:
+            # all pieces in one native call — FFI overhead amortizes
+            id_arrays = self._native.encode_batch(pieces)
+            if id_arrays is None:
+                raise ValueError(
+                    "text not fully covered by the vocabulary (vocab "
+                    "lacks single-byte tokens?)")
+            if not id_arrays:
+                return []
+            import numpy as np
+            return np.concatenate(id_arrays).tolist()
+        out = []
+        for piece in pieces:
+            out.extend(self._bpe(piece))
         return out
 
     def decode(self, ids: List[int]) -> str:

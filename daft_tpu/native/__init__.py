@@ -123,6 +123,16 @@ def _load():
     lib.dn_probe_run.restype = i64
     lib.dn_probe_free.argtypes = [ctypes.c_void_p]
 
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.dn_bpe_build.argtypes = [i64p, u8p, i32p, i64]
+    lib.dn_bpe_build.restype = ctypes.c_void_p
+    lib.dn_bpe_encode.argtypes = [ctypes.c_void_p, u8p, i64, i32p]
+    lib.dn_bpe_encode.restype = i64
+    lib.dn_bpe_encode_batch.argtypes = [ctypes.c_void_p, i64p, u8p, i64,
+                                        i32p, i64p]
+    lib.dn_bpe_encode_batch.restype = i64
+    lib.dn_bpe_free.argtypes = [ctypes.c_void_p]
+
     _lib = lib
     AVAILABLE = True
 
@@ -286,4 +296,69 @@ class ProbeTable:
     def __del__(self):
         if getattr(self, "_handle", None) and _lib is not None:
             _lib.dn_probe_free(self._handle)
+            self._handle = None
+
+
+class BpeVocab:
+    """Native BPE vocabulary: byte-sequence → rank lookup table + greedy
+    lowest-rank merge encoding (the tokenize hot loop; reference
+    capability ``src/daft-functions-tokenize``)."""
+
+    def __init__(self, tokens, ranks):
+        """tokens: list[bytes]; ranks: parallel list[int]."""
+        lens = np.fromiter((len(t) for t in tokens), dtype=np.int64,
+                           count=len(tokens))
+        offs = np.zeros(len(tokens) + 1, dtype=np.int64)
+        np.cumsum(lens, out=offs[1:])
+        data = np.frombuffer(b"".join(tokens), dtype=np.uint8) \
+            if tokens else np.empty(0, dtype=np.uint8)
+        data = np.ascontiguousarray(data)
+        r = np.ascontiguousarray(ranks, dtype=np.int32)
+        self._handle = _lib.dn_bpe_build(
+            _ptr(offs, ctypes.c_int64),
+            _ptr(data, ctypes.c_uint8) if len(data) else _NULL_U8P,
+            _ptr(r, ctypes.c_int32), len(tokens))
+
+    def encode(self, piece: bytes):
+        """→ int32 ids, or None when some byte sequence has no rank."""
+        n = len(piece)
+        if n == 0:
+            return np.empty(0, dtype=np.int32)
+        buf = np.frombuffer(piece, dtype=np.uint8)
+        buf = np.ascontiguousarray(buf)
+        out = np.empty(n, dtype=np.int32)
+        wrote = _lib.dn_bpe_encode(self._handle,
+                                   _ptr(buf, ctypes.c_uint8), n,
+                                   _ptr(out, ctypes.c_int32))
+        if wrote < 0:
+            return None
+        return out[:wrote]
+
+    def encode_batch(self, pieces):
+        """Encode many pieces in ONE native call (amortizes FFI overhead).
+        → list of int32 id arrays, or None on an uncovered sequence."""
+        if not pieces:
+            return []
+        lens = np.fromiter((len(p) for p in pieces), dtype=np.int64,
+                           count=len(pieces))
+        offs = np.zeros(len(pieces) + 1, dtype=np.int64)
+        np.cumsum(lens, out=offs[1:])
+        data = np.ascontiguousarray(
+            np.frombuffer(b"".join(pieces), dtype=np.uint8)) \
+            if offs[-1] else np.empty(0, dtype=np.uint8)
+        out = np.empty(max(int(offs[-1]), 1), dtype=np.int32)
+        counts = np.empty(len(pieces), dtype=np.int64)
+        total = _lib.dn_bpe_encode_batch(
+            self._handle, _ptr(offs, ctypes.c_int64),
+            _ptr(data, ctypes.c_uint8) if len(data) else _NULL_U8P,
+            len(pieces), _ptr(out, ctypes.c_int32),
+            _ptr(counts, ctypes.c_int64))
+        if total < 0:
+            return None
+        splits = np.cumsum(counts)[:-1]
+        return np.split(out[:total], splits)
+
+    def __del__(self):
+        if getattr(self, "_handle", None) and _lib is not None:
+            _lib.dn_bpe_free(self._handle)
             self._handle = None

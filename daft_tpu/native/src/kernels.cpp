@@ -389,4 +389,131 @@ int64_t dn_probe_run(void* table, const uint64_t* probe_h, int64_t n_probe,
 
 void dn_probe_free(void* table) { delete (ProbeTable*)table; }
 
+// ---------------------------------------------------------------------------
+// BPE vocabulary + greedy lowest-rank merge encoding (the tokenize hot loop;
+// reference capability: src/daft-functions-tokenize over tiktoken). The
+// vocabulary maps byte sequences → ranks; encoding repeatedly merges the
+// adjacent pair with the lowest rank until no merge applies.
+
+struct BpeVocab {
+  // flat storage of tokens, looked up through an open-addressing table of
+  // (hash, offset, len, rank)
+  std::vector<uint8_t> bytes;
+  std::vector<int64_t> offs;   // n+1 offsets into bytes
+  std::vector<int32_t> ranks;  // rank per token
+  std::vector<int64_t> slots;  // hash table: index into offs/ranks, -1 empty
+  uint64_t mask = 0;
+
+  int32_t lookup(const uint8_t* p, int64_t len) const {
+    uint64_t h = xxh64(p, len, 0);
+    uint64_t i = h & mask;
+    while (true) {
+      int64_t s = slots[i];
+      if (s < 0) return -1;
+      int64_t tl = offs[s + 1] - offs[s];
+      if (tl == len && std::memcmp(&bytes[offs[s]], p, len) == 0)
+        return ranks[s];
+      i = (i + 1) & mask;
+    }
+  }
+};
+
+void* dn_bpe_build(const int64_t* offsets, const uint8_t* data,
+                   const int32_t* ranks, int64_t n) {
+  auto* v = new BpeVocab();
+  int64_t total = offsets[n];
+  v->bytes.assign(data, data + total);
+  v->offs.assign(offsets, offsets + n + 1);
+  v->ranks.assign(ranks, ranks + n);
+  int64_t cap = 16;
+  while (cap < n * 2) cap <<= 1;
+  v->mask = (uint64_t)cap - 1;
+  v->slots.assign(cap, -1);
+  for (int64_t s = 0; s < n; s++) {
+    const uint8_t* p = &v->bytes[v->offs[s]];
+    int64_t len = v->offs[s + 1] - v->offs[s];
+    uint64_t i = xxh64(p, len, 0) & v->mask;
+    while (v->slots[i] >= 0) i = (i + 1) & v->mask;
+    v->slots[i] = s;
+  }
+  return v;
+}
+
+static int64_t bpe_encode_one(const BpeVocab* v, const uint8_t* piece,
+                              int64_t len, int32_t* out) {
+  if (len == 0) return 0;
+  int32_t whole = v->lookup(piece, len);
+  if (whole >= 0) { out[0] = whole; return 1; }
+  // parts as (start, len) plus the rank of each adjacent pair; a merge
+  // only invalidates the two pair-ranks touching the merge point, so each
+  // iteration costs one O(n) min-scan + two lookups (the tiktoken
+  // recipe), not a full pair-rank recomputation
+  std::vector<int64_t> starts(len), lens(len, 1);
+  std::vector<int32_t> pair_rank(len > 1 ? len - 1 : 0);
+  for (int64_t i = 0; i < len; i++) starts[i] = i;
+  int64_t nparts = len;
+  for (int64_t i = 0; i + 1 < nparts; i++)
+    pair_rank[i] = v->lookup(piece + starts[i], 2);
+  while (nparts > 1) {
+    int32_t best_rank = -1;
+    int64_t best_i = -1;
+    for (int64_t i = 0; i + 1 < nparts; i++) {
+      int32_t r = pair_rank[i];
+      if (r >= 0 && (best_rank < 0 || r < best_rank)) {
+        best_rank = r;
+        best_i = i;
+      }
+    }
+    if (best_i < 0) break;
+    lens[best_i] += lens[best_i + 1];
+    for (int64_t i = best_i + 1; i + 1 < nparts; i++) {
+      starts[i] = starts[i + 1];
+      lens[i] = lens[i + 1];
+      if (i + 2 < nparts) pair_rank[i] = pair_rank[i + 1];
+    }
+    nparts--;
+    if (best_i > 0)
+      pair_rank[best_i - 1] = v->lookup(
+          piece + starts[best_i - 1], lens[best_i - 1] + lens[best_i]);
+    if (best_i + 1 < nparts)
+      pair_rank[best_i] = v->lookup(
+          piece + starts[best_i], lens[best_i] + lens[best_i + 1]);
+  }
+  for (int64_t i = 0; i < nparts; i++) {
+    int32_t r = v->lookup(piece + starts[i], lens[i]);
+    if (r < 0) return -1;
+    out[i] = r;
+  }
+  return nparts;
+}
+
+// Encode one pretokenized piece. Returns the number of ids written (≤ len),
+// or -1 if some byte sequence has no rank (vocab lacks single-byte tokens).
+int64_t dn_bpe_encode(void* vocab, const uint8_t* piece, int64_t len,
+                      int32_t* out) {
+  return bpe_encode_one((BpeVocab*)vocab, piece, len, out);
+}
+
+// Encode a batch of pretokenized pieces in one call (amortizes the FFI
+// round-trip — the per-piece path loses to call overhead on short pieces).
+// out must hold piece_offs[n_pieces] ids; out_counts[i] receives piece i's
+// id count. Returns total ids written, or -1 on an uncovered sequence.
+int64_t dn_bpe_encode_batch(void* vocab, const int64_t* piece_offs,
+                            const uint8_t* data, int64_t n_pieces,
+                            int32_t* out, int64_t* out_counts) {
+  auto* v = (BpeVocab*)vocab;
+  int64_t pos = 0;
+  for (int64_t p = 0; p < n_pieces; p++) {
+    int64_t wrote = bpe_encode_one(v, data + piece_offs[p],
+                                   piece_offs[p + 1] - piece_offs[p],
+                                   out + pos);
+    if (wrote < 0) return -1;
+    out_counts[p] = wrote;
+    pos += wrote;
+  }
+  return pos;
+}
+
+void dn_bpe_free(void* vocab) { delete (BpeVocab*)vocab; }
+
 }  // extern "C"

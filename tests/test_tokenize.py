@@ -66,6 +66,29 @@ def test_expression_default_bytes_tokenizer():
     assert out["t"] == [[97, 98]]
 
 
+def test_native_and_python_merges_identical(tmp_path):
+    """The C++ merge loop and the pure-python fallback must be
+    bit-identical over random text."""
+    import numpy as np
+
+    from daft_tpu import native
+    if not native.AVAILABLE:
+        pytest.skip("native library unavailable")
+    path, ranks = _vocab_file(tmp_path)
+    tk = get_tokenizer(path)
+    assert tk._native is not None
+    rng = np.random.default_rng(0)
+    alphabet = "helo wrd xyz\n\t"
+    for _ in range(50):
+        text = "".join(rng.choice(list(alphabet), rng.integers(0, 40)))
+        native_ids = tk.encode(text)
+        python_ids = []
+        for m in tk._rx.finditer(text):
+            python_ids.extend(tk._bpe(m.group().encode("utf-8")))
+        assert native_ids == python_ids, text
+        assert tk.decode(native_ids) == text
+
+
 def test_unknown_token_id_raises():
     tk = BPETokenizer({b"a": 0})
     with pytest.raises(ValueError):
