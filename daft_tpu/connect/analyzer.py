@@ -87,10 +87,62 @@ class SparkAnalyzer:
 
     def _rel_local_relation(self, r: pb.LocalRelation):
         import daft_tpu as dt
-        _require(r.HasField("data"), "LocalRelation without data")
+        if not r.HasField("data"):
+            # schema-only: an empty frame with the declared columns
+            _require(r.HasField("schema"),
+                     "LocalRelation without data or schema")
+            proto = parse_ddl(r.schema)
+            _require(proto.WhichOneof("kind") == "struct",
+                     "LocalRelation schema must be a struct DDL")
+            cols = {f.name: pa.array([], type=proto_to_dtype(
+                f.data_type).to_arrow()) for f in proto.struct.fields}
+            return dt.from_arrow(pa.table(cols))
         with pa.ipc.open_stream(pa.BufferReader(r.data)) as rd:
             table = rd.read_all()
         return dt.from_arrow(table)
+
+    def _rel_to_schema(self, r: pb.ToSchema):
+        """Cast to the declared struct schema, column by name (pyspark's
+        createDataFrame-with-schema path)."""
+        from daft_tpu import col
+        df = self.relation_to_df(r.input)
+        _require(r.schema.WhichOneof("kind") == "struct",
+                 "to_schema needs a struct DataType")
+        exprs = []
+        for f in r.schema.struct.fields:
+            _require(f.name in df.column_names,
+                     f"to_schema: column {f.name!r} missing")
+            exprs.append(col(f.name).cast(
+                proto_to_dtype(f.data_type)).alias(f.name))
+        return df.select(*exprs)
+
+    def _rel_html_string(self, r: pb.HtmlString):
+        """Spark's _repr_html_ path: one row, one column of rendered HTML.
+        Cell values and headers are escaped — data must never inject
+        markup."""
+        import html as _html
+
+        import daft_tpu as dt
+        rows, names, truncated = self._fetch_rows(r.input, r.num_rows)
+        out = ["<table border='1'>", "<tr>"]
+        out += [f"<th>{_html.escape(n)}</th>" for n in names]
+        out.append("</tr>")
+        for row in rows:
+            out.append("<tr>" + "".join(
+                f"<td>{_html.escape(_fmt_cell(row[c], r.truncate))}</td>"
+                for c in names) + "</tr>")
+        out.append("</table>")
+        if truncated:
+            out.append(f"only showing top {r.num_rows} rows")
+        return dt.from_pydict({"html_string": ["\n".join(out) + "\n"]})
+
+    def _fetch_rows(self, input_rel: pb.Relation, num_rows: int):
+        """Shared show/html prologue: first num_rows(+1 to detect
+        truncation) rows as dicts plus column names."""
+        df = self.relation_to_df(input_rel).limit(num_rows + 1)
+        rows = df.to_pylist()
+        truncated = len(rows) > num_rows
+        return rows[:num_rows], df.column_names, truncated
 
     def _rel_project(self, r: pb.Project):
         df = self.relation_to_df(r.input)
@@ -254,18 +306,9 @@ class SparkAnalyzer:
         """Renders like Spark's show(): a one-row, one-column table holding
         the formatted text."""
         import daft_tpu as dt
-        df = self.relation_to_df(r.input).limit(r.num_rows + 1)
-        rows = df.to_pylist()
-        truncated = len(rows) > r.num_rows
-        rows = rows[:r.num_rows]
-        names = df.column_names
-
-        def fmt(v):
-            s = "NULL" if v is None else str(v)
-            t = r.truncate
-            return s if t <= 0 or len(s) <= t else s[:max(t - 3, 1)] + "..."
-
-        cells = [[fmt(row[c]) for c in names] for row in rows]
+        rows, names, truncated = self._fetch_rows(r.input, r.num_rows)
+        cells = [[_fmt_cell(row[c], r.truncate) for c in names]
+                 for row in rows]
         widths = [max([len(n)] + [len(c[i]) for c in cells])
                   for i, n in enumerate(names)]
         sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
@@ -343,6 +386,14 @@ class SparkAnalyzer:
         fn = _FUNCTIONS.get(name)
         _require(fn is not None, f"function {name!r}")
         return fn(*args)
+
+
+def _fmt_cell(v, truncate: int) -> str:
+    """Spark's show()/htmlString cell rendering: NULL text + truncation."""
+    s = "NULL" if v is None else str(v)
+    if truncate <= 0 or len(s) <= truncate:
+        return s
+    return s[:max(truncate - 3, 1)] + "..."
 
 
 def _count_all():

@@ -145,6 +145,42 @@ def test_local_relation_and_join(channel):
     assert t.column("b").to_pylist() == [20, 30]
 
 
+def test_to_schema_and_schema_only_local_relation(channel):
+    rng = pb.Relation(range=pb.Range(end=3, step=1))
+    cast = pb.Relation(to_schema=pb.ToSchema(
+        input=rng, schema=pb.DataType(struct=pb.DataType.Struct(fields=[
+            pb.DataType.StructField(
+                name="id", data_type=pb.DataType(
+                    integer=pb.DataType.Integer()))]))))
+    t = _execute(channel, cast)
+    assert t.column("id").to_pylist() == [0, 1, 2]
+    assert t.schema.field("id").type == pa.int32()
+
+    empty = pb.Relation(local_relation=pb.LocalRelation(
+        schema="a INT, b STRING"))
+    t2 = _execute(channel, empty)
+    assert t2.schema.names == ["a", "b"] and t2.num_rows == 0
+
+
+def test_html_string_escapes_markup(channel):
+    rng = pb.Relation(range=pb.Range(end=2, step=1))
+    h = pb.Relation(html_string=pb.HtmlString(input=rng, num_rows=10,
+                                              truncate=20))
+    t = _execute(channel, h)
+    html = t.column("html_string").to_pylist()[0]
+    assert "<table" in html and "<th>id</th>" in html
+
+    # data must never inject markup
+    evil = pb.Relation(project=pb.Project(
+        input=rng, expressions=[pb.Expression(alias=pb.Expression.Alias(
+            expr=pb.Expression(literal=pb.Expression.Literal(
+                string="<td>x&y</table>")), name=["s"]))]))
+    h2 = pb.Relation(html_string=pb.HtmlString(input=evil, num_rows=5,
+                                               truncate=100))
+    html2 = _execute(channel, h2).column("html_string").to_pylist()[0]
+    assert "<td>&lt;td&gt;x&amp;y&lt;/table&gt;</td>" in html2
+
+
 def test_sql_command_roundtrip(channel):
     # spark.sql() flow: the SQL arrives as a command; the server hands back
     # a relation which the client then executes.
