@@ -61,6 +61,23 @@ def run_daft_q1():
     return out, warm, hot
 
 
+def run_daft_q6():
+    """Second device-tier data point: selective filter + global agg (the
+    fused scan→filter→reduce fragment shape)."""
+    import daft_tpu as dt
+    from benchmarking.tpch import queries as Q
+
+    def get_df(name):
+        return dt.read_parquet(f"{DATA}/{name}/*.parquet")
+    t0 = time.time()
+    out = Q.q6(get_df).to_pydict()
+    warm = time.time() - t0
+    t1 = time.time()
+    out = Q.q6(get_df).to_pydict()
+    hot = time.time() - t1
+    return out, warm, hot
+
+
 def run_arrow_baseline():
     import pyarrow.dataset as pads
     import pyarrow.compute as pc
@@ -81,7 +98,9 @@ def run_arrow_baseline():
 
 
 def _device_child():
-    """Child-process entry: run Q1 with the device tier on, print one JSON."""
+    """Child-process entry: run Q1 (+Q6) with the device tier on, print one
+    JSON line. Q1 prints FIRST so a Q6 compile stall can't zero the main
+    measurement."""
     os.environ["DAFT_TPU_DEVICE"] = "1"
     out, warm, hot = run_daft_q1()
     from daft_tpu.device import backend as dbackend
@@ -89,6 +108,8 @@ def _device_child():
         "warm": warm, "hot": hot, "groups": len(out["l_returnflag"]),
         "backend": dbackend.backend_name() or "host-fallback",
     }), flush=True)
+    _, q6_warm, q6_hot = run_daft_q6()
+    print(json.dumps({"q6_warm": q6_warm, "q6_hot": q6_hot}), flush=True)
 
 
 def _try_device_tier():
@@ -97,21 +118,37 @@ def _try_device_tier():
             [sys.executable, os.path.abspath(__file__), "--device-child"],
             capture_output=True, text=True, timeout=DEVICE_TIMEOUT,
             cwd=REPO, env={**os.environ, "DAFT_TPU_DEVICE": "1"})
-    except subprocess.TimeoutExpired:
-        print("device tier: timed out; using host tier", file=sys.stderr)
-        return None
+    except subprocess.TimeoutExpired as exc:
+        # keep whatever the child already measured (Q1 prints first, so a
+        # Q6 compile stall cannot zero the main measurement)
+        print("device tier: timed out; using partial output",
+              file=sys.stderr)
+        partial = exc.stdout or b""
+        if isinstance(partial, bytes):
+            partial = partial.decode(errors="replace")
+        merged = {}
+        for line in partial.strip().splitlines():
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(parsed, dict):
+                merged.update(parsed)
+        return merged or None
     if proc.returncode != 0:
         print(f"device tier: child failed rc={proc.returncode}\n"
               f"{proc.stderr[-2000:]}", file=sys.stderr)
         return None
-    for line in reversed(proc.stdout.strip().splitlines()):
+    # the child emits one JSON line per measured query; merge them
+    merged = {}
+    for line in proc.stdout.strip().splitlines():
         try:
             parsed = json.loads(line)
         except ValueError:
             continue
         if isinstance(parsed, dict):
-            return parsed
-    return None
+            merged.update(parsed)
+    return merged or None
 
 
 def main():
@@ -129,9 +166,12 @@ def main():
     assert len(out["l_returnflag"]) == base_tbl.num_rows, \
         (len(out["l_returnflag"]), base_tbl.num_rows)
 
+    os.environ["DAFT_TPU_DEVICE"] = "0"
+    _, q6_host_warm, q6_host_hot = run_daft_q6()
     detail = {
         "host_warm_s": round(host_warm, 3), "host_hot_s": round(host_hot, 3),
         "arrow_cpu_baseline_s": round(base_s, 3), "lineitem_rows": nrows,
+        "q6_host_hot_s": round(min(q6_host_warm, q6_host_hot), 3),
         "backend": "host",
     }
     ours = min(host_warm, host_hot)
@@ -146,6 +186,8 @@ def main():
         detail["device_warm_s"] = round(dev["warm"], 3)
         detail["device_hot_s"] = round(dev["hot"], 3)
         detail["device_backend"] = dev.get("backend")
+        if "q6_hot" in dev:
+            detail["q6_device_hot_s"] = round(dev["q6_hot"], 3)
         if dev["hot"] < ours:
             ours = dev["hot"]
             detail["backend"] = dev.get("backend", "device")
