@@ -222,7 +222,10 @@ class SparkAnalyzer:
                 for a in f.arguments:
                     walk(a)
                 return
-            _require(f.function_name in ("==", "=", "eqNullSafe", "<=>"),
+            _require(f.function_name not in ("eqNullSafe", "<=>"),
+                     "null-safe equality (<=>) join keys: NULL <=> NULL "
+                     "must match, which hash join keys do not honor")
+            _require(f.function_name in ("==", "="),
                      f"join condition operator {f.function_name!r}")
             _require(len(f.arguments) == 2, "binary equality expected")
             lk.append(self.expr(f.arguments[0]))
@@ -411,6 +414,13 @@ def _is_star_or_one(e: pb.Expression) -> bool:
     return False
 
 
+def _null_safe_eq(a, b):
+    """Spark `<=>`: never NULL — and_kleene(NULL, False)=False makes each
+    disjunct definite before the OR."""
+    return (a.is_null() & b.is_null()) \
+        | ((a == b) & a.not_null() & b.not_null())
+
+
 # Spark unresolved function name → daft_tpu Expression builder. pyspark's
 # Column operators arrive as the operator symbol; pyspark.sql.functions
 # arrive by name.
@@ -428,7 +438,8 @@ _FUNCTIONS = {
     "==": lambda a, b: a == b,
     "=": lambda a, b: a == b,
     "!=": lambda a, b: a != b,
-    "<=>": lambda a, b: a == b,
+    "<=>": lambda a, b: _null_safe_eq(a, b),
+    "eqNullSafe": lambda a, b: _null_safe_eq(a, b),
     "and": lambda a, b: a & b,
     "or": lambda a, b: a | b,
     "not": lambda a: ~a,

@@ -22,6 +22,7 @@ import http.server
 import io
 import os
 import threading
+import urllib.parse
 import urllib.request
 import uuid
 from typing import Dict, List, Optional, Tuple
@@ -232,8 +233,10 @@ class FlightShuffleServer:
                 gen = _spill_file_batches(path)
                 first = next(gen, None)
                 if first is None:
-                    # empty partition: zero-column empty stream sentinel
-                    empty = pa.schema([])
+                    # empty partition: marked-schema sentinel (out-of-band —
+                    # a real zero-column partition with rows must survive)
+                    empty = pa.schema(
+                        [], metadata={b"daft_tpu_empty": b"1"})
                     return paflight.GeneratorStream(empty, iter(()))
                 schema, batch0 = first
 
@@ -303,7 +306,9 @@ def configure_local_shuffle_server(host: str, advertise_host: str):
         if _local_server is not None:
             current = _local_server.address
             want_host = advertise_host or host
-            if want_host not in current:
+            cur_host = urllib.parse.urlparse(
+                current if "://" in current else f"http://{current}").hostname
+            if cur_host != want_host.lower():  # urlparse lowercases hostname
                 raise RuntimeError(
                     f"shuffle server already running at {current}; cannot "
                     f"re-advertise as {want_host}")
@@ -405,7 +410,8 @@ def fetch_partition(address: str, shuffle_id: str, partition: int
             t = reader.read_all()
         finally:
             client.close()
-        return None if t.num_columns == 0 else t
+        meta = t.schema.metadata or {}
+        return None if meta.get(b"daft_tpu_empty") == b"1" else t
     url = f"{address}/shuffle/{shuffle_id}/{partition}"
     timeout = float(os.environ.get("DAFT_TPU_SHUFFLE_TIMEOUT", "600"))
     with urllib.request.urlopen(url, timeout=timeout) as r:

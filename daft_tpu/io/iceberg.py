@@ -9,6 +9,14 @@ directly (``avro.py``), and appends write spec-compliant v1 metadata —
 so ``read_iceberg``/``write_iceberg`` work against a plain warehouse path
 on any supported object store (local/S3/GCS/Azure).
 
+Writes carry Iceberg spec field-ids in the Avro manifest schemas and commit
+optimistically: the new ``v(N+1).metadata.json`` is create-exclusive (truly
+atomic on local paths; check-then-put on object stores) and the commit is
+retried against the refreshed table state on conflict, so concurrent
+writers serialize instead of clobbering. Prior snapshots are retained in
+the metadata snapshot log on overwrite (time travel). External-engine
+interop (pyiceberg/Spark/Trino) is untested in this environment.
+
 Unsupported (raises): v2 position/equality delete files, schema evolution
 by field-id remapping, partitioned writes.
 """
@@ -64,6 +72,31 @@ def _exists(uri: str, io_config) -> bool:
         return False
 
 
+def _put_if_absent(uri: str, data: bytes, io_config) -> bool:
+    """Create-exclusive write for the metadata-commit race. Local paths are
+    truly atomic (O_CREAT|O_EXCL); object stores get check-then-put, which
+    narrows but cannot eliminate the window without store preconditions."""
+    if _is_remote(uri):
+        client = get_io_client(io_config)
+        try:
+            client.source_for(uri).get_size(uri)
+            return False  # object already exists (HEAD, not a full GET)
+        except FileNotFoundError:
+            pass  # transport errors propagate: clobbering a committed
+            # metadata file is worse than failing the commit attempt
+        client.put(uri, data)
+        return True
+    p = uri[7:] if uri.startswith("file://") else uri
+    os.makedirs(os.path.dirname(p), exist_ok=True)
+    try:
+        fd = os.open(p, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+    return True
+
+
 # ------------------------------------------------------------- metadata
 
 def _resolve_metadata_path(table_uri: str, io_config) -> str:
@@ -71,29 +104,34 @@ def _resolve_metadata_path(table_uri: str, io_config) -> str:
     vN.metadata.json via glob)."""
     if table_uri.endswith(".metadata.json"):
         return table_uri
-    hint = _join(table_uri, "metadata", "version-hint.text")
-    try:
-        v = _get(hint, io_config).decode().strip()
-        cand = _join(table_uri, "metadata", f"v{v}.metadata.json")
-        if _exists(cand, io_config):
-            return cand
-    except Exception:
-        pass
     pattern = _join(table_uri, "metadata", "*.metadata.json")
     if _is_remote(table_uri):
         hits = get_io_client(io_config).glob(pattern)
     else:
         import glob as _g
         hits = sorted(_g.glob(pattern))
-    if not hits:
-        raise FileNotFoundError(
-            f"no Iceberg metadata under {table_uri!r}")
 
     def version(p: str) -> Tuple[int, str]:
         m = re.search(r"v?(\d+)[^/]*\.metadata\.json$", p)
         return (int(m.group(1)) if m else -1, p)
 
-    return max(hits, key=version)
+    # the hint is a last-writer-wins pointer that a racing committer may
+    # not have updated yet — take the max of hint and glob, never trust
+    # the hint alone (a stale hint would wedge every later commit)
+    best = max(hits, key=version) if hits else None
+    hint = _join(table_uri, "metadata", "version-hint.text")
+    try:
+        v = _get(hint, io_config).decode().strip()
+        cand = _join(table_uri, "metadata", f"v{v}.metadata.json")
+        if (best is None or version(cand) > version(best)) \
+                and _exists(cand, io_config):
+            best = cand
+    except Exception:
+        pass
+    if best is None:
+        raise FileNotFoundError(
+            f"no Iceberg metadata under {table_uri!r}")
+    return best
 
 
 def load_table_metadata(table_uri: str,
@@ -241,30 +279,50 @@ def _iceberg_type(dtype) -> str:
 
 # ----------------------------------------------------------------- write
 
+# Field-ids per the Iceberg v1 spec's manifest / manifest-list tables
+# (spec "Manifests" and "Manifest Lists" sections; the reference relies on
+# pyiceberg carrying the same ids).
 _MANIFEST_ENTRY_SCHEMA = {
     "type": "record", "name": "manifest_entry", "fields": [
-        {"name": "status", "type": "int"},
-        {"name": "snapshot_id", "type": ["null", "long"]},
-        {"name": "data_file", "type": {
+        {"name": "status", "type": "int", "field-id": 0},
+        {"name": "snapshot_id", "type": ["null", "long"], "field-id": 1},
+        {"name": "data_file", "field-id": 2, "type": {
             "type": "record", "name": "r2", "fields": [
-                {"name": "file_path", "type": "string"},
-                {"name": "file_format", "type": "string"},
-                {"name": "partition", "type": {
+                {"name": "file_path", "type": "string", "field-id": 100},
+                {"name": "file_format", "type": "string", "field-id": 101},
+                {"name": "partition", "field-id": 102, "type": {
                     "type": "record", "name": "r102", "fields": []}},
-                {"name": "record_count", "type": "long"},
-                {"name": "file_size_in_bytes", "type": "long"},
+                {"name": "record_count", "type": "long", "field-id": 103},
+                {"name": "file_size_in_bytes", "type": "long",
+                 "field-id": 104},
+                {"name": "block_size_in_bytes", "type": "long",
+                 "field-id": 105},
             ]}},
+    ]}
+
+_FIELD_SUMMARY_SCHEMA = {
+    "type": "record", "name": "field_summary", "fields": [
+        {"name": "contains_null", "type": "boolean", "field-id": 509},
+        {"name": "lower_bound", "type": ["null", "bytes"], "field-id": 510},
+        {"name": "upper_bound", "type": ["null", "bytes"], "field-id": 511},
     ]}
 
 _MANIFEST_FILE_SCHEMA = {
     "type": "record", "name": "manifest_file", "fields": [
-        {"name": "manifest_path", "type": "string"},
-        {"name": "manifest_length", "type": "long"},
-        {"name": "partition_spec_id", "type": "int"},
-        {"name": "added_snapshot_id", "type": ["null", "long"]},
-        {"name": "added_data_files_count", "type": ["null", "int"]},
-        {"name": "existing_data_files_count", "type": ["null", "int"]},
-        {"name": "deleted_data_files_count", "type": ["null", "int"]},
+        {"name": "manifest_path", "type": "string", "field-id": 500},
+        {"name": "manifest_length", "type": "long", "field-id": 501},
+        {"name": "partition_spec_id", "type": "int", "field-id": 502},
+        {"name": "added_snapshot_id", "type": ["null", "long"],
+         "field-id": 503},
+        {"name": "added_data_files_count", "type": ["null", "int"],
+         "field-id": 504},
+        {"name": "existing_data_files_count", "type": ["null", "int"],
+         "field-id": 505},
+        {"name": "deleted_data_files_count", "type": ["null", "int"],
+         "field-id": 506},
+        {"name": "partitions", "field-id": 507, "type": [
+            "null", {"type": "array", "items": _FIELD_SUMMARY_SCHEMA,
+                     "element-id": 508}]},
     ]}
 
 
@@ -279,96 +337,119 @@ def write_iceberg(df, table_uri: str, mode: str = "append",
     if mode not in ("append", "overwrite"):
         raise ValueError(f"write_iceberg mode {mode!r}")
     table = df.to_arrow()
-    try:
-        meta = load_table_metadata(table_uri, io_config)
-        version = int(re.search(r"v?(\d+)[^/]*\.metadata\.json$",
-                                meta["_metadata_path"]).group(1))
-    except FileNotFoundError:
-        meta = None
-        version = 0
-
     snapshot_id = int(uuid.uuid4().int % (1 << 62))
-    now_ms = int(time.time() * 1000)
 
-    # 1. data file
+    # 1. data file + its manifest: immutable, content-addressed by uuid —
+    # written once, reused across metadata-commit retries.
     import io as _io
     buf = _io.BytesIO()
     pq.write_table(table, buf)
-    data_name = f"data/{uuid.uuid4().hex}.parquet"
-    data_uri = _join(table_uri, data_name)
+    data_uri = _join(table_uri, f"data/{uuid.uuid4().hex}.parquet")
     _put(data_uri, buf.getvalue(), io_config)
-
-    # 2. manifest
     entry = {"status": 1, "snapshot_id": snapshot_id, "data_file": {
         "file_path": data_uri, "file_format": "PARQUET", "partition": {},
         "record_count": table.num_rows,
-        "file_size_in_bytes": buf.getbuffer().nbytes}}
-    manifest_blob = write_avro(_MANIFEST_ENTRY_SCHEMA, [entry])
-    manifest_name = f"metadata/{uuid.uuid4().hex}-m0.avro"
-    manifest_uri = _join(table_uri, manifest_name)
+        "file_size_in_bytes": buf.getbuffer().nbytes,
+        "block_size_in_bytes": 64 * 1024 * 1024}}
+    manifest_blob = write_avro(
+        _MANIFEST_ENTRY_SCHEMA, [entry],
+        metadata={"format-version": "1", "content": "data",
+                  "partition-spec-id": "0"})
+    manifest_uri = _join(table_uri, f"metadata/{uuid.uuid4().hex}-m0.avro")
     _put(manifest_uri, manifest_blob, io_config)
 
-    # 3. manifest list: prior manifests carry over on append
-    manifests = [{"manifest_path": manifest_uri,
-                  "manifest_length": len(manifest_blob),
-                  "partition_spec_id": 0,
-                  "added_snapshot_id": snapshot_id,
-                  "added_data_files_count": 1,
-                  "existing_data_files_count": 0,
-                  "deleted_data_files_count": 0}]
-    if meta is not None and mode == "append":
-        snap = _current_snapshot(meta, None)
-        if snap is not None:
-            mlist_uri = _rewrite_location(snap["manifest-list"], meta,
-                                          table_uri)
-            _, prior = read_avro(_get(mlist_uri, io_config))
-            carried = [{k: m.get(k) for k in (
-                "manifest_path", "manifest_length", "partition_spec_id",
-                "added_snapshot_id", "added_data_files_count",
-                "existing_data_files_count", "deleted_data_files_count")}
-                for m in prior]
-            manifests = carried + manifests
-    mlist_blob = write_avro(_MANIFEST_FILE_SCHEMA, manifests)
-    mlist_name = f"metadata/snap-{snapshot_id}-1-{uuid.uuid4().hex}.avro"
-    mlist_uri = _join(table_uri, mlist_name)
-    _put(mlist_uri, mlist_blob, io_config)
-
-    # 4. metadata json + version hint
     schema = df.schema()
     ice_schema = {"type": "struct", "schema-id": 0, "fields": [
         {"id": i + 1, "name": f.name, "required": False,
          "type": _iceberg_type(f.dtype)}
         for i, f in enumerate(schema)]}
-    snapshot = {"snapshot-id": snapshot_id, "timestamp-ms": now_ms,
-                "manifest-list": mlist_uri,
-                "summary": {"operation": "append" if mode == "append"
-                            else "overwrite"},
-                "schema-id": 0}
-    if meta is None:
-        new_meta = {
-            "format-version": 1,
-            "table-uuid": str(uuid.uuid4()),
-            "location": table_uri,
-            "last-updated-ms": now_ms,
-            "last-column-id": len(schema.fields),
-            "schema": ice_schema, "schemas": [ice_schema],
-            "current-schema-id": 0,
-            "partition-spec": [],
-            "partition-specs": [{"spec-id": 0, "fields": []}],
-            "default-spec-id": 0,
-            "properties": {},
-            "current-snapshot-id": snapshot_id,
-            "snapshots": [snapshot],
-        }
-    else:
-        new_meta = {k: v for k, v in meta.items()
-                    if k != "_metadata_path"}
-        snaps = new_meta.get("snapshots", []) if mode == "append" else []
-        new_meta["snapshots"] = snaps + [snapshot]
-        new_meta["current-snapshot-id"] = snapshot_id
-        new_meta["last-updated-ms"] = now_ms
-    new_version = version + 1
-    _put(_join(table_uri, "metadata", f"v{new_version}.metadata.json"),
-         json.dumps(new_meta, indent=2).encode(), io_config)
-    _put(_join(table_uri, "metadata", "version-hint.text"),
-         str(new_version).encode(), io_config)
+
+    _MLIST_KEYS = ("manifest_path", "manifest_length", "partition_spec_id",
+                   "added_snapshot_id", "added_data_files_count",
+                   "existing_data_files_count", "deleted_data_files_count",
+                   "partitions")
+
+    # 2. optimistic metadata commit: v(N+1) is create-exclusive; on losing
+    # the race, re-read the table state and rebuild the manifest list
+    # against the new current snapshot.
+    for _attempt in range(5):
+        try:
+            meta = load_table_metadata(table_uri, io_config)
+            version = int(re.search(r"v?(\d+)[^/]*\.metadata\.json$",
+                                    meta["_metadata_path"]).group(1))
+        except FileNotFoundError:
+            meta = None
+            version = 0
+        now_ms = int(time.time() * 1000)
+
+        manifests = [{"manifest_path": manifest_uri,
+                      "manifest_length": len(manifest_blob),
+                      "partition_spec_id": 0,
+                      "added_snapshot_id": snapshot_id,
+                      "added_data_files_count": 1,
+                      "existing_data_files_count": 0,
+                      "deleted_data_files_count": 0,
+                      "partitions": None}]
+        if meta is not None and mode == "append":
+            snap = _current_snapshot(meta, None)
+            if snap is not None:
+                mlist_uri0 = _rewrite_location(snap["manifest-list"], meta,
+                                               table_uri)
+                _, prior = read_avro(_get(mlist_uri0, io_config))
+                for m in prior:
+                    if m.get("content", 0) != 0:
+                        raise NotImplementedError(
+                            "append to a table with v2 delete manifests "
+                            "would silently rewrite them as data manifests")
+                carried = [{k: m.get(k) for k in _MLIST_KEYS}
+                           for m in prior]
+                manifests = carried + manifests
+        mlist_blob = write_avro(
+            _MANIFEST_FILE_SCHEMA, manifests,
+            metadata={"format-version": "1"})
+        mlist_uri = _join(
+            table_uri,
+            f"metadata/snap-{snapshot_id}-1-{uuid.uuid4().hex}.avro")
+        _put(mlist_uri, mlist_blob, io_config)
+
+        snapshot = {"snapshot-id": snapshot_id, "timestamp-ms": now_ms,
+                    "manifest-list": mlist_uri,
+                    "summary": {"operation": "append" if mode == "append"
+                                else "overwrite"},
+                    "schema-id": 0}
+        if meta is None:
+            new_meta = {
+                "format-version": 1,
+                "table-uuid": str(uuid.uuid4()),
+                "location": table_uri,
+                "last-updated-ms": now_ms,
+                "last-column-id": len(schema.fields),
+                "schema": ice_schema, "schemas": [ice_schema],
+                "current-schema-id": 0,
+                "partition-spec": [],
+                "partition-specs": [{"spec-id": 0, "fields": []}],
+                "default-spec-id": 0,
+                "properties": {},
+                "current-snapshot-id": snapshot_id,
+                "snapshots": [snapshot],
+            }
+        else:
+            new_meta = {k: v for k, v in meta.items()
+                        if k != "_metadata_path"}
+            # prior snapshots stay in the log either way (time travel);
+            # overwrite only changes which manifests the NEW snapshot lists
+            new_meta["snapshots"] = (new_meta.get("snapshots", [])
+                                     + [snapshot])
+            new_meta["current-snapshot-id"] = snapshot_id
+            new_meta["last-updated-ms"] = now_ms
+        new_version = version + 1
+        meta_uri = _join(table_uri, "metadata",
+                         f"v{new_version}.metadata.json")
+        if _put_if_absent(meta_uri, json.dumps(new_meta, indent=2).encode(),
+                          io_config):
+            _put(_join(table_uri, "metadata", "version-hint.text"),
+                 str(new_version).encode(), io_config)
+            return
+    raise RuntimeError(
+        f"write_iceberg: lost the metadata commit race at {table_uri!r} "
+        "5 times (concurrent writers)")
