@@ -54,25 +54,45 @@ def _min_rows() -> int:
     return 4096 if _is_transfer_bound() else 0
 
 
-def _row_output_profitable(n_rows: int) -> bool:
+def _series_nbytes(s: Series) -> int:
+    try:
+        return int(s.to_arrow().nbytes)
+    except Exception:
+        return 9 * len(s)
+
+
+def _batch_cols_nbytes(batch, cols) -> int:
+    return sum(_series_nbytes(batch.get_column(c)) for c in cols)
+
+
+def _min_rows_override(n_rows: int) -> Optional[bool]:
+    """An explicit DAFT_TPU_DEVICE_MIN_ROWS keeps its documented meaning on
+    every backend (device runs at or above that many rows); FORCE trumps it.
+    None → no override, consult the cost model."""
+    env = os.environ.get("DAFT_TPU_DEVICE_MIN_ROWS")
+    if env is None or os.environ.get("DAFT_TPU_DEVICE_FORCE") is not None:
+        return None
+    return n_rows >= max(int(env), 1)
+
+
+def _row_output_profitable(batch, needs_cols, n_outputs: int,
+                           out_bytes_per_row: int = 8) -> bool:
     """Cost gate for ops whose OUTPUT is row-shaped (projection values, sort
-    permutations, filter masks): on a transfer-bound link the result must
-    come back over the slow device→host path (~30 MB/s measured on this
-    tunnel vs ~GB/s host kernel throughput), which the compute saving can
-    essentially never repay, so these ops default to host there.
-    Reduction-shaped ops (aggregations) are exempt — their outputs are group
-    blocks, transferred once in packed form. Overrides:
-    DAFT_TPU_DEVICE_FORCE=1 forces the device on; an explicit
+    permutations, filter masks): the measured-link cost model compares
+    transfer+RTT against a host vector pass (``costmodel.py``). On the
+    bench tunnel (~40 MB/s) this picks host, on a local chip it picks the
+    device — same code, measured numbers. Reduction-shaped ops are gated
+    separately (their outputs are packed group blocks). An explicit
     DAFT_TPU_DEVICE_MIN_ROWS keeps its documented meaning (the device runs
     at or above that many rows) on every backend."""
-    if os.environ.get("DAFT_TPU_DEVICE_FORCE") == "1":
-        return True
-    env = os.environ.get("DAFT_TPU_DEVICE_MIN_ROWS")
-    if env is not None:
-        return n_rows >= max(int(env), 1)
-    if _is_transfer_bound():
-        return False
-    return n_rows >= 1
+    from . import costmodel
+    n_rows = len(batch)
+    ov = _min_rows_override(n_rows)
+    if ov is not None:
+        return ov
+    bytes_up = _batch_cols_nbytes(batch, needs_cols)
+    bytes_down = n_rows * out_bytes_per_row * max(n_outputs, 1)
+    return costmodel.row_output_op_wins(bytes_up, bytes_down)
 
 
 _projection_cache: Dict[Tuple, compiler.Compiled] = {}
@@ -144,8 +164,7 @@ def _run_compiled(c: compiler.Compiled, batch, exprs: List[Expression]):
 def try_eval_projection(batch, exprs: List[Expression]):
     """Full projection on device; None → host fallback."""
     from ..recordbatch import RecordBatch
-    if not device_enabled() \
-            or not _row_output_profitable(len(batch)):
+    if not device_enabled():
         return None
     schema = batch.schema
     out_fields = []
@@ -164,6 +183,8 @@ def try_eval_projection(batch, exprs: List[Expression]):
     c = _get_compiled(exprs, schema)
     if c is None:
         return None
+    if not _row_output_profitable(batch, c.needs_cols, len(exprs)):
+        return None
     for name in c.needs_cols:
         if batch.get_column(name).is_pyobject():
             return None
@@ -181,10 +202,13 @@ def try_eval_projection(batch, exprs: List[Expression]):
 
 def try_eval_predicate(batch, predicate: Expression) -> Optional[np.ndarray]:
     """Predicate → host boolean mask (for arrow-side filtering)."""
-    if not device_enabled() or not _row_output_profitable(len(batch)):
+    if not device_enabled():
         return None
     c = _get_compiled([predicate], batch.schema)
     if c is None:
+        return None
+    if not _row_output_profitable(batch, c.needs_cols, 1,
+                                  out_bytes_per_row=1):
         return None
     for name in c.needs_cols:
         if batch.get_column(name).is_pyobject():
@@ -197,10 +221,17 @@ def try_eval_predicate(batch, predicate: Expression) -> Optional[np.ndarray]:
 
 def try_argsort(key_series: List[Series], descending: List[bool],
                 nulls_first: List[bool]) -> Optional[np.ndarray]:
+    from . import costmodel
     if not device_enabled() or not key_series:
         return None
     n = len(key_series[0])
-    if n < 2 or not _row_output_profitable(n):
+    if n < 2:
+        return None
+    ov = _min_rows_override(n)
+    if ov is False:
+        return None
+    if ov is None and not costmodel.argsort_wins(
+            n, sum(_series_nbytes(s) for s in key_series), len(key_series)):
         return None
     for s in key_series:
         if s.is_pyobject():
@@ -226,6 +257,7 @@ def try_agg(batch, to_agg: List[Expression], group_by: List[Expression]):
     """Grouped/global aggregation on device; None → host fallback."""
     from ..aggs import split_agg_expr
     from ..recordbatch import RecordBatch
+    from . import costmodel
     if not device_enabled() or len(batch) < max(_min_rows(), 1):
         return None
     schema = batch.schema
@@ -268,6 +300,12 @@ def try_agg(batch, to_agg: List[Expression], group_by: List[Expression]):
     for nm in c.needs_cols:
         if batch.get_column(nm).is_pyobject():
             return None
+    # in-memory batch: no HBM-cache identity, the upload is one-shot
+    packed_out = (1 + 2 * (len(group_by) + len(to_agg))) * 128 * 8
+    if not costmodel.agg_upload_wins(
+            _batch_cols_nbytes(batch, c.needs_cols),
+            packed_out, cacheable=False):
+        return None
 
     dt, outs = _run_compiled(c, batch, proj)
     nk = len(group_by)
@@ -316,5 +354,9 @@ def try_agg(batch, to_agg: List[Expression], group_by: List[Expression]):
 
 def _decode_scalar(name: str, dtype: DataType, v: np.ndarray, m: np.ndarray
                    ) -> Series:
-    dc = dcol.DeviceColumn(jnp.asarray(v), jnp.asarray(m), dtype, None)
+    # v/m are already host-side numpy (fetched in the caller's single packed
+    # transfer) — wrapping them in jnp.asarray would re-upload to the device
+    # only for decode_column to fetch them straight back: 2 extra RTTs per
+    # scalar (~0.2 s each on the tunnel; this was the whole Q6 regression)
+    dc = dcol.DeviceColumn(v, m, dtype, None)
     return dcol.decode_column(name, dc, 1)

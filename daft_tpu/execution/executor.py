@@ -282,6 +282,8 @@ class LocalExecutor:
         from ..device import cache as dcache, column as dcol, fragment
         from ..device import runtime as drt
 
+        n_tasks = len(src.tasks)
+
         def load(t) -> RecordBatch:
             est = t.size_bytes() or 0
             self.mem.acquire(est)
@@ -291,6 +293,7 @@ class LocalExecutor:
                 self.mem.release(est)
 
         def resolve(t):
+            from ..device import costmodel
             fp = dcache.task_fingerprint(t)
             if fp is not None:
                 dt = dcache.get_cache().get_table(fp, prog.compiled.needs_cols)
@@ -302,6 +305,19 @@ class LocalExecutor:
             for nm in prog.compiled.needs_cols:
                 if rb.get_column(nm).is_pyobject():
                     return ("host", rb, t)
+            # measured cost gate: a cacheable upload is an investment the
+            # HBM cache repays on every later scan of the same task — but
+            # only if the whole scan's working set actually FITS the budget
+            # (otherwise LRU thrash re-pays the upload every query and
+            # put_table would refuse oversized tables anyway)
+            packed_out = (1 + 2 * len(prog.ops) + 2 * prog.nk) * 128 * 8
+            col_bytes = drt._batch_cols_nbytes(rb, prog.compiled.needs_cols)
+            est_encoded = 2 * col_bytes  # capacity bucketing ≤ doubles
+            fits = est_encoded * max(n_tasks, 1) <= dcache._budget()
+            if not costmodel.agg_upload_wins(
+                    col_bytes, packed_out,
+                    cacheable=fp is not None and fits):
+                return ("host", rb, t)
             try:
                 dt = dcol.encode_batch(rb, prog.compiled.needs_cols)
             except (ValueError, TypeError):

@@ -71,17 +71,29 @@ def match_indices(l_gids: np.ndarray, r_gids: np.ndarray,
     Returns (li, ri, l_match_counts): parallel index arrays of the matching
     pairs plus per-left-row match counts.
 
-    With ``DAFT_TPU_DEVICE_JOIN=1`` the index generation runs the device
-    tier's three-phase sort/searchsorted/expand kernels
-    (``device.kernels.join_phase_*``) instead of numpy. Opt-in, not the
-    default: the output is row-shaped (one index pair per match), so on a
-    transfer-bound single-chip link the device loses to the host by >10×
-    measured — the kernels pay off when join inputs already live in HBM
-    and stay there (mesh-resident pipelines), which is what this seam is
-    for.
+    The device tier's three-phase sort/searchsorted/expand kernels
+    (``device.kernels.join_phase_*``) are chosen by the measured link cost
+    model (``device.costmodel.join_wins``): the output is row-shaped (one
+    index pair per match), so on a transfer-bound single-chip link the
+    device loses to the host by >10× measured and the model picks numpy;
+    on a local chip (or the CPU mesh in tests) the kernels win and the
+    model picks them. ``DAFT_TPU_DEVICE_JOIN=1/0`` force-overrides.
     """
     import os
-    if os.environ.get("DAFT_TPU_DEVICE_JOIN") == "1":
+    env = os.environ.get("DAFT_TPU_DEVICE_JOIN")
+    use_device = env == "1"
+    if env is None:
+        from .device import costmodel, runtime as drt
+        n_l, n_r = len(l_gids), len(r_gids)
+        # output estimate: FK-join shaped — about one match per probe row
+        est_out = 2 * 8 * max(n_l, n_r)
+        use_device = (drt.device_enabled()
+                      and n_l + n_r >= 8192
+                      and costmodel.join_wins(
+                          n_l, n_r,
+                          l_gids.nbytes + r_gids.nbytes
+                          + l_valid.nbytes + r_valid.nbytes, est_out))
+    if use_device:
         out = _device_match_indices(l_gids, r_gids, l_valid, r_valid)
         if out is not None:
             return out
