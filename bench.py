@@ -1,21 +1,29 @@
-"""Benchmark driver: TPC-H Q1 through the daft_tpu engine.
+"""Benchmark driver: all five BASELINE.json config families through the
+daft_tpu engine.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
+
+Config families (BASELINE.json):
+1. TPC-H Q1 @ SF1  — the headline metric (rows/s/chip), host + device tiers
+2. TPC-H Q3/Q5/Q10 @ SF10 — 3-way joins + aggregate (runs when the SF10
+   dataset is present or BENCH_SF10=1 generates it; ~25 min one-time gen)
+3. TPC-H full Q1–Q22 — per-query hot + total wall-clock @ SF1 always, and
+   @ SF10 when present
+4. TPC-DS Q47/Q63/Q89 — window/rolling trio via the SQL frontend
+5. LAION-style multimodal — PNG decode → resize → random-projection
+   embedding (device matmul) → cosine sim → groupby
 
 Structure (hang-proof by construction, round-1 postmortem):
-1. baseline: the same Q1 via Arrow C++ compute (pyarrow TableGroupBy) on CPU
-   — the reference engine's substrate — measured in-process.
-2. host tier: the full daft_tpu DataFrame pipeline with the device tier
-   disabled (DAFT_TPU_DEVICE=0), in-process. This never touches the JAX
-   backend, so it cannot hang; its number is always captured.
-3. device tier: the same query with the device tier enabled, in a CHILD
-   process under a timeout (BENCH_DEVICE_TIMEOUT, default 600 s). A wedged
-   TPU plugin (round-1 failure: lazy PJRT init hung forever) kills only the
-   child; the engine-side watchdog (daft_tpu/device/backend.py) additionally
-   pins the child to the host tier if backend init times out.
-The reported number is the best tier. vs_baseline = baseline_s / ours_s
-(>1 → we're faster). BENCH_SF / BENCH_PARTS control the dataset.
+- the Arrow CPU baseline and the host tier (DAFT_TPU_DEVICE=0) run
+  in-process: they never touch the JAX backend and cannot hang.
+- the device tier runs in a CHILD process under BENCH_DEVICE_TIMEOUT
+  (default 900 s), printing one JSON line per completed section so a stall
+  only loses the sections after it. A wedged TPU plugin kills the child,
+  never the driver; the engine watchdog additionally pins a dead backend
+  to the host tier.
+The reported headline is the best tier on Q1@SF1. vs_baseline =
+arrow_baseline_s / ours_s (>1 → we're faster).
 """
 
 from __future__ import annotations
@@ -33,54 +41,181 @@ sys.path.insert(0, REPO)
 SF = float(os.environ.get("BENCH_SF", "1"))
 PARTS = int(os.environ.get("BENCH_PARTS", "8"))
 DATA = os.path.join(REPO, ".cache", f"tpch_sf{SF}")
-DEVICE_TIMEOUT = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "600"))
+SF10_DATA = os.path.join(REPO, ".cache", "tpch_sf10.0")
+TPCDS_DATA = os.path.join(REPO, ".cache", "tpcds_s1")
+LAION_DATA = os.path.join(REPO, ".cache", "laion_4k")
+DEVICE_TIMEOUT = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "900"))
+
+TPCH_QUERIES = [f"q{i}" for i in range(1, 23)]
 
 
 def ensure_data():
-    marker = os.path.join(DATA, "lineitem")
-    if not os.path.isdir(marker):
+    if not os.path.isdir(os.path.join(DATA, "lineitem")):
         from benchmarking.tpch.datagen import generate_tpch
         print(f"generating TPC-H SF{SF} …", file=sys.stderr, flush=True)
         generate_tpch(DATA, SF, PARTS)
-    return DATA
+    if os.environ.get("BENCH_SF10") == "1" \
+            and not os.path.isdir(os.path.join(SF10_DATA, "lineitem")):
+        from benchmarking.tpch.datagen import generate_tpch
+        print("generating TPC-H SF10 (one-time, ~25 min) …",
+              file=sys.stderr, flush=True)
+        generate_tpch(SF10_DATA, 10.0, 16)
+    if not os.path.isdir(os.path.join(TPCDS_DATA, "store_sales")):
+        from benchmarking.tpcds.datagen import generate_tpcds
+        print("generating TPC-DS …", file=sys.stderr, flush=True)
+        generate_tpcds(TPCDS_DATA, scale=1.0)
+    if not os.path.isdir(LAION_DATA):
+        _gen_laion(LAION_DATA)
 
 
-def run_daft_q1():
+def _gen_laion(root: str, n: int = 4096, px: int = 64):
+    """Synthetic LAION-like shard: (id, label, png) parquet. Labels are the
+    dominant color channel so the downstream groupby has semantics."""
+    import io as _io
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from PIL import Image
+    rng = np.random.default_rng(7)
+    labels, blobs = [], []
+    for i in range(n):
+        lab = i % 3
+        img = rng.integers(0, 96, size=(px, px, 3), dtype=np.uint8)
+        img[..., lab] += 128
+        b = _io.BytesIO()
+        Image.fromarray(img).save(b, format="PNG")
+        labels.append("rgb"[lab])
+        blobs.append(b.getvalue())
+    os.makedirs(root, exist_ok=True)
+    pq.write_table(
+        pa.table({"id": pa.array(range(n), pa.int64()),
+                  "label": pa.array(labels),
+                  "png": pa.array(blobs, pa.large_binary())}),
+        os.path.join(root, "images.parquet"))
+
+
+# --------------------------------------------------------------- sections
+
+def _get_df_factory(root):
     import daft_tpu as dt
-    from benchmarking.tpch import queries as Q
 
     def get_df(name):
-        return dt.read_parquet(f"{DATA}/{name}/*.parquet")
-    # warm once (compile cache + IO cache), then measure
+        return dt.read_parquet(f"{root}/{name}/*.parquet")
+    return get_df
+
+
+def run_tpch_query(root, qname: str):
+    """(warm_s, hot_s) for one TPC-H query over `root`."""
+    from benchmarking.tpch import queries as Q
+    get_df = _get_df_factory(root)
+    fn = getattr(Q, qname)
     t0 = time.time()
-    out = Q.q1(get_df).to_pydict()
+    out = fn(get_df).to_pydict()
     warm = time.time() - t0
-    t1 = time.time()
-    out = Q.q1(get_df).to_pydict()
-    hot = time.time() - t1
+    t0 = time.time()
+    fn(get_df).to_pydict()
+    hot = time.time() - t0
     return out, warm, hot
 
 
-def run_daft_q6():
-    """Second device-tier data point: selective filter + global agg (the
-    fused scan→filter→reduce fragment shape)."""
-    import daft_tpu as dt
-    from benchmarking.tpch import queries as Q
+def run_tpch_suite(root, queries=TPCH_QUERIES, budget_s: float = 1e9):
+    """Hot per-query times + totals. Respects a wall-clock budget: queries
+    past the budget are skipped and named in the result."""
+    per_q = {}
+    skipped = []
+    t_start = time.time()
+    total_hot = 0.0
+    for qn in queries:
+        if time.time() - t_start > budget_s:
+            skipped.append(qn)
+            continue
+        try:
+            _, warm, hot = run_tpch_query(root, qn)
+        except Exception as exc:  # a failing query must not kill the bench
+            per_q[qn] = {"error": str(exc)[:200]}
+            continue
+        per_q[qn] = round(min(warm, hot), 3)
+        total_hot += min(warm, hot)
+    out = {"per_query_hot_s": per_q, "total_hot_s": round(total_hot, 3)}
+    if skipped:
+        out["skipped"] = skipped
+    return out
 
-    def get_df(name):
-        return dt.read_parquet(f"{DATA}/{name}/*.parquet")
+
+def run_tpcds_trio(root):
+    from benchmarking.tpcds import queries as Q
+    get_df = _get_df_factory(root)
+    out = {}
+    for qnum in (47, 63, 89):
+        t0 = time.time()
+        Q.run(qnum, get_df).to_pydict()
+        warm = time.time() - t0
+        t0 = time.time()
+        Q.run(qnum, get_df).to_pydict()
+        out[f"q{qnum}_hot_s"] = round(min(warm, time.time() - t0), 3)
+    return out
+
+
+def run_laion(root):
+    """decode → resize → 128-d random-projection embedding → cosine sim →
+    groupby(label). The embed matmul is the MXU-shaped step: on the device
+    tier it runs as one jit batched matmul; host tier uses numpy."""
+    import numpy as np
+
+    import daft_tpu as dt
+    from daft_tpu import col
+    from daft_tpu.datatype import DataType
+
+    rng = np.random.default_rng(3)
+    P = rng.standard_normal((32 * 32 * 3, 128)).astype(np.float32)
+    qv = rng.standard_normal(128).astype(np.float32)
+    qv /= np.linalg.norm(qv)
+    use_device = os.environ.get("DAFT_TPU_DEVICE", "1") != "0"
+
+    @dt.udf(return_dtype=DataType.float32())
+    def cos_sim(images):
+        arrs = images.to_pylist()
+        if not arrs:
+            return []
+        x = np.stack([np.asarray(a, dtype=np.float32).reshape(-1)
+                      for a in arrs])
+        x /= 255.0
+        if use_device:
+            import jax.numpy as jnp
+            emb = np.asarray(jnp.asarray(x) @ jnp.asarray(P))
+        else:
+            emb = x @ P
+        norms = np.linalg.norm(emb, axis=1)
+        norms[norms == 0] = 1.0
+        return (emb @ qv / norms).tolist()
+
+    def pipeline():
+        df = dt.read_parquet(os.path.join(root, "images.parquet"))
+        df = df.with_column("img", col("png").image.decode(mode="RGB"))
+        df = df.with_column("small", col("img").image.resize(32, 32))
+        df = df.with_column("sim", cos_sim(col("small")))
+        return (df.groupby("label")
+                .agg(col("sim").mean().alias("mean_sim"),
+                     col("sim").count().alias("n"))
+                .sort("label").to_pydict())
+
     t0 = time.time()
-    out = Q.q6(get_df).to_pydict()
+    out = pipeline()
     warm = time.time() - t0
-    t1 = time.time()
-    out = Q.q6(get_df).to_pydict()
-    hot = time.time() - t1
-    return out, warm, hot
+    t0 = time.time()
+    pipeline()
+    hot = time.time() - t0
+    n_imgs = sum(out["n"])
+    best = min(warm, hot)
+    return {"hot_s": round(best, 3),
+            "images_per_s": round(n_imgs / best, 1),
+            "groups": len(out["label"])}
 
 
 def run_arrow_baseline():
-    import pyarrow.dataset as pads
     import pyarrow.compute as pc
+    import pyarrow.dataset as pads
     t0 = time.time()
     t = pads.dataset(os.path.join(DATA, "lineitem")).to_table()
     t = t.filter(pc.field("l_shipdate") <= datetime.date(1998, 9, 2))
@@ -93,23 +228,56 @@ def run_arrow_baseline():
          ("disc_price", "sum"), ("charge", "sum"), ("l_quantity", "mean"),
          ("l_extendedprice", "mean"), ("l_discount", "mean"),
          ("l_quantity", "count")])
-    g = g.sort_by([("l_returnflag", "ascending"), ("l_linestatus", "ascending")])
+    g = g.sort_by([("l_returnflag", "ascending"),
+                   ("l_linestatus", "ascending")])
     return g, time.time() - t0
 
 
+# ----------------------------------------------------------- device child
+
+def _emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
 def _device_child():
-    """Child-process entry: run Q1 (+Q6) with the device tier on, print one
-    JSON line. Q1 prints FIRST so a Q6 compile stall can't zero the main
-    measurement."""
+    """Child-process entry with the device tier on. One JSON line per
+    section, cheapest/most-important first, so a stall or timeout only
+    loses the sections after it."""
     os.environ["DAFT_TPU_DEVICE"] = "1"
-    out, warm, hot = run_daft_q1()
+    deadline = time.time() + DEVICE_TIMEOUT * 0.92
+
+    out, warm, hot = run_tpch_query(DATA, "q1")
     from daft_tpu.device import backend as dbackend
-    print(json.dumps({
-        "warm": warm, "hot": hot, "groups": len(out["l_returnflag"]),
-        "backend": dbackend.backend_name() or "host-fallback",
-    }), flush=True)
-    _, q6_warm, q6_hot = run_daft_q6()
-    print(json.dumps({"q6_warm": q6_warm, "q6_hot": q6_hot}), flush=True)
+    _emit({"warm": warm, "hot": hot,
+           "groups": len(next(iter(out.values()))),
+           "backend": dbackend.backend_name() or "host-fallback"})
+
+    for qn in ("q6", "q3", "q10"):
+        if time.time() > deadline:
+            return
+        _, w, h = run_tpch_query(DATA, qn)
+        _emit({f"{qn}_warm": round(w, 3), f"{qn}_hot": round(h, 3)})
+
+    if time.time() < deadline:
+        suite = run_tpch_suite(DATA, budget_s=deadline - time.time())
+        _emit({"tpch_sf1_suite": suite})
+
+    if time.time() < deadline:
+        try:
+            _emit({"tpcds": run_tpcds_trio(TPCDS_DATA)})
+        except Exception as exc:
+            _emit({"tpcds": {"error": str(exc)[:200]}})
+
+    if time.time() < deadline:
+        try:
+            _emit({"laion": run_laion(LAION_DATA)})
+        except Exception as exc:
+            _emit({"laion": {"error": str(exc)[:200]}})
+
+    if os.path.isdir(os.path.join(SF10_DATA, "lineitem")) \
+            and time.time() < deadline:
+        sf10 = run_tpch_suite(SF10_DATA, budget_s=deadline - time.time())
+        _emit({"tpch_sf10_suite": sf10})
 
 
 def _try_device_tier():
@@ -119,29 +287,22 @@ def _try_device_tier():
             capture_output=True, text=True, timeout=DEVICE_TIMEOUT,
             cwd=REPO, env={**os.environ, "DAFT_TPU_DEVICE": "1"})
     except subprocess.TimeoutExpired as exc:
-        # keep whatever the child already measured (Q1 prints first, so a
-        # Q6 compile stall cannot zero the main measurement)
         print("device tier: timed out; using partial output",
               file=sys.stderr)
         partial = exc.stdout or b""
         if isinstance(partial, bytes):
             partial = partial.decode(errors="replace")
-        merged = {}
-        for line in partial.strip().splitlines():
-            try:
-                parsed = json.loads(line)
-            except ValueError:
-                continue
-            if isinstance(parsed, dict):
-                merged.update(parsed)
-        return merged or None
+        return _merge_lines(partial)
     if proc.returncode != 0:
         print(f"device tier: child failed rc={proc.returncode}\n"
               f"{proc.stderr[-2000:]}", file=sys.stderr)
-        return None
-    # the child emits one JSON line per measured query; merge them
+        return _merge_lines(proc.stdout or "")
+    return _merge_lines(proc.stdout or "")
+
+
+def _merge_lines(text: str):
     merged = {}
-    for line in proc.stdout.strip().splitlines():
+    for line in text.strip().splitlines():
         try:
             parsed = json.loads(line)
         except ValueError:
@@ -151,10 +312,13 @@ def _try_device_tier():
     return merged or None
 
 
+# ------------------------------------------------------------------ main
+
 def main():
     ensure_data()
-    import pyarrow.parquet as pq
     import glob as g
+
+    import pyarrow.parquet as pq
     nrows = sum(pq.ParquetFile(p).metadata.num_rows
                 for p in g.glob(f"{DATA}/lineitem/*.parquet"))
 
@@ -162,35 +326,55 @@ def main():
 
     # host tier first: hang-free, guarantees a number is always reported
     os.environ["DAFT_TPU_DEVICE"] = "0"
-    out, host_warm, host_hot = run_daft_q1()
+    out, host_warm, host_hot = run_tpch_query(DATA, "q1")
     assert len(out["l_returnflag"]) == base_tbl.num_rows, \
         (len(out["l_returnflag"]), base_tbl.num_rows)
 
-    os.environ["DAFT_TPU_DEVICE"] = "0"
-    _, q6_host_warm, q6_host_hot = run_daft_q6()
     detail = {
         "host_warm_s": round(host_warm, 3), "host_hot_s": round(host_hot, 3),
         "arrow_cpu_baseline_s": round(base_s, 3), "lineitem_rows": nrows,
-        "q6_host_hot_s": round(min(q6_host_warm, q6_host_hot), 3),
         "backend": "host",
     }
+    for qn in ("q6", "q3", "q10"):
+        _, w, h = run_tpch_query(DATA, qn)
+        detail[f"{qn}_host_hot_s"] = round(min(w, h), 3)
+    detail["tpch_sf1_suite_host"] = run_tpch_suite(DATA)
+    try:
+        detail["tpcds_host"] = run_tpcds_trio(TPCDS_DATA)
+    except Exception as exc:
+        detail["tpcds_host"] = {"error": str(exc)[:200]}
+    try:
+        detail["laion_host"] = run_laion(LAION_DATA)
+    except Exception as exc:
+        detail["laion_host"] = {"error": str(exc)[:200]}
+    if os.path.isdir(os.path.join(SF10_DATA, "lineitem")):
+        detail["tpch_sf10_suite_host"] = run_tpch_suite(SF10_DATA)
+
     ours = min(host_warm, host_hot)
 
     dev = _try_device_tier()
     if dev is not None and dev.get("backend") == "host-fallback":
-        # the child's watchdog pinned it to the host tier: there was no
-        # device measurement — don't report one.
         detail["device_backend"] = "host-fallback"
         dev = None
-    if dev is not None and dev.get("groups") == base_tbl.num_rows:
-        detail["device_warm_s"] = round(dev["warm"], 3)
-        detail["device_hot_s"] = round(dev["hot"], 3)
-        detail["device_backend"] = dev.get("backend")
-        if "q6_hot" in dev:
-            detail["q6_device_hot_s"] = round(dev["q6_hot"], 3)
-        if dev["hot"] < ours:
-            ours = dev["hot"]
-            detail["backend"] = dev.get("backend", "device")
+    if dev is not None:
+        # independent sections are recorded regardless of the Q1 sanity
+        # gate below — a Q1 regression must not silently hide them
+        for k in ("q6_hot", "q3_hot", "q10_hot"):
+            if k in dev:
+                detail[f"{k.split('_')[0]}_device_hot_s"] = dev[k]
+        for k in ("tpch_sf1_suite", "tpcds", "laion", "tpch_sf10_suite"):
+            if k in dev:
+                detail[f"{k}_device"] = dev[k]
+        if dev.get("groups") == base_tbl.num_rows:
+            detail["device_warm_s"] = round(dev["warm"], 3)
+            detail["device_hot_s"] = round(dev["hot"], 3)
+            detail["device_backend"] = dev.get("backend")
+            if dev["hot"] < ours:
+                ours = dev["hot"]
+                detail["backend"] = dev.get("backend", "device")
+        elif "groups" in dev:
+            detail["device_q1_mismatch"] = \
+                {"groups": dev["groups"], "expected": base_tbl.num_rows}
 
     print(json.dumps({
         "metric": f"tpch_q1_sf{SF}_rows_per_sec_per_chip",

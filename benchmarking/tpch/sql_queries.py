@@ -1,0 +1,67 @@
+"""TPC-H queries expressible only via subqueries, as SQL text.
+
+The DataFrame forms in ``queries.py`` hand-decorrelate these (explicit
+joins); these texts exercise the SQL frontend's subquery support —
+``Expr::Subquery/InSubquery/Exists`` in the reference
+(``src/daft-dsl/src/expr/mod.rs:213-292``, unnested by
+``optimization/rules/unnest_subquery.rs``; here ``daft_tpu/logical/
+subquery.py``)."""
+
+Q4 = """
+SELECT o_orderpriority, count(*) AS order_count
+FROM orders
+WHERE o_orderdate >= DATE '1993-07-01'
+  AND o_orderdate < DATE '1993-10-01'
+  AND EXISTS (
+    SELECT * FROM lineitem
+    WHERE l_orderkey = o_orderkey AND l_commitdate < l_receiptdate)
+GROUP BY o_orderpriority
+ORDER BY o_orderpriority
+"""
+
+Q17 = """
+SELECT sum(l_extendedprice) / 7.0 AS avg_yearly
+FROM lineitem, part
+WHERE p_partkey = l_partkey
+  AND p_brand = 'Brand#23'
+  AND p_container = 'MED BOX'
+  AND l_quantity < (
+    SELECT 0.2 * avg(l_quantity) FROM lineitem WHERE l_partkey = p_partkey)
+"""
+
+Q20 = """
+SELECT s_name, s_address
+FROM supplier, nation
+WHERE s_suppkey IN (
+    SELECT ps_suppkey FROM partsupp
+    WHERE ps_partkey IN (
+        SELECT p_partkey FROM part WHERE p_name LIKE 'forest%')
+      AND ps_availqty > (
+        SELECT 0.5 * sum(l_quantity) FROM lineitem
+        WHERE l_partkey = ps_partkey AND l_suppkey = ps_suppkey
+          AND l_shipdate >= DATE '1994-01-01'
+          AND l_shipdate < DATE '1995-01-01'))
+  AND s_nationkey = n_nationkey
+  AND n_name = 'CANADA'
+ORDER BY s_name
+"""
+
+Q22 = """
+SELECT cntrycode, count(*) AS numcust, sum(c_acctbal) AS totacctbal
+FROM (
+  SELECT substr(c_phone, 1, 2) AS cntrycode, c_acctbal
+  FROM customer
+  WHERE substr(c_phone, 1, 2) IN ('13', '31', '23', '29', '30', '18', '17')
+    AND c_acctbal > (
+      SELECT avg(c_acctbal) FROM customer
+      WHERE c_acctbal > 0.00
+        AND substr(c_phone, 1, 2) IN ('13', '31', '23', '29', '30', '18',
+                                      '17'))
+    AND NOT EXISTS (
+      SELECT * FROM orders WHERE o_custkey = c_custkey)
+) AS custsale
+GROUP BY cntrycode
+ORDER BY cntrycode
+"""
+
+SUBQUERY_QUERIES = {"q4": Q4, "q17": Q17, "q20": Q20, "q22": Q22}

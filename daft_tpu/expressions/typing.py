@@ -101,6 +101,11 @@ def infer_field(e, schema: Schema) -> Field:
             raise ValueError(
                 f"unresolved column {name!r}; available: {schema.column_names}")
         return schema[name]
+    if op in ("subquery", "in_subquery", "exists"):
+        raise ValueError(
+            f"{op} expression must be unnested into a join before execution "
+            "(logical/subquery.py apply_where); it reached evaluation "
+            "unsupported — e.g. a subquery in a SELECT list or HAVING")
     if op == "lit":
         return _lit_field(e.params[0])
     if op == "lit_interval":

@@ -1,0 +1,239 @@
+"""Subquery expressions and their unnest-to-join rewrites.
+
+Reference: ``src/daft-dsl/src/expr/mod.rs:213-292`` models scalar
+subqueries, ``InSubquery`` and ``Exists`` as first-class ``Expr`` variants;
+``src/daft-logical-plan/src/optimization/rules/unnest_subquery.rs`` rewrites
+them into joins. This module is the TPU-native equivalent, designed around
+the DataFrame builder instead of a plan-to-plan rule: the SQL planner
+parses subqueries into three expression node kinds —
+
+- ``Expression("subquery", (), (info,))``       — scalar subquery
+- ``Expression("in_subquery", (lhs,), (info,))`` — ``lhs IN (SELECT …)``
+- ``Expression("exists", (), (info,))``          — ``EXISTS (SELECT …)``
+
+— and :func:`apply_where` realizes them while applying a WHERE clause:
+
+- EXISTS / NOT EXISTS      → semi / anti join on the correlation keys
+  (uncorrelated: on a constant key against the subquery limited to 1 row)
+- IN / NOT IN (SELECT …)   → semi / anti join on (lhs = select item) plus
+  correlation keys. NOT IN keeps anti-join semantics: SQL's "any NULL in
+  the subquery ⇒ empty result" edge is not modeled (documented caveat,
+  same pragmatic rewrite the reference's optimizer performs).
+- scalar, uncorrelated     → cross join of the 1-row aggregate
+- scalar, correlated       → GROUP BY correlation keys + LEFT JOIN; a
+  missing group yields NULL, so comparisons against it are false — SQL's
+  empty-subquery-scalar semantics.
+
+Correlation is equality-only (``inner_expr = outer_expr``), the same scope
+the reference's rule handles; anything else raises NotImplementedError.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Tuple
+
+from ..expressions.expressions import Expression, col, lit
+
+_uid = itertools.count()
+
+
+class SubqueryInfo:
+    """A parsed subquery, pre-unnesting.
+
+    ``df``            — the inner DataFrame: FROM/joins applied, plain
+                        (uncorrelated) WHERE conjuncts applied, and — when
+                        ``corr`` is empty — fully projected/aggregated.
+    ``corr``          — [(inner_expr, outer_expr)] equality correlation
+                        pairs extracted from the inner WHERE.
+    ``deferred_aggs`` — when correlated and the select list aggregates,
+                        the un-applied select expressions (the rewrite
+                        groups them by the correlation keys instead).
+    ``value_cols``    — output column names of ``df`` (used when the
+                        subquery was fully built by the normal path).
+    """
+
+    def __init__(self, df, corr, deferred_aggs, value_cols):
+        self.df = df
+        self.corr = list(corr)
+        self.deferred_aggs = list(deferred_aggs or [])
+        self.value_cols = list(value_cols or [])
+
+    def __repr__(self):
+        return (f"SubqueryInfo(corr={len(self.corr)}, "
+                f"deferred={len(self.deferred_aggs)})")
+
+
+def scalar_expr(info: SubqueryInfo) -> Expression:
+    return Expression("subquery", (), (info,))
+
+
+def in_expr(lhs: Expression, info: SubqueryInfo) -> Expression:
+    return Expression("in_subquery", (lhs,), (info,))
+
+
+def exists_expr(info: SubqueryInfo) -> Expression:
+    return Expression("exists", (), (info,))
+
+
+# ------------------------------------------------------------------ utils
+
+def split_conjuncts(e: Expression) -> List[Expression]:
+    u = e._unalias()
+    if u.op == "and":
+        return split_conjuncts(u.args[0]) + split_conjuncts(u.args[1])
+    return [e]
+
+
+def and_all(es: List[Expression]) -> Expression:
+    out = es[0]
+    for e in es[1:]:
+        out = out & e
+    return out
+
+
+def free_columns(e: Expression) -> set:
+    """Column names referenced by e (not descending into subquery infos)."""
+    return set(e.column_names())
+
+
+def contains_subquery(e: Expression) -> bool:
+    if e.op in ("subquery", "in_subquery", "exists"):
+        return True
+    return any(contains_subquery(a) for a in e.args)
+
+
+def _replace_node(e: Expression, target: Expression,
+                  replacement: Expression) -> Expression:
+    if e is target:
+        return replacement
+    if not e.args:
+        return e
+    return e.with_children(
+        [_replace_node(a, target, replacement) for a in e.args])
+
+
+# -------------------------------------------------------------- rewrites
+
+def _inner_value_expr(info: SubqueryInfo) -> Tuple[object, Expression]:
+    """The subquery's single output as (df, value expression over df)."""
+    if info.deferred_aggs:
+        if len(info.deferred_aggs) != 1:
+            raise NotImplementedError(
+                "correlated subquery must select exactly one expression")
+        return info.df, info.deferred_aggs[0]
+    if len(info.value_cols) != 1:
+        raise NotImplementedError(
+            f"subquery must select exactly one column, got "
+            f"{info.value_cols}")
+    return info.df, col(info.value_cols[0])
+
+
+def _semi_anti(df, info: SubqueryInfo, anti: bool,
+               lhs: Optional[Expression] = None):
+    """EXISTS/IN → semi join; NOT variants → anti join."""
+    how = "anti" if anti else "semi"
+    left_on = [o for _, o in info.corr]
+    right_on = [i for i, _ in info.corr]
+    rdf = info.df
+    if lhs is not None:
+        rdf2, val = _inner_value_expr(info)
+        left_on = left_on + [lhs]
+        right_on = right_on + [val]
+        rdf = rdf2
+    if not left_on:
+        # uncorrelated EXISTS: does the subquery have any row at all?
+        k = f"__exists{next(_uid)}__"
+        rdf = rdf.limit(1).select(lit(1).alias(k))
+        df2 = df.with_column(k, lit(1))
+        out = df2.join(rdf, left_on=[col(k)], right_on=[col(k)], how=how)
+        return out.exclude(k) if hasattr(out, "exclude") \
+            else out.select(*[col(c) for c in df.column_names])
+    return df.join(rdf, left_on=left_on, right_on=right_on, how=how)
+
+
+def _attach_scalar(df, node: Expression) -> Tuple[object, str]:
+    """Join the scalar subquery's value onto df under a unique column name;
+    returns (new df, value column name)."""
+    info: SubqueryInfo = node.params[0]
+    name = f"__subq{next(_uid)}__"
+    if info.corr:
+        if not info.deferred_aggs:
+            raise NotImplementedError(
+                "correlated scalar subquery must aggregate (e.g. "
+                "SELECT avg(x) …); a bare correlated column select has no "
+                "single-value semantics the rewrite can preserve")
+        rdf, val = _inner_value_expr(info)
+        key_names = []
+        keys = []
+        outers = []
+        for i, (inner, outer) in enumerate(info.corr):
+            kn = f"__subqk{next(_uid)}__"
+            key_names.append(kn)
+            keys.append(inner.alias(kn))
+            outers.append(outer)
+        agg = rdf.groupby(*keys).agg(val.alias(name))
+        agg = agg.select(*([col(k) for k in key_names] + [col(name)]))
+        out = df.join(agg, left_on=outers,
+                      right_on=[col(k) for k in key_names], how="left")
+        return out, name
+    # uncorrelated: the inner df is fully built and 1-row/1-col
+    rdf, val = _inner_value_expr(info)
+    rdf = rdf.select(val.alias(name))
+    return df.join(rdf, how="cross"), name
+
+
+def _rewrite_conjunct(df, conj: Expression) -> Tuple[Optional[Expression],
+                                                     object]:
+    """Realize the subquery nodes of one conjunct against df. Returns
+    (residual predicate or None, new df)."""
+    u = conj._unalias()
+    neg = False
+    while u.op == "not":
+        neg = not neg
+        u = u.args[0]._unalias()
+    if u.op == "exists":
+        return None, _semi_anti(df, u.params[0], anti=neg)
+    if u.op == "in_subquery":
+        if contains_subquery(u.args[0]):
+            raise NotImplementedError("subquery inside IN's left operand")
+        return None, _semi_anti(df, u.params[0], anti=neg, lhs=u.args[0])
+    # scalar subqueries nested anywhere in the conjunct
+    out = conj
+    while True:
+        node = _find_scalar(out)
+        if node is None:
+            break
+        df, name = _attach_scalar(df, node)
+        out = _replace_node(out, node, col(name))
+    return out, df
+
+
+def _find_scalar(e: Expression) -> Optional[Expression]:
+    if e.op == "subquery":
+        return e
+    if e.op in ("in_subquery", "exists"):
+        raise NotImplementedError(
+            "EXISTS/IN subquery must be a top-level conjunct "
+            "(optionally negated), not nested in an expression")
+    for a in e.args:
+        found = _find_scalar(a)
+        if found is not None:
+            return found
+    return None
+
+
+def apply_where(df, pred: Expression):
+    """df.where(pred), realizing any subquery nodes via joins first. Helper
+    columns introduced by scalar-subquery joins stay in the frame; SQL's
+    projection step (or the caller) drops them."""
+    if not contains_subquery(pred):
+        return df.where(pred)
+    residuals = []
+    for conj in split_conjuncts(pred):
+        residual, df = _rewrite_conjunct(df, conj)
+        if residual is not None:
+            residuals.append(residual)
+    if residuals:
+        df = df.where(and_all(residuals))
+    return df

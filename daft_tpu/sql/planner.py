@@ -115,12 +115,32 @@ class Scope:
         return out
 
 
+class _SubCtx:
+    """Per-subquery parse context: the enclosing scope for correlated name
+    resolution plus what the unnesting rewrite needs (see
+    ``logical/subquery.py``)."""
+
+    __slots__ = ("outer_scope", "corr", "deferred_aggs", "value_names",
+                 "owned", "cte_depth")
+
+    def __init__(self, outer_scope: Scope, cte_depth: int = 0):
+        self.outer_scope = outer_scope
+        self.corr = []            # [(inner_expr, outer_expr)]
+        self.deferred_aggs = []   # select exprs when agg is deferred
+        self.value_names = []     # projected output names of the sub root
+        self.owned = False        # claimed by the subquery's root SELECT
+        self.cte_depth = cte_depth  # root select lives at this CTE depth
+
+
 class SQLPlanner:
     def __init__(self, tables: Dict[str, "object"], session=None):
         self.tables = {k.lower(): v for k, v in tables.items()}
         self.session = session
         self.toks: List[Tok] = []
         self.i = 0
+        self._sub_stack: List[_SubCtx] = []
+        self._cur_ctes: Dict[str, "object"] = {}
+        self._cte_depth = 0
 
     # -- public ------------------------------------------------------------
     def plan_query(self, query: str):
@@ -334,7 +354,13 @@ class SQLPlanner:
                 name = self._next().text
                 self._expect("AS")
                 self._expect("(")
-                sub = self._query(dict(ctes))
+                # a CTE body must not claim an enclosing subquery's context
+                # (the subquery's ROOT select owns it) — see _select
+                self._cte_depth += 1
+                try:
+                    sub = self._query(dict(ctes))
+                finally:
+                    self._cte_depth -= 1
                 self._expect(")")
                 ctes[name.lower()] = sub
                 if not self._kw(","):
@@ -356,6 +382,23 @@ class SQLPlanner:
         return left
 
     def _select(self, ctes):
+        from ..dataframe import DataFrame
+        prev_ctes = self._cur_ctes
+        self._cur_ctes = ctes
+        # the first SELECT parsed under a fresh subquery context is that
+        # subquery's root: correlation pairs and deferred aggregates attach
+        # to it (nested derived tables/subqueries push their own contexts)
+        sub_ctx = None
+        if self._sub_stack and not self._sub_stack[-1].owned \
+                and self._sub_stack[-1].cte_depth == self._cte_depth:
+            sub_ctx = self._sub_stack[-1]
+            sub_ctx.owned = True
+        try:
+            return self._select_inner(ctes, sub_ctx)
+        finally:
+            self._cur_ctes = prev_ctes
+
+    def _select_inner(self, ctes, sub_ctx):
         from ..dataframe import DataFrame
         self._expect("SELECT")
         distinct = self._kw("DISTINCT")
@@ -454,10 +497,39 @@ class SQLPlanner:
         self.i = save
 
         # assemble plan ----------------------------------------------------
+        from ..logical import subquery as subq
         if where is not None:
-            df = df.where(where)
+            df = self._apply_where(df, where, sub_ctx)
+        if having is not None and subq.contains_subquery(having):
+            raise NotImplementedError("subquery in HAVING")
         agg_mode = bool(group_by) or any(_has_agg(e) for e in exprs) \
             or (having is not None and _has_agg(having))
+        if sub_ctx is not None:
+            sub_ctx.value_names = [e.name() for e in exprs]
+            if sub_ctx.corr and agg_mode:
+                # correlated aggregating subquery: the unnesting rewrite
+                # re-aggregates grouped by the correlation keys — defer.
+                # Clauses that would apply AFTER the aggregate cannot be
+                # deferred faithfully: refuse rather than silently drop.
+                if group_by:
+                    raise NotImplementedError(
+                        "correlated subquery with GROUP BY")
+                if having is not None or distinct or order_by \
+                        or limit is not None or offset:
+                    raise NotImplementedError(
+                        "correlated aggregating subquery with "
+                        "HAVING/DISTINCT/ORDER BY/LIMIT")
+                sub_ctx.deferred_aggs = exprs
+                return df
+            if sub_ctx.corr and not agg_mode:
+                # the correlation keys must survive the projection for the
+                # unnest join (e.g. EXISTS(SELECT 1 FROM t WHERE k = outer))
+                names = {e.name() for e in exprs}
+                for inner, _ in sub_ctx.corr:
+                    for c in sorted(subq.free_columns(inner)):
+                        if c not in names:
+                            exprs.append(col(c))
+                            names.add(c)
         if agg_mode:
             gb_keys = []
             gb_out_names = []
@@ -535,6 +607,67 @@ class SQLPlanner:
         elif offset:
             df = df.offset(offset)
         return df
+
+    def _apply_where(self, df, where, sub_ctx):
+        """Apply a WHERE clause: realize subquery nodes via unnest joins,
+        and — inside a subquery — lift equality conjuncts that reference
+        enclosing-scope columns into the context's correlation keys."""
+        from ..logical import subquery as subq
+        if sub_ctx is None and not subq.contains_subquery(where):
+            return df.where(where)
+        avail = set(df.column_names)
+        plain = []
+        for conj in subq.split_conjuncts(where):
+            free = subq.free_columns(conj)
+            if free <= avail or sub_ctx is None:
+                plain.append(conj)
+                continue
+            u = conj._unalias()
+            if u.op == "eq" and not subq.contains_subquery(u):
+                a, b = u.args
+                fa, fb = subq.free_columns(a), subq.free_columns(b)
+                if fa <= avail and fb and not (fb & avail):
+                    sub_ctx.corr.append((a, b))
+                    continue
+                if fb <= avail and fa and not (fa & avail):
+                    sub_ctx.corr.append((b, a))
+                    continue
+            raise NotImplementedError(
+                f"correlated predicate {conj!r}: only equality "
+                "correlation (inner = outer, no nested subquery) is "
+                "supported")
+        if not plain:
+            return df
+        return subq.apply_where(df, subq.and_all(plain))
+
+    def _parse_subquery(self, scope):
+        """Parse ``(SELECT …)`` appearing as an expression operand; `scope`
+        is the enclosing query's scope (for correlated name fallback)."""
+        from ..logical import subquery as subq
+        ctx = _SubCtx(scope if scope is not None else Scope(),
+                      self._cte_depth)
+        self._sub_stack.append(ctx)
+        try:
+            df = self._query(dict(self._cur_ctes))
+        finally:
+            self._sub_stack.pop()
+        return subq.SubqueryInfo(
+            df, ctx.corr, ctx.deferred_aggs,
+            ctx.value_names if ctx.value_names else list(df.column_names))
+
+    def _resolve_col(self, scope, name, alias=None) -> Expression:
+        """Scope resolution with correlated fallback: a name unknown to the
+        current scope may belong to an enclosing query's scope when we are
+        inside a subquery."""
+        try:
+            return col(scope.resolve(name, alias))
+        except ValueError:
+            for ctx in reversed(self._sub_stack):
+                try:
+                    return col(ctx.outer_scope.resolve(name, alias))
+                except ValueError:
+                    continue
+            raise
 
     def _prev_was_as(self, start: int) -> bool:
         return False
@@ -742,6 +875,13 @@ class SQLPlanner:
                 continue
             if self._kw("IN"):
                 self._expect("(")
+                if self._peek_kw("SELECT") or self._peek_kw("WITH"):
+                    from ..logical import subquery as subq
+                    info = self._parse_subquery(scope)
+                    self._expect(")")
+                    b = subq.in_expr(e, info)
+                    e = ~b if neg else b
+                    continue
                 items = []
                 while True:
                     items.append(self._expr(scope))
@@ -818,6 +958,11 @@ class SQLPlanner:
     def _primary(self, scope) -> Expression:
         t = self._next()
         if t.text == "(":
+            if self._peek_kw("SELECT") or self._peek_kw("WITH"):
+                from ..logical import subquery as subq
+                info = self._parse_subquery(scope)
+                self._expect(")")
+                return subq.scalar_expr(info)
             e = self._expr(scope)
             self._expect(")")
             return e
@@ -847,6 +992,12 @@ class SQLPlanner:
             s = self._next().text
             qty, unit = s.split(" ", 1) if " " in s else (s, self._next().text)
             return _interval(int(qty), unit)
+        if up == "EXISTS" and self._peek().text == "(":
+            from ..logical import subquery as subq
+            self._next()
+            info = self._parse_subquery(scope)
+            self._expect(")")
+            return subq.exists_expr(info)
         if up == "CASE":
             return self._case(scope)
         if up == "CAST":
@@ -873,10 +1024,10 @@ class SQLPlanner:
             colname = self._next().text
             if scope is None:
                 return col(colname)
-            return col(scope.resolve(colname, t.text))
+            return self._resolve_col(scope, colname, t.text)
         if scope is None:
             return col(t.text)
-        return col(scope.resolve(t.text))
+        return self._resolve_col(scope, t.text)
 
     def _case(self, scope) -> Expression:
         base = None

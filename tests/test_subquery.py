@@ -1,0 +1,151 @@
+"""SQL subqueries: scalar / IN / EXISTS parsing + unnest-to-join rewrites
+(VERDICT r2 item 4 done-criterion: TPC-H Q4/Q17/Q20/Q22 run as SQL text and
+match the DataFrame results).
+
+Reference seam: ``Expr::Subquery/InSubquery/Exists``
+(``src/daft-dsl/src/expr/mod.rs:213-292``) +
+``optimization/rules/unnest_subquery.rs``; here
+``daft_tpu/logical/subquery.py`` + the SQL planner's correlated scopes."""
+
+import pytest
+
+import daft_tpu as dt
+
+
+@pytest.fixture(scope="module")
+def shop():
+    """Handcrafted data where every subquery shape has non-empty output."""
+    cust = dt.from_pydict({
+        "c_id": [1, 2, 3, 4],
+        "c_name": ["ann", "bob", "cat", "dan"],
+        "c_bal": [100.0, 5.0, 60.0, 40.0],
+    })
+    orders = dt.from_pydict({
+        "o_id": [10, 11, 12, 13, 14],
+        "o_cust": [1, 1, 2, 3, 3],
+        "o_total": [20.0, 30.0, 7.0, 55.0, 5.0],
+    })
+    return {"cust": cust, "orders": orders}
+
+
+def test_exists_correlated(shop):
+    out = dt.sql(
+        "SELECT c_name FROM cust WHERE EXISTS "
+        "(SELECT * FROM orders WHERE o_cust = c_id) ORDER BY c_name",
+        **shop).to_pydict()
+    assert out == {"c_name": ["ann", "bob", "cat"]}
+
+
+def test_not_exists_correlated(shop):
+    out = dt.sql(
+        "SELECT c_name FROM cust WHERE NOT EXISTS "
+        "(SELECT * FROM orders WHERE o_cust = c_id)",
+        **shop).to_pydict()
+    assert out == {"c_name": ["dan"]}
+
+
+def test_exists_with_inner_filter(shop):
+    out = dt.sql(
+        "SELECT c_name FROM cust WHERE EXISTS "
+        "(SELECT * FROM orders WHERE o_cust = c_id AND o_total > 25) "
+        "ORDER BY c_name", **shop).to_pydict()
+    assert out == {"c_name": ["ann", "cat"]}
+
+
+def test_in_subquery_uncorrelated(shop):
+    out = dt.sql(
+        "SELECT c_name FROM cust WHERE c_id IN "
+        "(SELECT o_cust FROM orders WHERE o_total > 25) ORDER BY c_name",
+        **shop).to_pydict()
+    assert out == {"c_name": ["ann", "cat"]}
+
+
+def test_not_in_subquery(shop):
+    out = dt.sql(
+        "SELECT c_name FROM cust WHERE c_id NOT IN "
+        "(SELECT o_cust FROM orders)", **shop).to_pydict()
+    assert out == {"c_name": ["dan"]}
+
+
+def test_scalar_uncorrelated(shop):
+    out = dt.sql(
+        "SELECT c_name FROM cust WHERE c_bal > "
+        "(SELECT avg(c_bal) FROM cust) ORDER BY c_name",
+        **shop).to_pydict()
+    assert out == {"c_name": ["ann", "cat"]}  # avg = 51.25
+
+
+def test_scalar_correlated_groupby_join(shop):
+    # customers whose balance exceeds twice their average order value
+    out = dt.sql(
+        "SELECT c_name FROM cust WHERE c_bal > "
+        "(SELECT 2 * avg(o_total) FROM orders WHERE o_cust = c_id) "
+        "ORDER BY c_name", **shop).to_pydict()
+    # ann: 100 > 2*25 ✓; bob: 5 > 2*7 ✗; cat: 60 > 2*30 ✗;
+    # dan: no orders → NULL → comparison false (SQL semantics)
+    assert out == {"c_name": ["ann"]}
+
+
+def test_scalar_in_arithmetic(shop):
+    out = dt.sql(
+        "SELECT c_name FROM cust WHERE c_bal / 2 > "
+        "(SELECT min(c_bal) FROM cust) ORDER BY c_name", **shop).to_pydict()
+    # min = 5: ann 50 ✓, bob 2.5 ✗, cat 30 ✓, dan 20 ✓
+    assert out == {"c_name": ["ann", "cat", "dan"]}
+
+
+def test_nested_in_with_correlated_scalar(shop):
+    # Q20 shape: IN-subquery whose WHERE holds a correlated scalar subquery
+    out = dt.sql(
+        "SELECT c_name FROM cust WHERE c_id IN ("
+        "  SELECT o_cust FROM orders WHERE o_total > "
+        "    (SELECT avg(o_total) FROM orders)"
+        ") ORDER BY c_name", **shop).to_pydict()
+    # avg(o_total) = 23.4; orders above: 30 (ann), 55 (cat)
+    assert out == {"c_name": ["ann", "cat"]}
+
+
+def test_subquery_in_select_list_raises(shop):
+    with pytest.raises((ValueError, NotImplementedError)):
+        dt.sql("SELECT (SELECT max(o_total) FROM orders) FROM cust",
+               **shop).to_pydict()
+
+
+def test_exists_nested_in_or_raises(shop):
+    with pytest.raises((NotImplementedError, ValueError)):
+        dt.sql(
+            "SELECT c_name FROM cust WHERE c_bal > 1000 OR EXISTS "
+            "(SELECT * FROM orders WHERE o_cust = c_id)",
+            **shop).to_pydict()
+
+
+# ---------------------------------------------------------- TPC-H parity
+
+@pytest.fixture(scope="module")
+def tpch(tmp_path_factory):
+    from benchmarking.tpch.datagen import generate_tpch
+    root = tmp_path_factory.mktemp("tpch_subq")
+    generate_tpch(str(root), 0.05, 2)
+
+    def get_df(name):
+        return dt.read_parquet(f"{root}/{name}/*.parquet")
+    return get_df
+
+
+@pytest.mark.parametrize("qname", ["q4", "q17", "q20", "q22"])
+def test_tpch_subquery_sql_matches_dataframe(tpch, qname):
+    from benchmarking.tpch import queries as Q
+    from benchmarking.tpch.sql_queries import SUBQUERY_QUERIES
+    tables = {t: tpch(t) for t in ("orders", "lineitem", "part", "partsupp",
+                                   "supplier", "customer", "nation")}
+    got = dt.sql(SUBQUERY_QUERIES[qname], **tables).to_pydict()
+    want = getattr(Q, qname)(tpch).to_pydict()
+    assert set(got) == set(want)
+    for k in want:
+        gv, wv = got[k], want[k]
+        assert len(gv) == len(wv), (k, len(gv), len(wv))
+        for a, b in zip(gv, wv):
+            if isinstance(b, float):
+                assert a == pytest.approx(b, rel=1e-9)
+            else:
+                assert a == b
