@@ -148,3 +148,100 @@ def estimate(node: lp.LogicalPlan) -> Stats:
     if kids:
         return kids[0]
     return UNKNOWN
+
+
+# ------------------------------------------------------------------ NDV
+
+def column_ndv(node: lp.LogicalPlan, name: str,
+               est_rows: Optional[float] = None) -> Optional[float]:
+    """Approximate distinct-value count of a column in a plan subtree.
+
+    Integer/date key columns get ``max - min + 1`` from parquet footer
+    statistics (exact for the dense surrogate keys join graphs are built
+    on: nationkey 0–24 → 25), capped by the subtree's estimated rows —
+    a filter that keeps 1 row caps the key's ndv at 1. Columns without
+    usable stats fall back to the row estimate (near-unique assumption,
+    i.e. FK-join-shaped). The reference reads the same footer stats for
+    its scan stats (``daft-scan``'s parquet metadata path).
+
+    ``est_rows``: the caller's row estimate for ``node``, if already
+    computed (avoids a redundant estimate() walk)."""
+    src = _find_source_with(node, name)
+    est = estimate(node).rows if est_rows is None else est_rows
+    if src is None:
+        return est
+    rng = _source_column_range(src, name)
+    if rng is None:
+        return est
+    if est is None:
+        return rng
+    return min(rng, est)
+
+
+def _find_source_with(node: lp.LogicalPlan, name: str):
+    if isinstance(node, lp.Source):
+        return node if name in node.schema().column_names else None
+    for c in node.children:
+        try:
+            if name in c.schema().column_names:
+                return _find_source_with(c, name)
+        except Exception:
+            return None
+    return None
+
+
+def _source_column_range(node: lp.Source, name: str) -> Optional[float]:
+    """(max-min+1) over all files' footer stats for an int/date column."""
+    cache = getattr(node, "_ndv_cache", None)
+    if cache is None:
+        cache = node._ndv_cache = {}
+    if name in cache:
+        return cache[name]
+    out = None
+    try:
+        tasks = getattr(node, "materialized_tasks", None)
+        if tasks is None and node.scan_op is not None:
+            tasks = node.scan_op.to_scan_tasks(node.pushdowns)
+            node.materialized_tasks = tasks
+        lo = hi = None
+        seen_paths = set()
+        if tasks:
+            import pyarrow.parquet as pq
+            for t in tasks:
+                if t.file_format != "parquet":
+                    raise ValueError
+                # split tasks share a file; one footer per path, reusing
+                # the reader's cached footer when the task carries one
+                md_cached = getattr(t, "pq_metadata", None)
+                for p in t.paths:
+                    if p in seen_paths:
+                        continue
+                    seen_paths.add(p)
+                    md = md_cached if md_cached is not None \
+                        and len(t.paths) == 1 else pq.ParquetFile(p).metadata
+                    idx = {md.schema.column(i).name: i
+                           for i in range(md.num_columns)}.get(name)
+                    if idx is None:
+                        raise ValueError
+                    for rg in range(md.num_row_groups):
+                        st = md.row_group(rg).column(idx).statistics
+                        if st is None or not st.has_min_max:
+                            raise ValueError
+                        mn, mx = st.min, st.max
+                        if not isinstance(mn, int) or isinstance(mn, bool):
+                            import datetime as _dt
+                            # date is day-granular so max-min+1 is an ndv
+                            # bound; datetime is NOT (a day of distinct
+                            # timestamps would collapse to ndv 1) — reject
+                            if isinstance(mn, _dt.datetime) \
+                                    or not isinstance(mn, _dt.date):
+                                raise ValueError
+                            mn, mx = mn.toordinal(), mx.toordinal()
+                        lo = mn if lo is None else min(lo, mn)
+                        hi = mx if hi is None else max(hi, mx)
+        if lo is not None:
+            out = float(hi - lo + 1)
+    except Exception:
+        out = None
+    cache[name] = out
+    return out
