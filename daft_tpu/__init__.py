@@ -55,13 +55,16 @@ def __getattr__(name):
     if name == "udf":
         from .udf import udf
         return udf
+    # NB: `from . import context` here would recurse — _handle_fromlist
+    # probes hasattr(package, "context") first, which re-enters this
+    # __getattr__ before the submodule ever imports. importlib avoids it.
     if name == "context":
-        from . import context
-        return context
+        import importlib
+        return importlib.import_module(".context", __name__)
     if name in ("set_execution_config", "set_planning_config", "execution_config_ctx",
                 "get_context", "set_runner_native", "set_runner_tpu_distributed"):
-        from . import context as _ctx
-        return getattr(_ctx, name)
+        import importlib
+        return getattr(importlib.import_module(".context", __name__), name)
     if name == "Window":
         from .window import Window
         return Window

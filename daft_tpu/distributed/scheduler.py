@@ -20,6 +20,23 @@ from .worker import (FetchSpec, ShuffleOutSpec, ShuffleResult, StageTask,
                      WorkerManager, WorkerState)
 
 
+def _sort_fragment_root(remainder, pid: int):
+    """The remainder's global Sort node, when the fragment is shaped
+    Project* → Sort(col keys) → StageInput(pid) — the shape the
+    worker-side range-sort protocol handles. Projects above the sort are
+    row-order-preserving, so per-range outputs concatenate to the global
+    order."""
+    n = remainder
+    while isinstance(n, pp.Project):
+        n = n.children[0]
+    if isinstance(n, pp.Sort) \
+            and isinstance(n.children[0], pp.StageInput) \
+            and n.children[0].stage_id == pid \
+            and all(e.op == "col" for e in n.sort_by):
+        return n
+    return None
+
+
 class Scheduler:
     """Policy: pick a worker for a task given current worker states."""
 
@@ -207,6 +224,12 @@ class StageRunner:
             if all(StagePlan._contains_input(sub, up)
                    for up in fetch_srcs):
                 sub_stage = Stage(stage.id, sub, [])
+                sort_node = _sort_fragment_root(remainder, pid)
+                if sort_node is not None and shuffle_out is None \
+                        and self._shuffle_enabled():
+                    return self._range_sort_remainder(
+                        sub_stage, remainder, pid, sort_node,
+                        fetch_srcs, mat_inputs, n)
                 parts = self._run_reduce_fanout(sub_stage, fetch_srcs,
                                                 mat_inputs, n, None)
                 rest = Stage(stage.id, remainder, [])
@@ -217,6 +240,82 @@ class StageRunner:
         for up, srcs in fetch_srcs.items():
             mat_inputs[up] = self._driver_fetch(srcs, n)
         return self._run_stage(stage, mat_inputs, shuffle_out)
+
+    def _range_sort_remainder(self, sub_stage: Stage, remainder, pid: int,
+                              sort_node, fetch_srcs: Dict[int, list],
+                              mat_inputs: Dict[int, List[MicroPartition]],
+                              n: int) -> Optional[list]:
+        """Distributed global sort with rows never touching the driver
+        (the r2 verdict's scale ceiling: every range/sort boundary funneled
+        through the driver). Three worker-side phases:
+
+        1. the partition-local sub-fragment runs per hash partition with
+           ``store`` shuffle-out: outputs stay in worker shuffle caches,
+           each task returns a sort-key SAMPLE with its receipt;
+        2. the driver computes range boundaries from the samples alone
+           (KB, not rows) and dispatches per-receipt ``range`` repartition
+           tasks — rows move worker→worker through the shuffle transport;
+        3. one reduce task per range sorts its partition locally; the
+           driver concatenates results in partition order, which IS the
+           global order (ranges are disjoint and ordered).
+
+        Shape gating happens in ``_sort_fragment_root`` BEFORE this is
+        called; failures inside the protocol abort the query (same
+        contract as the hash-shuffle path)."""
+        from ..context import get_context
+        from ..execution.executor import sample_boundaries
+        from .worker import FetchSpec, ShuffleOutSpec, StageTask, _ipc_bytes
+        cfg = get_context().execution_config
+        by = list(sort_node.sort_by)
+        desc = list(sort_node.descending)
+        nf = list(sort_node.nulls_first)
+
+        store = ShuffleOutSpec(1, tuple(by), kind="store",
+                               sample_k=cfg.sample_size_for_sort)
+        receipts = self._run_reduce_fanout(sub_stage, fetch_srcs,
+                                           mat_inputs, n, store)
+        try:
+            from ..recordbatch import RecordBatch
+            from .worker import _ipc_table
+            samples = [RecordBatch.from_arrow_table(
+                _ipc_table(r.samples_ipc))
+                for r in receipts if r.samples_ipc]
+            k = max(len(receipts), 1)
+            names = [e.name() for e in by]
+            boundaries = sample_boundaries(samples, names, desc, nf, k) \
+                if samples else None
+            if boundaries is None or k == 1:
+                # no keys to sample or single partition: one sort task
+                # reading every stored output through the shuffle service
+                rest = Stage(sub_stage.id, remainder, [])
+                bindings: Dict[int, object] = {pid: FetchSpec(
+                    [(r.address, r.shuffle_id) for r in receipts], 0)}
+                bindings.update(mat_inputs)
+                return self._run_stage(rest, bindings, None)
+            bipc = _ipc_bytes(boundaries.to_arrow_table())
+            range_spec = ShuffleOutSpec(k, tuple(by), kind="range",
+                                        descending=tuple(desc),
+                                        boundaries_ipc=bipc)
+            phase2 = [StageTask(
+                sub_stage.id, pp.StageInput(pid, sort_node.schema()),
+                {pid: FetchSpec([(r.address, r.shuffle_id)], 0)},
+                task_idx=j, shuffle_out=range_spec)
+                for j, r in enumerate(receipts)]
+            receipts2 = self._collect(phase2)
+        finally:
+            self._cleanup_shuffles(
+                {0: [(r.address, r.shuffle_id) for r in receipts]})
+        srcs2 = [(r.address, r.shuffle_id) for r in receipts2]
+        try:
+            tasks = []
+            for i in range(k):
+                bindings = {pid: FetchSpec(srcs2, i)}
+                bindings.update(mat_inputs)
+                tasks.append(StageTask(sub_stage.id, remainder, bindings,
+                                       task_idx=i))
+            return self._collect(tasks)
+        finally:
+            self._cleanup_shuffles({0: srcs2})
 
     @staticmethod
     def _driver_fetch(srcs: list, n: int) -> List[MicroPartition]:

@@ -21,11 +21,24 @@ from ..physical import plan as pp
 
 @dataclass
 class ShuffleOutSpec:
-    """Map-side instruction: hash-partition this task's output into the
-    worker-local shuffle cache instead of returning rows."""
+    """Map-side instruction: partition this task's output into the
+    worker-local shuffle cache instead of returning rows.
+
+    ``kind``:
+    - ``hash``  — hash-partition by ``by`` into ``num_partitions``.
+    - ``store`` — store the whole output as partition 0 and (when
+      ``sample_k`` > 0) return a key sample for driver-side boundary
+      computation: phase 1 of the distributed range/sort protocol.
+    - ``range`` — range-partition by ``by`` against ``boundaries_ipc``
+      (arrow-IPC boundary rows): phase 2; rows move worker→worker, the
+      driver only ever sees samples, boundaries and receipts."""
 
     num_partitions: int
     by: tuple  # key Expressions
+    kind: str = "hash"
+    descending: tuple = ()
+    boundaries_ipc: Optional[bytes] = None
+    sample_k: int = 0
 
 
 @dataclass
@@ -37,6 +50,7 @@ class ShuffleResult:
     shuffle_id: str
     num_partitions: int
     rows: int
+    samples_ipc: Optional[bytes] = None
 
 
 @dataclass
@@ -98,21 +112,69 @@ def run_task(task: StageTask) -> object:
     stream = ex.run(task.plan, stage_inputs=inputs)
     if task.shuffle_out is None:
         return list(stream)
+    from ..recordbatch import RecordBatch
     from .shuffle_service import ShuffleCache, get_local_shuffle_server
     spec = task.shuffle_out
     by = list(spec.by)
     cache = ShuffleCache()
     rows = 0
-    for mp in stream:
-        rows += len(mp)
-        for i, piece in enumerate(
-                mp.partition_by_hash(by, spec.num_partitions)):
-            if len(piece):
-                cache.push(i, piece.combined().to_arrow_table())
+    samples_ipc = None
+    if spec.kind == "hash":
+        for mp in stream:
+            rows += len(mp)
+            for i, piece in enumerate(
+                    mp.partition_by_hash(by, spec.num_partitions)):
+                if len(piece):
+                    cache.push(i, piece.combined().to_arrow_table())
+    elif spec.kind == "store":
+        sampled = []
+        for mp in stream:
+            rows += len(mp)
+            if len(mp):
+                cache.push(0, mp.combined().to_arrow_table())
+                if spec.sample_k > 0:
+                    rb = mp.combined()
+                    s = rb.sample(size=min(spec.sample_k, len(rb)))
+                    sampled.append(s.eval_expression_list(by))
+        if sampled:
+            merged = RecordBatch.concat(sampled)
+            if len(merged) > spec.sample_k:
+                merged = merged.sample(size=spec.sample_k)
+            samples_ipc = _ipc_bytes(merged.to_arrow_table())
+    elif spec.kind == "range":
+        boundaries = RecordBatch.from_arrow_table(
+            _ipc_table(spec.boundaries_ipc))
+        desc = list(spec.descending) or [False] * len(by)
+        for mp in stream:
+            rows += len(mp)
+            for i, piece in enumerate(mp.combined().partition_by_range(
+                    by, boundaries, desc)):
+                if len(piece):
+                    cache.push(i, piece.to_arrow_table())
+    else:
+        raise ValueError(f"shuffle-out kind {spec.kind!r}")
     server = get_local_shuffle_server()
     server.register(cache)
     return ShuffleResult(server.address, cache.shuffle_id,
-                         spec.num_partitions, rows)
+                         spec.num_partitions, rows, samples_ipc)
+
+
+def _ipc_bytes(table) -> bytes:
+    import io
+
+    import pyarrow as pa
+    buf = io.BytesIO()
+    with pa.ipc.new_stream(buf, table.schema) as w:
+        w.write_table(table)
+    return buf.getvalue()
+
+
+def _ipc_table(data: bytes):
+    import io
+
+    import pyarrow as pa
+    with pa.ipc.open_stream(io.BytesIO(data)) as r:
+        return r.read_all()
 
 
 class Worker:

@@ -116,6 +116,26 @@ class LocalExecutor:
         return it
 
     # sources ----------------------------------------------------------
+    def _morselize(self, stream: Iterator) -> Iterator:
+        """Re-chunk a partition stream to ``default_morsel_size`` rows
+        (the reference's dispatcher-side morsel re-chunking,
+        ``src/daft-local-execution/src/buffer.rs``): oversized source
+        partitions split so downstream operators pipeline at morsel
+        granularity. Observed sizes land in the per-op trace stats."""
+        morsel = int(self.cfg.default_morsel_size or 0)
+        if morsel <= 0:
+            yield from stream
+            return
+        for p in stream:
+            n = len(p)
+            if n <= morsel + morsel // 2:
+                yield p
+                continue
+            rb = p.combined()
+            for start in range(0, n, morsel):
+                yield MicroPartition.from_recordbatch(
+                    rb.slice(start, min(start + morsel, n)))
+
     def _exec_ScanSource(self, node: pp.ScanSource):
         def run(t):
             est = t.size_bytes() or 0
@@ -127,7 +147,7 @@ class LocalExecutor:
         if not node.tasks:
             yield MicroPartition.empty(node.schema())
             return
-        yield from _ordered_parallel(iter(node.tasks), run)
+        yield from self._morselize(_ordered_parallel(iter(node.tasks), run))
 
     def _exec_InMemorySource(self, node: pp.InMemorySource):
         if not node.partitions:
@@ -593,8 +613,6 @@ class LocalExecutor:
                 f"'spill_cache'")
         if algo == "naive":
             return False
-        if self.cfg.enable_aqe and getattr(node, "engine_inserted", False):
-            return False  # AQE resizes from materialized bytes
         if drt.device_enabled() and pmesh.mesh_size() >= 2 \
                 and node.num_partitions == pmesh.mesh_size():
             return False  # the mesh collective repartition may apply
@@ -674,18 +692,8 @@ class LocalExecutor:
                            key_names: List[str], descending: List[bool],
                            nulls_first: List[bool], n: int
                            ) -> Optional[RecordBatch]:
-        """Concatenated key samples → n-1 range boundaries (sorted,
-        null-free), or None when there is nothing to sample."""
-        merged = RecordBatch.concat(sampled_keys)
-        by = [col(nm) for nm in key_names]
-        merged = merged.filter(~_any_null(by, merged)) if len(merged) \
-            else merged
-        if len(merged) == 0:
-            return None
-        merged_sorted = merged.sort(by, descending, nulls_first)
-        idx = [min(int(len(merged_sorted) * (i + 1) / n),
-                   len(merged_sorted) - 1) for i in range(n - 1)]
-        return merged_sorted.take(np.asarray(idx, dtype=np.int64))
+        return sample_boundaries(sampled_keys, key_names, descending,
+                                 nulls_first, n)
 
     def _sample_keys(self, parts, by: List[Expression]) -> List[RecordBatch]:
         k = self.cfg.sample_size_for_sort
@@ -790,21 +798,42 @@ class LocalExecutor:
                                                 node.right_on, how))
             return
         from . import memory
-        lparts = memory.materialize(self._exec(node.children[0]))
-        rparts = memory.materialize(self._exec(node.children[1]))
-        if len(lparts) != len(rparts):
-            # partition-count mismatch: re-fan BOTH sides to the larger
-            # count by key hash (same xxh64 chain on both → co-partitioned)
-            # instead of collapsing to one gathered pair, which silently
-            # destroyed all join parallelism
-            n = max(len(lparts), len(rparts), 1)
-            lparts = self._refan(lparts, list(node.left_on), n)
-            rparts = self._refan(rparts, list(node.right_on), n)
-        # zip stays lazy: spilled partitions reload only inside the bounded
-        # in-flight window, keeping the join under the memory budget
+        lnode, rnode = node.children
+        copart = (isinstance(lnode, pp.Exchange) and lnode.kind == "hash"
+                  and isinstance(rnode, pp.Exchange) and rnode.kind == "hash"
+                  and lnode.num_partitions == rnode.num_partitions)
+        if copart:
+            # streaming probe: the build side is the blocking sink
+            # (spill-bounded SpillBuffer); probe partitions stream straight
+            # from the exchange one at a time — never materialized as a
+            # list (reference: hash_join.rs build-then-stream-probe)
+            rparts = memory.materialize(self._exec(rnode))
+            try:
+                yield from _ordered_parallel(
+                    enumerate(self._exec(lnode)),
+                    lambda ip: ip[1].hash_join(
+                        rparts[ip[0]], node.left_on, node.right_on, how))
+            finally:
+                rparts.close()
+            return
+        lparts = memory.materialize(self._exec(lnode))
+        rparts = memory.materialize(self._exec(rnode))
+        if len(lparts) == len(rparts) == 1:
+            yield from _ordered_parallel(
+                zip(lparts, rparts),
+                lambda lr: lr[0].hash_join(lr[1], node.left_on,
+                                           node.right_on, how))
+            return
+        # no static co-partitioning evidence: index pairing would join
+        # unrelated partitions — re-fan BOTH sides by key hash (same xxh64
+        # chain on both → co-partitioned)
+        n = max(len(lparts), len(rparts), 1)
+        lparts = self._refan(lparts, list(node.left_on), n)
+        rparts = self._refan(rparts, list(node.right_on), n)
         yield from _ordered_parallel(
             zip(lparts, rparts),
-            lambda lr: lr[0].hash_join(lr[1], node.left_on, node.right_on, how))
+            lambda lr: lr[0].hash_join(lr[1], node.left_on, node.right_on,
+                                       how))
 
     def _adaptive_hash_join(self, node: pp.HashJoin, li, ri):
         """AQE join-strategy demotion (reference: AdaptivePlanner re-plans
@@ -969,6 +998,26 @@ def _np_plane_encoder(rb: RecordBatch, cap: int):
 def _gather_all(parts: Iterator[MicroPartition]) -> MicroPartition:
     ps = list(parts)
     return ps[0].concat(ps[1:]) if len(ps) > 1 else ps[0]
+
+
+def sample_boundaries(sampled_keys: List[RecordBatch],
+                      key_names: List[str], descending: List[bool],
+                      nulls_first: List[bool], n: int
+                      ) -> Optional[RecordBatch]:
+    """Concatenated key samples → n-1 range boundaries (sorted,
+    null-free), or None when there is nothing to sample. Shared by the
+    local range exchange and the distributed worker-side sort protocol
+    (the driver computes boundaries from samples only)."""
+    merged = RecordBatch.concat(sampled_keys)
+    by = [col(nm) for nm in key_names]
+    merged = merged.filter(~_any_null(by, merged)) if len(merged) \
+        else merged
+    if len(merged) == 0:
+        return None
+    merged_sorted = merged.sort(by, descending, nulls_first)
+    idx = [min(int(len(merged_sorted) * (i + 1) / n),
+               len(merged_sorted) - 1) for i in range(n - 1)]
+    return merged_sorted.take(np.asarray(idx, dtype=np.int64))
 
 
 def _any_null(by: List[Expression], rb: RecordBatch) -> Expression:

@@ -33,13 +33,18 @@ class OperatorStats:
     """Counters for one physical operator (reference:
     ``RuntimeStatsContext`` counters)."""
 
-    __slots__ = ("name", "rows_out", "batches_out", "inclusive_us", "lock")
+    __slots__ = ("name", "rows_out", "batches_out", "inclusive_us",
+                 "morsel_rows_min", "morsel_rows_max", "lock")
 
     def __init__(self, name: str):
         self.name = name
         self.rows_out = 0
         self.batches_out = 0
         self.inclusive_us = 0
+        # observed morsel sizes: shows the re-chunking buffer honoring
+        # execution_config.default_morsel_size in explain_analyze/traces
+        self.morsel_rows_min = None
+        self.morsel_rows_max = None
         self.lock = threading.Lock()
 
     def record(self, nrows: int, dur_us: int):
@@ -47,6 +52,10 @@ class OperatorStats:
             self.rows_out += nrows
             self.batches_out += 1
             self.inclusive_us += dur_us
+            if self.morsel_rows_min is None or nrows < self.morsel_rows_min:
+                self.morsel_rows_min = nrows
+            if self.morsel_rows_max is None or nrows > self.morsel_rows_max:
+                self.morsel_rows_max = nrows
 
     def record_time(self, dur_us: int):
         with self.lock:
@@ -175,6 +184,8 @@ class RuntimeStatsContext:
                 name = f"{st.name}#{i}"
                 i += 1
             out[name] = {"rows_out": st.rows_out,
+                         "morsel_rows_min": st.morsel_rows_min,
+                         "morsel_rows_max": st.morsel_rows_max,
                          "batches_out": st.batches_out,
                          "inclusive_us": st.inclusive_us,
                          "exclusive_us": self.exclusive_us(key)}

@@ -3,12 +3,24 @@
 Reference: ``AdaptivePlanner`` (``src/daft-physical-plan/src/
 physical_planner/planner.rs:451-640`` — ``next_stage`` / ``update_stats`` /
 ``explain_analyze``): stages materialize at exchange boundaries, ACTUAL
-cardinalities feed back into planning of the remaining query. Here the
-adaptivity acts on the same boundary the reference re-plans most profitably:
-engine-inserted shuffles re-size their partition count from the measured
-bytes of the materialized child (coalescing almost-empty shuffles to a few
-partitions, capping giant ones at the configured target partition size),
-and per-stage actuals are recorded for ``explain_analyze``.
+cardinalities feed back into planning of the remaining query. Three
+adaptive layers compose here:
+
+1. **Stage re-planning** (``runners/native_runner.py:_run_adaptive``):
+   join inputs materialize cheapest-first; each one's measured rows/bytes
+   replace its subtree as an in-memory source and the WHOLE optimizer
+   re-runs over the remainder — join order (ReorderJoins with actuals)
+   and broadcast-vs-hash flip from measurements. ``record_replan`` logs
+   each round for explain_analyze.
+2. **Shuffle resizing** (executor ``_exec_Exchange``): engine-inserted
+   shuffles re-size partition counts from materialized bytes (coalescing
+   almost-empty shuffles, capping giant ones at the target size).
+3. **Join demotion** (executor ``_adaptive_hash_join``): a planned hash
+   join whose measured input fits the broadcast threshold skips both
+   shuffles.
+
+The streaming spill-cache shuffle composes with all three (it simply
+takes precedence over resizing at exchanges it serves).
 
 Enable with ``DAFT_TPU_ENABLE_AQE=1`` / ``set_execution_config(enable_aqe=
 True)``.
@@ -51,6 +63,16 @@ class AdaptivePlanner:
                 decision=(f"shuffle {planned}→{adapted} parts "
                           f"({total_bytes} bytes materialized)")))
         return adapted
+
+    def record_replan(self, decision: str, rows: int = 0,
+                      size_bytes: int = 0) -> None:
+        """Stage-level re-plan: a join input was materialized, its ACTUAL
+        stats folded back into the logical plan, and the optimizer re-run
+        over the remainder (the reference's update_stats → next_stage)."""
+        with self._lock:
+            self.history.append(StageStats(
+                rows=rows, size_bytes=size_bytes, partitions=0,
+                decision=decision))
 
     def record_join(self, decision: str, measured_bytes: int) -> None:
         """Join-strategy adaptation from measured input sizes (hash ↔

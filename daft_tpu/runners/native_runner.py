@@ -1,11 +1,17 @@
 """NativeRunner: optimize → translate → local streaming executor.
 
-Reference: ``daft/runners/native_runner.py:49-99``.
+Reference: ``daft/runners/native_runner.py:49-99``. With
+``enable_aqe=True`` the runner becomes the reference's AdaptivePlanner
+loop (``physical_planner/planner.rs:451-640`` next_stage/update_stats):
+join inputs materialize stage by stage, their ACTUAL cardinalities are
+folded back into the logical plan as in-memory sources, and the whole
+optimizer re-runs over the remainder — join order and broadcast
+decisions are made from measurements, not estimates.
 """
 
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import Iterator, List, Optional, Tuple
 
 from ..execution.executor import LocalExecutor
 from ..micropartition import MicroPartition
@@ -18,7 +24,95 @@ class NativeRunner(Runner):
 
     def run_iter(self, builder, results_buffer_size: Optional[int] = None
                  ) -> Iterator[MicroPartition]:
+        from ..context import get_context
+        cfg = get_context().execution_config
+        if cfg.enable_aqe:
+            yield from self._run_adaptive(builder, cfg)
+            return
         optimized = builder.optimize()
         pplan = translate(optimized.plan)
         executor = LocalExecutor()
         yield from executor.run(pplan)
+
+    # ------------------------------------------------------------- AQE
+    def _run_adaptive(self, builder, cfg) -> Iterator[MicroPartition]:
+        """Stage-by-stage adaptive loop: materialize the cheapest
+        unresolved join input, substitute an in-memory source carrying its
+        ACTUAL rows/bytes, re-optimize the remainder, repeat. The final
+        translate sees only measured sizes, so broadcast-vs-hash and join
+        order are decided from actuals (re-plans are visible in
+        ``explain_analyze``)."""
+        from ..logical import plan as lp
+        from ..logical.optimizer import Optimizer
+        from ..physical import adaptive
+
+        planner = adaptive.new_planner(cfg)
+        plan = Optimizer().optimize(builder._plan)
+        for _round in range(32):  # bound the loop defensively
+            target = _pick_join_input(plan)
+            if target is None:
+                break
+            ex = LocalExecutor()
+            ex._aqe_planner = planner
+            parts = list(ex.run(translate(target)))
+            rows = sum(len(p) for p in parts)
+            size = sum(p.size_bytes() or 0 for p in parts)
+            src = lp.Source(partitions=parts, schema=target.schema(),
+                            num_partitions=max(len(parts), 1))
+            planner.record_replan(
+                f"materialized join input ({rows} rows, {size} bytes "
+                f"actual) → re-optimized remainder", rows, size)
+            plan = _replace_subtree(plan, target, src)
+            plan = Optimizer().optimize(plan)
+        ex = LocalExecutor()
+        ex._aqe_planner = planner
+        planner.final_plan = translate(plan)
+        yield from ex.run(planner.final_plan)
+
+
+def _is_measured(node) -> bool:
+    """Only a bare in-memory source carries EXACT stats — anything above
+    it (Filter/Aggregate/Join/scan) still runs on estimates and is worth
+    materializing before the join decision."""
+    from ..logical import plan as lp
+    return isinstance(node, lp.Source) and node.partitions is not None
+
+
+def _pick_join_input(plan):
+    """The cheapest-estimated unmeasured input of the bottom-most join
+    that still has one, or None when every join input is a measured
+    in-memory source. Joins whose inputs are all measured stop blocking
+    their ancestors, so the loop works its way up the join tree."""
+    from ..logical import plan as lp
+    from ..logical import stats as lstats
+
+    best: Optional[Tuple[float, object]] = None
+
+    def visit(node) -> bool:
+        """True iff the subtree contains a join with unmeasured inputs."""
+        nonlocal best
+        kid_flags = [visit(c) for c in node.children]  # no short-circuit
+        has_inner = any(kid_flags)
+        if isinstance(node, lp.Join):
+            pending = [c for c in node.children if not _is_measured(c)]
+            if not pending:
+                return has_inner
+            if not has_inner:
+                for c in pending:
+                    est = lstats.estimate(c).size_bytes
+                    key = est if est is not None else float("inf")
+                    if best is None or key < best[0]:
+                        best = (key, c)
+            return True
+        return has_inner
+
+    visit(plan)
+    return None if best is None else best[1]
+
+
+def _replace_subtree(plan, target, replacement):
+    if plan is target:
+        return replacement
+    kids = [_replace_subtree(c, target, replacement)
+            for c in plan.children]
+    return plan.with_children(kids)

@@ -348,3 +348,57 @@ def test_sort_merge_join_not_fanned_out(monkeypatch):
     # exactly one row for right's unmatched k=9, not one per partition
     assert sum(1 for k in out["k"] if k == 9) == 1
     assert len(out["k"]) == 4  # 1,2,3 plus unmatched 9
+
+
+def test_distributed_sort_stays_off_driver(monkeypatch):
+    """Global sort under the flight shuffle runs the worker-side range
+    protocol: driver sees samples/boundaries/receipts, never the rows
+    (VERDICT r2 item 3 done-criterion). The sorted result still matches
+    the local runner exactly."""
+    import numpy as np
+
+    from daft_tpu.distributed import scheduler as sched_mod
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")  # host hash exchange path
+    rng = np.random.default_rng(11)
+    n = 5000
+    data = {"k": rng.integers(0, 40, n).tolist(),
+            "v": rng.uniform(0, 1000, n).round(3).tolist()}
+
+    def q(frame):
+        return (frame.groupby("k").agg(col("v").sum().alias("s"))
+                .sort("s", desc=True).to_pydict())
+
+    def fresh():
+        # a fresh frame per run: a collected result would otherwise cache
+        # its partitions and the second plan would skip the exchanges
+        return daft_tpu.from_pydict(data).into_partitions(4)
+
+    local = q(fresh())
+
+    calls = {"range_sort": 0}
+    orig = sched_mod.StageRunner._range_sort_remainder
+
+    def spy(self, *a, **kw):
+        calls["range_sort"] += 1
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(sched_mod.StageRunner, "_range_sort_remainder", spy)
+
+    def no_driver_fetch(srcs, n):
+        raise AssertionError("sort routed rows through the driver")
+
+    monkeypatch.setattr(sched_mod.StageRunner, "_driver_fetch",
+                        staticmethod(no_driver_fetch))
+
+    runner = DistributedRunner(num_workers=3)
+    import daft_tpu.context as ctx
+    old = ctx.get_context()._runner
+    ctx.get_context().set_runner(runner)
+    try:
+        dist = q(fresh())
+    finally:
+        ctx.get_context().set_runner(old)
+    assert calls["range_sort"] == 1
+    assert dist["k"] == local["k"]
+    for a, b in zip(dist["s"], local["s"]):
+        assert a == pytest.approx(b, rel=1e-9)
