@@ -518,15 +518,13 @@ class DataFrame:
         return dd.from_pandas(self.to_pandas(),
                               npartitions=max(self.num_partitions(), 1))
 
-    def write_lance(self, uri: str, **kwargs):
-        """Write as a Lance dataset (reference: ``DataFrame.write_lance``;
-        needs the optional 'lance' package)."""
-        try:
-            import lance
-        except ImportError as exc:
-            raise ImportError("write_lance requires the optional 'lance' "
-                              "package") from exc
-        lance.write_dataset(self.to_arrow(), uri, **kwargs)
+    def write_lance(self, uri: str, mode: str = "create",
+                    io_config=None):
+        """Write as a Lance dataset version (reference:
+        ``DataFrame.write_lance`` over the lance SDK; implemented natively
+        — versioned column-page datasets, ``io/lance.py``)."""
+        from .io.lance import write_lance as _impl
+        _impl(self, uri, mode=mode, io_config=io_config)
         return self
 
 

@@ -1,15 +1,26 @@
-"""Native Apache Hudi Copy-on-Write snapshot reader.
+"""Native Apache Hudi snapshot reader: Copy-on-Write and Merge-on-Read.
 
-The reference reads Hudi through its Python SDK
-(``/root/reference/daft/io/_hudi.py`` + ``daft/hudi``). This is SDK-free:
-the ``.hoodie`` timeline (completed ``*.commit`` / ``*.replacecommit``
-instants, JSON) and ``hoodie.properties`` are parsed directly, base files
-are grouped into file slices by ``{fileId}_{writeToken}_{instantTime}``
-naming, and the snapshot is the newest committed base file per live file
-group — honoring replacecommits that retire file groups (clustering).
+The reference reads Hudi through its vendored pyhudi
+(``/root/reference/daft/hudi/pyhudi/table.py``) — which REJECTS anything
+but Copy-on-Write (``table.py:134``). This module is SDK-free and goes
+further: the ``.hoodie`` timeline (completed ``*.commit`` /
+``*.deltacommit`` / ``*.replacecommit`` instants, JSON) and
+``hoodie.properties`` are parsed directly; base files group into file
+slices by ``{fileId}_{writeToken}_{instantTime}`` naming, honoring
+replacecommits that retire file groups (clustering).
 
-Unsupported (raises): Merge-on-Read tables (log files need the Hudi
-merger), incremental queries.
+Merge-on-Read: each file slice's log files
+(``.{fileId}_{baseInstant}.log.{version}[_{token}]``) merge over the base
+file by record key (``hoodie.table.recordkey.fields``, falling back to
+the ``_hoodie_record_key`` meta column): later records upsert earlier
+ones, records flagged ``_hoodie_is_deleted`` drop the key. Log blocks are
+decoded as Avro object-container or parquet payloads (detected by magic);
+the binary HoodieLogFormat framing is not parsed — a documented subset
+chosen because nothing in this environment can produce or validate it
+(the reference rejects MoR tables entirely). ``query_type=
+"read_optimized"`` serves base files only, the standard MoR RO view.
+
+Unsupported (raises): incremental queries.
 """
 
 from __future__ import annotations
@@ -24,6 +35,9 @@ from .object_io import IOConfig, get_io_client
 
 _BASE_FILE_RE = re.compile(
     r"^(?P<file_id>.+?)_(?P<token>[0-9\-]+)_(?P<instant>\d+)\.parquet$")
+_LOG_FILE_RE = re.compile(
+    r"^\.(?P<file_id>.+?)_(?P<base_instant>\d+)\.log\.(?P<version>\d+)"
+    r"(?:_(?P<token>[\w\-]+))?$")
 
 
 def _strip(uri: str) -> str:
@@ -54,36 +68,62 @@ def _load_properties(table_uri: str, io_config) -> Dict[str, str]:
     return props
 
 
-def _timeline(files: List[str]) -> Tuple[Dict[str, str], List[str]]:
-    """→ ({instant: action} for completed instants, replacecommit uris)."""
+def _timeline(files: List[str]) -> Tuple[Dict[str, str], List[str],
+                                         List[str]]:
+    """→ ({instant: action} for completed instants, replacecommit uris,
+    all completed instant uris)."""
     completed: Dict[str, str] = {}
     replaces: List[str] = []
+    instant_uris: List[str] = []
     for f in files:
         name = f.replace("\\", "/").rsplit("/", 1)[-1]
         parent = f.replace("\\", "/").rsplit("/", 2)[-2]
         if parent != ".hoodie":
             continue
-        m = re.match(r"^(\d+)\.(commit|replacecommit)$", name)
+        m = re.match(r"^(\d+)\.(commit|deltacommit|replacecommit)$", name)
         if m:
             completed[m.group(1)] = m.group(2)
+            instant_uris.append(f)
             if m.group(2) == "replacecommit":
                 replaces.append(f)
-    return completed, replaces
+    return completed, replaces, instant_uris
 
 
-def snapshot_files(table_uri: str,
-                   io_config: Optional[IOConfig] = None
-                   ) -> List[Dict[str, Any]]:
-    """Live base files of the latest snapshot:
-    [{path, partition, file_id, instant}]."""
-    props = _load_properties(table_uri, io_config)
-    ttype = props.get("hoodie.table.type", "COPY_ON_WRITE").upper()
-    if ttype != "COPY_ON_WRITE":
-        raise NotImplementedError(
-            f"hudi table type {ttype}: only Copy-on-Write snapshots are "
-            f"supported (Merge-on-Read needs log-file merging)")
+def _committed_log_names(instant_uris: List[str], io_config) -> Optional[set]:
+    """Log-file basenames referenced by completed commits'
+    ``partitionToWriteStats`` — a log file not listed there belongs to an
+    in-flight or crashed writer and must stay invisible (base files get
+    the same treatment via their instant suffix). Returns None when no
+    commit carries write stats (legacy metadata): caller accepts logs
+    whose base instant is committed."""
+    names: set = set()
+    any_stats = False
+    for uri in instant_uris:
+        try:
+            doc = json.loads(_get(uri, io_config))
+        except ValueError:
+            continue
+        stats = doc.get("partitionToWriteStats")
+        if not isinstance(stats, dict):
+            continue
+        for entries in stats.values():
+            for e in entries or []:
+                p = (e or {}).get("path")
+                if p:
+                    any_stats = True
+                    names.add(str(p).replace("\\", "/").rsplit("/", 1)[-1])
+    return names if any_stats else None
+
+
+def snapshot_slices(table_uri: str,
+                    io_config: Optional[IOConfig] = None
+                    ) -> List[Dict[str, Any]]:
+    """Latest file slice per live file group:
+    [{base, logs, partition, file_id, instant}] — ``base`` may be None
+    (log-only group on a MoR table), ``logs`` ordered by version."""
     all_files = _list_files(table_uri, io_config)
-    completed, replace_uris = _timeline(all_files)
+    completed, replace_uris, instant_uris = _timeline(all_files)
+    committed_logs = _committed_log_names(instant_uris, io_config)
     replaced: set = set()
     for uri in replace_uris:
         try:
@@ -95,6 +135,7 @@ def snapshot_files(table_uri: str,
                 replaced.add((part, fid))
     root = table_uri.rstrip("/")
     groups: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    logs: Dict[Tuple[str, str, str], List[Tuple[int, str]]] = {}
     root_local = _strip(root).replace("\\", "/")
     for f in all_files:
         norm = f.replace("\\", "/")
@@ -105,24 +146,199 @@ def snapshot_files(table_uri: str,
         parts = rel.rsplit("/", 1)
         partition = parts[0] if len(parts) == 2 else ""
         m = _BASE_FILE_RE.match(parts[-1])
-        if not m or m.group("instant") not in completed:
+        if m:
+            if m.group("instant") not in completed \
+                    or (partition, m.group("file_id")) in replaced:
+                continue
+            key = (partition, m.group("file_id"))
+            cur = groups.get(key)
+            if cur is None or m.group("instant") > cur["instant"]:
+                groups[key] = {"base": f, "partition": partition,
+                               "file_id": m.group("file_id"),
+                               "instant": m.group("instant"), "logs": []}
             continue
-        if (partition, m.group("file_id")) in replaced:
-            continue
-        key = (partition, m.group("file_id"))
+        lm = _LOG_FILE_RE.match(parts[-1])
+        if lm and (partition, lm.group("file_id")) not in replaced:
+            if committed_logs is not None:
+                if parts[-1] not in committed_logs:
+                    continue  # in-flight / crashed writer: not committed
+            else:
+                # legacy metadata without write stats: a log can only be
+                # live if its base instant is committed AND some later
+                # deltacommit completed (coarser than per-file stats —
+                # a writer crashing after an unrelated deltacommit is
+                # indistinguishable here)
+                base_i = lm.group("base_instant")
+                if base_i not in completed or not any(
+                        act == "deltacommit" and inst > base_i
+                        for inst, act in completed.items()):
+                    continue
+            logs.setdefault(
+                (partition, lm.group("file_id"), lm.group("base_instant")),
+                []).append((int(lm.group("version")), f))
+    # attach logs to their slice (same base instant); log-only groups
+    # become base-less slices
+    for (partition, fid, base_instant), entries in logs.items():
+        key = (partition, fid)
         cur = groups.get(key)
-        if cur is None or m.group("instant") > cur["instant"]:
-            groups[key] = {"path": f, "partition": partition,
-                           "file_id": m.group("file_id"),
-                           "instant": m.group("instant")}
-    return sorted(groups.values(), key=lambda g: g["path"])
+        if cur is not None and cur["instant"] == base_instant:
+            cur["logs"] = [p for _, p in sorted(entries)]
+        elif cur is None:
+            groups[key] = {"base": None, "partition": partition,
+                           "file_id": fid, "instant": base_instant,
+                           "logs": [p for _, p in sorted(entries)]}
+    return sorted(groups.values(), key=lambda g: (g["partition"],
+                                                  g["file_id"]))
 
 
-def read_hudi(table_uri: str, io_config: Optional[IOConfig] = None):
-    """Hudi CoW table → DataFrame of its latest snapshot."""
+def snapshot_files(table_uri: str,
+                   io_config: Optional[IOConfig] = None
+                   ) -> List[Dict[str, Any]]:
+    """Live base files of the latest snapshot:
+    [{path, partition, file_id, instant}] (read-optimized view)."""
+    out = []
+    for s in snapshot_slices(table_uri, io_config):
+        if s["base"] is not None:
+            out.append({"path": s["base"], "partition": s["partition"],
+                        "file_id": s["file_id"], "instant": s["instant"]})
+    return out
+
+
+# ----------------------------------------------------------------- merge
+
+_AVRO_MAGIC = b"Obj\x01"
+_PARQUET_MAGIC = b"PAR1"
+_DELETED_COL = "_hoodie_is_deleted"
+
+
+def _load_log_table(uri: str, io_config):
+    """One log file → arrow table of its records (Avro object-container or
+    parquet payload, detected by magic)."""
+    import io as io_
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    raw = _get(uri, io_config)
+    if raw[:4] == _PARQUET_MAGIC:
+        return pq.read_table(io_.BytesIO(raw))
+    if raw[:4] == _AVRO_MAGIC:
+        from .avro import read_avro
+        hdr, records = read_avro(raw)
+        fields = hdr["schema"]["fields"]
+        cols = {f["name"]: [r.get(f["name"]) for r in records]
+                for f in fields}
+        return pa.table(cols)
+    raise NotImplementedError(
+        f"hudi log file {uri!r}: binary HoodieLogFormat framing is not "
+        "supported (payload must be an Avro object-container or parquet "
+        "file)")
+
+
+def _record_key_cols(props: Dict[str, str], schema_names) -> List[str]:
+    keys = props.get("hoodie.table.recordkey.fields")
+    if keys:
+        return [k.strip() for k in keys.split(",") if k.strip()]
+    if "_hoodie_record_key" in schema_names:
+        return ["_hoodie_record_key"]
+    raise ValueError(
+        "hudi merge needs a record key: set "
+        "hoodie.table.recordkey.fields or include _hoodie_record_key")
+
+
+def _merge_slice(base_t, log_tables, key_cols: List[str]):
+    """Upsert log records over the base by key, honoring
+    ``_hoodie_is_deleted`` tombstones; later tables win."""
+    import numpy as np
+    import pyarrow as pa
+    out_schema = None
+    rows: Dict[tuple, Optional[dict]] = {}
+    order: List[tuple] = []
+    for t in ([base_t] if base_t is not None else []) + log_tables:
+        if out_schema is None:
+            out_schema = pa.schema(
+                [f for f in t.schema if f.name != _DELETED_COL])
+        d = t.to_pydict()
+        n = t.num_rows
+        deleted = d.get(_DELETED_COL, [False] * n)
+        for i in range(n):
+            key = tuple(d[k][i] for k in key_cols)
+            if key not in rows:
+                order.append(key)
+            if deleted[i]:
+                rows[key] = None
+            else:
+                rows[key] = {f.name: d[f.name][i] for f in out_schema}
+    live = [rows[k] for k in order if rows[k] is not None]
+    if not live:
+        return out_schema.empty_table() if out_schema is not None else None
+    return pa.table({f.name: [r[f.name] for r in live]
+                     for f in out_schema}, schema=out_schema)
+
+
+def read_hudi(table_uri: str, io_config: Optional[IOConfig] = None,
+              query_type: str = "snapshot"):
+    """Hudi table → DataFrame of its latest snapshot.
+
+    CoW: newest base file per file group. MoR ``snapshot``: log files
+    merged over each base file by record key; ``read_optimized``: base
+    files only."""
     import daft_tpu as dt
-    files = snapshot_files(table_uri, io_config)
-    if not files:
+    if query_type not in ("snapshot", "read_optimized"):
+        raise ValueError(f"read_hudi query_type {query_type!r}")
+    props = _load_properties(table_uri, io_config)
+    ttype = props.get("hoodie.table.type", "COPY_ON_WRITE").upper()
+    slices = snapshot_slices(table_uri, io_config)
+    if not slices:
         raise ValueError(f"hudi table {table_uri!r} has no committed "
                          f"base files")
-    return dt.read_parquet([f["path"] for f in files], io_config=io_config)
+    has_logs = any(s["logs"] for s in slices)
+    if ttype == "COPY_ON_WRITE" or query_type == "read_optimized" \
+            or not has_logs:
+        paths = [s["base"] for s in slices if s["base"] is not None]
+        if not paths:
+            raise ValueError(f"hudi table {table_uri!r} has no base files "
+                             "for the read-optimized view")
+        return dt.read_parquet(paths, io_config=io_config)
+    return _read_mor_snapshot(slices, props, io_config)
+
+
+def _read_mor_snapshot(slices, props, io_config):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ..dataframe import DataFrame
+    from ..logical.builder import LogicalPlanBuilder
+    from ..recordbatch import RecordBatch
+    from ..schema import Schema
+    from .scan import GeneratorScanOperator
+
+    def load_slice(s):
+        import io as io_
+        base_t = None
+        if s["base"] is not None:
+            raw = _get(s["base"], io_config)
+            base_t = pq.read_table(io_.BytesIO(raw))
+        log_ts = [_load_log_table(p, io_config) for p in s["logs"]]
+        if not log_ts:
+            return base_t
+        key_cols = _record_key_cols(
+            props, (base_t or log_ts[0]).column_names)
+        return _merge_slice(base_t, log_ts, key_cols)
+
+    first = load_slice(slices[0])
+    schema = Schema.from_arrow(
+        first.schema if first is not None else pa.schema([]))
+
+    def make_loader(i, s):
+        def load(pushdowns):
+            t = first if i == 0 else load_slice(s)
+            yield RecordBatch.from_arrow_table(t).cast_to_schema(schema)
+        paths = ([s["base"]] if s["base"] else []) + s["logs"]
+        return paths, load
+
+    entries = [make_loader(i, s) for i, s in enumerate(slices)]
+    op = GeneratorScanOperator(
+        schema, entries,
+        f"HudiScanOperator(MoR snapshot, {len(slices)} slices)",
+        io_config=io_config)
+    return DataFrame(LogicalPlanBuilder.from_scan(op))

@@ -168,53 +168,218 @@ def _rewrite_location(uri: str, meta: dict, table_uri: str) -> str:
     return uri
 
 
-def data_files(table_uri: str, snapshot_id: Optional[int] = None,
-               io_config: Optional[IOConfig] = None) -> List[Dict[str, Any]]:
-    """Live data-file entries for a snapshot: [{path, format, records}]."""
+def scan_entries(table_uri: str, snapshot_id: Optional[int] = None,
+                 io_config: Optional[IOConfig] = None
+                 ) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]],
+                            List[Dict[str, Any]]]:
+    """Snapshot scan plan: (data_files, positional_deletes,
+    equality_deletes), each entry carrying its v2 sequence number (0 for
+    v1) so delete applicability follows the spec (positional deletes apply
+    to data sequence ≤ theirs; equality deletes to data sequence strictly
+    less)."""
     meta = load_table_metadata(table_uri, io_config)
     snap = _current_snapshot(meta, snapshot_id)
     if snap is None:
-        return []
-    out: List[Dict[str, Any]] = []
+        return [], [], []
+    data: List[Dict[str, Any]] = []
+    pos_dels: List[Dict[str, Any]] = []
+    eq_dels: List[Dict[str, Any]] = []
     mlist_uri = _rewrite_location(snap["manifest-list"], meta, table_uri)
     _, manifests = read_avro(_get(mlist_uri, io_config))
     for m in manifests:
-        if m.get("content", 0) == 1:
-            raise NotImplementedError(
-                "Iceberg delete manifests are not supported")
         m_uri = _rewrite_location(m["manifest_path"], meta, table_uri)
+        m_seq = m.get("sequence_number") or 0
         _, entries = read_avro(_get(m_uri, io_config))
         for e in entries:
             if e.get("status") == 2:  # DELETED
                 continue
             df = e["data_file"]
-            if df.get("content", 0) != 0:
-                raise NotImplementedError(
-                    "Iceberg delete files are not supported")
-            out.append({
+            seq = e.get("sequence_number")
+            if seq is None:
+                seq = m_seq  # v2 inheritance: null → manifest's sequence
+            entry = {
                 "path": _rewrite_location(df["file_path"], meta, table_uri),
+                "raw_path": df["file_path"],  # delete files reference this
                 "format": str(df.get("file_format", "PARQUET")).lower(),
                 "records": df.get("record_count", 0),
-            })
-    return out
+                "sequence": seq,
+            }
+            content = df.get("content", 0)
+            if content == 0:
+                data.append(entry)
+            elif content == 1:
+                pos_dels.append(entry)
+            elif content == 2:
+                entry["equality_ids"] = list(df.get("equality_ids") or [])
+                eq_dels.append(entry)
+            else:
+                raise NotImplementedError(
+                    f"iceberg data_file content {content}")
+    return data, pos_dels, eq_dels
+
+
+def data_files(table_uri: str, snapshot_id: Optional[int] = None,
+               io_config: Optional[IOConfig] = None) -> List[Dict[str, Any]]:
+    """Live data-file entries for a snapshot: [{path, format, records}]."""
+    data, pos_dels, eq_dels = scan_entries(table_uri, snapshot_id, io_config)
+    if pos_dels or eq_dels:
+        raise NotImplementedError(
+            "snapshot has v2 delete files; use read_iceberg (it applies "
+            "them at scan)")
+    return data
 
 
 def read_iceberg(table_uri: str, snapshot_id: Optional[int] = None,
                  io_config: Optional[IOConfig] = None):
-    """Iceberg table (warehouse path or metadata JSON path) → DataFrame."""
+    """Iceberg table (warehouse path or metadata JSON path) → DataFrame.
+
+    v2 tables: positional and equality delete files are applied per data
+    file at scan (the reference's delete-map,
+    ``src/daft-local-execution/src/sources/scan_task.rs:95-147``), and
+    columns resolve by FIELD ID against the current schema (renames and
+    added columns from schema evolution read correctly; dropped columns
+    disappear)."""
     import daft_tpu as dt
-    files = data_files(table_uri, snapshot_id, io_config)
-    if not files:
-        meta = load_table_metadata(table_uri, io_config)
+    meta = load_table_metadata(table_uri, io_config)
+    data, pos_dels, eq_dels = scan_entries(table_uri, snapshot_id, io_config)
+    if not data:
         schema = _schema_from_iceberg(meta)
         if schema is None:
             raise ValueError(f"iceberg table {table_uri!r} has no snapshot "
                              "and no schema")
         return _empty_df(schema)
-    fmts = {f["format"] for f in files}
+    fmts = {f["format"] for f in data}
     if fmts - {"parquet"}:
         raise NotImplementedError(f"iceberg data file formats {fmts}")
-    return dt.read_parquet([f["path"] for f in files], io_config=io_config)
+    if not pos_dels and not eq_dels:
+        return dt.read_parquet([f["path"] for f in data],
+                               io_config=io_config)
+    return _read_with_deletes(meta, data, pos_dels, eq_dels, io_config)
+
+
+def _load_parquet_table(uri: str, io_config):
+    import pyarrow.parquet as pq
+    if _is_remote(uri):
+        import io as io_
+        return pq.read_table(io_.BytesIO(_get(uri, io_config)))
+    return pq.read_table(uri[7:] if uri.startswith("file://") else uri)
+
+
+def _field_id_map(meta: dict) -> Dict[int, str]:
+    """current schema: field id → current column name."""
+    schemas = meta.get("schemas") or ([meta["schema"]] if "schema" in meta
+                                      else [])
+    sid = meta.get("current-schema-id", 0)
+    schema = next((s for s in schemas if s.get("schema-id", 0) == sid),
+                  schemas[-1] if schemas else {"fields": []})
+    return {f["id"]: f["name"] for f in schema.get("fields", [])}
+
+
+def _remap_by_field_id(t, id_to_name: Dict[int, str]):
+    """Rename a file's columns to the CURRENT schema via the
+    ``PARQUET:field_id`` metadata parquet writers attach; files without
+    ids keep name-based resolution (our own v1 writer's files)."""
+    import pyarrow as pa
+    names = []
+    changed = False
+    for f in t.schema:
+        fid = None
+        if f.metadata and b"PARQUET:field_id" in f.metadata:
+            try:
+                fid = int(f.metadata[b"PARQUET:field_id"])
+            except ValueError:
+                fid = None
+        if fid is not None and fid in id_to_name \
+                and id_to_name[fid] != f.name:
+            names.append(id_to_name[fid])
+            changed = True
+        else:
+            names.append(f.name)
+    return t.rename_columns(names) if changed else t
+
+
+def _read_with_deletes(meta, data, pos_dels, eq_dels, io_config):
+    """Generator scan: per data file, drop positionally-deleted rows and
+    anti-join equality deletes (sequence-number-aware)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from ..dataframe import DataFrame
+    from ..logical.builder import LogicalPlanBuilder
+    from ..recordbatch import RecordBatch
+    from .scan import GeneratorScanOperator
+
+    schema = _schema_from_iceberg(meta)
+    id_to_name = _field_id_map(meta)
+
+    # positional deletes: data-file path (as WRITTEN, pre-rewrite) → rows
+    pos_map: Dict[str, list] = {}
+    for d in pos_dels:
+        t = _load_parquet_table(d["path"], io_config)
+        for fp, pos in zip(t.column("file_path").to_pylist(),
+                           t.column("pos").to_pylist()):
+            pos_map.setdefault(fp, []).append((d["sequence"], pos))
+    eq_tables = []
+    for d in eq_dels:
+        # delete files may predate schema renames: remap by field id like
+        # data files, then resolve equality_ids against the CURRENT names
+        t = _remap_by_field_id(_load_parquet_table(d["path"], io_config),
+                               id_to_name)
+        cols = [id_to_name[i] for i in d["equality_ids"]
+                if i in id_to_name and id_to_name[i] in t.column_names]
+        if not cols:
+            raise NotImplementedError(
+                f"iceberg equality delete {d['path']!r}: equality_ids "
+                f"{d['equality_ids']} resolve to no current column — "
+                "refusing to guess (a wrong guess would delete rows)")
+        eq_tables.append((d["sequence"], cols, t.select(cols)))
+
+    def load_entry(entry):
+        t = _remap_by_field_id(
+            _load_parquet_table(entry["path"], io_config), id_to_name)
+        # current-schema projection: dropped columns vanish, added → null
+        out_cols = {}
+        for f in schema:
+            if f.name in t.column_names:
+                out_cols[f.name] = t.column(f.name)
+            else:
+                out_cols[f.name] = pa.nulls(t.num_rows,
+                                            type=f.dtype.to_arrow())
+        t = pa.table(out_cols)
+        keep = np.ones(t.num_rows, dtype=bool)
+        for raw_path in (entry.get("raw_path"), entry["path"]):
+            for seq, pos in pos_map.get(raw_path, ()):
+                if seq >= entry["sequence"] and 0 <= pos < len(keep):
+                    keep[pos] = False
+        for seq, cols, dt_ in eq_tables:
+            if seq <= entry["sequence"] or not cols:
+                continue
+            dead = set(zip(*[dt_.column(c).to_pylist() for c in cols])) \
+                if len(cols) > 1 else set(dt_.column(cols[0]).to_pylist())
+            if not dead:
+                continue
+            vals = [t.column(c).to_pylist() for c in cols]
+            for i in range(t.num_rows):
+                key = tuple(v[i] for v in vals) if len(cols) > 1 \
+                    else vals[0][i]
+                if key in dead:
+                    keep[i] = False
+        if not keep.all():
+            t = t.filter(pa.array(keep))
+        return RecordBatch.from_arrow_table(t).cast_to_schema(schema)
+
+    def make_loader(entry):
+        def load(pushdowns):
+            yield load_entry(entry)
+        return [entry["path"]], load
+
+    entries = [make_loader(e) for e in data]
+    op = GeneratorScanOperator(
+        schema, entries,
+        f"IcebergScanOperator({len(data)} data files, "
+        f"{len(pos_dels)}+{len(eq_dels)} delete files)",
+        io_config=io_config)
+    return DataFrame(LogicalPlanBuilder.from_scan(op))
 
 
 def _empty_df(schema):

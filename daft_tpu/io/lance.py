@@ -1,0 +1,347 @@
+"""Native Lance dataset support: versioned columnar datasets with
+column-page files, no `lance` SDK.
+
+The reference delegates Lance IO to the lancedb SDK
+(``/root/reference/daft/io/_lance.py`` read path,
+``/root/reference/src/daft-writers/src/lance.rs`` write path). This module
+implements the dataset natively, mirroring Lance's architecture:
+
+- **dataset layout**: ``data/<uuid>.lance`` column-page files and
+  ``_versions/<v>.manifest`` version snapshots — append/overwrite create
+  a NEW version; old versions stay readable (``read_lance(uri,
+  version=N)`` time travel). Version resolution always globs the
+  manifest directory (no hint file that could go stale under races).
+- **file layout** (v2-style): page data first, then a column-metadata
+  table addressing every page's byte range, then a fixed-size footer
+  (``meta_off, meta_len, major=2, minor=0, magic b"LANC"``). Reads seek
+  the footer, fetch the metadata table, then fetch ONLY the projected
+  columns' page ranges — real columnar IO over any object store.
+- **page encoding**: each page is a single-column Arrow IPC blob
+  (Lance v2 treats page encodings as pluggable; Arrow IPC is this
+  implementation's encoding, which keeps every Arrow dtype round-trippable).
+- **pushdowns**: column projection (byte-range reads), limit (page-count
+  cutoff), filter (fragment pruning via per-column min/max stats, residual
+  applied at scan).
+- **commits**: create-exclusive version manifests with the same optimistic
+  retry as ``iceberg.py`` — concurrent writers serialize, never clobber.
+
+Byte-level interop with the lance SDK is NOT claimed (the manifest/page
+protobufs cannot be validated in this environment); the dataset semantics
+— versioning, fragments, column pages, projection/limit/filter pushdown —
+match, and the format is self-describing.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import json
+import re
+import struct
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import pyarrow as pa
+
+from .iceberg import _get, _is_remote, _join, _put, _put_if_absent
+from .object_io import IOConfig, get_io_client
+
+_MAGIC = b"LANC"
+_PAGE_ROWS = 64 * 1024
+_FOOTER = struct.Struct("<QQHH4s")  # meta_off, meta_len, major, minor, magic
+
+
+# ----------------------------------------------------------------- file
+
+def _ipc_blob(arr: pa.ChunkedArray, name: str) -> bytes:
+    t = pa.table({name: arr})
+    buf = _io.BytesIO()
+    with pa.ipc.new_stream(buf, t.schema) as w:
+        w.write_table(t)
+    return buf.getvalue()
+
+
+def _ipc_unblob(data: bytes) -> pa.ChunkedArray:
+    with pa.ipc.open_stream(_io.BytesIO(data)) as r:
+        return r.read_all().column(0)
+
+
+def _col_stats(arr: pa.ChunkedArray) -> Dict[str, Any]:
+    import pyarrow.compute as pc
+    out: Dict[str, Any] = {"null_count": arr.null_count}
+    try:
+        mn, mx = pc.min(arr).as_py(), pc.max(arr).as_py()
+        if isinstance(mn, (int, float, str, bool)) or mn is None:
+            out["min"], out["max"] = mn, mx
+    except Exception:
+        pass
+    return out
+
+
+def write_fragment_file(table: pa.Table, uri: str, io_config) -> dict:
+    """One Arrow table → one .lance column-page file; returns the fragment
+    manifest entry."""
+    body = bytearray()
+    columns = []
+    for name in table.column_names:
+        arr = table.column(name)
+        pages = []
+        for start in range(0, max(table.num_rows, 1), _PAGE_ROWS):
+            page = arr.slice(start, _PAGE_ROWS)
+            if len(page) == 0 and table.num_rows > 0:
+                break
+            blob = _ipc_blob(page, name)
+            pages.append({"rows": len(page),
+                          "offset": len(body), "length": len(blob)})
+            body += blob
+            if table.num_rows == 0:
+                break
+        columns.append({"name": name, "pages": pages,
+                        "stats": _col_stats(arr)})
+    meta = json.dumps({"columns": columns,
+                       "rows": table.num_rows}).encode()
+    meta_off = len(body)
+    body += meta
+    body += _FOOTER.pack(meta_off, len(meta), 2, 0, _MAGIC)
+    _put(uri, bytes(body), io_config)
+    return {"file": uri.rsplit("/", 1)[-1], "rows": table.num_rows,
+            "size": len(body),
+            "stats": {c["name"]: c["stats"] for c in columns}}
+
+
+def _read_footer_meta(uri: str, io_config, file_size: Optional[int] = None
+                      ) -> dict:
+    client = get_io_client(io_config)
+    if _is_remote(uri):
+        if file_size is None:
+            file_size = client.source_for(uri).get_size(uri)
+        tail = client.get(uri, byte_range=(file_size - _FOOTER.size,
+                                           file_size))
+    else:
+        import os
+        p = uri[7:] if uri.startswith("file://") else uri
+        file_size = os.path.getsize(p)
+        with open(p, "rb") as f:
+            f.seek(file_size - _FOOTER.size)
+            tail = f.read()
+    meta_off, meta_len, major, minor, magic = _FOOTER.unpack(tail)
+    if magic != _MAGIC:
+        raise ValueError(f"not a lance file: {uri!r}")
+    return {"meta": json.loads(_read_range(uri, meta_off,
+                                           meta_len, io_config)),
+            "major": major, "minor": minor}
+
+
+def _read_range(uri: str, off: int, length: int, io_config) -> bytes:
+    if _is_remote(uri):
+        return get_io_client(io_config).get(uri, byte_range=(off,
+                                                             off + length))
+    p = uri[7:] if uri.startswith("file://") else uri
+    with open(p, "rb") as f:
+        f.seek(off)
+        return f.read(length)
+
+
+def read_fragment_file(uri: str, io_config,
+                       columns: Optional[List[str]] = None,
+                       limit: Optional[int] = None) -> pa.Table:
+    """Projected (and limit-bounded) read of one .lance file: only the
+    selected columns' page ranges are fetched."""
+    meta = _read_footer_meta(uri, io_config)["meta"]
+    by_name = {c["name"]: c for c in meta["columns"]}
+    names = columns if columns is not None else [c["name"]
+                                                for c in meta["columns"]]
+    nrows = meta["rows"] if limit is None else min(meta["rows"], limit)
+    out = {}
+    for name in names:
+        c = by_name.get(name)
+        if c is None:
+            # fragment predates this column (appended with a wider
+            # schema): null-fill; the caller casts to the dataset schema
+            out[name] = pa.nulls(nrows)
+            continue
+        arrs = []
+        got = 0
+        for pg in c["pages"]:
+            if limit is not None and got >= limit:
+                break
+            blob = _read_range(uri, pg["offset"], pg["length"], io_config)
+            arrs.append(_ipc_unblob(blob))
+            got += pg["rows"]
+        if arrs:
+            chunks = [ch for a in arrs for ch in a.chunks]
+            merged = pa.chunked_array(chunks, type=arrs[0].type)
+        else:
+            merged = pa.chunked_array([], type=pa.null())
+        if limit is not None and len(merged) > limit:
+            merged = merged.slice(0, limit)
+        out[name] = merged
+    if not out:  # count-style: no columns, rows only
+        n = meta["rows"] if limit is None else min(meta["rows"], limit)
+        return pa.table({"__dummy__": pa.nulls(n)}).drop(["__dummy__"])
+    return pa.table(out)
+
+
+# -------------------------------------------------------------- dataset
+
+def _manifest_dir(uri: str) -> str:
+    return _join(uri, "_versions")
+
+
+def _resolve_version(uri: str, io_config, version: Optional[int] = None
+                     ) -> Optional[dict]:
+    pattern = _join(_manifest_dir(uri), "*.manifest")
+    if _is_remote(uri):
+        hits = get_io_client(io_config).glob(pattern)
+    else:
+        import glob as _g
+        hits = _g.glob(pattern)
+
+    def vnum(p: str) -> int:
+        m = re.search(r"(\d+)\.manifest$", p)
+        return int(m.group(1)) if m else -1
+
+    if version is not None:
+        for p in hits:
+            if vnum(p) == version:
+                return json.loads(_get(p, io_config))
+        raise ValueError(f"lance dataset {uri!r} has no version {version}")
+    if not hits:
+        return None
+    return json.loads(_get(max(hits, key=vnum), io_config))
+
+
+def write_lance(df, uri: str, mode: str = "create",
+                io_config: Optional[IOConfig] = None) -> None:
+    """DataFrame → Lance dataset version. ``mode``: ``create`` (error if
+    the dataset exists), ``append``, ``overwrite`` (new version listing
+    only the new fragments; prior versions stay readable)."""
+    if mode not in ("create", "append", "overwrite"):
+        raise ValueError(f"write_lance mode {mode!r}")
+    existing = _resolve_version(uri, io_config)
+    if mode == "create" and existing is not None:
+        raise ValueError(f"lance dataset already exists at {uri!r} "
+                         "(use mode='append' or 'overwrite')")
+    table = df.to_arrow()
+    frag = write_fragment_file(
+        table, _join(uri, "data", f"{uuid.uuid4().hex}.lance"), io_config)
+    buf = _io.BytesIO()
+    with pa.ipc.new_stream(buf, table.schema):
+        pass  # header-only stream: the exact arrow schema, no batches
+    import base64
+    for _attempt in range(5):
+        cur = _resolve_version(uri, io_config)
+        if mode == "create" and cur is not None:
+            # a concurrent create won the race: creating "over" it would
+            # silently stack a version — honor the exclusive contract
+            raise ValueError(f"lance dataset already exists at {uri!r} "
+                             "(use mode='append' or 'overwrite')")
+        base_version = cur["version"] if cur else 0
+        frags = list(cur["fragments"]) if (cur and mode == "append") else []
+        frags.append(frag)
+        manifest = {
+            "version": base_version + 1,
+            "timestamp_ms": int(time.time() * 1000),
+            "arrow_schema_ipc_b64": base64.b64encode(
+                buf.getvalue()).decode(),
+            "fragments": frags,
+        }
+        target = _join(_manifest_dir(uri),
+                       f"{base_version + 1}.manifest")
+        if _put_if_absent(target, json.dumps(manifest, indent=1).encode(),
+                          io_config):
+            return
+    raise RuntimeError(f"write_lance: lost the version commit race at "
+                       f"{uri!r} 5 times")
+
+
+# ----------------------------------------------------------------- scan
+
+_NUM_OPS = {"lt": lambda mn, mx, v: mn < v, "le": lambda mn, mx, v: mn <= v,
+            "gt": lambda mn, mx, v: mx > v, "ge": lambda mn, mx, v: mx >= v,
+            "eq": lambda mn, mx, v: mn <= v <= mx}
+
+
+def _fragment_survives(filters, stats: Dict[str, dict]) -> bool:
+    """Conservative min/max pruning: False only when a conjunct provably
+    excludes every row of the fragment."""
+    if filters is None:
+        return True
+    from ..logical.optimizer import split_conjuncts
+    try:
+        conjs = split_conjuncts(filters)
+    except Exception:
+        return True
+    for c in conjs:
+        u = c._unalias()
+        if u.op not in _NUM_OPS or len(u.args) != 2:
+            continue
+        a, b = u.args
+        if a.op == "col" and b.op == "lit":
+            name, v = a.params[0], b.params[0]
+        elif b.op == "col" and a.op == "lit":
+            inv = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+                   "eq": "eq"}
+            u = type(u)(inv[u.op], u.args, u.params)
+            name, v = b.params[0], a.params[0]
+        else:
+            continue
+        st = stats.get(name) or {}
+        mn, mx = st.get("min"), st.get("max")
+        if mn is None or mx is None or v is None:
+            continue
+        try:
+            if not _NUM_OPS[u.op](mn, mx, v):
+                return False
+        except TypeError:
+            continue
+    return True
+
+
+def read_lance(uri: str, version: Optional[int] = None,
+               io_config: Optional[IOConfig] = None):
+    """Lance dataset → DataFrame (column-projection, limit and
+    filter-pruning pushdowns applied at scan)."""
+    from ..dataframe import DataFrame
+    from ..logical.builder import LogicalPlanBuilder
+    from ..recordbatch import RecordBatch
+    from ..schema import Schema
+    from .scan import GeneratorScanOperator
+
+    manifest = _resolve_version(uri, io_config, version)
+    if manifest is None:
+        raise FileNotFoundError(f"no lance dataset at {uri!r}")
+    import base64
+    arrow_schema = pa.ipc.open_stream(_io.BytesIO(base64.b64decode(
+        manifest["arrow_schema_ipc_b64"]))).schema
+    schema = Schema.from_arrow(arrow_schema)
+
+    frags = manifest["fragments"]
+
+    def make_loader(fr):
+        furi = _join(uri, "data", fr["file"])
+
+        def load(pushdowns):
+            cols = list(pushdowns.columns) \
+                if pushdowns.columns is not None else None
+            t = read_fragment_file(
+                furi, io_config, columns=cols,
+                limit=pushdowns.limit
+                if pushdowns.filters is None else None)
+            yield RecordBatch.from_arrow_table(t).cast_to_schema(
+                schema.project(cols) if cols is not None else schema)
+        return [furi], load
+
+    entries = [make_loader(fr) for fr in frags]
+    hints = [{"format": "lance", "rows": fr.get("rows"),
+              "size": fr.get("size")} for fr in frags]
+
+    def prune(i, pushdowns):
+        return _fragment_survives(pushdowns.filters,
+                                  frags[i].get("stats", {}))
+
+    op = GeneratorScanOperator(
+        schema, entries,
+        f"LanceScanOperator({uri!r}, version={manifest['version']})",
+        io_config=io_config, prune_fn=prune, entry_hints=hints)
+    return DataFrame(LogicalPlanBuilder.from_scan(op))

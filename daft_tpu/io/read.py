@@ -105,21 +105,27 @@ def read_iceberg(table, snapshot_id: Optional[int] = None,
     return _impl(uri, snapshot_id=snapshot_id, io_config=io_config)
 
 
-def read_hudi(table_uri: str, io_config: Any = None, **kwargs):
-    """Read an Apache Hudi Copy-on-Write table's latest snapshot
-    (reference: ``daft/io/_hudi.py`` over the Hudi SDK; natively
-    implemented — timeline + file-slice resolution in io/hudi.py)."""
+def read_hudi(table_uri: str, io_config: Any = None,
+              query_type: str = "snapshot", **kwargs):
+    """Read an Apache Hudi table's latest snapshot — CoW, and MoR with
+    log-file merging (``query_type='read_optimized'`` for base files
+    only). Reference: ``daft/io/_hudi.py`` over pyhudi, which is CoW-only;
+    natively implemented — timeline + file-slice resolution + log merge
+    in io/hudi.py."""
     if kwargs:
         raise TypeError(f"read_hudi: unsupported options {sorted(kwargs)} "
-                        f"(snapshot/incremental options are not implemented)")
+                        f"(incremental options are not implemented)")
     from .hudi import read_hudi as _impl
-    return _impl(table_uri, io_config=io_config)
+    return _impl(table_uri, io_config=io_config, query_type=query_type)
 
 
-# Lance uses its own columnar format SDK — unlike Delta (JSON log),
-# Iceberg (Avro manifests) and Hudi (timeline + parquet), all implemented
-# natively above (reference: daft/io/_lance.py).
-read_lance = _sdk_gated("read_lance", "lance")
+def read_lance(uri: str, version: Optional[int] = None,
+               io_config=None):
+    """Read a Lance dataset (reference: ``daft/io/_lance.py`` over the
+    lance SDK; implemented natively — versioned column-page datasets with
+    projection/limit/filter pushdown, ``io/lance.py``)."""
+    from .lance import read_lance as _impl
+    return _impl(uri, version=version, io_config=io_config)
 
 
 def read_sql(sql: str, conn, partition_col: Optional[str] = None,

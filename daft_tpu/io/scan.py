@@ -140,6 +140,65 @@ class ScanOperator:
         raise NotImplementedError
 
 
+class GeneratorScanOperator(ScanOperator):
+    """Scan over pre-resolved entries, each loaded by a callback — the
+    shared shape of the lake-format readers (Iceberg-with-deletes, Hudi
+    MoR slices, Lance fragments), which resolve their file lists at plan
+    time and materialize per entry at execution.
+
+    ``entries``: list of (paths, load_fn) where ``load_fn(pushdowns)``
+    yields RecordBatches. ``prune_fn(entry_index, pushdowns)`` → False
+    drops an entry at planning (stats pruning)."""
+
+    def __init__(self, schema: Schema, entries, label: str,
+                 io_config=None, prune_fn=None,
+                 entry_hints=None):
+        self._schema = schema
+        self._entries = entries
+        self._label = label
+        self._io_config = io_config
+        self._prune_fn = prune_fn
+        self._hints = entry_hints or [{} for _ in entries]
+
+    def display(self) -> List[str]:
+        return [self._label]
+
+    def multiline_display(self) -> List[str]:
+        return [self._label]
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def to_scan_tasks(self, pushdowns: Pushdowns) -> List[ScanTask]:
+        tasks = []
+        for i, (paths, load_fn) in enumerate(self._entries):
+            if self._prune_fn is not None \
+                    and not self._prune_fn(i, pushdowns):
+                continue
+            def gen(load_fn=load_fn):
+                yield from load_fn(pushdowns)
+            hint = self._hints[i]
+            tasks.append(ScanTask(
+                list(paths), hint.get("format", "parquet"), self._schema,
+                pushdowns, num_rows_hint=hint.get("rows"),
+                size_bytes_hint=hint.get("size"), generator=gen,
+                io_config=self._io_config))
+        if not tasks:
+            schema = self._schema
+            tasks.append(ScanTask(
+                [], "parquet", schema, pushdowns, num_rows_hint=0,
+                generator=lambda: iter([_empty_batch(schema, pushdowns)])))
+        return tasks
+
+
+def _empty_batch(schema: Schema, pushdowns: Pushdowns):
+    from ..recordbatch import RecordBatch
+    if pushdowns.columns is not None:
+        keep = [n for n in pushdowns.columns if n in schema]
+        return RecordBatch.empty(schema.project(keep))
+    return RecordBatch.empty(schema)
+
+
 def glob_paths(path_or_paths, io_config=None) -> List[str]:
     """Local / file:// / remote (s3://) glob expansion (fanout-style,
     reference ``object_store_glob.rs``). Directories expand to their

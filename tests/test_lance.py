@@ -1,0 +1,106 @@
+"""Native Lance dataset tests (VERDICT r2 item 6 done-criterion:
+round-trip write_lance/read_lance without the lance SDK).
+
+Reference surface: ``daft/io/_lance.py`` /
+``src/daft-writers/src/lance.rs``; native implementation in
+``daft_tpu/io/lance.py``."""
+
+import json
+import os
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col
+
+
+@pytest.fixture()
+def ds(tmp_path):
+    uri = str(tmp_path / "ds")
+    dt.from_pydict({
+        "a": [1, 2, 3, 4],
+        "b": ["w", "x", "y", "z"],
+        "c": [1.5, 2.5, None, 4.5],
+    }).write_lance(uri)
+    return uri
+
+
+def test_roundtrip(ds):
+    out = dt.read_lance(ds).sort("a").to_pydict()
+    assert out == {"a": [1, 2, 3, 4], "b": ["w", "x", "y", "z"],
+                   "c": [1.5, 2.5, None, 4.5]}
+
+
+def test_append_and_time_travel(ds):
+    dt.from_pydict({"a": [5], "b": ["q"], "c": [9.0]}) \
+        .write_lance(ds, mode="append")
+    assert dt.read_lance(ds).sort("a").to_pydict()["a"] == [1, 2, 3, 4, 5]
+    assert dt.read_lance(ds, version=1).sort("a").to_pydict()["a"] \
+        == [1, 2, 3, 4]
+
+
+def test_overwrite_keeps_versions(ds):
+    dt.from_pydict({"a": [7], "b": ["r"], "c": [0.0]}) \
+        .write_lance(ds, mode="overwrite")
+    assert dt.read_lance(ds).to_pydict()["a"] == [7]
+    assert dt.read_lance(ds, version=1).sort("a").to_pydict()["a"] \
+        == [1, 2, 3, 4]
+
+
+def test_create_over_existing_raises(ds):
+    with pytest.raises(ValueError, match="already exists"):
+        dt.from_pydict({"a": [1], "b": ["b"], "c": [1.0]}).write_lance(ds)
+
+
+def test_projection_reads_only_selected_column_pages(ds, monkeypatch):
+    """Column pushdown must fetch only the projected columns' byte
+    ranges."""
+    from daft_tpu.io import lance as L
+    read_cols = []
+    orig = L.read_fragment_file
+
+    def spy(uri, io_config, columns=None, limit=None):
+        read_cols.append(columns)
+        return orig(uri, io_config, columns=columns, limit=limit)
+
+    monkeypatch.setattr(L, "read_fragment_file", spy)
+    out = dt.read_lance(ds).select("b").to_pydict()
+    assert out["b"] == ["w", "x", "y", "z"]
+    assert read_cols and all(list(c) == ["b"] for c in read_cols)
+
+
+def test_filter_prunes_fragments(tmp_path):
+    uri = str(tmp_path / "pruned")
+    dt.from_pydict({"k": [1, 2, 3], "v": ["a", "b", "c"]}).write_lance(uri)
+    dt.from_pydict({"k": [100, 200], "v": ["x", "y"]}) \
+        .write_lance(uri, mode="append")
+    from daft_tpu.io import lance as L
+    manifest = L._resolve_version(uri, None)
+    assert len(manifest["fragments"]) == 2
+    # stats-based pruning: k > 50 provably excludes the first fragment
+    surviving = [f for f in manifest["fragments"]
+                 if L._fragment_survives((col("k") > 50)._unalias(),
+                                         f.get("stats", {}))]
+    assert len(surviving) == 1
+    out = dt.read_lance(uri).where(col("k") > 50).sort("k").to_pydict()
+    assert out == {"k": [100, 200], "v": ["x", "y"]}
+
+
+def test_limit_pushdown(ds):
+    out = dt.read_lance(ds).limit(2).to_pydict()
+    assert len(out["a"]) == 2
+
+
+def test_file_footer_magic(ds):
+    import glob
+    f = glob.glob(os.path.join(ds, "data", "*.lance"))[0]
+    with open(f, "rb") as fh:
+        fh.seek(-4, os.SEEK_END)
+        assert fh.read() == b"LANC"
+
+
+def test_empty_dataframe_roundtrip(tmp_path):
+    uri = str(tmp_path / "empty")
+    dt.from_pydict({"a": [1]}).where(col("a") > 5).write_lance(uri)
+    out = dt.read_lance(uri).to_pydict()
+    assert out == {"a": []}
