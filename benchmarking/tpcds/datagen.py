@@ -26,21 +26,34 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
     n_sales = max(int(500_000 * scale), 5000)
 
     # date_dim: 3 years of days
+    import datetime as _dt
     n_days = 3 * 365
     d_date_sk = np.arange(1, n_days + 1)
     years = 1999 + (np.arange(n_days) // 365)
     moy = ((np.arange(n_days) % 365) // 31) + 1
     moy_clip = np.minimum(moy, 12)
+    base_date = _dt.date(1999, 1, 1)
+    dates = [base_date + _dt.timedelta(days=int(i)) for i in range(n_days)]
+    day_names = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+                 "Saturday", "Sunday"]
     date_dim = pa.table({
         "d_date_sk": d_date_sk,
+        "d_date": pa.array(dates, pa.date32()),
         "d_year": years,
         "d_moy": moy_clip,
         "d_qoy": (moy_clip - 1) // 3 + 1,
         "d_dom": (np.arange(n_days) % 31) + 1,
+        "d_dow": np.array([d.weekday() for d in dates]),
+        "d_day_name": [day_names[d.weekday()] for d in dates],
+        "d_week_seq": np.arange(n_days) // 7 + 1,
+        "d_month_seq": (years - 1999) * 12 + moy_clip - 1 + 1200,
     })
 
-    categories = ["Books", "Home", "Electronics", "Music", "Sports"]
-    classes = ["cls%02d" % i for i in range(10)]
+    categories = ["Books", "Home", "Electronics", "Music", "Sports",
+                  "Children", "Women", "Men", "Jewelry", "Shoes"]
+    classes = ["computers", "stereo", "football", "shirts", "birdal",
+               "dresses", "personal", "portable", "reference", "self-help",
+               "accessories", "classical", "fragrances", "pants"]
     brands = ["brand%03d" % i for i in range(50)]
     cat = rng.choice(len(categories), n_items)
     cls = rng.choice(len(classes), n_items)
@@ -58,30 +71,55 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
         "i_brand_id": brd + 1,
         "i_manager_id": rng.integers(1, 100, n_items),
         "i_manufact_id": rng.integers(1, 200, n_items),
+        "i_manufact": ["manu%03d" % m for m in rng.integers(0, 60, n_items)],
+        "i_product_name": ["product%05d" % i for i in range(n_items)],
+        "i_color": rng.choice(["powder", "orchid", "slate", "peach",
+                               "smoke", "sienna", "navy", "aquamarine"],
+                              n_items),
+        "i_size": rng.choice(["small", "medium", "large", "petite",
+                              "extra large", "N/A"], n_items),
+        "i_units": rng.choice(["Oz", "Bunch", "Ton", "Each", "Case"],
+                              n_items),
+        "i_wholesale_cost": rng.uniform(0.5, 80, n_items).round(2),
     })
 
     store = pa.table({
         "s_store_sk": np.arange(1, n_stores + 1),
-        "s_store_name": ["store%d" % i for i in range(n_stores)],
+        "s_store_name": ["ese" if i == 0 else "store%d" % i
+                         for i in range(n_stores)],
         "s_company_name": ["company%d" % (i % 3) for i in range(n_stores)],
+        "s_city": rng.choice(["rivertown", "lakeside", "hilltop"], n_stores),
+        "s_county": rng.choice(["Ziebach County", "Williamson County"],
+                               n_stores),
+        "s_state": rng.choice(["TN", "SD", "CA"], n_stores),
+        "s_gmt_offset": rng.choice([-5.0, -6.0, -8.0], n_stores),
+        "s_number_employees": rng.integers(200, 300, n_stores),
+        "s_store_id": ["S%08d" % i for i in range(n_stores)],
     })
 
     n_custs = max(int(2000 * scale), 100)
     n_cd = 200  # demographic combinations
     customer = pa.table({
         "c_customer_sk": np.arange(1, n_custs + 1),
+        "c_customer_id": ["CUST%08d" % i for i in range(n_custs)],
         "c_current_cdemo_sk": rng.integers(1, n_cd + 1, n_custs),
         "c_current_addr_sk": np.arange(1, n_custs + 1),
         "c_first_name": ["first%d" % i for i in range(n_custs)],
         "c_last_name": ["last%d" % i for i in range(n_custs)],
         "c_birth_year": rng.integers(1930, 2005, n_custs),
+        "c_preferred_cust_flag": rng.choice(["Y", "N"], n_custs),
     })
     customer_address = pa.table({
         "ca_address_sk": np.arange(1, n_custs + 1),
         "ca_city": rng.choice(["rivertown", "lakeside", "hilltop",
                                "meadow", "brookfield"], n_custs),
-        "ca_state": rng.choice(["CA", "NY", "TX", "WA", "OR"], n_custs),
+        "ca_county": rng.choice(["Ziebach County", "Williamson County",
+                                 "Walker County"], n_custs),
+        "ca_state": rng.choice(["CA", "NY", "TX", "WA", "OR", "TN", "SD",
+                                "GA", "KY", "NM"], n_custs),
         "ca_zip": ["%05d" % z for z in rng.integers(10000, 99999, n_custs)],
+        "ca_country": ["United States"] * n_custs,
+        "ca_gmt_offset": rng.choice([-5.0, -6.0, -7.0, -8.0], n_custs),
     })
     customer_demographics = pa.table({
         "cd_demo_sk": np.arange(1, n_cd + 1),
@@ -95,6 +133,13 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
         "p_promo_sk": np.arange(1, n_promos + 1),
         "p_channel_email": rng.choice(["Y", "N"], n_promos),
         "p_channel_event": rng.choice(["Y", "N"], n_promos),
+        "p_channel_dmail": rng.choice(["Y", "N"], n_promos),
+        "p_channel_tv": rng.choice(["Y", "N"], n_promos),
+    })
+    n_reasons = 10
+    reason = pa.table({
+        "r_reason_sk": np.arange(1, n_reasons + 1),
+        "r_reason_desc": ["reason %d" % i for i in range(n_reasons)],
     })
 
     n_hd = 100
@@ -124,14 +169,21 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
     t_cust = rng.integers(1, n_custs + 1, n_tickets + 1)
     t_cd = rng.integers(1, n_cd + 1, n_tickets + 1)
     t_hd = rng.integers(1, n_hd + 1, n_tickets + 1)
+    # delivery address is NOT always the customer's own (Q46/Q68 compare
+    # bought city vs current city)
+    t_addr = rng.integers(1, n_custs + 1, n_tickets + 1)
+    # zipf-skewed item popularity: real catalogs have hits and long
+    # tails (Q65 hunts store-item pairs far below the store average)
+    ss_item = (rng.zipf(1.3, n_sales) - 1) % n_items + 1
     store_sales = pa.table({
         "ss_sold_date_sk": t_date[ticket],
         "ss_sold_time_sk": t_time[ticket],
-        "ss_item_sk": rng.integers(1, n_items + 1, n_sales),
+        "ss_item_sk": ss_item,
         "ss_store_sk": t_store[ticket],
         "ss_customer_sk": t_cust[ticket],
         "ss_cdemo_sk": t_cd[ticket],
         "ss_hdemo_sk": t_hd[ticket],
+        "ss_addr_sk": t_addr[ticket],
         "ss_promo_sk": rng.integers(1, n_promos + 1, n_sales),
         "ss_ticket_number": ticket,
         "ss_sales_price": rng.uniform(1, 300, n_sales).round(2),
@@ -139,6 +191,28 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
         "ss_list_price": rng.uniform(1, 300, n_sales).round(2),
         "ss_coupon_amt": rng.uniform(0, 50, n_sales).round(2),
         "ss_ext_sales_price": rng.uniform(1, 3000, n_sales).round(2),
+        "ss_ext_list_price": rng.uniform(1, 3000, n_sales).round(2),
+        "ss_ext_discount_amt": rng.uniform(0, 300, n_sales).round(2),
+        "ss_ext_wholesale_cost": rng.uniform(1, 1500, n_sales).round(2),
+        "ss_wholesale_cost": rng.uniform(1, 100, n_sales).round(2),
+        "ss_ext_tax": rng.uniform(0, 200, n_sales).round(2),
+        "ss_net_paid": rng.uniform(1, 2500, n_sales).round(2),
+        "ss_net_profit": rng.uniform(-500, 1500, n_sales).round(2),
+    })
+
+    # store_returns: ~8% of sale lines come back, days after the sale
+    n_ret = max(n_sales // 12, 10)
+    ret_idx = rng.choice(n_sales, n_ret, replace=False)
+    store_returns = pa.table({
+        "sr_returned_date_sk": np.minimum(
+            t_date[ticket[ret_idx]] + rng.integers(1, 60, n_ret), n_days),
+        "sr_item_sk": ss_item[ret_idx],
+        "sr_customer_sk": t_cust[ticket[ret_idx]],
+        "sr_store_sk": t_store[ticket[ret_idx]],
+        "sr_ticket_number": ticket[ret_idx],
+        "sr_reason_sk": rng.integers(1, n_reasons + 1, n_ret),
+        "sr_return_quantity": rng.integers(1, 20, n_ret),
+        "sr_return_amt": rng.uniform(1, 300, n_ret).round(2),
     })
 
     for name, t in (("date_dim", date_dim), ("item", item),
@@ -148,7 +222,8 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
                     ("customer_demographics", customer_demographics),
                     ("promotion", promotion),
                     ("household_demographics", household_demographics),
-                    ("time_dim", time_dim)):
+                    ("time_dim", time_dim), ("reason", reason),
+                    ("store_returns", store_returns)):
         d = os.path.join(root, name)
         os.makedirs(d, exist_ok=True)
         pq.write_table(t, os.path.join(d, "part-0.parquet"))

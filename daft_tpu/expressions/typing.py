@@ -101,6 +101,11 @@ def infer_field(e, schema: Schema) -> Field:
             raise ValueError(
                 f"unresolved column {name!r}; available: {schema.column_names}")
         return schema[name]
+    if op == "outer_col":
+        raise ValueError(
+            f"outer_col({e.params[0]!r}): a correlated outer-scope "
+            "reference escaped its subquery's WHERE clause — only "
+            "equality correlation in WHERE is supported")
     if op in ("subquery", "in_subquery", "exists"):
         raise ValueError(
             f"{op} expression must be unnested into a join before execution "

@@ -267,7 +267,9 @@ def _merge_slice(base_t, log_tables, key_cols: List[str]):
             if deleted[i]:
                 rows[key] = None
             else:
-                rows[key] = {f.name: d[f.name][i] for f in out_schema}
+                # partial-update log payloads may omit columns: null-fill
+                rows[key] = {f.name: d[f.name][i] if f.name in d else None
+                             for f in out_schema}
     live = [rows[k] for k in order if rows[k] is not None]
     if not live:
         return out_schema.empty_table() if out_schema is not None else None
@@ -325,18 +327,27 @@ def _read_mor_snapshot(slices, props, io_config):
             props, (base_t or log_ts[0]).column_names)
         return _merge_slice(base_t, log_ts, key_cols)
 
-    first = load_slice(slices[0])
-    schema = Schema.from_arrow(
-        first.schema if first is not None else pa.schema([]))
+    # schema from footers/headers only — no slice materializes at plan time
+    s0 = slices[0]
+    if s0["base"] is not None:
+        import io as io_
+        arrow_schema = pq.read_schema(
+            io_.BytesIO(_get(s0["base"], io_config))) \
+            if _is_remote(s0["base"]) else pq.read_schema(_strip(s0["base"]))
+    else:
+        arrow_schema = _load_log_table(s0["logs"][0], io_config).schema
+    arrow_schema = pa.schema(
+        [f for f in arrow_schema if f.name != _DELETED_COL])
+    schema = Schema.from_arrow(arrow_schema)
 
-    def make_loader(i, s):
+    def make_loader(s):
         def load(pushdowns):
-            t = first if i == 0 else load_slice(s)
-            yield RecordBatch.from_arrow_table(t).cast_to_schema(schema)
+            yield RecordBatch.from_arrow_table(
+                load_slice(s)).cast_to_schema(schema)
         paths = ([s["base"]] if s["base"] else []) + s["logs"]
         return paths, load
 
-    entries = [make_loader(i, s) for i, s in enumerate(slices)]
+    entries = [make_loader(s) for s in slices]
     op = GeneratorScanOperator(
         schema, entries,
         f"HudiScanOperator(MoR snapshot, {len(slices)} slices)",

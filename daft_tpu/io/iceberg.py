@@ -352,18 +352,22 @@ def _read_with_deletes(meta, data, pos_dels, eq_dels, io_config):
                 if seq >= entry["sequence"] and 0 <= pos < len(keep):
                     keep[pos] = False
         for seq, cols, dt_ in eq_tables:
-            if seq <= entry["sequence"] or not cols:
+            if seq <= entry["sequence"] or not cols or not dt_.num_rows:
                 continue
-            dead = set(zip(*[dt_.column(c).to_pylist() for c in cols])) \
-                if len(cols) > 1 else set(dt_.column(cols[0]).to_pylist())
-            if not dead:
-                continue
-            vals = [t.column(c).to_pylist() for c in cols]
-            for i in range(t.num_rows):
-                key = tuple(v[i] for v in vals) if len(cols) > 1 \
-                    else vals[0][i]
-                if key in dead:
-                    keep[i] = False
+            import pyarrow.compute as pc
+            if len(cols) == 1:
+                hit = pc.is_in(t.column(cols[0]),
+                               value_set=dt_.column(cols[0])
+                               .combine_chunks())
+                keep &= ~np.asarray(hit.fill_null(False).combine_chunks())
+            else:
+                # multi-key: arrow semi join against the (deduped) delete
+                # keys instead of a per-row Python probe
+                probe = t.select(cols).append_column(
+                    "__idx__", pa.array(np.arange(t.num_rows)))
+                dedup = dt_.group_by(cols).aggregate([])
+                hit = probe.join(dedup, keys=cols, join_type="left semi")
+                keep[hit.column("__idx__").to_numpy()] = False
         if not keep.all():
             t = t.filter(pa.array(keep))
         return RecordBatch.from_arrow_table(t).cast_to_schema(schema)

@@ -218,8 +218,11 @@ def write_lance(df, uri: str, mode: str = "create",
     only the new fragments; prior versions stay readable)."""
     if mode not in ("create", "append", "overwrite"):
         raise ValueError(f"write_lance mode {mode!r}")
-    existing = _resolve_version(uri, io_config)
-    if mode == "create" and existing is not None:
+    # one resolve covers both the create-exclusivity check (BEFORE any
+    # bytes land, so no orphan fragments on user error) and the first
+    # commit attempt; conflicts re-resolve inside the loop
+    cur = _resolve_version(uri, io_config)
+    if mode == "create" and cur is not None:
         raise ValueError(f"lance dataset already exists at {uri!r} "
                          "(use mode='append' or 'overwrite')")
     table = df.to_arrow()
@@ -230,10 +233,9 @@ def write_lance(df, uri: str, mode: str = "create",
         pass  # header-only stream: the exact arrow schema, no batches
     import base64
     for _attempt in range(5):
-        cur = _resolve_version(uri, io_config)
         if mode == "create" and cur is not None:
-            # a concurrent create won the race: creating "over" it would
-            # silently stack a version — honor the exclusive contract
+            # a concurrent create won the race mid-retry: creating "over"
+            # it would silently stack a version
             raise ValueError(f"lance dataset already exists at {uri!r} "
                              "(use mode='append' or 'overwrite')")
         base_version = cur["version"] if cur else 0
@@ -251,6 +253,7 @@ def write_lance(df, uri: str, mode: str = "create",
         if _put_if_absent(target, json.dumps(manifest, indent=1).encode(),
                           io_config):
             return
+        cur = _resolve_version(uri, io_config)  # lost the race: refresh
     raise RuntimeError(f"write_lance: lost the version commit race at "
                        f"{uri!r} 5 times")
 

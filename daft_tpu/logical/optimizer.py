@@ -35,9 +35,21 @@ class Optimizer:
     def __init__(self, batches: Optional[List[Batch]] = None):
         self.batches = batches or [
             Batch("simplify", [SimplifyExpressions()], "fixed_point"),
-            Batch("pushdowns", [EliminateCrossJoin(), PushDownFilter(),
+            Batch("pushdowns", [EliminateCrossJoin(),
+                                SimplifyNullFilteredJoin(),
+                                PushDownFilter(),
+                                PushDownAntiSemiJoin(),
                                 PushDownProjection(), PushDownLimit(),
                                 DropRepartition()],
+                  "fixed_point"),
+            # key-derived filters once pushdowns settle: they ADD filters,
+            # so they run in their own once-batches (idempotent by
+            # structural dedupe) followed by a pushdown sweep to sink the
+            # new predicates into scans
+            Batch("derived_filters", [PushDownJoinPredicate(),
+                                      FilterNullJoinKey()], "once"),
+            Batch("derived_pushdown", [PushDownFilter(),
+                                       PushDownProjection()],
                   "fixed_point"),
             Batch("joins", [ReorderJoins()], "once"),
             Batch("post_join_pushdowns", [PushDownFilter(),
@@ -76,6 +88,12 @@ def substitute_columns(e: Expression, mapping: Dict[str, Expression]
 def split_conjuncts(e: Expression) -> List[Expression]:
     if e.op == "and":
         return split_conjuncts(e.args[0]) + split_conjuncts(e.args[1])
+    return [e]
+
+
+def _split_disjuncts(e: Expression) -> List[Expression]:
+    if e.op == "or":
+        return _split_disjuncts(e.args[0]) + _split_disjuncts(e.args[1])
     return [e]
 
 
@@ -118,6 +136,33 @@ def simplify(e: Expression) -> Expression:
     # not(not(x)) -> x
     if e.op == "not" and e.args[0].op == "not":
         return e.args[0].args[0]
+    # OR-common-conjunct factoring: (A & X) | (A & Y) -> A & (X | Y).
+    # TPC-DS Q13/Q48-style predicates repeat the JOIN conditions inside
+    # every OR branch; factoring them out lets EliminateCrossJoin find the
+    # equi keys instead of evaluating a multi-table cross product.
+    if e.op == "or":
+        branches = _split_disjuncts(e)
+        conj_sets = [split_conjuncts(b) for b in branches]
+        common = []
+        for c in conj_sets[0]:
+            if all(any(c.structurally_eq(x) for x in s)
+                   for s in conj_sets[1:]) \
+                    and not any(c.structurally_eq(x) for x in common):
+                common.append(c)
+        if common:
+            rests = []
+            for s in conj_sets:
+                rest = [x for x in s
+                        if not any(x.structurally_eq(c) for c in common)]
+                rests.append(combine_conjuncts(rest) if rest else lit(True))
+            if all(r.op == "lit" and r.params[0] is True for r in rests):
+                # every branch was fully absorbed (e.g. A | A): the OR is
+                # exactly the common part — recursing would loop forever
+                return combine_conjuncts(common)
+            out = rests[0]
+            for r in rests[1:]:
+                out = out | r
+            return combine_conjuncts(common + [simplify(out)])
     # x == True -> x ; x == False -> not x
     if e.op in ("eq", "neq"):
         l, r = e.args
@@ -136,6 +181,8 @@ def simplify(e: Expression) -> Expression:
         for a, b in ((l, r), (r, l)):
             if a.op == "lit" and a.params[0] is False:
                 return b
+            if a.op == "lit" and a.params[0] is True:
+                return a
     return e
 
 
@@ -184,9 +231,12 @@ class PushDownFilter(Rule):
                         to_l.append(c)
                     elif cols_used <= r_names and child.how in ("inner", "right"):
                         # map prefixed names back to right child columns
+                        # (exact names first: SQL pre-renames collisions)
                         rc_names = set(child.children[1].schema().column_names)
                         mapping = {}
                         for nm in cols_used:
+                            if nm in rc_names:
+                                continue  # literal right column, no remap
                             base = nm[6:] if nm.startswith("right.") else nm
                             if base in rc_names:
                                 mapping[nm] = col(base)
@@ -279,6 +329,7 @@ class PushDownProjection(Rule):
                                 node.aggs, node.group_by)
         if isinstance(node, lp.Join):
             l_names = set(node.children[0].schema().column_names)
+            r_names = set(node.children[1].schema().column_names)
             if required is None:
                 l_req = r_req = None
             else:
@@ -287,6 +338,10 @@ class PushDownProjection(Rule):
                 for nm in required:
                     if nm in l_names:
                         out_l.add(nm)
+                    elif nm in r_names:
+                        # SQL pre-renames collisions, so the name may be
+                        # the right child's literal column
+                        out_r.add(nm)
                     else:
                         base = nm[6:] if nm.startswith("right.") else nm
                         out_r.add(base)
@@ -420,7 +475,8 @@ class EliminateCrossJoin(Rule):
             if not left_on:
                 return node
             join = lp.Join(child.children[0], child.children[1],
-                           left_on, right_on, "inner")
+                           left_on, right_on, "inner", child.strategy,
+                           child.prefix, child.suffix)
             return lp.Filter(join, combine_conjuncts(rest)) if rest else join
         return plan.transform_up(fn)
 
@@ -557,3 +613,222 @@ class ReorderJoins(Rule):
         if set(out_names) != set(tree.schema().column_names):
             return None  # safety: must be a pure permutation
         return lp.Project(tree, [col(nm) for nm in out_names])
+
+
+def _null_rejecting_cols(conj: Expression) -> set:
+    """Columns for which the conjunct cannot hold when they are NULL
+    (comparison semantics propagate NULL → filter drops the row). A
+    conjunct containing null-tolerant ops (is_null / fill_null /
+    coalesce / is_in) contributes nothing."""
+    tolerant = {"is_null", "fill_null", "coalesce", "is_in", "or", "not"}
+
+    def has_tolerant(e: Expression) -> bool:
+        return e.op in tolerant or any(has_tolerant(c) for c in e.args)
+
+    u = conj._unalias()
+    if has_tolerant(u):
+        return set()
+    if u.op in ("eq", "neq", "lt", "le", "gt", "ge", "between",
+                "not_null"):
+        return set(u.column_names())
+    return set()
+
+
+class SimplifyNullFilteredJoin(Rule):
+    """Filter(outer Join) whose predicate null-rejects a column from the
+    null-producing side → strengthen the join (left/right → inner, outer →
+    left/right/inner): the filter would drop every unmatched row anyway,
+    and inner joins unlock reordering + broadcast (reference:
+    ``optimization/rules/simplify_null_filtered_join.rs``)."""
+
+    name = "simplify_null_filtered_join"
+
+    def apply(self, plan):
+        def fn(node):
+            if not isinstance(node, lp.Filter):
+                return node
+            child = node.children[0]
+            if not (isinstance(child, lp.Join)
+                    and child.how in ("left", "right", "outer")):
+                return node
+            l_names = set(child.children[0].schema().column_names)
+            out_names = set(child.schema().column_names)
+            r_out = out_names - l_names
+            rejected: set = set()
+            for c in split_conjuncts(node.predicate):
+                rejected |= _null_rejecting_cols(c)
+            rejects_left = bool(rejected & l_names)
+            rejects_right = bool(rejected & r_out)
+            how = child.how
+            if how == "left" and rejects_right:
+                how = "inner"
+            elif how == "right" and rejects_left:
+                how = "inner"
+            elif how == "outer":
+                # rejecting a RIGHT column kills LEFT-unmatched rows
+                # (their right columns are NULL) → what remains is a
+                # RIGHT join, and vice versa
+                if rejects_left and rejects_right:
+                    how = "inner"
+                elif rejects_right:
+                    how = "right"
+                elif rejects_left:
+                    how = "left"
+            if how == child.how:
+                return node
+            join = lp.Join(child.children[0], child.children[1],
+                           child.left_on, child.right_on, how,
+                           child.strategy, child.prefix, child.suffix)
+            return lp.Filter(join, node.predicate)
+        return plan.transform_up(fn)
+
+
+class PushDownAntiSemiJoin(Rule):
+    """Sink semi/anti joins below the left side's Projects and Sorts so
+    they filter before wide projections / orderings run (the join output
+    schema IS the left schema, so the rewrite is a pure reordering;
+    reference: ``optimization/rules/push_down_anti_semi_join.rs``)."""
+
+    name = "push_down_anti_semi_join"
+
+    def apply(self, plan):
+        def fn(node):
+            if not (isinstance(node, lp.Join)
+                    and node.how in ("semi", "anti")):
+                return node
+            child = node.children[0]
+            if isinstance(child, lp.Sort):
+                join = lp.Join(child.children[0], node.children[1],
+                               node.left_on, node.right_on, node.how,
+                               node.strategy)
+                return child.with_children([join])
+            if isinstance(child, lp.Project):
+                # keys must be pure passthroughs of the project's input
+                mapping = {}
+                for e in child.exprs:
+                    inner = e._unalias()
+                    if inner.op == "col":
+                        mapping[e.name()] = inner
+                remapped = []
+                for k in node.left_on:
+                    ku = k._unalias()
+                    if ku.op != "col" or ku.params[0] not in mapping:
+                        return node
+                    remapped.append(mapping[ku.params[0]])
+                join = lp.Join(child.children[0], node.children[1],
+                               remapped, node.right_on, node.how,
+                               node.strategy)
+                return child.with_children([join])
+            return node
+        return plan.transform_up(fn)
+
+
+class FilterNullJoinKey(Rule):
+    """Null join keys can never match an equi join: pre-filter them on
+    the sides whose unmatched rows are NOT preserved (both for inner and
+    semi; the probe side of left/right; the right side of anti). Shrinks
+    shuffle and build input (reference:
+    ``optimization/rules/filter_null_join_key.rs``)."""
+
+    name = "filter_null_join_key"
+
+    def apply(self, plan):
+        def not_null_pred(keys):
+            preds = [k.not_null() for k in keys
+                     if k._unalias().op == "col"]
+            return combine_conjuncts(preds) if preds else None
+
+        def already_filtered(child, pred) -> bool:
+            return (isinstance(child, lp.Filter)
+                    and all(any(c.structurally_eq(ex) for ex in
+                                split_conjuncts(child.predicate))
+                            for c in split_conjuncts(pred)))
+
+        def fn(node):
+            if not isinstance(node, lp.Join) or not node.left_on:
+                return node
+            filter_left = node.how in ("inner", "semi")
+            filter_right = node.how in ("inner", "left", "semi", "anti")
+            if node.how == "right":
+                filter_left = True
+            newl, newr = node.children
+            changed = False
+            if filter_left:
+                p = not_null_pred(node.left_on)
+                if p is not None and not already_filtered(newl, p):
+                    newl = lp.Filter(newl, p)
+                    changed = True
+            if filter_right:
+                p = not_null_pred(node.right_on)
+                if p is not None and not already_filtered(newr, p):
+                    newr = lp.Filter(newr, p)
+                    changed = True
+            if not changed:
+                return node
+            return node.with_children([newl, newr])
+        return plan.transform_up(fn)
+
+
+class PushDownJoinPredicate(Rule):
+    """Predicate transfer across equi-join keys: a literal comparison
+    pinned to one side's key column holds identically for the other
+    side's key (rows can only match on equal key values), so clone it
+    across — both shuffle inputs shrink (reference:
+    ``optimization/rules/push_down_join_predicate.rs``)."""
+
+    name = "push_down_join_predicate"
+
+    _OPS = ("eq", "lt", "le", "gt", "ge", "between", "is_in")
+
+    def apply(self, plan):
+        def key_conjuncts(child, key_name):
+            """Literal-only conjuncts of an immediate Filter over exactly
+            the key column."""
+            if not isinstance(child, lp.Filter):
+                return []
+            out = []
+            for c in split_conjuncts(child.predicate):
+                u = c._unalias()
+                if u.op in self._OPS and set(u.column_names()) == {key_name} \
+                        and all(a.op != "col" or a.params[0] == key_name
+                                for a in u.args):
+                    out.append(c)
+            return out
+
+        def fn(node):
+            if not (isinstance(node, lp.Join)
+                    and node.how in ("inner", "semi")):
+                return node
+            newl, newr = node.children
+            add_l, add_r = [], []
+            for lk, rk in zip(node.left_on, node.right_on):
+                lu, ru = lk._unalias(), rk._unalias()
+                if lu.op != "col" or ru.op != "col":
+                    continue
+                for c in key_conjuncts(newl, lu.params[0]):
+                    t = substitute_columns(c, {lu.params[0]: ru})
+                    add_r.append(t)
+                for c in key_conjuncts(newr, ru.params[0]):
+                    t = substitute_columns(c, {ru.params[0]: lu})
+                    add_l.append(t)
+
+            def extend(child, extra):
+                if not extra:
+                    return child, False
+                existing = split_conjuncts(child.predicate) \
+                    if isinstance(child, lp.Filter) else []
+                fresh = [e for e in extra
+                         if not any(e.structurally_eq(x) for x in existing)]
+                if not fresh:
+                    return child, False
+                base = child.children[0] if isinstance(child, lp.Filter) \
+                    else child
+                return lp.Filter(base, combine_conjuncts(
+                    existing + fresh)), True
+
+            newl, cl = extend(newl, add_l)
+            newr, cr = extend(newr, add_r)
+            if not (cl or cr):
+                return node
+            return node.with_children([newl, newr])
+        return plan.transform_up(fn)

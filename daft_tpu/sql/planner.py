@@ -18,6 +18,7 @@ from typing import Dict, List, Optional, Tuple
 from ..datatype import DataType, TimeUnit
 from ..expressions import Expression, col, lit, coalesce
 from ..expressions.expressions import list_
+from ..logical.optimizer import substitute_columns
 
 # ---------------------------------------------------------------------------
 # tokenizer
@@ -616,22 +617,43 @@ class SQLPlanner:
         if sub_ctx is None and not subq.contains_subquery(where):
             return df.where(where)
         avail = set(df.column_names)
+
+        def has_outer(e) -> bool:
+            return e.op == "outer_col" or any(has_outer(a) for a in e.args)
+
+        def unmark(e):
+            """outer_col marker → plain col (for exprs that will evaluate
+            against the ENCLOSING frame as join keys)."""
+            if e.op == "outer_col":
+                return col(e.params[0])
+            if not e.args:
+                return e
+            return e.with_children([unmark(a) for a in e.args])
+
         plain = []
         for conj in subq.split_conjuncts(where):
             free = subq.free_columns(conj)
-            if free <= avail or sub_ctx is None:
+            outer = has_outer(conj)
+            if not outer and (free <= avail or sub_ctx is None):
                 plain.append(conj)
                 continue
             u = conj._unalias()
-            if u.op == "eq" and not subq.contains_subquery(u):
+            if sub_ctx is not None and outer and u.op == "eq" \
+                    and not subq.contains_subquery(u):
                 a, b = u.args
-                fa, fb = subq.free_columns(a), subq.free_columns(b)
-                if fa <= avail and fb and not (fb & avail):
-                    sub_ctx.corr.append((a, b))
-                    continue
-                if fb <= avail and fa and not (fa & avail):
-                    sub_ctx.corr.append((b, a))
-                    continue
+                for inner, outer_e in ((a, b), (b, a)):
+                    if has_outer(inner):
+                        continue
+                    if subq.free_columns(inner) <= avail \
+                            and has_outer(outer_e) \
+                            and not subq.free_columns(outer_e):
+                        sub_ctx.corr.append((inner, unmark(outer_e)))
+                        break
+                else:
+                    raise NotImplementedError(
+                        f"correlated predicate {conj!r}: only equality "
+                        "correlation (inner = outer) is supported")
+                continue
             raise NotImplementedError(
                 f"correlated predicate {conj!r}: only equality "
                 "correlation (inner = outer, no nested subquery) is "
@@ -658,15 +680,19 @@ class SQLPlanner:
     def _resolve_col(self, scope, name, alias=None) -> Expression:
         """Scope resolution with correlated fallback: a name unknown to the
         current scope may belong to an enclosing query's scope when we are
-        inside a subquery."""
+        inside a subquery. Outer references come back as marked
+        ``outer_col`` nodes — the actual name alone cannot distinguish
+        them when inner and outer tables share column names (e.g.
+        ``item j`` correlated with outer ``item i`` on i_category)."""
         try:
             return col(scope.resolve(name, alias))
         except ValueError:
             for ctx in reversed(self._sub_stack):
                 try:
-                    return col(ctx.outer_scope.resolve(name, alias))
+                    actual = ctx.outer_scope.resolve(name, alias)
                 except ValueError:
                     continue
+                return Expression("outer_col", (), (actual,))
             raise
 
     def _prev_was_as(self, start: int) -> bool:
@@ -724,9 +750,13 @@ class SQLPlanner:
                 break
             right_scope = Scope()
             rdf = self._table_factor(ctes, right_scope)
+            # rename colliding right columns BEFORE the ON condition
+            # parses, so every later resolution sees final actual names
+            # (self-join chains of any depth stay unambiguous)
+            rdf, rename = self._rename_collisions(rdf, scope, right_scope)
             if how == "cross" and not self._peek_kw("ON"):
                 df = self._merge_join(df, rdf, scope, right_scope, "cross",
-                                      [], [], None)
+                                      [], [], None, rename)
                 continue
             if self._kw("USING"):
                 self._expect("(")
@@ -739,26 +769,55 @@ class SQLPlanner:
                 lo = [col(scope.resolve(c)) for c in cols_u]
                 ro = [col(right_scope.resolve(c)) for c in cols_u]
                 df = self._merge_join(df, rdf, scope, right_scope, how, lo,
-                                      ro, None)
+                                      ro, None, rename)
                 continue
             self._expect("ON")
             cond = self._expr_joined(scope, right_scope)
             lo, ro, residual = _split_join_condition(cond, scope, right_scope)
             df = self._merge_join(df, rdf, scope, right_scope,
                                   how if how != "cross" else "inner",
-                                  lo, ro, residual)
+                                  lo, ro, residual, rename)
         return df
 
-    def _merge_join(self, df, rdf, scope: Scope, right_scope: Scope, how,
-                    lo, ro, residual):
+    def _rename_collisions(self, rdf, scope: Scope, right_scope: Scope):
+        """Alias right-side columns that collide with the accumulated left
+        scope to unique ``right[N].<name>`` actuals, updating the right
+        scope in place. Keeps every plan's column names globally distinct
+        (the optimizer's join rules rely on that) and makes self-join
+        chains of any depth unambiguous."""
         lcols = set(scope.all_columns())
+
+        def uniq(c: str) -> str:
+            base = "right." + c
+            n = 2
+            while base in lcols:
+                base = f"right{n}.{c}"
+                n += 1
+            return base
+
+        rename = {c: uniq(c) for c in right_scope.all_columns()
+                  if c in lcols}
+        if rename:
+            rdf = rdf.select(*[col(c).alias(rename.get(c, c))
+                               for c in rdf.column_names])
+            for alias in right_scope.order:
+                right_scope.tables[alias] = {
+                    sql: rename.get(act, act)
+                    for sql, act in right_scope.tables[alias].items()}
+        return rdf, rename
+
+    def _merge_join(self, df, rdf, scope: Scope, right_scope: Scope, how,
+                    lo, ro, residual, rename=None):
+        """Join pre-renamed sides (see ``_rename_collisions``); the scope
+        maps SQL names to the renamed actuals. Same-SQL-named equi keys
+        resolve to the left copy (SQL's merged-key behavior)."""
+        unrename = {v: k for k, v in (rename or {}).items()}
+        ro_names = [e.name() for e in ro]
+        lo_names = [e.name() for e in lo]
         if how == "cross":
             out = df.join(rdf, how="cross")
         else:
             out = df.join(rdf, left_on=lo, right_on=ro, how=how)
-        # fold right scope into left scope with collision prefixes
-        ro_names = [e.name() for e in ro]
-        lo_names = [e.name() for e in lo]
         for alias in right_scope.order:
             m = {}
             for sqlname, act in right_scope.tables[alias].items():
@@ -766,10 +825,11 @@ class SQLPlanner:
                     continue
                 if act in ro_names and how not in ("outer",):
                     ki = ro_names.index(act)
-                    if ki < len(lo_names) and lo_names[ki] == act:
-                        m[sqlname] = act  # merged key column
+                    orig = unrename.get(act, act)
+                    if ki < len(lo_names) and lo_names[ki] == orig:
+                        m[sqlname] = lo_names[ki]  # merged key: left copy
                         continue
-                m[sqlname] = ("right." + act) if act in lcols else act
+                m[sqlname] = act
             scope.tables[alias] = m
             scope.order.append(alias)
         if residual is not None:

@@ -125,3 +125,98 @@ def test_reorder_skips_name_collisions():
     assert out["k"] == [1, 2]
     assert out["w"] == [3.0, 4.0]
     assert out["z"] == [5.0, 6.0]
+
+
+# ---------------------------------------------- r3 join-rule additions
+
+def _optimized(df):
+    from daft_tpu.logical.optimizer import Optimizer
+    return Optimizer().optimize(df._builder._plan)
+
+
+def _find_nodes(plan, cls):
+    from daft_tpu.logical import plan as lp
+    out = []
+
+    def walk(n):
+        if isinstance(n, cls):
+            out.append(n)
+        for c in n.children:
+            walk(c)
+    walk(plan)
+    return out
+
+
+def test_simplify_null_filtered_join_strengthens_to_inner():
+    from daft_tpu.logical import plan as lp
+    l = daft_tpu.from_pydict({"k": [1, 2], "a": [10, 20]})
+    r = daft_tpu.from_pydict({"k": [1], "b": [5]})
+    df = l.join(r, on="k", how="left").where(col("b") > 0)
+    joins = _find_nodes(_optimized(df), lp.Join)
+    assert joins and all(j.how == "inner" for j in joins)
+    # null-tolerant predicate must NOT strengthen
+    df2 = l.join(r, on="k", how="left").where(col("b").is_null())
+    joins2 = _find_nodes(_optimized(df2), lp.Join)
+    assert joins2 and all(j.how == "left" for j in joins2)
+    # results stay correct
+    assert df.to_pydict()["k"] == [1]
+    assert sorted(df2.to_pydict()["k"]) == [2]
+
+
+def test_filter_null_join_key_inserts_not_null():
+    from daft_tpu.logical import plan as lp
+    l = daft_tpu.from_pydict({"k": [1, None, 2], "a": [1, 2, 3]})
+    r = daft_tpu.from_pydict({"k": [1, None], "b": [5, 6]})
+    df = l.join(r, on="k")
+    plan = _optimized(df)
+    filters = _find_nodes(plan, lp.Filter)
+    nn = [f for f in filters if "not_null" in repr(f.predicate)]
+    assert len(nn) >= 2, [repr(f.predicate) for f in filters]
+    assert df.to_pydict()["k"] == [1]  # nulls never match
+
+
+def test_push_down_anti_semi_join_below_project_and_sort():
+    from daft_tpu.logical import plan as lp
+    l = daft_tpu.from_pydict({"k": [3, 1, 2], "a": [30, 10, 20]})
+    r = daft_tpu.from_pydict({"k": [2]})
+
+    def probe(df):
+        plan = _optimized(df)
+        joins = _find_nodes(plan, lp.Join)
+        assert joins
+        j = joins[0]
+        # the semi/anti join sank below: its parent chain from the root
+        # contains the Sort/Project, i.e. the join's left child is not one
+        assert not isinstance(j.children[0], (lp.Sort,)), plan.repr_ascii()
+        return plan
+
+    semi = l.sort("a").join(r, on="k", how="semi")
+    probe(semi)
+    assert semi.to_pydict() == {"k": [2], "a": [20]}
+    anti = l.sort("a").join(r, on="k", how="anti")
+    probe(anti)
+    assert anti.to_pydict() == {"k": [1, 3], "a": [10, 30]}
+
+
+def test_push_down_join_predicate_transfers_key_filter():
+    from daft_tpu.logical import plan as lp
+    big = daft_tpu.from_pydict({"k": list(range(100)),
+                                "v": list(range(100))})
+    small = daft_tpu.from_pydict({"k": list(range(100)),
+                                  "w": list(range(100))})
+    df = big.where(col("k") < 5).join(small, on="k")
+    plan = _optimized(df)
+    joins = _find_nodes(plan, lp.Join)
+    assert joins
+
+    def side_has_key_filter(side):
+        # the transferred k<5 lands either as a Filter or inside the
+        # in-memory source path as a Filter node
+        return any("col(k) < lit(5)" in repr(f.predicate)
+                   for f in _find_nodes(side, lp.Filter))
+
+    j = joins[0]
+    assert side_has_key_filter(j.children[0])
+    assert side_has_key_filter(j.children[1]), plan.repr_ascii()
+    out = df.sort("k").to_pydict()
+    assert out["k"] == [0, 1, 2, 3, 4]

@@ -13,7 +13,7 @@ from benchmarking.tpcds.datagen import generate_tpcds
 @pytest.fixture(scope="module")
 def tpcds(tmp_path_factory):
     root = tmp_path_factory.mktemp("tpcds")
-    generate_tpcds(str(root), scale=0.01)
+    generate_tpcds(str(root), scale=0.04)
 
     def get_df(name):
         return dt.read_parquet(f"{root}/{name}/*.parquet")
@@ -112,17 +112,31 @@ def test_q7_vs_pandas(tpcds):
 
 
 def test_q63_vs_pandas(tpcds):
+    """Spec-faithful Q63: month_seq window, category/class OR groups,
+    store join, CASE-abs deviation filter."""
     got = Q.run(63, tpcds).to_pandas()
     ss = tpcds("store_sales").to_pandas()
     it = tpcds("item").to_pandas()
     dd = tpcds("date_dim").to_pandas()
+    st = tpcds("store").to_pandas()
     j = (ss.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
-         .merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk"))
-    j = j[j.d_year == 2000]
+         .merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(st, left_on="ss_store_sk", right_on="s_store_sk"))
+    j = j[j.d_month_seq.isin(range(1200, 1212))]
+    g1 = (j.i_category.isin(["Books", "Children", "Electronics"])
+          & j.i_class.isin(["personal", "portable", "reference",
+                            "self-help"]))
+    g2 = (j.i_category.isin(["Women", "Music", "Men"])
+          & j.i_class.isin(["accessories", "classical", "fragrances",
+                            "pants"]))
+    j = j[g1 | g2]
     monthly = (j.groupby(["i_manager_id", "d_moy"], as_index=False)
                .agg(sum_sales=("ss_sales_price", "sum")))
     monthly["avg_monthly_sales"] = monthly.groupby("i_manager_id")[
         "sum_sales"].transform("mean")
+    dev = (monthly.sum_sales - monthly.avg_monthly_sales).abs() \
+        / monthly.avg_monthly_sales
+    monthly = monthly[(monthly.avg_monthly_sales > 0) & (dev > 0.1)]
     exp = monthly.sort_values(
         ["i_manager_id", "avg_monthly_sales", "sum_sales"]).head(100)
     assert list(got.i_manager_id) == list(exp.i_manager_id)
@@ -130,3 +144,48 @@ def test_q63_vs_pandas(tpcds):
         assert a == pytest.approx(b, rel=1e-9)
     for a, b in zip(got.avg_monthly_sales, exp.avg_monthly_sales):
         assert a == pytest.approx(b, rel=1e-9)
+
+
+def test_q1_vs_pandas(tpcds):
+    """Q1's correlated scalar subquery (per-store avg return) against a
+    pandas transcription."""
+    got = Q.run(1, tpcds).to_pandas()
+    sr = tpcds("store_returns").to_pandas()
+    dd = tpcds("date_dim").to_pandas()
+    st = tpcds("store").to_pandas()
+    cu = tpcds("customer").to_pandas()
+    j = sr.merge(dd, left_on="sr_returned_date_sk", right_on="d_date_sk")
+    j = j[j.d_year == 2000]
+    ctr = (j.groupby(["sr_customer_sk", "sr_store_sk"], as_index=False)
+           .agg(ctr_total_return=("sr_return_amt", "sum")))
+    ctr["avg_r"] = ctr.groupby("sr_store_sk")[
+        "ctr_total_return"].transform("mean")
+    ctr = ctr[ctr.ctr_total_return > ctr.avg_r * 1.2]
+    ctr = ctr.merge(st[st.s_state == "TN"], left_on="sr_store_sk",
+                    right_on="s_store_sk")
+    ctr = ctr.merge(cu, left_on="sr_customer_sk", right_on="c_customer_sk")
+    exp = sorted(ctr.c_customer_id)[:100]
+    assert list(got.c_customer_id) == exp
+
+
+def test_q43_vs_pandas(tpcds):
+    """Q43 weekday pivot (restored d_day_name columns)."""
+    import numpy as np
+    got = Q.run(43, tpcds).to_pandas()
+    ss = tpcds("store_sales").to_pandas()
+    dd = tpcds("date_dim").to_pandas()
+    st = tpcds("store").to_pandas()
+    j = (ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(st, left_on="ss_store_sk", right_on="s_store_sk"))
+    j = j[(j.d_year == 2000) & (j.s_gmt_offset == -5.0)]
+    if j.empty:
+        assert got.empty
+        return
+    for day, colname in (("Sunday", "sun_sales"), ("Friday", "fri_sales")):
+        jj = j[j.d_day_name == day]
+        exp = jj.groupby(["s_store_name", "s_store_sk"])[
+            "ss_sales_price"].sum()
+        for _, row in got.iterrows():
+            key = (row.s_store_name, row.s_store_sk)
+            if key in exp.index:
+                assert row[colname] == pytest.approx(exp[key], rel=1e-9)
