@@ -95,6 +95,7 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
         "s_gmt_offset": rng.choice([-5.0, -6.0, -8.0], n_stores),
         "s_number_employees": rng.integers(200, 300, n_stores),
         "s_store_id": ["S%08d" % i for i in range(n_stores)],
+        "s_zip": ["%05d" % z for z in rng.integers(10000, 99999, n_stores)],
     })
 
     n_custs = max(int(2000 * scale), 100)
@@ -200,9 +201,14 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
         "ss_net_profit": rng.uniform(-500, 1500, n_sales).round(2),
     })
 
-    # store_returns: ~8% of sale lines come back, days after the sale
-    n_ret = max(n_sales // 12, 10)
-    ret_idx = rng.choice(n_sales, n_ret, replace=False)
+    # store_returns: ~8% of sale lines come back, days after the sale.
+    # (sr_item_sk, sr_ticket_number) is the spec's PK — dedupe candidate
+    # lines on that pair (tickets often hold several lines of one item)
+    cand = rng.choice(n_sales, max(n_sales // 10, 12), replace=False)
+    pair = ss_item[cand].astype(np.int64) * (n_tickets + 2) + ticket[cand]
+    _, first = np.unique(pair, return_index=True)
+    ret_idx = cand[np.sort(first)]
+    n_ret = len(ret_idx)
     store_returns = pa.table({
         "sr_returned_date_sk": np.minimum(
             t_date[ticket[ret_idx]] + rng.integers(1, 60, n_ret), n_days),

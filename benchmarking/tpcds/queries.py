@@ -415,7 +415,7 @@ FROM store_sales, date_dim, store
 WHERE ss_store_sk = s_store_sk
   AND ss_sold_date_sk = d_date_sk
   AND d_qoy = 2 AND d_year = 2000
-  AND substr(s_store_name, 1, 3) IN ('ese', 'sto')
+  AND substr(s_zip, 1, 2) IN (SELECT substr(ca_zip, 1, 2) FROM zips)
 GROUP BY s_store_name
 ORDER BY s_store_name
 LIMIT 100
@@ -722,14 +722,16 @@ FROM
 
 Q93 = """
 WITH t AS (
-  SELECT ss_item_sk, ss_ticket_number, ss_customer_sk,
+  SELECT ss_customer_sk,
          CASE WHEN sr_return_quantity IS NOT NULL
               THEN (ss_quantity - sr_return_quantity) * ss_sales_price
               ELSE ss_quantity * ss_sales_price END AS act_sales
   FROM store_sales
   LEFT JOIN store_returns
-    ON sr_item_sk = ss_item_sk AND sr_ticket_number = ss_ticket_number
-  LEFT JOIN reason ON sr_reason_sk = r_reason_sk
+    ON sr_item_sk = ss_item_sk AND sr_ticket_number = ss_ticket_number,
+       reason
+  WHERE sr_reason_sk = r_reason_sk
+    AND r_reason_desc = 'reason 3'
 )
 SELECT ss_customer_sk, SUM(act_sales) AS sumsales
 FROM t

@@ -304,6 +304,17 @@ def read_hudi(table_uri: str, io_config: Optional[IOConfig] = None,
     return _read_mor_snapshot(slices, props, io_config)
 
 
+def _parquet_schema(uri: str, io_config):
+    """Arrow schema from a parquet FOOTER only — the readers module's
+    ranged-open reads just the tail over any object store."""
+    import pyarrow.parquet as pq
+
+    from .readers import _open_ranged
+    if not _is_remote(uri):
+        return pq.read_schema(_strip(uri))
+    return pq.read_schema(_open_ranged(uri, io_config))
+
+
 def _read_mor_snapshot(slices, props, io_config):
     import pyarrow as pa
     import pyarrow.parquet as pq
@@ -330,10 +341,7 @@ def _read_mor_snapshot(slices, props, io_config):
     # schema from footers/headers only — no slice materializes at plan time
     s0 = slices[0]
     if s0["base"] is not None:
-        import io as io_
-        arrow_schema = pq.read_schema(
-            io_.BytesIO(_get(s0["base"], io_config))) \
-            if _is_remote(s0["base"]) else pq.read_schema(_strip(s0["base"]))
+        arrow_schema = _parquet_schema(s0["base"], io_config)
     else:
         arrow_schema = _load_log_table(s0["logs"][0], io_config).schema
     arrow_schema = pa.schema(

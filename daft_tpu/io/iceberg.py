@@ -355,12 +355,18 @@ def _read_with_deletes(meta, data, pos_dels, eq_dels, io_config):
             if seq <= entry["sequence"] or not cols or not dt_.num_rows:
                 continue
             import pyarrow.compute as pc
+            keys_have_null = any(dt_.column(c).null_count > 0 for c in cols)
             if len(cols) == 1:
                 hit = pc.is_in(t.column(cols[0]),
                                value_set=dt_.column(cols[0])
                                .combine_chunks())
-                keep &= ~np.asarray(hit.fill_null(False).combine_chunks())
-            else:
+                hit = np.asarray(hit.fill_null(False).combine_chunks())
+                if keys_have_null:
+                    # iceberg eq-deletes treat null as equal to null
+                    hit |= np.asarray(
+                        pc.is_null(t.column(cols[0])).combine_chunks())
+                keep &= ~hit
+            elif not keys_have_null:
                 # multi-key: arrow semi join against the (deduped) delete
                 # keys instead of a per-row Python probe
                 probe = t.select(cols).append_column(
@@ -368,6 +374,15 @@ def _read_with_deletes(meta, data, pos_dels, eq_dels, io_config):
                 dedup = dt_.group_by(cols).aggregate([])
                 hit = probe.join(dedup, keys=cols, join_type="left semi")
                 keep[hit.column("__idx__").to_numpy()] = False
+            else:
+                # multi-key with NULLs: arrow joins never match nulls, but
+                # the spec's null-equals-null semantics must — fall back
+                # to the exact set probe for this (rare) delete file
+                dead = set(zip(*[dt_.column(c).to_pylist() for c in cols]))
+                vals = [t.column(c).to_pylist() for c in cols]
+                for i in range(t.num_rows):
+                    if tuple(v[i] for v in vals) in dead:
+                        keep[i] = False
         if not keep.all():
             t = t.filter(pa.array(keep))
         return RecordBatch.from_arrow_table(t).cast_to_schema(schema)

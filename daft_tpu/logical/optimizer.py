@@ -620,7 +620,10 @@ def _null_rejecting_cols(conj: Expression) -> set:
     (comparison semantics propagate NULL → filter drops the row). A
     conjunct containing null-tolerant ops (is_null / fill_null /
     coalesce / is_in) contributes nothing."""
-    tolerant = {"is_null", "fill_null", "coalesce", "is_in", "or", "not"}
+    # if_else (CASE) can take a branch that never touches the null column;
+    # eq_null_safe is definite on nulls by definition
+    tolerant = {"is_null", "fill_null", "coalesce", "is_in", "or", "not",
+                "if_else", "eq_null_safe"}
 
     def has_tolerant(e: Expression) -> bool:
         return e.op in tolerant or any(has_tolerant(c) for c in e.args)

@@ -6,6 +6,13 @@ the full suite runs against a virtual 8-device CPU mesh
 (``xla_force_host_platform_device_count``) so multi-chip sharding logic is
 exercised without TPU hardware, and ``DAFT_TPU_DEVICE=0`` in the environment
 reruns everything on the pure host tier.
+
+``DAFT_TPU_REAL_DEVICE=1`` flips the suite onto the REAL accelerator
+backend instead (no CPU forcing, no virtual mesh): an opt-in pass that
+catches TPU-only numerics (f32 accumulation, int64 emulation) the CPU
+backend hides. Budget warning: first compiles of each shape are remote
+(10–160 s) — run a targeted subset, e.g.
+``DAFT_TPU_REAL_DEVICE=1 pytest tests/test_tpch.py tests/test_exchange.py``.
 """
 
 import os
@@ -13,14 +20,17 @@ import os
 # must run before any jax backend initializes. NOTE: this machine's site
 # customization re-registers a TPU plugin and overrides the JAX_PLATFORMS env
 # var, so we force the platform through jax.config instead.
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = \
-        flags + " --xla_force_host_platform_device_count=8"
+_REAL = os.environ.get("DAFT_TPU_REAL_DEVICE") == "1"
+if not _REAL:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            flags + " --xla_force_host_platform_device_count=8"
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not _REAL:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pyarrow as pa
@@ -42,3 +52,17 @@ def device_tier(request, monkeypatch):
 
 def make_df(data):
     return daft_tpu.from_pydict(data)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Under the DAFT_TPU_REAL_DEVICE=1 opt-in pass, tests that require a
+    multi-device mesh skip on single-chip boxes instead of failing."""
+    if not _REAL:
+        return
+    if jax.device_count() >= 2:
+        return
+    skip = pytest.mark.skip(
+        reason="real-device pass on a single chip: no multi-device mesh")
+    for item in items:
+        if "exchange" in item.nodeid or "multichip" in item.nodeid:
+            item.add_marker(skip)

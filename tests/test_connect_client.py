@@ -1,0 +1,80 @@
+"""Client-driven Spark Connect conformance (VERDICT r2 item 10): the
+vendored pyspark-flavored client (``daft_tpu/connect/client.py``) drives
+read / filter / agg / join / SQL / write end-to-end over the wire —
+pyspark itself is not installable in this environment, so the client
+mirrors its request patterns (UserContext + client_type + operation_id,
+analyze-then-execute, Arrow-IPC streaming decode)."""
+
+import pyarrow.parquet as pq
+import pytest
+
+from daft_tpu.connect import start_server
+from daft_tpu.connect.client import col, connect, lit, _agg_fn
+
+
+@pytest.fixture(scope="module")
+def spark():
+    server = start_server()
+    s = connect(f"127.0.0.1:{server.port}")
+    yield s
+    s.stop()
+    server.stop()
+
+
+def test_version(spark):
+    assert spark.version
+
+
+def test_range_filter_select_collect(spark):
+    rows = (spark.range(100)
+            .filter(col("id") >= 95)
+            .select((col("id") * 2).alias("x"))
+            .sort("x").collect())
+    assert [r["x"] for r in rows] == [190, 192, 194, 196, 198]
+
+
+def test_create_dataframe_groupby_agg(spark):
+    df = spark.createDataFrame({"k": ["a", "a", "b"], "v": [1, 2, 10]})
+    rows = (df.groupBy("k")
+            .agg(_agg_fn("sum", col("v")).alias("s"))
+            .sort("k").collect())
+    assert rows == [{"k": "a", "s": 3}, {"k": "b", "s": 10}]
+
+
+def test_join(spark):
+    left = spark.createDataFrame({"k": [1, 2, 3], "v": ["x", "y", "z"]})
+    right = spark.createDataFrame({"k": [2, 3, 4], "w": [20, 30, 40]})
+    rows = left.join(right, on="k").sort("k").collect()
+    assert rows == [{"k": 2, "v": "y", "w": 20},
+                    {"k": 3, "v": "z", "w": 30}]
+
+
+def test_sql_and_temp_view(spark):
+    df = spark.createDataFrame({"x": [1, 2, 3, 4]})
+    df.createOrReplaceTempView("nums")
+    rows = spark.sql(
+        "SELECT sum(x) AS total FROM nums WHERE x > 1").collect()
+    assert rows == [{"total": 9}]
+
+
+def test_schema_analyze(spark):
+    import pyarrow as pa
+    s = spark.createDataFrame({"a": [1], "b": ["x"]}).schema
+    assert isinstance(s, pa.Schema)
+    assert s.names == ["a", "b"]
+    assert pa.types.is_integer(s.field("a").type)
+    assert pa.types.is_large_string(s.field("b").type) \
+        or pa.types.is_string(s.field("b").type)
+
+
+def test_write_then_read_parquet(spark, tmp_path):
+    out = str(tmp_path / "out")
+    spark.createDataFrame({"a": [1, 2, 3]}).write.parquet(out)
+    back = spark.read_parquet(out + "/*.parquet").sort("a").collect()
+    assert [r["a"] for r in back] == [1, 2, 3]
+
+
+def test_with_column_and_limit(spark):
+    rows = (spark.range(10).withColumn("double", col("id") * 2)
+            .sort("id").limit(3).collect())
+    assert [r["double"] for r in rows] == [0, 2, 4]

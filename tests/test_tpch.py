@@ -3,6 +3,14 @@ independent pandas implementation on the same generated data
 (reference model: ``tests/integration/test_tpch.py`` vs dbgen answers).
 """
 
+import os
+
+# the real-device opt-in pass runs XLA on the TPU, where f64 downcasts to
+# f32 by design: numeric comparisons against f64 pandas need f32-scale
+# tolerance there (this is exactly the numerics delta the pass exists to
+# surface — and bound)
+_REL = 1e-4 if os.environ.get("DAFT_TPU_REAL_DEVICE") == "1" else 1e-9
+
 import datetime
 import sys
 
@@ -63,7 +71,7 @@ def test_q1_vs_pandas(tpch, pdf):
     assert list(got.l_returnflag) == list(exp.l_returnflag)
     for c in ["sum_qty", "sum_base_price", "sum_disc_price", "sum_charge",
               "avg_qty", "avg_price", "avg_disc"]:
-        np.testing.assert_allclose(got[c], exp[c], rtol=1e-9)
+        np.testing.assert_allclose(got[c], exp[c], rtol=_REL)
     assert list(got.count_order) == list(exp.count_order)
 
 
@@ -116,7 +124,7 @@ def test_q6_vs_pandas(tpch, pdf):
            & (li.l_discount >= 0.05) & (li.l_discount <= 0.07)
            & (li.l_quantity < 24)]
     exp = (f.l_extendedprice * f.l_discount).sum()
-    assert got == pytest.approx(exp, rel=1e-9)
+    assert got == pytest.approx(exp, rel=_REL)
 
 
 def test_q10_vs_pandas(tpch, pdf):
