@@ -42,7 +42,8 @@ SF = float(os.environ.get("BENCH_SF", "1"))
 PARTS = int(os.environ.get("BENCH_PARTS", "8"))
 DATA = os.path.join(REPO, ".cache", f"tpch_sf{SF}")
 SF10_DATA = os.path.join(REPO, ".cache", "tpch_sf10.0")
-TPCDS_DATA = os.path.join(REPO, ".cache", "tpcds_s1")
+# version-stamped: regenerates when the datagen schema grows
+TPCDS_DATA = os.path.join(REPO, ".cache", "tpcds_s1_v2")
 LAION_DATA = os.path.join(REPO, ".cache", "laion_4k")
 DEVICE_TIMEOUT = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "900"))
 
@@ -171,7 +172,20 @@ def run_laion(root):
     P = rng.standard_normal((32 * 32 * 3, 128)).astype(np.float32)
     qv = rng.standard_normal(128).astype(np.float32)
     qv /= np.linalg.norm(qv)
-    use_device = os.environ.get("DAFT_TPU_DEVICE", "1") != "0"
+
+    def _embed_on_device() -> bool:
+        """The embed matmul goes to the accelerator only when the measured
+        link can afford the per-batch transfers (the engine's own cost
+        model) — on a tunneled chip the MXU win can't repay ~40 MB/s
+        freight, on a local chip it can."""
+        if os.environ.get("DAFT_TPU_DEVICE", "1") == "0":
+            return False
+        from daft_tpu.device import costmodel
+        n, d_in, d_out = 4096, 32 * 32 * 3, 128
+        return costmodel.row_output_op_wins(
+            bytes_up=n * d_in * 4, bytes_down=n * d_out * 4)
+
+    use_device = _embed_on_device()
 
     @dt.udf(return_dtype=DataType.float32())
     def cos_sim(images):

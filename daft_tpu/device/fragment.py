@@ -39,7 +39,6 @@ _fused_cache: Dict[Tuple, object] = {}
 # scale with the bucket — and grow geometrically on overflow (the packed
 # header always carries the true group count, so overflow costs one re-run).
 _OUT_CAP0 = 128
-_OUT_CAP_GROW = 16
 
 
 def _pack_i64(x: jnp.ndarray) -> jnp.ndarray:
@@ -199,17 +198,54 @@ def _decode_packed_grouped(prog: FusedAggProgram, packed: np.ndarray,
     return RecordBatch.from_series(cols)
 
 
+def packed_bytes_per_group(nk: int, nops: int) -> int:
+    """Bytes one group row occupies in the packed result matrix (the
+    header row amortizes; keys+values each carry a validity plane). The
+    executor's cost gates price transfers with this — it must stay in
+    lockstep with ``run_packed``'s layout."""
+    return (1 + 2 * (nk + nops)) * 8
+
+
+def _max_out_cap(prog: FusedAggProgram, dt: dcol.DeviceTable) -> int:
+    """Group-capacity ceiling from the measured link: the packed-result
+    transfer must not exceed what the HOST would spend aggregating the
+    same rows outright — a non-reductive grouping (TPC-H Q18's
+    near-unique l_orderkey) makes device partials pure freight, while a
+    reductive one (Q1's 4 groups) is almost free. Shared-memory links are
+    unbounded."""
+    import math
+
+    from . import costmodel
+    p = costmodel.link_profile()
+    full = dcol.bucket_capacity(max(dt.capacity, 1))
+    if p.down_bps == math.inf:
+        return full
+    bytes_per_group = packed_bytes_per_group(prog.nk, len(prog.ops))
+    in_bytes = sum(int(c.data.nbytes) + int(c.validity.nbytes)
+                   for c in dt.columns.values())
+    host_s = in_bytes / costmodel.HOST_AGG_BPS
+    raw = int(host_s * p.down_bps // bytes_per_group)
+    if raw < _OUT_CAP0:
+        return _OUT_CAP0
+    # round DOWN to a power of two: dispatch caps are static jit args, so
+    # arbitrary integers would compile a fresh executable per value
+    return min(1 << (raw.bit_length() - 1), full)
+
+
 def run_fused_agg_table(prog: FusedAggProgram, dt: dcol.DeviceTable,
                         in_schema: Schema, group_exprs, agg_exprs,
                         out_schema: Schema, start_out_cap: int = _OUT_CAP0):
-    """Execute on one encoded DeviceTable (possibly HBM-cache-resident)."""
+    """Execute on one encoded DeviceTable (possibly HBM-cache-resident).
+    Returns None (→ host fallback) when the group count exceeds the
+    link-budgeted packed-output ceiling."""
     key_fields = [e.to_field(in_schema) for e in group_exprs]
     agg_fields = [out_schema[e.name()] for e in agg_exprs]
     if prog.nk == 0:
         packed = np.asarray(jax.device_get(
             _dispatch_packed(prog, dt, _OUT_CAP0)))
         return _decode_packed_global(prog, packed, agg_fields)
-    out_cap = start_out_cap
+    cap_limit = _max_out_cap(prog, dt)
+    out_cap = min(start_out_cap, cap_limit)
     while True:
         packed = np.asarray(jax.device_get(
             _dispatch_packed(prog, dt, out_cap)))
@@ -217,8 +253,13 @@ def run_fused_agg_table(prog: FusedAggProgram, dt: dcol.DeviceTable,
                                      key_fields, agg_fields)
         if out is not None:
             return out
-        out_cap = min(out_cap * _OUT_CAP_GROW,
-                      dcol.bucket_capacity(max(dt.capacity, 1)))
+        # the packed header carries the TRUE group count: jump straight
+        # to a fitting bucket, or bail to host when the link can't afford
+        # the packed transfer
+        g = int(packed[0, 0])
+        if g > cap_limit:
+            return None
+        out_cap = min(dcol.bucket_capacity(max(g, _OUT_CAP0)), cap_limit)
 
 
 _stack_cache: Dict[int, object] = {}
@@ -250,21 +291,40 @@ def run_fused_agg_tables(prog: FusedAggProgram, tables, in_schema: Schema,
             if len(packs) > 1 else [np.asarray(jax.device_get(packs[0]))]
     except Exception:
         return [None] * len(tables)
-    results = []
-    for dt, mat in zip(tables, stacked):
+    results: list = [None] * len(tables)
+    retry: list = []  # (index, out_cap) — re-dispatched as ONE batch, not
+    # per-table (each serial round trip costs ~0.1 s on the tunnel)
+    for i, (dt, mat) in enumerate(zip(tables, stacked)):
         try:
             if prog.nk == 0:
-                results.append(_decode_packed_global(prog, mat, agg_fields))
+                results[i] = _decode_packed_global(prog, mat, agg_fields)
                 continue
             out = _decode_packed_grouped(prog, mat, dt, group_exprs,
                                          key_fields, agg_fields)
-            if out is None:  # group overflow: re-run this table grown
-                out = run_fused_agg_table(
-                    prog, dt, in_schema, group_exprs, agg_exprs, out_schema,
-                    start_out_cap=min(_OUT_CAP0 * _OUT_CAP_GROW,
-                                      dcol.bucket_capacity(
-                                          max(dt.capacity, 1))))
-            results.append(out)
+            if out is not None:
+                results[i] = out
+                continue
+            g = int(mat[0, 0])
+            cap_limit = _max_out_cap(prog, dt)
+            if g <= cap_limit:  # else: stays None → host fallback
+                retry.append((i, min(dcol.bucket_capacity(max(g, _OUT_CAP0)),
+                                     cap_limit)))
         except Exception:
-            results.append(None)
+            results[i] = None
+    if retry:
+        try:
+            packs2 = [_dispatch_packed(prog, tables[i], cap)
+                      for i, cap in retry]
+            mats = [np.asarray(m) for m in jax.device_get(packs2)]
+        except Exception:
+            mats = [None] * len(retry)
+        for (i, _cap), mat in zip(retry, mats):
+            if mat is None:
+                continue
+            try:
+                results[i] = _decode_packed_grouped(
+                    prog, mat, tables[i], group_exprs, key_fields,
+                    agg_fields)
+            except Exception:
+                results[i] = None
     return results

@@ -301,7 +301,9 @@ def try_agg(batch, to_agg: List[Expression], group_by: List[Expression]):
         if batch.get_column(nm).is_pyobject():
             return None
     # in-memory batch: no HBM-cache identity, the upload is one-shot
-    packed_out = (1 + 2 * (len(group_by) + len(to_agg))) * 128 * 8
+    from .fragment import _OUT_CAP0, packed_bytes_per_group
+    packed_out = packed_bytes_per_group(len(group_by),
+                                        len(to_agg)) * _OUT_CAP0
     if not costmodel.agg_upload_wins(
             _batch_cols_nbytes(batch, c.needs_cols),
             packed_out, cacheable=False):

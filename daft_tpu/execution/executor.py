@@ -250,12 +250,21 @@ class LocalExecutor:
                 rb.agg(node.aggs, node.group_by).cast_to_schema(node.schema()))
 
         def device_agg(rb: RecordBatch) -> Optional[MicroPartition]:
+            from ..device import costmodel
             if not (drt.device_enabled()
                     and len(rb) >= max(drt._min_rows(), 1)):
                 return None
             prog = fragment.get_fused_agg(node.group_by, child_exprs, ops,
                                           node.predicate, rb.schema)
             if prog is None:
+                return None
+            # in-memory batch: the upload is one-shot, it must beat the
+            # host outright (no HBM-cache identity to invest in)
+            packed_out = fragment.packed_bytes_per_group(
+                len(node.group_by), len(ops)) * fragment._OUT_CAP0
+            if not costmodel.agg_upload_wins(
+                    drt._batch_cols_nbytes(rb, prog.compiled.needs_cols),
+                    packed_out, cacheable=False):
                 return None
             try:
                 out = fragment.run_fused_agg(prog, rb, node.group_by,
@@ -269,7 +278,8 @@ class LocalExecutor:
 
         src = node.children[0]
         if isinstance(src, pp.ScanSource) and src.tasks \
-                and drt.device_enabled():
+                and drt.device_enabled() \
+                and _fragment_groups_affordable(node, src):
             # task-level path: consult the HBM column cache per scan task —
             # a hit runs the fused program on device-resident columns with
             # zero file IO and zero host→device transfer. All tasks' packed
@@ -330,7 +340,9 @@ class LocalExecutor:
             # only if the whole scan's working set actually FITS the budget
             # (otherwise LRU thrash re-pays the upload every query and
             # put_table would refuse oversized tables anyway)
-            packed_out = (1 + 2 * len(prog.ops) + 2 * prog.nk) * 128 * 8
+            from ..device import fragment as dfrag
+            packed_out = dfrag.packed_bytes_per_group(
+                prog.nk, len(prog.ops)) * dfrag._OUT_CAP0
             col_bytes = drt._batch_cols_nbytes(rb, prog.compiled.needs_cols)
             est_encoded = 2 * col_bytes  # capacity bucketing ≤ doubles
             fits = est_encoded * max(n_tasks, 1) <= dcache._budget()
@@ -913,6 +925,81 @@ class LocalExecutor:
             return
         yield MicroPartition.from_recordbatch(
             RecordBatch.concat(outs).cast_to_schema(node.schema()))
+
+
+def _task_column_ndv(tasks, name: str):
+    """max-min+1 folded over ALL tasks' parquet footers for an int column
+    (the scan-level twin of logical/stats.column_ndv). A single file's
+    range would underestimate scans range-partitioned on the key and let
+    a non-reductive grouping through the gate."""
+    try:
+        import pyarrow.parquet as pq
+        lo = hi = None
+        seen = set()
+        for t in tasks:
+            if t.file_format != "parquet" or not t.paths:
+                return None
+            md_cached = getattr(t, "pq_metadata", None)
+            for path in t.paths:
+                if path in seen:
+                    continue
+                seen.add(path)
+                md = md_cached if md_cached is not None \
+                    and len(t.paths) == 1 else pq.ParquetFile(path).metadata
+                idx = {md.schema.column(i).name: i
+                       for i in range(md.num_columns)}.get(name)
+                if idx is None:
+                    return None
+                for rg in range(md.num_row_groups):
+                    st = md.row_group(rg).column(idx).statistics
+                    if st is None or not st.has_min_max \
+                            or not isinstance(st.min, int) \
+                            or isinstance(st.min, bool):
+                        return None
+                    lo = st.min if lo is None else min(lo, st.min)
+                    hi = st.max if hi is None else max(hi, st.max)
+        return None if lo is None else float(hi - lo + 1)
+    except Exception:
+        return None
+
+
+def _fragment_groups_affordable(node, src) -> bool:
+    """Upfront group-cardinality gate for the fused device aggregation:
+    a NON-reductive grouping (TPC-H Q18's near-unique l_orderkey, Q20's
+    partkey×suppkey) would ship a group block rivaling the input over the
+    link — estimate groups from parquet footer NDVs and refuse the device
+    path when the packed transfer would exceed the host's own aggregation
+    time (the same parity rule ``fragment._max_out_cap`` enforces at run
+    time, applied before any upload or probe happens)."""
+    import math
+
+    from ..device import costmodel
+    p = costmodel.link_profile()
+    if p.down_bps == math.inf:
+        return True
+    ndvs = []
+    for g in node.group_by:
+        u = g._unalias()
+        if u.op != "col":
+            return True  # computed key: unknown → assume reductive
+        ndv = _task_column_ndv(src.tasks, u.params[0])
+        if ndv is None:
+            return True  # strings/no stats → assume reductive
+        ndvs.append(ndv)
+    if not ndvs:
+        return True  # global aggregation: one packed scalar row
+    est_groups = 1.0
+    for n in ndvs:
+        est_groups *= n
+    rows = sum(t.num_rows() or 0 for t in src.tasks)
+    if rows:
+        est_groups = min(est_groups, float(rows))
+    from ..device.fragment import packed_bytes_per_group
+    bytes_per_group = packed_bytes_per_group(len(node.group_by),
+                                             len(node.aggs))
+    size = sum(t.size_bytes() or 0 for t in src.tasks)
+    host_s = max(size, 1) / costmodel.HOST_AGG_BPS
+    return est_groups * bytes_per_group <= host_s * p.down_bps
 
 
 def _lit_true() -> Expression:

@@ -73,8 +73,17 @@ class NativeRunner(Runner):
 def _is_measured(node) -> bool:
     """Only a bare in-memory source carries EXACT stats — anything above
     it (Filter/Aggregate/Join/scan) still runs on estimates and is worth
-    materializing before the join decision."""
+    materializing before the join decision. The optimizer's own derived
+    null-key filters (FilterNullJoinKey re-adds them every pass) don't
+    count: treating them as unmeasured would re-materialize the same
+    source forever."""
     from ..logical import plan as lp
+    from ..logical.optimizer import split_conjuncts
+    while isinstance(node, lp.Filter) and all(
+            c._unalias().op == "not_null"
+            and c._unalias().args[0].op == "col"
+            for c in split_conjuncts(node.predicate)):
+        node = node.children[0]
     return isinstance(node, lp.Source) and node.partitions is not None
 
 

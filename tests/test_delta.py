@@ -118,8 +118,8 @@ def test_gated_readers_error_actionably():
         daft_tpu.read_iceberg("whatever")
     with pytest.raises(FileNotFoundError):
         daft_tpu.read_hudi("whatever")
-    with pytest.raises(ImportError, match="lance"):
-        daft_tpu.read_lance("whatever")
+    with pytest.raises(FileNotFoundError, match="lance"):
+        daft_tpu.read_lance("whatever")  # native now (io/lance.py)
 
 
 def test_read_sql_over_sqlite():

@@ -92,7 +92,7 @@ def test_q3_vs_pandas(tpch, pdf):
            .agg(revenue=("volume", "sum"))
            .sort_values(["revenue", "o_orderdate"], ascending=[False, True])
            .head(10))
-    np.testing.assert_allclose(got.revenue, exp.revenue, rtol=1e-9)
+    np.testing.assert_allclose(got.revenue, exp.revenue, rtol=_REL)
     assert list(got.o_orderkey) == list(exp.o_orderkey)
 
 
@@ -113,7 +113,7 @@ def test_q5_vs_pandas(tpch, pdf):
     exp = (j.groupby("n_name", as_index=False).agg(revenue=("volume", "sum"))
            .sort_values("revenue", ascending=False))
     assert list(got.n_name) == list(exp.n_name)
-    np.testing.assert_allclose(got.revenue, exp.revenue, rtol=1e-9)
+    np.testing.assert_allclose(got.revenue, exp.revenue, rtol=_REL)
 
 
 def test_q6_vs_pandas(tpch, pdf):
@@ -142,7 +142,7 @@ def test_q10_vs_pandas(tpch, pdf):
            .sort_values(["revenue", "c_custkey"], ascending=[False, True])
            .head(20))
     assert list(got.c_custkey) == list(exp.c_custkey)
-    np.testing.assert_allclose(got.revenue, exp.revenue, rtol=1e-9)
+    np.testing.assert_allclose(got.revenue, exp.revenue, rtol=_REL)
 
 
 def test_q12_vs_pandas(tpch, pdf):
@@ -200,7 +200,7 @@ def test_q5_distributed_runner_matches_local(tpch):
     finally:
         ctx.get_context().set_runner(old)
     assert dist["n_name"] == local["n_name"]
-    np.testing.assert_allclose(dist["revenue"], local["revenue"], rtol=1e-9)
+    np.testing.assert_allclose(dist["revenue"], local["revenue"], rtol=_REL)
 
 
 @pytest.mark.parametrize("qnum", list(range(1, 23)))
@@ -218,6 +218,7 @@ def test_queries_device_matches_host(tpch, qnum, monkeypatch):
         assert len(hv) == len(dv), (qnum, k, len(hv), len(dv))
         for a, b in zip(hv, dv):
             if isinstance(a, float) and b is not None:
-                assert b == pytest.approx(a, rel=1e-6, abs=1e-9), (qnum, k)
+                assert b == pytest.approx(a, rel=max(1e-6, _REL),
+                                          abs=1e-9), (qnum, k)
             else:
                 assert a == b, (qnum, k, a, b)
