@@ -12,8 +12,9 @@ dispatch seam implies (SURVEY.md §7 hard-part #2):
     host_time   = bytes_touched / host_kernel_bandwidth
 
 and runs the op on whichever side is cheaper. The link terms are MEASURED,
-not assumed: the first decision on a non-CPU backend times a small and a
-4 MiB transfer in each direction (once per process, ~3 round trips). Host
+not assumed: the first decision on a non-CPU backend calibrates RTT and
+both bandwidths (see ``_measure`` — a few tiny round trips plus 8 MiB
+transfers, once per process). Host
 kernel bandwidths are coarse constants for pyarrow's SIMD kernels — they
 only need to be right to an order of magnitude because real decisions are
 dominated by the link terms (40 MB/s tunnel vs GB/s host, or 100 GB/s
@@ -84,27 +85,54 @@ def _env_profile() -> Optional[LinkProfile]:
 
 
 def _measure() -> LinkProfile:
-    """One-time link calibration: a tiny round trip (RTT) and a 4 MiB
-    transfer each way (bandwidth). ~3 round trips total."""
+    """One-time link calibration: 4 tiny round trips plus three 8 MiB
+    one-way legs (~2 s total on a 15-25 MB/s tunnel, microseconds on a
+    local chip; paid once per process, only on non-CPU backends).
+
+    Robustness notes learned on the tunneled chip: the FIRST tiny round
+    trip pays lazy-init costs (~10-20× a steady-state RTT) — warm up and
+    take the median of three. ``block_until_ready`` after ``jnp.asarray``
+    does not reliably reflect wire time for uploads (staged copies), and
+    a cold timed pass would absorb XLA compile time on a local chip — so
+    an UNTIMED pass compiles + stages first, then the upload rate comes
+    from a verified round trip (upload, force a kernel, fetch) minus the
+    separately measured download time."""
+    import statistics
+
     import jax
     import jax.numpy as jnp
 
     tiny = np.zeros(8, dtype=np.float32)
-    t0 = time.perf_counter()
-    jax.device_get(jnp.asarray(tiny))
-    rtt = max(time.perf_counter() - t0, 1e-7)
+    jax.device_get(jnp.asarray(tiny))  # warmup: lazy init paid here
+    rtts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(jnp.asarray(tiny))
+        rtts.append(time.perf_counter() - t0)
+    rtt = max(statistics.median(rtts), 1e-7)
 
-    mb4 = np.zeros(1 << 20, dtype=np.float32)  # 4 MiB
-    t0 = time.perf_counter()
-    dev = jnp.asarray(mb4)
+    nbytes = 1 << 23  # 8 MiB
+    big = np.zeros(nbytes // 4, dtype=np.float32)
+    # untimed first pass: compiles the +0 executable AND leaves the data
+    # resident, so the timed rounds below measure pure wire time
+    dev = jnp.asarray(big) + 0
     dev.block_until_ready()
-    up_s = max(time.perf_counter() - t0 - rtt / 2, 1e-7)
     t0 = time.perf_counter()
     jax.device_get(dev)
     down_s = max(time.perf_counter() - t0 - rtt / 2, 1e-7)
+    # verified round trip (compile-cached): upload + fetch. NB: must use a
+    # FRESH buffer — jax dedupes transfers of the same numpy object, which
+    # would make the upload leg look free
+    big2 = np.ones(nbytes // 4, dtype=np.float32)
+    t0 = time.perf_counter()
+    jax.device_get(jnp.asarray(big2) + 0)
+    round_s = time.perf_counter() - t0
+    # a sane floor: the upload leg of an 8 MiB round cannot beat 10× the
+    # measured download rate even on asymmetric links
+    up_s = max(round_s - down_s - rtt, down_s / 10, 1e-7)
     return LinkProfile(rtt_s=rtt,
-                       up_bps=mb4.nbytes / up_s,
-                       down_bps=mb4.nbytes / down_s)
+                       up_bps=nbytes / up_s,
+                       down_bps=nbytes / down_s)
 
 
 def link_profile() -> LinkProfile:
