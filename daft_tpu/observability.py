@@ -278,6 +278,83 @@ def set_last_stats(ctx: RuntimeStatsContext):
     from . import dashboard
     if dashboard._server is not None:
         dashboard.broadcast_query(ctx)
+    endpoint = os.environ.get("DAFT_TPU_OTLP_ENDPOINT")
+    if endpoint:
+        export_otlp(ctx, endpoint)
+
+
+# ------------------------------------------------------------------ OTLP
+
+def otlp_payload(ctx: RuntimeStatsContext) -> dict:
+    """Per-operator counters as an OTLP/HTTP JSON ExportMetricsServiceRequest
+    (the reference exports the same counters over OTLP:
+    ``src/common/tracing/src/lib.rs:29-90``, ``runtime_stats.rs:23-66``).
+    DELTA temporality: each export carries one query's contribution, keyed
+    only by operator name — bounded series cardinality, and collectors sum
+    deltas across queries without reset semantics."""
+    now_ns = int(time.time() * 1e9)
+    start_ns = now_ns - (ctx.wall_us or 0) * 1000
+
+    def sum_metric(name: str, unit: str, points):
+        return {"name": name, "unit": unit, "sum": {
+            "aggregationTemporality": 1,  # DELTA
+            "isMonotonic": True,
+            "dataPoints": points}}
+
+    def point(value: int, op_name: str):
+        return {"asInt": str(int(value)),
+                "startTimeUnixNano": str(start_ns),
+                "timeUnixNano": str(now_ns),
+                "attributes": [
+                    {"key": "operator",
+                     "value": {"stringValue": op_name}}]}
+
+    per_op = ctx.as_dict()
+    metrics = [
+        sum_metric("daft_tpu.operator.rows_out", "{row}",
+                   [point(st["rows_out"], nm)
+                    for nm, st in per_op.items()]),
+        sum_metric("daft_tpu.operator.batches_out", "{batch}",
+                   [point(st["batches_out"], nm)
+                    for nm, st in per_op.items()]),
+        sum_metric("daft_tpu.operator.cpu_us", "us",
+                   [point(st["exclusive_us"], nm)
+                    for nm, st in per_op.items()]),
+    ]
+    return {"resourceMetrics": [{
+        "resource": {"attributes": [
+            {"key": "service.name",
+             "value": {"stringValue": "daft_tpu"}}]},
+        "scopeMetrics": [{
+            "scope": {"name": "daft_tpu.observability"},
+            "metrics": metrics}]}]}
+
+
+def export_otlp(ctx: RuntimeStatsContext, endpoint: str) -> None:
+    """Fire-and-forget POST of the query's operator counters to an
+    OTLP/HTTP collector (``<endpoint>/v1/metrics``). Never fails or
+    blocks the query: everything — including payload construction and
+    thread spawn (which can raise at interpreter shutdown) — is
+    swallowed."""
+    import urllib.request
+
+    try:
+        payload = json.dumps(otlp_payload(ctx)).encode()
+        url = endpoint.rstrip("/") + "/v1/metrics"
+
+        def post():
+            try:
+                req = urllib.request.Request(
+                    url, data=payload,
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:
+                pass
+
+        threading.Thread(target=post, name="daft-tpu-otlp",
+                         daemon=True).start()
+    except Exception:
+        pass  # observability must never break the query
 
 
 def last_query_stats() -> Optional[RuntimeStatsContext]:

@@ -233,3 +233,57 @@ def test_xplane_trace_captures_per_query(tmp_path, monkeypatch):
     for root, _, files in os.walk(tmp_path):
         found.extend(files)
     assert found, "no xplane trace artifacts written"
+
+
+def test_otlp_export_posts_operator_counters(monkeypatch):
+    """Per-op counters export as OTLP/HTTP JSON metrics when
+    DAFT_TPU_OTLP_ENDPOINT is set (reference: common/tracing OTLP export,
+    runtime_stats.rs)."""
+    import http.server
+    import json
+    import threading
+
+    import daft_tpu
+    from daft_tpu import col
+
+    received = []
+    done = threading.Event()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append((self.path, json.loads(body)))
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"{}")
+            done.set()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        monkeypatch.setenv("DAFT_TPU_OTLP_ENDPOINT",
+                           f"http://127.0.0.1:{srv.server_port}")
+        out = (daft_tpu.from_pydict({"k": [1, 1, 2], "v": [1.0, 2.0, 3.0]})
+               .groupby("k").agg(col("v").sum().alias("s"))
+               .sort("k").to_pydict())
+        assert out["k"] == [1, 2]
+        assert done.wait(10), "no OTLP POST arrived"
+    finally:
+        srv.shutdown()
+    path, payload = received[0]
+    assert path == "/v1/metrics"
+    scope = payload["resourceMetrics"][0]["scopeMetrics"][0]
+    names = {m["name"] for m in scope["metrics"]}
+    assert names == {"daft_tpu.operator.rows_out",
+                     "daft_tpu.operator.batches_out",
+                     "daft_tpu.operator.cpu_us"}
+    rows = next(m for m in scope["metrics"]
+                if m["name"] == "daft_tpu.operator.rows_out")
+    ops = {a["value"]["stringValue"]
+           for p in rows["sum"]["dataPoints"]
+           for a in p["attributes"] if a["key"] == "operator"}
+    assert any("Aggregate" in o or "Agg" in o for o in ops), ops
