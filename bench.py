@@ -14,7 +14,16 @@ Config families (BASELINE.json):
 5. LAION-style multimodal — PNG decode → resize → random-projection
    embedding (device matmul) → cosine sim → groupby
 
-Structure (hang-proof by construction, round-1 postmortem):
+Structure (hang-proof AND deadline-proof by construction; round-1 and
+round-3 postmortems):
+- a GLOBAL wall-clock budget (`BENCH_TOTAL_BUDGET_S`, default 600 s) is
+  enforced across all sections: each checks the remaining budget before
+  starting; sections that don't fit are named in `skipped_sections` and
+  the single JSON line is always emitted within the budget.
+- the Arrow baseline is pinned (best-of-3, persisted per dataset) so the
+  headline `vs_baseline` denominator is stable across runs.
+- any section failure lands in the top-level `section_errors`, never
+  silently inside a detail dict.
 - the Arrow CPU baseline and the host tier (DAFT_TPU_DEVICE=0) run
   in-process: they never touch the JAX backend and cannot hang.
 - the device tier runs in a CHILD process under BENCH_DEVICE_TIMEOUT
@@ -46,6 +55,17 @@ SF10_DATA = os.path.join(REPO, ".cache", "tpch_sf10.0")
 TPCDS_DATA = os.path.join(REPO, ".cache", "tpcds_s1_v2")
 LAION_DATA = os.path.join(REPO, ".cache", "laion_4k")
 DEVICE_TIMEOUT = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "900"))
+
+# Global wall-clock budget (round-3 postmortem: two of three driver runs
+# timed out because per-section budgets never summed to a bound). EVERY
+# section checks the remaining budget before starting; whatever doesn't fit
+# is named in `skipped_sections` and the one JSON line is still emitted.
+TOTAL_BUDGET = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "600"))
+_T0 = time.time()
+
+
+def _remaining() -> float:
+    return TOTAL_BUDGET - (time.time() - _T0)
 
 TPCH_QUERIES = [f"q{i}" for i in range(1, 23)]
 
@@ -247,6 +267,28 @@ def run_arrow_baseline():
     return g, time.time() - t0
 
 
+def pinned_arrow_baseline():
+    """Best-of-3 Arrow Q1 baseline, persisted once per dataset. The r2→r3
+    headline `vs_baseline` swung 105×→13× purely on denominator contention;
+    pinning makes consecutive runs agree. Delete the cache file to re-pin.
+
+    Returns (num_q1_groups, seconds)."""
+    cache = os.path.join(DATA, "arrow_baseline_q1.json")
+    if os.path.exists(cache):
+        with open(cache) as f:
+            d = json.load(f)
+        return d["q1_groups"], d["seconds"]
+    best, groups = None, None
+    for _ in range(3):
+        tbl, s = run_arrow_baseline()
+        groups = tbl.num_rows
+        best = s if best is None else min(best, s)
+    with open(cache, "w") as f:
+        json.dump({"q1_groups": groups, "seconds": round(best, 3),
+                   "method": "best-of-3, uncontended"}, f)
+    return groups, best
+
+
 # ----------------------------------------------------------- device child
 
 def _emit(obj):
@@ -258,7 +300,8 @@ def _device_child():
     section, cheapest/most-important first, so a stall or timeout only
     loses the sections after it."""
     os.environ["DAFT_TPU_DEVICE"] = "1"
-    deadline = time.time() + DEVICE_TIMEOUT * 0.92
+    budget = float(os.environ.get("BENCH_DEVICE_BUDGET_S", DEVICE_TIMEOUT))
+    deadline = time.time() + budget * 0.92
 
     out, warm, hot = run_tpch_query(DATA, "q1")
     from daft_tpu.device import backend as dbackend
@@ -294,12 +337,13 @@ def _device_child():
         _emit({"tpch_sf10_suite": sf10})
 
 
-def _try_device_tier():
+def _try_device_tier(budget_s: float):
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--device-child"],
-            capture_output=True, text=True, timeout=DEVICE_TIMEOUT,
-            cwd=REPO, env={**os.environ, "DAFT_TPU_DEVICE": "1"})
+            capture_output=True, text=True, timeout=budget_s,
+            cwd=REPO, env={**os.environ, "DAFT_TPU_DEVICE": "1",
+                           "BENCH_DEVICE_BUDGET_S": str(budget_s)})
     except subprocess.TimeoutExpired as exc:
         print("device tier: timed out; using partial output",
               file=sys.stderr)
@@ -329,6 +373,22 @@ def _merge_lines(text: str):
 # ------------------------------------------------------------------ main
 
 def main():
+    skipped: list = []
+    errors: dict = {}
+
+    def section(name, fn, min_needed=5.0):
+        """Run `fn` only if the global budget affords it; name it in
+        `skipped_sections` otherwise; any exception lands LOUDLY in the
+        top-level `section_errors`, never silently inside a detail dict."""
+        if _remaining() < min_needed:
+            skipped.append(name)
+            return None
+        try:
+            return fn()
+        except Exception as exc:
+            errors[name] = str(exc)[:200]
+            return None
+
     ensure_data()
     import glob as g
 
@@ -336,42 +396,33 @@ def main():
     nrows = sum(pq.ParquetFile(p).metadata.num_rows
                 for p in g.glob(f"{DATA}/lineitem/*.parquet"))
 
-    base_tbl, base_s = run_arrow_baseline()
+    base_groups, base_s = pinned_arrow_baseline()
 
     # host tier first: hang-free, guarantees a number is always reported
     os.environ["DAFT_TPU_DEVICE"] = "0"
     out, host_warm, host_hot = run_tpch_query(DATA, "q1")
-    assert len(out["l_returnflag"]) == base_tbl.num_rows, \
-        (len(out["l_returnflag"]), base_tbl.num_rows)
+    assert len(out["l_returnflag"]) == base_groups, \
+        (len(out["l_returnflag"]), base_groups)
 
     detail = {
         "host_warm_s": round(host_warm, 3), "host_hot_s": round(host_hot, 3),
         "arrow_cpu_baseline_s": round(base_s, 3), "lineitem_rows": nrows,
         "backend": "host",
+        "total_budget_s": TOTAL_BUDGET,
     }
     for qn in ("q6", "q3", "q10"):
-        _, w, h = run_tpch_query(DATA, qn)
-        detail[f"{qn}_host_hot_s"] = round(min(w, h), 3)
-    detail["tpch_sf1_suite_host"] = run_tpch_suite(DATA)
-    try:
-        detail["tpcds_host"] = run_tpcds_trio(TPCDS_DATA)
-    except Exception as exc:
-        detail["tpcds_host"] = {"error": str(exc)[:200]}
-    try:
-        detail["laion_host"] = run_laion(LAION_DATA)
-    except Exception as exc:
-        detail["laion_host"] = {"error": str(exc)[:200]}
-    if os.path.isdir(os.path.join(SF10_DATA, "lineitem")) \
-            and os.environ.get("BENCH_SKIP_SF10") != "1":
-        # budget-bounded so the driver's bench invocation always finishes:
-        # queries past the budget are listed as skipped, never hung
-        sf10_budget = float(os.environ.get("BENCH_SF10_BUDGET_S", "900"))
-        detail["tpch_sf10_suite_host"] = run_tpch_suite(
-            SF10_DATA, budget_s=sf10_budget)
+        r = section(f"{qn}_host", lambda qn=qn: run_tpch_query(DATA, qn))
+        if r is not None:
+            detail[f"{qn}_host_hot_s"] = round(min(r[1], r[2]), 3)
 
     ours = min(host_warm, host_hot)
 
-    dev = _try_device_tier()
+    # device tier next (it carries the headline's best case and its own
+    # per-section emission tolerates truncation); it gets at most half the
+    # remaining budget so the host suites below always run too
+    dev_budget = min(DEVICE_TIMEOUT, max(_remaining() * 0.5, 60.0))
+    dev = (section("device_tier", lambda: _try_device_tier(dev_budget),
+                   min_needed=60.0))
     if dev is not None and dev.get("backend") == "host-fallback":
         detail["device_backend"] = "host-fallback"
         dev = None
@@ -384,7 +435,7 @@ def main():
         for k in ("tpch_sf1_suite", "tpcds", "laion", "tpch_sf10_suite"):
             if k in dev:
                 detail[f"{k}_device"] = dev[k]
-        if dev.get("groups") == base_tbl.num_rows:
+        if dev.get("groups") == base_groups:
             detail["device_warm_s"] = round(dev["warm"], 3)
             detail["device_hot_s"] = round(dev["hot"], 3)
             detail["device_backend"] = dev.get("backend")
@@ -393,15 +444,49 @@ def main():
                 detail["backend"] = dev.get("backend", "device")
         elif "groups" in dev:
             detail["device_q1_mismatch"] = \
-                {"groups": dev["groups"], "expected": base_tbl.num_rows}
+                {"groups": dev["groups"], "expected": base_groups}
 
-    print(json.dumps({
+    r = section("tpch_sf1_suite_host",
+                lambda: run_tpch_suite(DATA, budget_s=_remaining() - 10),
+                min_needed=20.0)
+    if r is not None:
+        detail["tpch_sf1_suite_host"] = r
+    r = section("tpcds_host", lambda: run_tpcds_trio(TPCDS_DATA),
+                min_needed=15.0)
+    if r is not None:
+        detail["tpcds_host"] = r
+    r = section("laion_host", lambda: run_laion(LAION_DATA), min_needed=15.0)
+    if r is not None:
+        detail["laion_host"] = r
+
+    if os.path.isdir(os.path.join(SF10_DATA, "lineitem")) \
+            and os.environ.get("BENCH_SKIP_SF10") != "1":
+        # last: whatever global budget is left, queries past it are named
+        r = section("tpch_sf10_suite_host",
+                    lambda: run_tpch_suite(SF10_DATA,
+                                           budget_s=_remaining() - 10),
+                    min_needed=30.0)
+        if r is not None:
+            detail["tpch_sf10_suite_host"] = r
+
+    # errors that older rounds buried inside detail dicts surface here too
+    for k, v in list(detail.items()):
+        if isinstance(v, dict) and "error" in v:
+            errors.setdefault(k, v["error"])
+
+    summary = {
         "metric": f"tpch_q1_sf{SF}_rows_per_sec_per_chip",
         "value": round(nrows / ours, 1),
         "unit": "rows/s",
         "vs_baseline": round(base_s / ours, 3),
         "detail": detail,
-    }))
+    }
+    if skipped:
+        summary["skipped_sections"] = skipped
+    if errors:
+        summary["section_errors"] = errors
+    summary["elapsed_s"] = round(time.time() - _T0, 1)
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

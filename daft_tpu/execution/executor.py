@@ -813,7 +813,15 @@ class LocalExecutor:
         lnode, rnode = node.children
         copart = (isinstance(lnode, pp.Exchange) and lnode.kind == "hash"
                   and isinstance(rnode, pp.Exchange) and rnode.kind == "hash"
-                  and lnode.num_partitions == rnode.num_partitions)
+                  and lnode.num_partitions == rnode.num_partitions
+                  # the exchanges must partition on the JOIN keys: index
+                  # pairing is only valid when both sides were fanned by
+                  # the same key chain (a future non-key hash Exchange
+                  # under a join must not silently drop matches)
+                  and [e._key() for e in lnode.by]
+                  == [e._key() for e in node.left_on]
+                  and [e._key() for e in rnode.by]
+                  == [e._key() for e in node.right_on])
         if copart:
             # streaming probe: the build side is the blocking sink
             # (spill-bounded SpillBuffer); probe partitions stream straight
@@ -995,6 +1003,10 @@ def _fragment_groups_affordable(node, src) -> bool:
     if rows:
         est_groups = min(est_groups, float(rows))
     from ..device.fragment import packed_bytes_per_group
+    # node.aggs is the PARTIAL agg list (_split_aggs already decomposed
+    # mean→sum+count etc. before _try_fuse_partial built this node), so its
+    # length equals len(prog.ops) and prices the same packed layout that
+    # run_packed emits
     bytes_per_group = packed_bytes_per_group(len(node.group_by),
                                              len(node.aggs))
     size = sum(t.size_bytes() or 0 for t in src.tasks)

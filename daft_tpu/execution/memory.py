@@ -114,8 +114,10 @@ class SpillBuffer:
         self._entries: List[Tuple[str, object]] = []  # ("mem", mp)|("disk", path)
         self._mem_bytes = 0
         self.bytes_spilled = 0
+        self.total_rows = 0
 
     def append(self, mp) -> None:
+        self.total_rows += len(mp)
         sz = mp.size_bytes() or 0
         if self.budget is not None and self._mem_bytes + sz > self.budget:
             path = self._write_ipc(mp)

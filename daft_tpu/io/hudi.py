@@ -245,34 +245,95 @@ def _record_key_cols(props: Dict[str, str], schema_names) -> List[str]:
         "hoodie.table.recordkey.fields or include _hoodie_record_key")
 
 
-def _merge_slice(base_t, log_tables, key_cols: List[str]):
-    """Upsert log records over the base by key, honoring
-    ``_hoodie_is_deleted`` tombstones; later tables win."""
+def _align_tables(tables, out_schema):
+    """(aligned tables over out_schema with omitted columns null-filled,
+    per-row tombstone bool array)."""
     import numpy as np
     import pyarrow as pa
-    out_schema = None
+    import pyarrow.compute as pc
+    aligned, dead = [], []
+    for t in tables:
+        cols = []
+        for f in out_schema:
+            if f.name in t.column_names:
+                cols.append(t.column(f.name).cast(f.type))
+            else:
+                # partial-update log payloads may omit columns: null-fill
+                cols.append(pa.chunked_array([pa.nulls(t.num_rows, f.type)]))
+        aligned.append(pa.table(dict(zip(out_schema.names, cols)),
+                                schema=out_schema))
+        if _DELETED_COL in t.column_names:
+            d = pc.fill_null(t.column(_DELETED_COL).cast(pa.bool_()), False)
+            dead.append(d.to_numpy(zero_copy_only=False).astype(bool))
+        else:
+            dead.append(np.zeros(t.num_rows, dtype=bool))
+    return aligned, np.concatenate(dead) if dead else np.zeros(0, bool)
+
+
+def _merge_slice(base_t, log_tables, key_cols: List[str]):
+    """Upsert log records over the base by key, honoring
+    ``_hoodie_is_deleted`` tombstones; later tables win.
+
+    Vectorized: dictionary-encode each key column to integer codes, group
+    rows with one ``np.unique(axis=0)``, pick each group's LAST row
+    (np.maximum.at) and emit winners in first-appearance order — one
+    ``take`` instead of per-row Python dict churn. Key types that refuse
+    dictionary encoding fall back to the interpreted merge."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    tables = ([base_t] if base_t is not None else []) + log_tables
+    out_schema = pa.schema(
+        [f for f in tables[0].schema if f.name != _DELETED_COL])
+    aligned, dead = _align_tables(tables, out_schema)
+    big = pa.concat_tables(aligned)
+    n = big.num_rows
+    if n == 0:
+        return out_schema.empty_table()
+    try:
+        planes = []
+        for k in key_cols:
+            enc = pc.dictionary_encode(big.column(k).combine_chunks())
+            codes = pc.fill_null(enc.indices.cast(pa.int64()), -1)
+            planes.append(codes.to_numpy(zero_copy_only=False))
+    except (pa.ArrowException, TypeError):
+        return _merge_slice_rows(tables, out_schema, key_cols)
+    _, inv = np.unique(np.stack(planes, axis=1), axis=0,
+                       return_inverse=True)
+    inv = inv.reshape(-1)
+    ng = int(inv.max()) + 1
+    rowidx = np.arange(n, dtype=np.int64)
+    # rowidx is ascending, so plain fancy assignment computes per-group
+    # max (last write wins) and, reversed, per-group min — no ufunc.at
+    last = np.full(ng, -1, dtype=np.int64)
+    last[inv] = rowidx
+    first = np.full(ng, n, dtype=np.int64)
+    first[inv[::-1]] = rowidx[::-1]
+    winners = last[np.argsort(first, kind="stable")]
+    winners = winners[~dead[winners]]
+    return big.take(pa.array(winners))
+
+
+def _merge_slice_rows(tables, out_schema, key_cols: List[str]):
+    """Interpreted fallback for key types pyarrow can't dictionary-encode."""
+    import pyarrow as pa
     rows: Dict[tuple, Optional[dict]] = {}
     order: List[tuple] = []
-    for t in ([base_t] if base_t is not None else []) + log_tables:
-        if out_schema is None:
-            out_schema = pa.schema(
-                [f for f in t.schema if f.name != _DELETED_COL])
+    for t in tables:
         d = t.to_pydict()
         n = t.num_rows
         deleted = d.get(_DELETED_COL, [False] * n)
         for i in range(n):
-            key = tuple(d[k][i] for k in key_cols)
+            key = tuple(tuple(v) if isinstance(v, list) else v
+                        for v in (d[k][i] for k in key_cols))
             if key not in rows:
                 order.append(key)
-            if deleted[i]:
-                rows[key] = None
-            else:
-                # partial-update log payloads may omit columns: null-fill
-                rows[key] = {f.name: d[f.name][i] if f.name in d else None
-                             for f in out_schema}
+            rows[key] = None if deleted[i] else \
+                {f.name: d[f.name][i] if f.name in d else None
+                 for f in out_schema}
     live = [rows[k] for k in order if rows[k] is not None]
     if not live:
-        return out_schema.empty_table() if out_schema is not None else None
+        return out_schema.empty_table()
     return pa.table({f.name: [r[f.name] for r in live]
                      for f in out_schema}, schema=out_schema)
 
@@ -323,20 +384,8 @@ def _read_mor_snapshot(slices, props, io_config):
     from ..logical.builder import LogicalPlanBuilder
     from ..recordbatch import RecordBatch
     from ..schema import Schema
+    from .readers import _open_ranged
     from .scan import GeneratorScanOperator
-
-    def load_slice(s):
-        import io as io_
-        base_t = None
-        if s["base"] is not None:
-            raw = _get(s["base"], io_config)
-            base_t = pq.read_table(io_.BytesIO(raw))
-        log_ts = [_load_log_table(p, io_config) for p in s["logs"]]
-        if not log_ts:
-            return base_t
-        key_cols = _record_key_cols(
-            props, (base_t or log_ts[0]).column_names)
-        return _merge_slice(base_t, log_ts, key_cols)
 
     # schema from footers/headers only — no slice materializes at plan time
     s0 = slices[0]
@@ -344,14 +393,48 @@ def _read_mor_snapshot(slices, props, io_config):
         arrow_schema = _parquet_schema(s0["base"], io_config)
     else:
         arrow_schema = _load_log_table(s0["logs"][0], io_config).schema
+    key_cols = _record_key_cols(props, arrow_schema.names)
     arrow_schema = pa.schema(
         [f for f in arrow_schema if f.name != _DELETED_COL])
     schema = Schema.from_arrow(arrow_schema)
 
+    def load_slice(s, columns):
+        """Column pushdown: the base parquet reads only the requested
+        columns + record keys + tombstone flag (ranged reads on remote
+        stores); the merge runs over that slim set; the final select trims
+        the merge-only helpers back out."""
+        merge_cols = None if columns is None else list(
+            dict.fromkeys(list(columns) + key_cols))
+        base_t = None
+        if s["base"] is not None:
+            src = _strip(s["base"]) if not _is_remote(s["base"]) else \
+                _open_ranged(s["base"], io_config)
+            pf = pq.ParquetFile(src)
+            rc = None if merge_cols is None else \
+                [c for c in merge_cols + [_DELETED_COL]
+                 if c in pf.schema_arrow.names]
+            base_t = pf.read(columns=rc)
+        log_ts = [_load_log_table(p, io_config) for p in s["logs"]]
+        if merge_cols is not None:
+            log_ts = [t.select([c for c in merge_cols + [_DELETED_COL]
+                                if c in t.column_names]) for t in log_ts]
+        if not log_ts:
+            t = base_t
+        else:
+            t = _merge_slice(base_t, log_ts, key_cols)
+        if columns is not None:
+            t = t.select([c for c in columns if c in t.column_names])
+        return t
+
     def make_loader(s):
         def load(pushdowns):
+            cols = list(pushdowns.columns) \
+                if pushdowns.columns is not None else None
+            out_schema = schema.project(
+                [c for c in cols if c in schema]) if cols is not None \
+                else schema
             yield RecordBatch.from_arrow_table(
-                load_slice(s)).cast_to_schema(schema)
+                load_slice(s, cols)).cast_to_schema(out_schema)
         paths = ([s["base"]] if s["base"] else []) + s["logs"]
         return paths, load
 

@@ -40,8 +40,15 @@ UNKNOWN = Stats(None, None)
 def _source_stats(node: lp.Source) -> Stats:
     if node.partitions is not None:
         try:
-            rows = sum(len(p) for p in node.partitions)
-            size = sum(p.size_bytes() or 0 for p in node.partitions)
+            parts = node.partitions
+            # SpillBuffer-backed sources (AQE actuals) track counts at
+            # append time — summing would reload spilled entries from disk
+            rows = getattr(parts, "total_rows", None)
+            size = getattr(parts, "total_bytes", None)
+            if rows is None:
+                rows = sum(len(p) for p in parts)
+            if size is None:
+                size = sum(p.size_bytes() or 0 for p in parts)
             return Stats(float(rows), float(size) or None)
         except Exception:
             return UNKNOWN

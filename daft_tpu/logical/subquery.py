@@ -177,10 +177,52 @@ def _attach_scalar(df, node: Expression) -> Tuple[object, str]:
         out = df.join(agg, left_on=outers,
                       right_on=[col(k) for k in key_names], how="left")
         return out, name
-    # uncorrelated: the inner df is fully built and 1-row/1-col
+    # uncorrelated: the inner df is fully built and 1-col; SQL requires it
+    # to be 1-ROW too. A provably-single-row plan (bare aggregate, LIMIT 1)
+    # cross-joins directly; anything else gets a runtime cardinality guard
+    # so a multi-row subquery raises instead of silently duplicating every
+    # outer row (the reference's UnnestScalarSubquery duplicates silently).
     rdf, val = _inner_value_expr(info)
     rdf = rdf.select(val.alias(name))
+    if not _provably_single_row(rdf._builder._plan):
+        rdf = _guard_single_row(rdf, name)
     return df.join(rdf, how="cross"), name
+
+
+def _provably_single_row(plan) -> bool:
+    """True when the plan yields EXACTLY one row by construction: a global
+    (no-groupby) Aggregate, optionally under projections/sorts (which
+    preserve cardinality). LIMIT 1 does NOT qualify — it can yield zero
+    rows, and a 0-row cross join would silently drop every outer row where
+    SQL wants a NULL scalar (the guard emits that NULL)."""
+    from . import plan as lp
+    node = plan
+    while isinstance(node, (lp.Project, lp.Sort)):
+        node = node.children[0]
+    return isinstance(node, lp.Aggregate) and not node.group_by
+
+
+def _guard_single_row(rdf, name: str):
+    """Collapse to one row carrying (value, row count), then project a
+    checked value: count > 1 raises SQL's scalar-cardinality error at
+    execution time."""
+    from ..datatype import DataType
+    from ..udf import udf
+    dtype = rdf.schema()[name].dtype
+    cnt = f"__subqcnt{next(_uid)}__"
+    one = rdf.agg(col(name).agg_list().alias(name),
+                  col(name).count("all").alias(cnt))
+
+    @udf(return_dtype=dtype)
+    def _check_single(vals, counts):
+        n = counts.to_pylist()[0] if len(counts) else 0
+        if n > 1:
+            raise ValueError(
+                f"scalar subquery produced {n} rows, expected at most 1")
+        lst = vals.to_pylist()[0] if len(vals) else []
+        return [lst[0] if lst else None]
+
+    return one.select(_check_single(col(name), col(cnt)).alias(name))
 
 
 def _rewrite_conjunct(df, conj: Expression) -> Tuple[Optional[Expression],

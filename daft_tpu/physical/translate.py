@@ -111,6 +111,9 @@ def _estimate_size(node: lp.LogicalPlan) -> Optional[int]:
     if isinstance(node, lp.Source):
         if node.partitions is not None:
             try:
+                sz = getattr(node.partitions, "total_bytes", None)
+                if sz is not None:
+                    return sz
                 return sum(p.size_bytes() or 0 for p in node.partitions)
             except Exception:
                 return None

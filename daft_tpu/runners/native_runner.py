@@ -42,6 +42,7 @@ class NativeRunner(Runner):
         translate sees only measured sizes, so broadcast-vs-hash and join
         order are decided from actuals (re-plans are visible in
         ``explain_analyze``)."""
+        from ..execution import memory
         from ..logical import plan as lp
         from ..logical.optimizer import Optimizer
         from ..physical import adaptive
@@ -54,11 +55,13 @@ class NativeRunner(Runner):
                 break
             ex = LocalExecutor()
             ex._aqe_planner = planner
-            parts = list(ex.run(translate(target)))
-            rows = sum(len(p) for p in parts)
-            size = sum(p.size_bytes() or 0 for p in parts)
-            src = lp.Source(partitions=parts, schema=target.schema(),
-                            num_partitions=max(len(parts), 1))
+            # spill-bounded, like the normal join-build path: the loop
+            # eventually materializes the largest fact side, which must not
+            # bypass the memory budget (it streams to disk past it)
+            buf = memory.materialize(ex.run(translate(target)))
+            rows, size = buf.total_rows, buf.total_bytes
+            src = lp.Source(partitions=buf, schema=target.schema(),
+                            num_partitions=max(len(buf), 1))
             planner.record_replan(
                 f"materialized join input ({rows} rows, {size} bytes "
                 f"actual) → re-optimized remainder", rows, size)
