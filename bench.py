@@ -52,7 +52,7 @@ PARTS = int(os.environ.get("BENCH_PARTS", "8"))
 DATA = os.path.join(REPO, ".cache", f"tpch_sf{SF}")
 SF10_DATA = os.path.join(REPO, ".cache", "tpch_sf10.0")
 # version-stamped: regenerates when the datagen schema grows
-TPCDS_DATA = os.path.join(REPO, ".cache", "tpcds_s1_v2")
+TPCDS_DATA = os.path.join(REPO, ".cache", "tpcds_s1_v3")
 LAION_DATA = os.path.join(REPO, ".cache", "laion_4k")
 DEVICE_TIMEOUT = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "900"))
 

@@ -1,11 +1,17 @@
-"""Synthetic TPC-DS-shaped data for the window-function query subset.
+"""Synthetic TPC-DS-shaped data covering all three sales channels.
 
 The reference ships full dsdgen + 99 queries (``benchmarking/tpcds``).
-This generator produces the ten tables the query subset touches —
-store_sales (ticket-coherent baskets), item, date_dim, time_dim, store,
-customer, customer_address, customer_demographics, household_demographics,
-promotion — with the TPC-DS column names and realistic key relationships,
-vectorized numpy like the TPC-H datagen.
+This generator produces the store channel (store_sales with
+ticket-coherent baskets, store_returns), the catalog channel
+(catalog_sales/catalog_returns with order-coherent lines, call_center,
+catalog_page, warehouse, ship_mode), the web channel
+(web_sales/web_returns, web_site, web_page), weekly inventory, and the
+shared dimensions (item, date_dim, time_dim, store, customer,
+customer_address, customer_demographics, household_demographics,
+income_band, promotion, reason) — TPC-DS column names and realistic key
+relationships, vectorized numpy like the TPC-H datagen. Line counts
+follow the spec's rough channel ratios (store : catalog : web ≈
+1 : 0.5 : 0.25).
 """
 
 from __future__ import annotations
@@ -36,17 +42,26 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
     dates = [base_date + _dt.timedelta(days=int(i)) for i in range(n_days)]
     day_names = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
                  "Saturday", "Sunday"]
+    dow = np.array([d.weekday() for d in dates])
+    qoy = (moy_clip - 1) // 3 + 1
     date_dim = pa.table({
         "d_date_sk": d_date_sk,
+        "d_date_id": ["D%08d" % i for i in range(n_days)],
         "d_date": pa.array(dates, pa.date32()),
         "d_year": years,
         "d_moy": moy_clip,
-        "d_qoy": (moy_clip - 1) // 3 + 1,
+        "d_qoy": qoy,
         "d_dom": (np.arange(n_days) % 31) + 1,
-        "d_dow": np.array([d.weekday() for d in dates]),
+        "d_dow": dow,
         "d_day_name": [day_names[d.weekday()] for d in dates],
         "d_week_seq": np.arange(n_days) // 7 + 1,
         "d_month_seq": (years - 1999) * 12 + moy_clip - 1 + 1200,
+        "d_quarter_name": ["%dQ%d" % (y, q) for y, q in zip(years, qoy)],
+        "d_weekend": np.where(dow >= 5, "Y", "N"),
+        "d_holiday": np.where((np.arange(n_days) % 97) == 0, "Y", "N"),
+        "d_following_holiday": np.where(
+            (np.arange(n_days) % 97) == 1, "Y", "N"),
+        "d_first_dom": d_date_sk - ((np.arange(n_days) % 31 + 1) - 1),
     })
 
     categories = ["Books", "Home", "Electronics", "Music", "Sports",
@@ -96,22 +111,51 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
         "s_number_employees": rng.integers(200, 300, n_stores),
         "s_store_id": ["S%08d" % i for i in range(n_stores)],
         "s_zip": ["%05d" % z for z in rng.integers(10000, 99999, n_stores)],
+        "s_market_id": rng.integers(1, 11, n_stores),
+        "s_floor_space": rng.integers(5000000, 10000000, n_stores),
+        "s_company_id": rng.integers(1, 4, n_stores),
+        "s_street_number": ["%d" % n for n in
+                            rng.integers(1, 1000, n_stores)],
+        "s_street_name": rng.choice(["Main", "Oak", "Elm", "First",
+                                     "Park"], n_stores),
+        "s_street_type": rng.choice(["St", "Ave", "Blvd"], n_stores),
     })
 
     n_custs = max(int(2000 * scale), 100)
     n_cd = 200  # demographic combinations
+    n_hd = 100
     customer = pa.table({
         "c_customer_sk": np.arange(1, n_custs + 1),
         "c_customer_id": ["CUST%08d" % i for i in range(n_custs)],
         "c_current_cdemo_sk": rng.integers(1, n_cd + 1, n_custs),
+        "c_current_hdemo_sk": rng.integers(1, n_hd + 1, n_custs),
         "c_current_addr_sk": np.arange(1, n_custs + 1),
+        "c_salutation": rng.choice(["Mr.", "Mrs.", "Ms.", "Dr."], n_custs),
         "c_first_name": ["first%d" % i for i in range(n_custs)],
         "c_last_name": ["last%d" % i for i in range(n_custs)],
         "c_birth_year": rng.integers(1930, 2005, n_custs),
+        "c_birth_month": rng.integers(1, 13, n_custs),
+        "c_birth_day": rng.integers(1, 29, n_custs),
+        "c_birth_country": rng.choice(
+            ["UNITED STATES", "CANADA", "MEXICO", "GERMANY", "JAPAN"],
+            n_custs),
+        "c_email_address": ["c%d@example.org" % i for i in range(n_custs)],
+        "c_login": ["login%d" % i for i in range(n_custs)],
         "c_preferred_cust_flag": rng.choice(["Y", "N"], n_custs),
+        "c_first_sales_date_sk": rng.integers(1, n_days + 1, n_custs),
+        "c_first_shipto_date_sk": rng.integers(1, n_days + 1, n_custs),
+        "c_last_review_date_sk": rng.integers(1, n_days + 1, n_custs),
     })
     customer_address = pa.table({
         "ca_address_sk": np.arange(1, n_custs + 1),
+        "ca_address_id": ["ADDR%08d" % i for i in range(n_custs)],
+        "ca_street_number": ["%d" % n for n in
+                             rng.integers(1, 1000, n_custs)],
+        "ca_street_name": rng.choice(["Main", "Oak", "Elm", "First",
+                                      "Park"], n_custs),
+        "ca_street_type": rng.choice(["St", "Ave", "Blvd", "Way"], n_custs),
+        "ca_suite_number": ["Suite %d" % n for n in
+                            rng.integers(1, 500, n_custs)],
         "ca_city": rng.choice(["rivertown", "lakeside", "hilltop",
                                "meadow", "brookfield"], n_custs),
         "ca_county": rng.choice(["Ziebach County", "Williamson County",
@@ -121,21 +165,36 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
         "ca_zip": ["%05d" % z for z in rng.integers(10000, 99999, n_custs)],
         "ca_country": ["United States"] * n_custs,
         "ca_gmt_offset": rng.choice([-5.0, -6.0, -7.0, -8.0], n_custs),
+        "ca_location_type": rng.choice(["apartment", "condo",
+                                        "single family"], n_custs),
     })
     customer_demographics = pa.table({
         "cd_demo_sk": np.arange(1, n_cd + 1),
         "cd_gender": rng.choice(["M", "F"], n_cd),
-        "cd_marital_status": rng.choice(["S", "M", "D", "W"], n_cd),
+        "cd_marital_status": rng.choice(["S", "M", "D", "W", "U"], n_cd),
         "cd_education_status": rng.choice(
-            ["Primary", "Secondary", "College", "Advanced Degree"], n_cd),
+            ["Primary", "Secondary", "College", "Advanced Degree",
+             "2 yr Degree", "4 yr Degree", "Unknown"], n_cd),
+        "cd_purchase_estimate": rng.integers(1, 11, n_cd) * 500,
+        "cd_credit_rating": rng.choice(["Low Risk", "Good", "High Risk",
+                                        "Unknown"], n_cd),
+        "cd_dep_count": rng.integers(0, 7, n_cd),
+        "cd_dep_employed_count": rng.integers(0, 7, n_cd),
+        "cd_dep_college_count": rng.integers(0, 7, n_cd),
     })
     n_promos = 30
     promotion = pa.table({
         "p_promo_sk": np.arange(1, n_promos + 1),
+        "p_promo_id": ["PROMO%06d" % i for i in range(n_promos)],
+        "p_promo_name": ["promo%d" % i for i in range(n_promos)],
+        "p_cost": rng.uniform(500, 2000, n_promos).round(2),
         "p_channel_email": rng.choice(["Y", "N"], n_promos),
         "p_channel_event": rng.choice(["Y", "N"], n_promos),
         "p_channel_dmail": rng.choice(["Y", "N"], n_promos),
         "p_channel_tv": rng.choice(["Y", "N"], n_promos),
+        "p_channel_catalog": rng.choice(["Y", "N"], n_promos),
+        "p_channel_internet": rng.choice(["Y", "N"], n_promos),
+        "p_discount_active": rng.choice(["Y", "N"], n_promos),
     })
     n_reasons = 10
     reason = pa.table({
@@ -143,9 +202,15 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
         "r_reason_desc": ["reason %d" % i for i in range(n_reasons)],
     })
 
-    n_hd = 100
+    n_ib = 20
+    income_band = pa.table({
+        "ib_income_band_sk": np.arange(1, n_ib + 1),
+        "ib_lower_bound": np.arange(n_ib) * 10000,
+        "ib_upper_bound": (np.arange(n_ib) + 1) * 10000,
+    })
     household_demographics = pa.table({
         "hd_demo_sk": np.arange(1, n_hd + 1),
+        "hd_income_band_sk": rng.integers(1, n_ib + 1, n_hd),
         "hd_dep_count": rng.integers(0, 10, n_hd),
         "hd_vehicle_count": rng.integers(0, 5, n_hd),
         "hd_buy_potential": rng.choice(
@@ -153,10 +218,22 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
              "Unknown"], n_hd),
     })
     n_times = 24 * 60  # one row per minute of day
+    t_hour = np.arange(n_times) // 60
     time_dim = pa.table({
         "t_time_sk": np.arange(1, n_times + 1),
-        "t_hour": np.arange(n_times) // 60,
+        "t_time_id": ["T%08d" % i for i in range(n_times)],
+        "t_time": np.arange(n_times) * 60,
+        "t_hour": t_hour,
         "t_minute": np.arange(n_times) % 60,
+        "t_am_pm": np.where(t_hour < 12, "AM", "PM"),
+        "t_shift": np.where(t_hour < 8, "third",
+                            np.where(t_hour < 16, "first", "second")),
+        "t_meal_time": np.where((t_hour >= 6) & (t_hour <= 8), "breakfast",
+                                np.where((t_hour >= 11) & (t_hour <= 13),
+                                         "lunch",
+                                         np.where((t_hour >= 17)
+                                                  & (t_hour <= 20),
+                                                  "dinner", ""))),
     })
 
     # tickets are coherent baskets: every line item of a ticket shares its
@@ -201,6 +278,241 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
         "ss_net_profit": rng.uniform(-500, 1500, n_sales).round(2),
     })
 
+    # ----------------------------------------------------------- catalog
+    # order-coherent lines like store tickets; ~half the store volume
+    n_wh = max(int(5 * scale), 2)
+    warehouse = pa.table({
+        "w_warehouse_sk": np.arange(1, n_wh + 1),
+        "w_warehouse_name": ["warehouse%d" % i for i in range(n_wh)],
+        "w_warehouse_sq_ft": rng.integers(50000, 1000000, n_wh),
+        "w_city": rng.choice(["rivertown", "lakeside", "hilltop"], n_wh),
+        "w_county": rng.choice(["Ziebach County", "Williamson County"],
+                               n_wh),
+        "w_state": rng.choice(["TN", "SD", "CA"], n_wh),
+        "w_country": ["United States"] * n_wh,
+        "w_gmt_offset": rng.choice([-5.0, -6.0, -8.0], n_wh),
+    })
+    n_cc = 4
+    call_center = pa.table({
+        "cc_call_center_sk": np.arange(1, n_cc + 1),
+        "cc_call_center_id": ["CC%06d" % i for i in range(n_cc)],
+        "cc_name": ["call center %d" % i for i in range(n_cc)],
+        "cc_county": rng.choice(["Ziebach County", "Williamson County"],
+                                n_cc),
+        "cc_manager": ["manager%d" % i for i in range(n_cc)],
+    })
+    n_cp = 50
+    catalog_page = pa.table({
+        "cp_catalog_page_sk": np.arange(1, n_cp + 1),
+        "cp_catalog_page_id": ["CP%08d" % i for i in range(n_cp)],
+        "cp_catalog_number": rng.integers(1, 10, n_cp),
+        "cp_catalog_page_number": rng.integers(1, 100, n_cp),
+    })
+    n_sm = 10
+    ship_mode = pa.table({
+        "sm_ship_mode_sk": np.arange(1, n_sm + 1),
+        "sm_ship_mode_id": ["SM%06d" % i for i in range(n_sm)],
+        "sm_type": rng.choice(["EXPRESS", "NEXT DAY", "OVERNIGHT",
+                               "REGULAR", "TWO DAY", "LIBRARY"], n_sm),
+        "sm_code": rng.choice(["AIR", "SURFACE", "SEA"], n_sm),
+        "sm_carrier": rng.choice(["UPS", "FEDEX", "DHL", "USPS",
+                                  "LATVIAN", "ZOUROS"], n_sm),
+    })
+
+    def _channel_sales(n_lines: int, lines_per_order: int):
+        """(order ids, per-order date/time/customer/addr/demo planes)."""
+        n_orders = max(n_lines // lines_per_order, 1)
+        order = rng.integers(1, n_orders + 1, n_lines)
+        return order, {
+            "date": rng.integers(1, n_days + 1, n_orders + 1),
+            "time": rng.integers(1, n_times + 1, n_orders + 1),
+            "cust": rng.integers(1, n_custs + 1, n_orders + 1),
+            "addr": rng.integers(1, n_custs + 1, n_orders + 1),
+            "cd": rng.integers(1, n_cd + 1, n_orders + 1),
+            "hd": rng.integers(1, n_hd + 1, n_orders + 1),
+            "ship_cust": rng.integers(1, n_custs + 1, n_orders + 1),
+            "ship_addr": rng.integers(1, n_custs + 1, n_orders + 1),
+        }
+
+    n_cs = max(n_sales // 2, 2500)
+    cs_order, cso = _channel_sales(n_cs, 10)
+    cs_item = (rng.zipf(1.3, n_cs) - 1) % n_items + 1
+    cs_price = rng.uniform(1, 300, n_cs).round(2)
+    cs_qty = rng.integers(1, 100, n_cs)
+    catalog_sales = pa.table({
+        "cs_sold_date_sk": cso["date"][cs_order],
+        "cs_sold_time_sk": cso["time"][cs_order],
+        "cs_ship_date_sk": np.minimum(
+            cso["date"][cs_order] + rng.integers(1, 30, n_cs), n_days),
+        "cs_bill_customer_sk": cso["cust"][cs_order],
+        "cs_bill_cdemo_sk": cso["cd"][cs_order],
+        "cs_bill_hdemo_sk": cso["hd"][cs_order],
+        "cs_bill_addr_sk": cso["addr"][cs_order],
+        "cs_ship_customer_sk": cso["ship_cust"][cs_order],
+        "cs_ship_addr_sk": cso["ship_addr"][cs_order],
+        "cs_ship_mode_sk": rng.integers(1, n_sm + 1, n_cs),
+        "cs_call_center_sk": rng.integers(1, n_cc + 1, n_cs),
+        "cs_catalog_page_sk": rng.integers(1, n_cp + 1, n_cs),
+        "cs_warehouse_sk": rng.integers(1, n_wh + 1, n_cs),
+        "cs_item_sk": cs_item,
+        "cs_promo_sk": rng.integers(1, n_promos + 1, n_cs),
+        "cs_order_number": cs_order,
+        "cs_quantity": cs_qty,
+        "cs_wholesale_cost": rng.uniform(1, 100, n_cs).round(2),
+        "cs_list_price": rng.uniform(1, 300, n_cs).round(2),
+        "cs_sales_price": cs_price,
+        "cs_ext_discount_amt": rng.uniform(0, 300, n_cs).round(2),
+        "cs_ext_sales_price": (cs_price * cs_qty).round(2),
+        "cs_ext_wholesale_cost": rng.uniform(1, 1500, n_cs).round(2),
+        "cs_ext_list_price": rng.uniform(1, 3000, n_cs).round(2),
+        "cs_ext_tax": rng.uniform(0, 200, n_cs).round(2),
+        "cs_coupon_amt": rng.uniform(0, 50, n_cs).round(2),
+        "cs_ext_ship_cost": rng.uniform(0, 150, n_cs).round(2),
+        "cs_net_paid": rng.uniform(1, 2500, n_cs).round(2),
+        "cs_net_paid_inc_tax": rng.uniform(1, 2700, n_cs).round(2),
+        "cs_net_paid_inc_ship": rng.uniform(1, 2600, n_cs).round(2),
+        "cs_net_paid_inc_ship_tax": rng.uniform(1, 2800, n_cs).round(2),
+        "cs_net_profit": rng.uniform(-500, 1500, n_cs).round(2),
+    })
+    cr_idx = rng.choice(n_cs, max(n_cs // 12, 6), replace=False)
+    cr_pair = cs_item[cr_idx].astype(np.int64) * (n_cs + 2) \
+        + cs_order[cr_idx]
+    _, cr_first = np.unique(cr_pair, return_index=True)
+    cr_idx = cr_idx[np.sort(cr_first)]
+    n_cr = len(cr_idx)
+    catalog_returns = pa.table({
+        "cr_returned_date_sk": np.minimum(
+            cso["date"][cs_order[cr_idx]] + rng.integers(1, 60, n_cr),
+            n_days),
+        "cr_returned_time_sk": rng.integers(1, n_times + 1, n_cr),
+        "cr_item_sk": cs_item[cr_idx],
+        "cr_refunded_customer_sk": cso["cust"][cs_order[cr_idx]],
+        "cr_refunded_addr_sk": cso["addr"][cs_order[cr_idx]],
+        "cr_refunded_cdemo_sk": cso["cd"][cs_order[cr_idx]],
+        "cr_refunded_hdemo_sk": cso["hd"][cs_order[cr_idx]],
+        "cr_returning_customer_sk": cso["cust"][cs_order[cr_idx]],
+        "cr_returning_addr_sk": cso["addr"][cs_order[cr_idx]],
+        "cr_call_center_sk": rng.integers(1, n_cc + 1, n_cr),
+        "cr_catalog_page_sk": rng.integers(1, n_cp + 1, n_cr),
+        "cr_ship_mode_sk": rng.integers(1, n_sm + 1, n_cr),
+        "cr_warehouse_sk": rng.integers(1, n_wh + 1, n_cr),
+        "cr_reason_sk": rng.integers(1, n_reasons + 1, n_cr),
+        "cr_order_number": cs_order[cr_idx],
+        "cr_return_quantity": rng.integers(1, 20, n_cr),
+        "cr_return_amount": rng.uniform(1, 300, n_cr).round(2),
+        "cr_return_amt_inc_tax": rng.uniform(1, 330, n_cr).round(2),
+        "cr_fee": rng.uniform(0, 100, n_cr).round(2),
+        "cr_return_ship_cost": rng.uniform(0, 120, n_cr).round(2),
+        "cr_refunded_cash": rng.uniform(0, 250, n_cr).round(2),
+        "cr_reversed_charge": rng.uniform(0, 120, n_cr).round(2),
+        "cr_store_credit": rng.uniform(0, 120, n_cr).round(2),
+        "cr_net_loss": rng.uniform(1, 400, n_cr).round(2),
+    })
+
+    # --------------------------------------------------------------- web
+    n_web_sites = 6
+    web_site = pa.table({
+        "web_site_sk": np.arange(1, n_web_sites + 1),
+        "web_site_id": ["WEB%06d" % i for i in range(n_web_sites)],
+        "web_name": ["site_%d" % i for i in range(n_web_sites)],
+        "web_company_name": ["pri" if i == 0 else "company%d" % (i % 3)
+                             for i in range(n_web_sites)],
+    })
+    n_wp = 60
+    web_page = pa.table({
+        "wp_web_page_sk": np.arange(1, n_wp + 1),
+        "wp_web_page_id": ["WP%08d" % i for i in range(n_wp)],
+        "wp_char_count": rng.integers(100, 8000, n_wp),
+        "wp_type": rng.choice(["ad", "dynamic", "feedback", "general",
+                               "order", "protected", "welcome"], n_wp),
+    })
+    n_ws = max(n_sales // 4, 1250)
+    ws_order, wso = _channel_sales(n_ws, 8)
+    ws_item = (rng.zipf(1.3, n_ws) - 1) % n_items + 1
+    ws_price = rng.uniform(1, 300, n_ws).round(2)
+    ws_qty = rng.integers(1, 100, n_ws)
+    web_sales = pa.table({
+        "ws_sold_date_sk": wso["date"][ws_order],
+        "ws_sold_time_sk": wso["time"][ws_order],
+        "ws_ship_date_sk": np.minimum(
+            wso["date"][ws_order] + rng.integers(1, 30, n_ws), n_days),
+        "ws_item_sk": ws_item,
+        "ws_bill_customer_sk": wso["cust"][ws_order],
+        "ws_bill_cdemo_sk": wso["cd"][ws_order],
+        "ws_bill_hdemo_sk": wso["hd"][ws_order],
+        "ws_bill_addr_sk": wso["addr"][ws_order],
+        "ws_ship_customer_sk": wso["ship_cust"][ws_order],
+        "ws_ship_cdemo_sk": wso["cd"][ws_order],
+        "ws_ship_hdemo_sk": wso["hd"][ws_order],
+        "ws_ship_addr_sk": wso["ship_addr"][ws_order],
+        "ws_web_page_sk": rng.integers(1, n_wp + 1, n_ws),
+        "ws_web_site_sk": rng.integers(1, n_web_sites + 1, n_ws),
+        "ws_ship_mode_sk": rng.integers(1, n_sm + 1, n_ws),
+        "ws_warehouse_sk": rng.integers(1, n_wh + 1, n_ws),
+        "ws_promo_sk": rng.integers(1, n_promos + 1, n_ws),
+        "ws_order_number": ws_order,
+        "ws_quantity": ws_qty,
+        "ws_wholesale_cost": rng.uniform(1, 100, n_ws).round(2),
+        "ws_list_price": rng.uniform(1, 300, n_ws).round(2),
+        "ws_sales_price": ws_price,
+        "ws_ext_discount_amt": rng.uniform(0, 300, n_ws).round(2),
+        "ws_ext_sales_price": (ws_price * ws_qty).round(2),
+        "ws_ext_wholesale_cost": rng.uniform(1, 1500, n_ws).round(2),
+        "ws_ext_list_price": rng.uniform(1, 3000, n_ws).round(2),
+        "ws_ext_tax": rng.uniform(0, 200, n_ws).round(2),
+        "ws_coupon_amt": rng.uniform(0, 50, n_ws).round(2),
+        "ws_ext_ship_cost": rng.uniform(0, 150, n_ws).round(2),
+        "ws_net_paid": rng.uniform(1, 2500, n_ws).round(2),
+        "ws_net_paid_inc_tax": rng.uniform(1, 2700, n_ws).round(2),
+        "ws_net_paid_inc_ship": rng.uniform(1, 2600, n_ws).round(2),
+        "ws_net_paid_inc_ship_tax": rng.uniform(1, 2800, n_ws).round(2),
+        "ws_net_profit": rng.uniform(-500, 1500, n_ws).round(2),
+    })
+    wr_idx = rng.choice(n_ws, max(n_ws // 12, 5), replace=False)
+    wr_pair = ws_item[wr_idx].astype(np.int64) * (n_ws + 2) \
+        + ws_order[wr_idx]
+    _, wr_first = np.unique(wr_pair, return_index=True)
+    wr_idx = wr_idx[np.sort(wr_first)]
+    n_wr = len(wr_idx)
+    web_returns = pa.table({
+        "wr_returned_date_sk": np.minimum(
+            wso["date"][ws_order[wr_idx]] + rng.integers(1, 60, n_wr),
+            n_days),
+        "wr_returned_time_sk": rng.integers(1, n_times + 1, n_wr),
+        "wr_item_sk": ws_item[wr_idx],
+        "wr_refunded_customer_sk": wso["cust"][ws_order[wr_idx]],
+        "wr_refunded_addr_sk": wso["addr"][ws_order[wr_idx]],
+        "wr_refunded_cdemo_sk": wso["cd"][ws_order[wr_idx]],
+        "wr_refunded_hdemo_sk": wso["hd"][ws_order[wr_idx]],
+        "wr_returning_cdemo_sk": wso["cd"][ws_order[wr_idx]],
+        "wr_returning_customer_sk": wso["cust"][ws_order[wr_idx]],
+        "wr_returning_addr_sk": wso["addr"][ws_order[wr_idx]],
+        "wr_web_page_sk": rng.integers(1, n_wp + 1, n_wr),
+        "wr_reason_sk": rng.integers(1, n_reasons + 1, n_wr),
+        "wr_order_number": ws_order[wr_idx],
+        "wr_return_quantity": rng.integers(1, 20, n_wr),
+        "wr_return_amt": rng.uniform(1, 300, n_wr).round(2),
+        "wr_return_amt_inc_tax": rng.uniform(1, 330, n_wr).round(2),
+        "wr_fee": rng.uniform(0, 100, n_wr).round(2),
+        "wr_return_ship_cost": rng.uniform(0, 120, n_wr).round(2),
+        "wr_refunded_cash": rng.uniform(0, 250, n_wr).round(2),
+        "wr_account_credit": rng.uniform(0, 120, n_wr).round(2),
+        "wr_net_loss": rng.uniform(1, 400, n_wr).round(2),
+    })
+
+    # --------------------------------------------------------- inventory
+    # weekly snapshots: one row per (week-start date, item, warehouse)
+    week_starts = d_date_sk[::7]
+    ii, ww, dd = np.meshgrid(np.arange(1, n_items + 1),
+                             np.arange(1, n_wh + 1),
+                             week_starts, indexing="ij")
+    inventory = pa.table({
+        "inv_date_sk": dd.ravel(),
+        "inv_item_sk": ii.ravel(),
+        "inv_warehouse_sk": ww.ravel(),
+        "inv_quantity_on_hand": rng.integers(0, 1000, dd.size),
+    })
+
     # store_returns: ~8% of sale lines come back, days after the sale.
     # (sr_item_sk, sr_ticket_number) is the spec's PK — dedupe candidate
     # lines on that pair (tickets often hold several lines of one item)
@@ -214,11 +526,23 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
             t_date[ticket[ret_idx]] + rng.integers(1, 60, n_ret), n_days),
         "sr_item_sk": ss_item[ret_idx],
         "sr_customer_sk": t_cust[ticket[ret_idx]],
+        "sr_cdemo_sk": t_cd[ticket[ret_idx]],
+        "sr_hdemo_sk": t_hd[ticket[ret_idx]],
         "sr_store_sk": t_store[ticket[ret_idx]],
         "sr_ticket_number": ticket[ret_idx],
         "sr_reason_sk": rng.integers(1, n_reasons + 1, n_ret),
         "sr_return_quantity": rng.integers(1, 20, n_ret),
         "sr_return_amt": rng.uniform(1, 300, n_ret).round(2),
+        "sr_return_amt_inc_tax": rng.uniform(1, 330, n_ret).round(2),
+        "sr_return_tax": rng.uniform(0, 30, n_ret).round(2),
+        "sr_fee": rng.uniform(0, 100, n_ret).round(2),
+        "sr_return_ship_cost": rng.uniform(0, 120, n_ret).round(2),
+        "sr_refunded_cash": rng.uniform(0, 250, n_ret).round(2),
+        "sr_reversed_charge": rng.uniform(0, 120, n_ret).round(2),
+        "sr_store_credit": rng.uniform(0, 120, n_ret).round(2),
+        "sr_net_loss": rng.uniform(1, 400, n_ret).round(2),
+        "sr_addr_sk": t_addr[ticket[ret_idx]],
+        "sr_return_time_sk": rng.integers(1, n_times + 1, n_ret),
     })
 
     for name, t in (("date_dim", date_dim), ("item", item),
@@ -228,8 +552,19 @@ def generate_tpcds(root: str, scale: float = 0.01, seed: int = 0) -> None:
                     ("customer_demographics", customer_demographics),
                     ("promotion", promotion),
                     ("household_demographics", household_demographics),
+                    ("income_band", income_band),
                     ("time_dim", time_dim), ("reason", reason),
-                    ("store_returns", store_returns)):
+                    ("store_returns", store_returns),
+                    ("warehouse", warehouse),
+                    ("call_center", call_center),
+                    ("catalog_page", catalog_page),
+                    ("ship_mode", ship_mode),
+                    ("catalog_sales", catalog_sales),
+                    ("catalog_returns", catalog_returns),
+                    ("web_site", web_site), ("web_page", web_page),
+                    ("web_sales", web_sales),
+                    ("web_returns", web_returns),
+                    ("inventory", inventory)):
         d = os.path.join(root, name)
         os.makedirs(d, exist_ok=True)
         pq.write_table(t, os.path.join(d, "part-0.parquet"))

@@ -54,6 +54,10 @@ class ExecutionConfig:
     # outputs accumulate merged per partition, never holding the child);
     # "auto" picks spill_cache when a memory limit is set
     shuffle_algorithm: str = "auto"
+    # local engine: "push" = per-operator workers over bounded channels
+    # (execution/pipeline.py, the reference's Swordfish dataflow); "interp"
+    # = the pull-generator interpreter (execution/executor.py alone)
+    local_executor: str = "push"
     # TPU-specific knobs
     device_min_rows: int = 0
     device_enabled: bool = True
@@ -71,6 +75,8 @@ def _exec_config_from_env() -> ExecutionConfig:
                 kwargs[f.name] = int(env)
             elif isinstance(f.default, float):
                 kwargs[f.name] = float(env)
+            elif isinstance(f.default, str):
+                kwargs[f.name] = env
     return ExecutionConfig(**kwargs)
 
 

@@ -386,23 +386,30 @@ class LocalExecutor:
         blocks over the device mesh, all_to_all by key hash over ICI, merge,
         and decode one disjoint group block per shard."""
         from . import memory
-        parts = memory.materialize(self._exec(node.children[0]))
-        outs = self._mesh_exchange_agg(node, parts)
-        if outs is not None:
-            yield from outs
-            return
-        # host fallback: hash exchange + final aggregate (what translate
-        # would have emitted without the mesh, including its partition cap)
-        n = max(min(len(parts),
-                    self.cfg.shuffle_aggregation_default_partitions), 1)
-        split = self._materialize_split(_ordered_parallel(
-            iter(parts),
-            lambda p: p.partition_by_hash(list(node.group_by), n)))
-        regrouped = self._regroup(split, n)
-        yield from _ordered_parallel(
-            regrouped, lambda p: MicroPartition.from_recordbatch(
-                p.combined().agg(node.aggs, node.group_by)
-                .cast_to_schema(node.schema())))
+        parts = memory.materialize(self._exec(node.children[0]),
+                                   memory.breaker_budget_bytes())
+        try:
+            outs = self._mesh_exchange_agg(node, parts)
+            if outs is not None:
+                yield from outs
+                return
+            # host fallback: hash exchange + final aggregate (what
+            # translate would have emitted without the mesh, including its
+            # partition cap) — bucket-store backed
+            n = max(min(len(parts),
+                        self.cfg.shuffle_aggregation_default_partitions), 1)
+            store = self._key_bucket_store(iter(parts),
+                                           list(node.group_by), n)
+            try:
+                yield from _ordered_parallel(
+                    self._emit_buckets(store, node.children[0].schema()),
+                    lambda p: MicroPartition.from_recordbatch(
+                        p.combined().agg(node.aggs, node.group_by)
+                        .cast_to_schema(node.schema())))
+            finally:
+                store.close()
+        finally:
+            parts.close()
 
     def _mesh_exchange_agg(self, node, parts) -> Optional[List[MicroPartition]]:
         import jax
@@ -537,17 +544,99 @@ class LocalExecutor:
 
     # sort -------------------------------------------------------------
     def _exec_Sort(self, node: pp.Sort):
+        """Streaming external sort (the blocking sink shape of
+        ``sinks/blocking_sink.rs:32-55``): ONE pass over the child spills
+        morsels under the breaker budget while reservoir-sampling keys;
+        boundaries from the sample range-fan the spilled stream into
+        per-bucket stores; each bucket then sorts independently — peak RSS
+        ≈ breaker budget + one bucket, never the whole child."""
+        by = list(node.sort_by)
+        desc, nf = list(node.descending), list(node.nulls_first)
+        buf, samples = self._consume_sampling(
+            self._exec(node.children[0]), by)
+        try:
+            if len(buf) == 0:
+                yield MicroPartition.empty(node.schema())
+                return
+            n = self._breaker_fanout(buf.total_bytes)
+            boundaries = None
+            if n > 1 and len(buf) > 1 and samples:
+                boundaries = self._sample_boundaries(
+                    samples, [e.name() for e in by], desc, nf, n)
+            if boundaries is None:
+                yield _gather_all(iter(buf)).sort(node.sort_by,
+                                                  node.descending,
+                                                  node.nulls_first)
+                return
+            yield from _ordered_parallel(
+                self._stream_range_buckets(buf, by, boundaries, desc, n,
+                                           node.schema()),
+                lambda p: p.sort(node.sort_by, node.descending,
+                                 node.nulls_first))
+        finally:
+            buf.close()
+
+    def _consume_sampling(self, stream, by: List[Expression]):
+        """Drain a child ONCE into a breaker-budget SpillBuffer while
+        reservoir-sampling its key columns (the old path re-walked the
+        materialized child to sample, re-reading spill files)."""
         from . import memory
-        parts = memory.materialize(self._exec(node.children[0]))
-        if len(parts) == 1:
-            yield parts[0].sort(node.sort_by, node.descending, node.nulls_first)
-            return
-        ranged = self._range_partition(parts, list(node.sort_by),
-                                       list(node.descending),
-                                       list(node.nulls_first))
-        yield from _ordered_parallel(
-            iter(ranged),
-            lambda p: p.sort(node.sort_by, node.descending, node.nulls_first))
+        k = self.cfg.sample_size_for_sort
+        buf = memory.SpillBuffer(memory.breaker_budget_bytes())
+        samples: List[RecordBatch] = []
+        for p in stream:
+            rb = p.combined()
+            if len(rb):
+                s = rb.sample(size=min(k, len(rb)))
+                samples.append(s.eval_expression_list(by))
+            buf.append(p)
+        return buf, samples
+
+    def _breaker_fanout(self, total_bytes: int) -> int:
+        """Bucket count for a streaming breaker: each bucket must fit
+        comfortably in the breaker budget (it is loaded whole at read
+        time), and stay near the configured partition size."""
+        from . import memory
+        target = min(self.cfg.target_partition_size_bytes,
+                     max(memory.breaker_budget_bytes() // 4, 1))
+        return max(1, min(1024, -(-int(total_bytes) // max(target, 1))))
+
+    def _stream_range_buckets(self, buf, by, boundaries, desc, n,
+                              schema):
+        """Re-stream a spilled buffer, range-fanning each morsel into an
+        n-bucket PartitionedSpillStore; emit buckets in range order."""
+        from . import memory
+        store = memory.PartitionedSpillStore(n)
+        try:
+            for mp in buf:
+                for i, piece in enumerate(
+                        mp.partition_by_range(by, boundaries, desc)):
+                    if len(piece):
+                        store.push(i, piece.combined().to_arrow_table())
+            buf.close()  # input spill frees before bucket reads begin
+            store.finalize()
+            yield from self._emit_buckets(store, schema)
+        finally:
+            store.close()
+
+    def _emit_buckets(self, store, schema, groups=None):
+        """One MicroPartition per bucket (or per GROUP of consecutive
+        buckets, for AQE-coalesced shuffles)."""
+        import pyarrow as pa
+        arrow_schema = schema.to_arrow()
+        for grp in (groups if groups is not None
+                    else [[i] for i in range(store.n)]):
+            tables = []
+            for i in grp:
+                tables.extend(store.bucket_tables(i))
+            tables = [t for t in tables if t.num_rows]
+            if tables:
+                t = pa.concat_tables(tables, promote_options="permissive") \
+                    if len(tables) > 1 else tables[0]
+                yield MicroPartition.from_recordbatch(
+                    RecordBatch.from_arrow_table(t).cast_to_schema(schema))
+            else:
+                yield MicroPartition.empty(schema)
 
     def _exec_TopN(self, node: pp.TopN):
         child = self._exec(node.children[0])
@@ -562,77 +651,122 @@ class LocalExecutor:
 
     # exchanges --------------------------------------------------------
     def _exec_Exchange(self, node: pp.Exchange):
+        """Streaming shuffles: hash/random/range fan every incoming morsel
+        into an n-bucket :class:`memory.PartitionedSpillStore` (RAM under
+        the breaker budget, whole-bucket spill past it) — the child is
+        never materialized as a unit. gather/split reshape partition
+        boundaries by global position, so they drain into a breaker-budget
+        SpillBuffer (spill-bounded, inherent to their contract)."""
         from . import memory
         kind, n = node.kind, node.num_partitions
-        if kind == "hash" and n > 1 and self._use_spill_cache_shuffle(node):
-            yield from self._spill_cache_hash_exchange(node, n)
-            return
-        parts = memory.materialize(self._exec(node.children[0]))
-        if self.cfg.enable_aqe and getattr(node, "engine_inserted", False) \
-                and kind in ("hash", "random") and n > 1:
-            # AQE: the child is materialized — re-size the shuffle from
-            # ACTUAL bytes instead of the planner's estimate
-            planner = self._aqe()
-            total_bytes = sum(p.size_bytes() or 0 for p in parts)
-            total_rows = sum(len(p) for p in parts)
-            n = planner.adapt_partition_count(n, total_bytes, total_rows)
-            if n == 1:  # coalesced shuffle = plain concat, skip hashing
-                yield parts[0].concat(parts[1:]) if len(parts) > 1 \
-                    else parts[0]
-                return
-        if kind == "gather" or (kind == "split" and n == 1):
-            yield parts[0].concat(parts[1:]) if len(parts) > 1 else parts[0]
-            return
-        if kind == "split":
-            yield from self._split(parts, n)
-            return
-        if kind == "random":
-            split = self._materialize_split(_ordered_parallel(
-                enumerate(parts),
-                lambda ip: ip[1].partition_by_random(n, seed=ip[0])))
-            yield from self._regroup(split, n)
-            return
-        if kind == "hash":
-            by = list(node.by)
-            mesh_out = self._mesh_hash_repartition(parts, by, n)
-            if mesh_out is not None:
-                yield from mesh_out
-                return
-            split = self._materialize_split(_ordered_parallel(
-                iter(parts), lambda p: p.partition_by_hash(by, n)))
-            yield from self._regroup(split, n)
-            return
-        if kind == "range":
-            yield from self._range_partition(parts, list(node.by),
-                                             list(node.descending) or
-                                             [False] * len(node.by),
-                                             None, n)
-            return
-        raise NotImplementedError(f"exchange kind {kind}")
-
-    def _use_spill_cache_shuffle(self, node) -> bool:
-        """Strategy pick (reference: ShuffleExchange strategy enum,
-        ``ops/shuffle_exchange.rs:41-58``): the streaming spill-cache path
-        skips materializing the exchange child entirely, but cedes to the
-        AQE partition-resizing path and the device-mesh collective path."""
-        from . import memory
-        from ..device import runtime as drt
-        from ..parallel import mesh as pmesh
         algo = getattr(self.cfg, "shuffle_algorithm", "auto")
         if algo not in ("auto", "naive", "spill_cache"):
             raise ValueError(
                 f"shuffle_algorithm {algo!r}: expected 'auto', 'naive' or "
                 f"'spill_cache'")
-        if algo == "naive":
-            return False
+        if kind == "hash" and n > 1:
+            if algo == "spill_cache":
+                yield from self._spill_cache_hash_exchange(node, n)
+            else:
+                yield from self._hash_exchange_streaming(node, n)
+            return
+        if kind == "random" and n > 1:
+            yield from self._fan_exchange_streaming(
+                node, n, lambda mp, i: mp.partition_by_random(n, seed=i))
+            return
+        if kind == "range":
+            yield from self._range_exchange_streaming(node, n)
+            return
+        # gather / split: global-position reshapes
+        parts = memory.materialize(self._exec(node.children[0]),
+                                   memory.breaker_budget_bytes())
+        try:
+            if len(parts) == 0:
+                yield MicroPartition.empty(node.schema())
+            elif kind in ("gather", "hash", "random") or n == 1:
+                # hash/random collapse to a concat at n == 1 (the n > 1
+                # cases took the streaming-store paths above)
+                yield _gather_all(iter(parts))
+            elif kind == "split":
+                yield from self._split(list(parts), n)
+            else:
+                raise NotImplementedError(f"exchange kind {kind}")
+        finally:
+            parts.close()
+
+    def _hash_exchange_streaming(self, node, n: int):
+        from . import memory
+        from ..device import runtime as drt
+        from ..parallel import mesh as pmesh
+        by = list(node.by)
+        child = self._exec(node.children[0])
         if drt.device_enabled() and pmesh.mesh_size() >= 2 \
-                and node.num_partitions == pmesh.mesh_size():
-            return False  # the mesh collective repartition may apply
-        if algo == "spill_cache":
-            return True
-        # auto: bounded-memory mode prefers the streaming cache (one
-        # partition in memory at a time)
-        return memory.memory_limit_bytes() is not None
+                and n == pmesh.mesh_size():
+            # the ICI collective repartition wants a partition list; fall
+            # back to the streaming store with the same (spill-bounded)
+            # buffer when it declines
+            parts = memory.materialize(child, memory.breaker_budget_bytes())
+            mesh_out = self._mesh_hash_repartition(list(parts), by, n)
+            if mesh_out is not None:
+                parts.close()
+                yield from mesh_out
+                return
+            child = iter(parts)
+        yield from self._fan_exchange_streaming(
+            node, n, lambda mp, i: mp.partition_by_hash(by, n),
+            stream=child)
+
+    def _fan_exchange_streaming(self, node, n: int, fan, stream=None):
+        """Shared streaming fanout: morsel → n pieces → bucket store; AQE
+        may coalesce consecutive buckets from measured totals (growing
+        beyond the planned n would need a re-hash of spilled buckets, so
+        adaptation only shrinks — the common small-data correction)."""
+        from . import memory
+        store = memory.PartitionedSpillStore(n)
+        try:
+            for i, mp in enumerate(stream if stream is not None
+                                   else self._exec(node.children[0])):
+                for j, piece in enumerate(fan(mp, i)):
+                    if len(piece):
+                        store.push(j, piece.combined().to_arrow_table())
+            store.finalize()
+            groups = None
+            if self.cfg.enable_aqe \
+                    and getattr(node, "engine_inserted", False):
+                planner = self._aqe()
+                n2 = min(planner.adapt_partition_count(
+                    n, sum(store.nbytes), sum(store.rows)), n)
+                if n2 < n:
+                    bounds = [round(j * n / n2) for j in range(n2 + 1)]
+                    groups = [list(range(bounds[j], bounds[j + 1]))
+                              for j in range(n2)]
+            yield from self._emit_buckets(store, node.schema(), groups)
+        finally:
+            store.close()
+
+    def _range_exchange_streaming(self, node, n: int):
+        by = list(node.by)
+        desc = list(node.descending) or [False] * len(by)
+        buf, samples = self._consume_sampling(
+            self._exec(node.children[0]), by)
+        try:
+            boundaries = None
+            if n > 1 and samples:
+                boundaries = self._sample_boundaries(
+                    samples, [e.name() for e in by], desc, desc, n)
+            if boundaries is None:
+                if len(buf) == 0:
+                    yield MicroPartition.empty(node.schema())
+                else:
+                    yield _gather_all(iter(buf))
+                for _ in range(max(n - 1, 0)):
+                    yield MicroPartition.empty(node.schema())
+                return
+            yield from self._stream_range_buckets(buf, by, boundaries,
+                                                  desc, n, node.schema())
+        finally:
+            buf.close()
+
 
     def _spill_cache_hash_exchange(self, node, n: int):
         """Streaming map-side shuffle: every incoming morsel is hash-
@@ -664,27 +798,7 @@ class LocalExecutor:
         finally:
             cache.cleanup()
 
-    def _materialize_split(self, rows):
-        """Fanout outputs → budgeted (possibly spilling) buffer, so the
-        exchange peak — every input's n split parts live at once — honors
-        the memory limit."""
-        from . import memory
-        split = memory.SplitSpillBuffer()
-        for outs in rows:
-            split.append_row(list(outs))
-        return split
 
-    def _regroup(self, split, n: int):
-        from . import memory
-        if isinstance(split, memory.SplitSpillBuffer):
-            for i in range(n):
-                subs = [split.get(s, i) for s in range(split.rows)]
-                yield subs[0].concat(subs[1:]) if len(subs) > 1 else subs[0]
-            split.close()
-            return
-        for i in range(n):
-            subs = [s[i] for s in split]
-            yield subs[0].concat(subs[1:]) if len(subs) > 1 else subs[0]
 
     def _split(self, parts: List[MicroPartition], n: int):
         """Split/coalesce to exactly n partitions, preserving order."""
@@ -707,81 +821,54 @@ class LocalExecutor:
         return sample_boundaries(sampled_keys, key_names, descending,
                                  nulls_first, n)
 
-    def _sample_keys(self, parts, by: List[Expression]) -> List[RecordBatch]:
-        k = self.cfg.sample_size_for_sort
-        out = []
-        for p in parts:
-            rb = p.combined()
-            s = rb.sample(size=min(k, len(rb))) if len(rb) else rb
-            out.append(s.eval_expression_list(by))
-        return out
 
-    def _range_fanout(self, parts, by: List[Expression],
-                      boundaries: RecordBatch, descending: List[bool],
-                      n: int):
-        split = self._materialize_split(_ordered_parallel(
-            iter(parts),
-            lambda p: p.partition_by_range(by, boundaries, descending)))
-        return self._regroup(split, n)
-
-    def _range_partition(self, parts: List[MicroPartition],
-                         by: List[Expression], descending: List[bool],
-                         nulls_first: Optional[List[bool]] = None,
-                         n: Optional[int] = None) -> List[MicroPartition]:
-        """Sample → boundaries → partition_by_range → regroup."""
-        n = n or len(parts)
-        nulls_first = nulls_first or list(descending)
-        if n == 1:
-            combined = parts[0].concat(parts[1:]) if len(parts) > 1 else parts[0]
-            return [combined]
-        boundaries = self._sample_boundaries(
-            self._sample_keys(parts, by), [e.name() for e in by],
-            descending, nulls_first, n)
-        if boundaries is None:
-            combined = parts[0].concat(parts[1:]) if len(parts) > 1 else parts[0]
-            return [combined] + [MicroPartition.empty(parts[0].schema)
-                                 for _ in range(n - 1)]
-        return self._range_fanout(parts, by, boundaries, descending, n)
-
-    # joins ------------------------------------------------------------
     def _sort_merge_join(self, node: pp.HashJoin):
         """Distributed sort-merge join (reference: SortMergeJoin physical
         op with ``sort_merge_join_sort_with_aligned_boundaries``): sample
-        BOTH sides' keys once, derive one shared set of range boundaries,
-        range-partition both sides with them (co-ranged, not co-hashed),
-        then merge-join pairwise. Output comes out range-clustered by key."""
-        from . import memory
+        BOTH sides' keys while spilling each under the breaker budget,
+        derive ONE shared set of range boundaries, range-bucket both sides
+        with them (co-ranged, not co-hashed), then join pairwise — one
+        bucket pair resident at a time. Output comes out range-clustered
+        by key."""
         how = node.how
         left_on, right_on = list(node.left_on), list(node.right_on)
-        lparts = memory.materialize(self._exec(node.children[0]))
-        rparts = memory.materialize(self._exec(node.children[1]))
-        n = max(len(lparts), len(rparts), 1)
-        if n == 1:
-            lall = _gather_all(iter(lparts))
-            rall = _gather_all(iter(rparts))
-            yield lall.hash_join(rall, left_on, right_on, how)
-            return
-        names = [e.name() for e in left_on]
-        # right-side key names normalize to the left's so samples concat
-        # into one boundary table (boundary comparison is positional)
-        samples = self._sample_keys(lparts, left_on) + [
-            RecordBatch.from_series([c.rename(nm) for c, nm in
-                                     zip(rb.columns(), names)])
-            for rb in self._sample_keys(rparts, right_on)]
-        desc = [False] * len(left_on)
-        boundaries = self._sample_boundaries(samples, names, desc, desc, n)
-        if boundaries is None:
-            lall = _gather_all(iter(lparts))
-            rall = _gather_all(iter(rparts))
-            yield lall.hash_join(rall, left_on, right_on, how)
-            return
-        lregrouped = memory.materialize(
-            self._range_fanout(lparts, left_on, boundaries, desc, n))
-        rregrouped = memory.materialize(
-            self._range_fanout(rparts, right_on, boundaries, desc, n))
-        yield from _ordered_parallel(
-            zip(lregrouped, rregrouped),
-            lambda lr: lr[0].hash_join(lr[1], left_on, right_on, how))
+        lbuf, lsamp = self._consume_sampling(self._exec(node.children[0]),
+                                             left_on)
+        rbuf, rsamp = self._consume_sampling(self._exec(node.children[1]),
+                                             right_on)
+        try:
+            n = max(self._breaker_fanout(lbuf.total_bytes),
+                    self._breaker_fanout(rbuf.total_bytes),
+                    min(max(len(lbuf), len(rbuf)), 16))
+            names = [e.name() for e in left_on]
+            # right-side key names normalize to the left's so samples
+            # concat into one boundary table (comparison is positional)
+            samples = lsamp + [
+                RecordBatch.from_series([c.rename(nm) for c, nm in
+                                         zip(rb.columns(), names)])
+                for rb in rsamp]
+            desc = [False] * len(left_on)
+            boundaries = self._sample_boundaries(samples, names, desc,
+                                                 desc, n) \
+                if n > 1 and samples else None
+            if boundaries is None:
+                lall = _gather_all_or_empty(iter(lbuf),
+                                            node.children[0].schema())
+                rall = _gather_all_or_empty(iter(rbuf),
+                                            node.children[1].schema())
+                yield lall.hash_join(rall, left_on, right_on, how)
+                return
+            yield from _ordered_parallel(
+                zip(self._stream_range_buckets(
+                        lbuf, left_on, boundaries, desc, n,
+                        node.children[0].schema()),
+                    self._stream_range_buckets(
+                        rbuf, right_on, boundaries, desc, n,
+                        node.children[1].schema())),
+                lambda lr: lr[0].hash_join(lr[1], left_on, right_on, how))
+        finally:
+            lbuf.close()
+            rbuf.close()
 
     def _exec_HashJoin(self, node: pp.HashJoin):
         how = node.how
@@ -823,37 +910,69 @@ class LocalExecutor:
                   and [e._key() for e in rnode.by]
                   == [e._key() for e in node.right_on])
         if copart:
-            # streaming probe: the build side is the blocking sink
-            # (spill-bounded SpillBuffer); probe partitions stream straight
-            # from the exchange one at a time — never materialized as a
-            # list (reference: hash_join.rs build-then-stream-probe)
-            rparts = memory.materialize(self._exec(rnode))
-            try:
-                yield from _ordered_parallel(
-                    enumerate(self._exec(lnode)),
-                    lambda ip: ip[1].hash_join(
-                        rparts[ip[0]], node.left_on, node.right_on, how))
-            finally:
-                rparts.close()
-            return
-        lparts = memory.materialize(self._exec(lnode))
-        rparts = memory.materialize(self._exec(rnode))
-        if len(lparts) == len(rparts) == 1:
+            # both exchanges emit exactly n partitions in index order and
+            # partition on the join keys — zip the two streams and join
+            # pairwise. Each side's exchange is a streaming bucket store,
+            # so at most one partition PAIR (plus the stores' bounded
+            # buffers) is resident; neither side materializes as a list
+            # (reference: hash_join.rs build-then-stream-probe, with the
+            # build side's state held by the exchange sink)
             yield from _ordered_parallel(
-                zip(lparts, rparts),
+                zip(self._exec(lnode), self._exec(rnode)),
                 lambda lr: lr[0].hash_join(lr[1], node.left_on,
                                            node.right_on, how))
             return
         # no static co-partitioning evidence: index pairing would join
-        # unrelated partitions — re-fan BOTH sides by key hash (same xxh64
-        # chain on both → co-partitioned)
-        n = max(len(lparts), len(rparts), 1)
-        lparts = self._refan(lparts, list(node.left_on), n)
-        rparts = self._refan(rparts, list(node.right_on), n)
-        yield from _ordered_parallel(
-            zip(lparts, rparts),
-            lambda lr: lr[0].hash_join(lr[1], node.left_on, node.right_on,
-                                       how))
+        # unrelated partitions — spill-partition BOTH sides by key hash
+        # (same xxh64 chain → co-partitioned buckets), then join pairwise;
+        # peak memory is one bucket pair, not both children
+        lbuf = memory.materialize(self._exec(lnode),
+                                  memory.breaker_budget_bytes())
+        rbuf = memory.materialize(self._exec(rnode),
+                                  memory.breaker_budget_bytes())
+        try:
+            # fanout sized from BOTH sides (a tiny left must not gather an
+            # arbitrarily large right into RAM); both buffers are
+            # spill-bounded, so sizing them first costs disk, not memory
+            n = max(self._breaker_fanout(lbuf.total_bytes),
+                    self._breaker_fanout(rbuf.total_bytes))
+            if n <= 1:
+                # both sides fit one bucket — direct in-memory join
+                lall = _gather_all_or_empty(iter(lbuf), lnode.schema())
+                rall = _gather_all_or_empty(iter(rbuf), rnode.schema())
+                yield lall.hash_join(rall, node.left_on, node.right_on,
+                                     how)
+                return
+            n = max(n, min(max(len(lbuf), len(rbuf)), 16))
+            lstore = self._key_bucket_store(iter(lbuf),
+                                            list(node.left_on), n)
+            lbuf.close()
+            rstore = self._key_bucket_store(iter(rbuf),
+                                            list(node.right_on), n)
+            rbuf.close()
+            try:
+                yield from _ordered_parallel(
+                    zip(self._emit_buckets(lstore, lnode.schema()),
+                        self._emit_buckets(rstore, rnode.schema())),
+                    lambda lr: lr[0].hash_join(lr[1], node.left_on,
+                                               node.right_on, how))
+            finally:
+                lstore.close()
+                rstore.close()
+        finally:
+            lbuf.close()
+            rbuf.close()
+
+    def _key_bucket_store(self, stream, by, n: int):
+        """Drain a stream into an n-bucket store hashed on ``by``."""
+        from . import memory
+        store = memory.PartitionedSpillStore(n)
+        for mp in stream:
+            for j, piece in enumerate(mp.partition_by_hash(by, n)):
+                if len(piece):
+                    store.push(j, piece.combined().to_arrow_table())
+        store.finalize()
+        return store
 
     def _adaptive_hash_join(self, node: pp.HashJoin, li, ri):
         """AQE join-strategy demotion (reference: AdaptivePlanner re-plans
@@ -865,7 +984,8 @@ class LocalExecutor:
         from . import memory
         how = node.how
         threshold = self.cfg.broadcast_join_size_bytes_threshold
-        lparts = memory.materialize(self._exec(li))
+        lparts = memory.materialize(self._exec(li),
+                                    memory.breaker_budget_bytes())
         if lparts.total_bytes <= threshold and how in ("inner", "right"):
             self._aqe().record_join("hash→broadcast_left",
                                     lparts.total_bytes)
@@ -875,7 +995,8 @@ class LocalExecutor:
                 self._exec(ri), lambda p: left.hash_join(
                     p, node.left_on, node.right_on, how))
             return
-        rparts = memory.materialize(self._exec(ri))
+        rparts = memory.materialize(self._exec(ri),
+                                    memory.breaker_budget_bytes())
         if rparts.total_bytes <= threshold and how in ("inner", "left",
                                                        "semi", "anti"):
             self._aqe().record_join("hash→broadcast_right",
@@ -889,21 +1010,27 @@ class LocalExecutor:
         n = node.children[0].num_partitions
         self._aqe().record_join("hash",
                                 lparts.total_bytes + rparts.total_bytes)
-        lparts = self._refan(lparts, list(node.left_on), n)
-        rparts = self._refan(rparts, list(node.right_on), n)
         yield from _ordered_parallel(
-            zip(lparts, rparts),
+            zip(self._refan(lparts, list(node.left_on), n, li.schema()),
+                self._refan(rparts, list(node.right_on), n, ri.schema())),
             lambda lr: lr[0].hash_join(lr[1], node.left_on, node.right_on,
                                        how))
 
-    def _refan(self, parts, by: List[Expression], n: int):
+    def _refan(self, parts, by: List[Expression], n: int, schema):
+        """Key-hash a (possibly spilled) partition buffer into n buckets
+        and emit them in order — bucket-store backed, one bucket resident
+        at a time."""
         from . import memory
-        split = self._materialize_split(_ordered_parallel(
-            iter(parts), lambda p: p.partition_by_hash(by, n)))
-        out = memory.materialize(self._regroup(split, n))
+        store = self._key_bucket_store(iter(parts), by, n)
         if isinstance(parts, memory.SpillBuffer):
             parts.close()
-        return out
+
+        def emit():
+            try:
+                yield from self._emit_buckets(store, schema)
+            finally:
+                store.close()
+        return emit()
 
     def _exec_CrossJoin(self, node: pp.CrossJoin):
         right = _gather_all(self._exec(node.children[1]))
@@ -1096,6 +1223,14 @@ def _np_plane_encoder(rb: RecordBatch, cap: int):
 
 def _gather_all(parts: Iterator[MicroPartition]) -> MicroPartition:
     ps = list(parts)
+    return ps[0].concat(ps[1:]) if len(ps) > 1 else ps[0]
+
+
+def _gather_all_or_empty(parts: Iterator[MicroPartition],
+                         schema) -> MicroPartition:
+    ps = list(parts)
+    if not ps:
+        return MicroPartition.empty(schema)
     return ps[0].concat(ps[1:]) if len(ps) > 1 else ps[0]
 
 

@@ -186,6 +186,140 @@ def materialize(parts: Iterable, budget: Optional[int] = None) -> SpillBuffer:
     return buf
 
 
+def breaker_budget_bytes() -> int:
+    """In-memory byte budget for pipeline-breaker buffers (sort input,
+    bucket stores, gather). The user's DAFT_TPU_MEMORY_LIMIT wins; without
+    one, a quarter of physical RAM — a breaker must never degenerate into
+    an unbounded in-memory materialize just because no limit was set."""
+    lim = memory_limit_bytes()
+    if lim is not None:
+        return lim
+    try:
+        total = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+        return max(total // 4, 256 << 20)
+    except (ValueError, OSError, AttributeError):
+        return 1 << 30
+
+
+class PartitionedSpillStore:
+    """n-bucket accumulator with one SHARED in-memory byte budget: pushes
+    stay in RAM until the store exceeds the budget, then whole buckets
+    (largest first) convert to per-bucket Arrow IPC spill files and any
+    later push to a spilled bucket appends to its file — push order within
+    a bucket is preserved. This is the blocking-sink store behind the
+    streaming breakers (hash/random/range exchanges, external sort buckets,
+    spill-partitioned joins): peak RSS ≈ budget + one bucket at read time
+    (reference: ``sinks/blocking_sink.rs:32-55`` consume-all-then-emit with
+    memory-pressure spilling; the distributed Flight path keeps its own
+    always-on-disk ``ShuffleCache``)."""
+
+    def __init__(self, n: int, budget: Optional[int] = None):
+        import uuid as _uuid
+        self.n = n
+        self.budget = budget if budget is not None else breaker_budget_bytes()
+        self._mem: List[List] = [[] for _ in range(n)]  # pa.Table lists
+        self._mem_bytes_per = [0] * n
+        self._mem_bytes = 0
+        self._writers: List[Optional[Tuple[object, object]]] = [None] * n
+        self._spilled = [False] * n
+        self.rows = [0] * n
+        self.nbytes = [0] * n
+        self.bytes_spilled = 0
+        self._root = os.path.join(spill_dir(),
+                                  f"pstore_{_uuid.uuid4().hex}")
+        self._lock = threading.Lock()
+        self._sealed = False
+
+    def _path(self, i: int) -> str:
+        return os.path.join(self._root, f"bucket-{i}.arrow")
+
+    def _writer(self, i: int, schema):
+        w = self._writers[i]
+        if w is None:
+            os.makedirs(self._root, exist_ok=True)
+            f = open(self._path(i), "ab")
+            w = (paipc.new_stream(f, schema), f)
+            self._writers[i] = w
+        return w[0]
+
+    def push(self, i: int, table) -> None:
+        nb = table.nbytes
+        with self._lock:
+            self.rows[i] += table.num_rows
+            self.nbytes[i] += nb
+            if self._spilled[i]:
+                self._writer(i, table.schema).write_table(table)
+                self.bytes_spilled += nb
+                return
+            self._mem[i].append(table)
+            self._mem_bytes_per[i] += nb
+            self._mem_bytes += nb
+            while self._mem_bytes > self.budget:
+                j = max(range(self.n), key=lambda x: self._mem_bytes_per[x])
+                if self._mem_bytes_per[j] == 0:
+                    break
+                self._spill_bucket(j)
+
+    def _spill_bucket(self, j: int) -> None:
+        for t in self._mem[j]:
+            self._writer(j, t.schema).write_table(t)
+        self.bytes_spilled += self._mem_bytes_per[j]
+        self._mem_bytes -= self._mem_bytes_per[j]
+        self._mem_bytes_per[j] = 0
+        self._mem[j] = []
+        self._spilled[j] = True
+
+    def finalize(self) -> None:
+        with self._lock:
+            for w in self._writers:
+                if w is not None:
+                    w[0].close()
+                    w[1].close()
+            self._writers = [None] * self.n
+            self._sealed = True
+
+    def bucket_tables(self, i: int) -> List:
+        """All of bucket i's tables, disk batches first then resident ones
+        (push order: a bucket spills wholly before disk appends begin)."""
+        assert self._sealed, "finalize() before reading buckets"
+        out = []
+        if self._spilled[i] and os.path.exists(self._path(i)):
+            with open(self._path(i), "rb") as f:
+                while True:
+                    try:
+                        r = paipc.open_stream(f)
+                    except Exception:
+                        break
+                    out.append(r.read_all())
+        out.extend(self._mem[i])
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            for w in self._writers:
+                if w is not None:
+                    try:
+                        w[0].close()
+                        w[1].close()
+                    except Exception:
+                        pass
+            self._writers = [None] * self.n
+            self._mem = [[] for _ in range(self.n)]
+            self._mem_bytes = 0
+            self._mem_bytes_per = [0] * self.n
+        try:
+            import shutil
+            shutil.rmtree(self._root, ignore_errors=True)
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class SplitSpillBuffer:
     """Budgeted holder for fanout outputs: each input partition contributes a
     row of ``n`` split partitions; rows accumulate under the same spill

@@ -57,6 +57,39 @@ _CMP = {"lt": pc.less, "le": pc.less_equal, "gt": pc.greater,
         "ge": pc.greater_equal, "eq": pc.equal, "neq": pc.not_equal}
 
 
+def _interval_shift(s: Series, months: int, days: int, nanos: int) -> Series:
+    """Shift a date/timestamp series by a calendar interval. Month shifts
+    clamp to month length (SQL calendar arithmetic); day/nano-only shifts
+    over columns run vectorized — the interpreted loop only survives for
+    the month-shift-over-column case (rare; literals are 1-row)."""
+    import calendar
+    import datetime as _dt
+    if months == 0 and len(s) > 1:
+        arr = s.to_arrow()
+        td = pa.scalar(_dt.timedelta(days=days, microseconds=nanos // 1000))
+        if pa.types.is_date32(arr.type) or pa.types.is_date64(arr.type):
+            out = pc.add(arr.cast(pa.timestamp("us")), td).cast(arr.type)
+        else:
+            out = pc.add(arr, td)
+        return Series.from_arrow(out, s.name())
+    out = []
+    for v in s.to_pylist():
+        if v is None:
+            out.append(None)
+            continue
+        if months:
+            y = v.year + (v.month - 1 + months) // 12
+            m = (v.month - 1 + months) % 12 + 1
+            d = min(v.day, calendar.monthrange(y, m)[1])
+            v = v.replace(year=y, month=m, day=d)
+        if days:
+            v = v + _dt.timedelta(days=days)
+        if nanos:
+            v = v + _dt.timedelta(microseconds=nanos // 1000)
+        out.append(v)
+    return Series.from_pylist(out, s.name(), dtype=s.datatype())
+
+
 def _eval(e: Expression, cols: Dict[str, Series], n: int) -> Series:
     op = e.op
 
@@ -76,6 +109,16 @@ def _eval(e: Expression, cols: Dict[str, Series], n: int) -> Series:
         return _eval(e.args[0], cols, n).rename(e.params[0])
     if op == "cast":
         return _eval(e.args[0], cols, n).cast(e.params[0])
+    if op in ("add", "sub") and any(a.op == "lit_interval" for a in e.args):
+        # date/timestamp ± INTERVAL: calendar-aware shift (months clamp to
+        # month length per SQL, days/nanos are exact)
+        iv = next(a for a in e.args if a.op == "lit_interval")
+        other = next(a for a in e.args if a.op != "lit_interval")
+        base = _eval(other, cols, n)
+        months, days, nanos = iv.params
+        sign = 1 if op == "add" else -1
+        return _interval_shift(base, sign * months, sign * days,
+                               sign * nanos)
 
     # evaluate children
     kids = [_eval(a, cols, n) for a in e.args]

@@ -65,6 +65,10 @@ class Expression:
             return "literal"
         if self.op == "list":
             return "list"
+        if self.op == "if_else":
+            # matches typing's infer_field: the value (THEN) branch names
+            # the output, not the condition
+            return self.args[1].name()
         if self.args:
             return self.args[0].name()
         return self.op

@@ -48,11 +48,17 @@ class Optimizer:
             # new predicates into scans
             Batch("derived_filters", [PushDownJoinPredicate(),
                                       FilterNullJoinKey()], "once"),
-            Batch("derived_pushdown", [PushDownFilter(),
+            # EliminateCrossJoin rides every pushdown sweep: filter motion
+            # in these batches can re-form Filter(CrossJoin) patterns long
+            # after the first batch settled (3-fact queries like TPC-DS
+            # Q25/Q29 surface equi conjuncts above a nested cross here)
+            Batch("derived_pushdown", [EliminateCrossJoin(),
+                                       PushDownFilter(),
                                        PushDownProjection()],
                   "fixed_point"),
             Batch("joins", [ReorderJoins()], "once"),
-            Batch("post_join_pushdowns", [PushDownFilter(),
+            Batch("post_join_pushdowns", [EliminateCrossJoin(),
+                                          PushDownFilter(),
                                           PushDownProjection()],
                   "fixed_point"),
             Batch("materialize", [MaterializeScans()], "once"),
@@ -455,9 +461,11 @@ class EliminateCrossJoin(Rule):
             child = node.children[0]
             if not (isinstance(child, lp.Join) and child.how == "cross"):
                 return node
-            l_names = set(child.children[0].schema().column_names)
-            r_names = set(child.children[1].schema().column_names)
-            left_on, right_on, rest = [], [], []
+            lchild, rchild = child.children
+            l_names = set(lchild.schema().column_names)
+            r_names = set(rchild.schema().column_names)
+            left_on, right_on = [], []
+            l_only, r_only, rest = [], [], []
             for c in split_conjuncts(node.predicate):
                 if c.op == "eq":
                     a, b = c.args
@@ -471,12 +479,27 @@ class EliminateCrossJoin(Rule):
                             left_on.append(b)
                             right_on.append(a)
                             continue
+                # side-contained conjuncts sink INTO the cross's child —
+                # a nested cross (3+-relation comma join, TPC-DS Q18/Q25
+                # shape) only converts once its own equis sit directly
+                # above it
+                refs = set(c.column_names())
+                if refs and refs <= l_names:
+                    l_only.append(c)
+                    continue
+                if refs and refs <= r_names:
+                    r_only.append(c)
+                    continue
                 rest.append(c)
-            if not left_on:
+            if not left_on and not l_only and not r_only:
                 return node
-            join = lp.Join(child.children[0], child.children[1],
-                           left_on, right_on, "inner", child.strategy,
-                           child.prefix, child.suffix)
+            if l_only:
+                lchild = lp.Filter(lchild, combine_conjuncts(l_only))
+            if r_only:
+                rchild = lp.Filter(rchild, combine_conjuncts(r_only))
+            how = "inner" if left_on else "cross"
+            join = lp.Join(lchild, rchild, left_on, right_on, how,
+                           child.strategy, child.prefix, child.suffix)
             return lp.Filter(join, combine_conjuncts(rest)) if rest else join
         return plan.transform_up(fn)
 

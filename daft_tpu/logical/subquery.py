@@ -53,11 +53,14 @@ class SubqueryInfo:
                         subquery was fully built by the normal path).
     """
 
-    def __init__(self, df, corr, deferred_aggs, value_cols):
+    def __init__(self, df, corr, deferred_aggs, value_cols, resid=None):
         self.df = df
         self.corr = list(corr)
         self.deferred_aggs = list(deferred_aggs or [])
         self.value_cols = list(value_cols or [])
+        # correlated NON-equality conjuncts (outer refs as ``outer_col``
+        # markers): realized by the rowid-join rewrite in _semi_anti
+        self.resid = list(resid or [])
 
     def __repr__(self):
         return (f"SubqueryInfo(corr={len(self.corr)}, "
@@ -132,6 +135,8 @@ def _inner_value_expr(info: SubqueryInfo) -> Tuple[object, Expression]:
 def _semi_anti(df, info: SubqueryInfo, anti: bool,
                lhs: Optional[Expression] = None):
     """EXISTS/IN → semi join; NOT variants → anti join."""
+    if info.resid:
+        return _semi_anti_residual(df, info, anti, lhs)
     how = "anti" if anti else "semi"
     left_on = [o for _, o in info.corr]
     right_on = [i for i, _ in info.corr]
@@ -150,6 +155,57 @@ def _semi_anti(df, info: SubqueryInfo, anti: bool,
         return out.exclude(k) if hasattr(out, "exclude") \
             else out.select(*[col(c) for c in df.column_names])
     return df.join(rdf, left_on=left_on, right_on=right_on, how=how)
+
+
+def _semi_anti_residual(df, info: SubqueryInfo, anti: bool,
+                        lhs: Optional[Expression]):
+    """EXISTS/IN with non-equality correlated conjuncts (TPC-DS Q16/Q94's
+    ``EXISTS (… WHERE inner.k = outer.k AND inner.wh <> outer.wh)``):
+
+    1. tag the outer frame with a monotonic rowid,
+    2. inner-join it to the subquery on the EQUALITY correlation keys
+       (inner columns renamed first — self-join-safe),
+    3. apply the residual predicates over the joined frame,
+    4. semi/anti-join the tagged outer on the surviving rowids.
+
+    The reference's unnest rule stops at equality correlation; this
+    rewrite is the standard decorrelation via row identity."""
+    if info.deferred_aggs:
+        raise NotImplementedError(
+            "aggregating subquery with non-equality correlation")
+    rid = f"__sqrid{next(_uid)}__"
+    tagged = df.add_monotonically_increasing_id(rid)
+    rdf = info.df
+    # rename every inner column so outer references never collide (the
+    # motivating queries self-join the same table)
+    ren = {c: f"__sqr{next(_uid)}_{c}__" for c in rdf.column_names}
+    rdf = rdf.select(*[col(c).alias(n) for c, n in ren.items()])
+
+    def fix_inner(e: Expression) -> Expression:
+        if e.op == "col":
+            return col(ren.get(e.params[0], e.params[0]))
+        if e.op == "outer_col":
+            return col(e.params[0])
+        if not e.args:
+            return e
+        return e.with_children([fix_inner(a) for a in e.args])
+
+    left_on = [o for _, o in info.corr]
+    right_on = [fix_inner(i) for i, _ in info.corr]
+    if lhs is not None:
+        rdf2, val = _inner_value_expr(info)
+        # re-derive the value expression over the renamed frame
+        left_on = left_on + [lhs]
+        right_on = right_on + [fix_inner(val)]
+    joined = tagged.join(rdf, left_on=left_on, right_on=right_on,
+                         how="inner") if left_on else \
+        tagged.join(rdf, how="cross")
+    joined = joined.where(and_all([fix_inner(r) for r in info.resid]))
+    matched = joined.select(col(rid)).distinct()
+    how = "anti" if anti else "semi"
+    out = tagged.join(matched, left_on=[col(rid)], right_on=[col(rid)],
+                      how=how)
+    return out.exclude(rid)
 
 
 def _attach_scalar(df, node: Expression) -> Tuple[object, str]:
@@ -268,11 +324,22 @@ def _find_scalar(e: Expression) -> Optional[Expression]:
 def apply_where(df, pred: Expression):
     """df.where(pred), realizing any subquery nodes via joins first. Helper
     columns introduced by scalar-subquery joins stay in the frame; SQL's
-    projection step (or the caller) drops them."""
+    projection step (or the caller) drops them.
+
+    Plain conjuncts apply BEFORE the subquery rewrites: the rewrites wrap
+    the frame in joins (and, for residual correlation, a monotonic rowid)
+    that block the optimizer's cross-join elimination underneath — the
+    equality filters must reach the join graph first."""
     if not contains_subquery(pred):
         return df.where(pred)
+    conjs = split_conjuncts(pred)
+    plain = [c for c in conjs if not contains_subquery(c)]
+    if plain:
+        df = df.where(and_all(plain))
     residuals = []
-    for conj in split_conjuncts(pred):
+    for conj in conjs:
+        if not contains_subquery(conj):
+            continue
         residual, df = _rewrite_conjunct(df, conj)
         if residual is not None:
             residuals.append(residual)

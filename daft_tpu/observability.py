@@ -34,7 +34,7 @@ class OperatorStats:
     ``RuntimeStatsContext`` counters)."""
 
     __slots__ = ("name", "rows_out", "batches_out", "inclusive_us",
-                 "morsel_rows_min", "morsel_rows_max", "lock")
+                 "morsel_rows_min", "morsel_rows_max", "workers", "lock")
 
     def __init__(self, name: str):
         self.name = name
@@ -45,6 +45,9 @@ class OperatorStats:
         # execution_config.default_morsel_size in explain_analyze/traces
         self.morsel_rows_min = None
         self.morsel_rows_max = None
+        # worker-thread count of this operator's pipeline stage (push
+        # executor map stages; None = single driver thread)
+        self.workers = None
         self.lock = threading.Lock()
 
     def record(self, nrows: int, dur_us: int):
@@ -158,11 +161,12 @@ class RuntimeStatsContext:
             if st is None:
                 lines.append(f"{pad}{type(node).__name__}")
             else:
+                wk = f" workers={st.workers}" if st.workers else ""
                 lines.append(
                     f"{pad}{st.name}: rows_out={st.rows_out} "
                     f"batches={st.batches_out} "
                     f"total={st.inclusive_us / 1e6:.3f}s "
-                    f"self={self.exclusive_us(key) / 1e6:.3f}s")
+                    f"self={self.exclusive_us(key) / 1e6:.3f}s{wk}")
             for c in node.children:
                 walk(c, depth + 1)
 
@@ -186,6 +190,7 @@ class RuntimeStatsContext:
             out[name] = {"rows_out": st.rows_out,
                          "morsel_rows_min": st.morsel_rows_min,
                          "morsel_rows_max": st.morsel_rows_max,
+                         "workers": st.workers,
                          "batches_out": st.batches_out,
                          "inclusive_us": st.inclusive_us,
                          "exclusive_us": self.exclusive_us(key)}

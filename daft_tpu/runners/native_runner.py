@@ -19,6 +19,16 @@ from ..physical.translate import translate
 from .runner import Runner
 
 
+def make_local_executor(cfg) -> LocalExecutor:
+    """Engine pick: the push-based morsel pipeline (default), or the
+    pull-generator interpreter via ``local_executor="interp"`` /
+    ``DAFT_LOCAL_EXECUTOR=interp``."""
+    if getattr(cfg, "local_executor", "push") == "interp":
+        return LocalExecutor()
+    from ..execution.pipeline import PushExecutor
+    return PushExecutor()
+
+
 class NativeRunner(Runner):
     name = "native"
 
@@ -31,7 +41,7 @@ class NativeRunner(Runner):
             return
         optimized = builder.optimize()
         pplan = translate(optimized.plan)
-        executor = LocalExecutor()
+        executor = make_local_executor(cfg)
         yield from executor.run(pplan)
 
     # ------------------------------------------------------------- AQE
@@ -53,7 +63,7 @@ class NativeRunner(Runner):
             target = _pick_join_input(plan)
             if target is None:
                 break
-            ex = LocalExecutor()
+            ex = make_local_executor(cfg)
             ex._aqe_planner = planner
             # spill-bounded, like the normal join-build path: the loop
             # eventually materializes the largest fact side, which must not
@@ -67,7 +77,7 @@ class NativeRunner(Runner):
                 f"actual) → re-optimized remainder", rows, size)
             plan = _replace_subtree(plan, target, src)
             plan = Optimizer().optimize(plan)
-        ex = LocalExecutor()
+        ex = make_local_executor(cfg)
         ex._aqe_planner = planner
         planner.final_plan = translate(plan)
         yield from ex.run(planner.final_plan)

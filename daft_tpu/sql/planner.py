@@ -121,12 +121,14 @@ class _SubCtx:
     resolution plus what the unnesting rewrite needs (see
     ``logical/subquery.py``)."""
 
-    __slots__ = ("outer_scope", "corr", "deferred_aggs", "value_names",
-                 "owned", "cte_depth")
+    __slots__ = ("outer_scope", "corr", "resid", "deferred_aggs",
+                 "value_names", "owned", "cte_depth")
 
     def __init__(self, outer_scope: Scope, cte_depth: int = 0):
         self.outer_scope = outer_scope
         self.corr = []            # [(inner_expr, outer_expr)]
+        self.resid = []           # correlated NON-equality conjuncts
+        #                           (outer_col markers intact)
         self.deferred_aggs = []   # select exprs when agg is deferred
         self.value_names = []     # projected output names of the sub root
         self.owned = False        # claimed by the subquery's root SELECT
@@ -366,21 +368,33 @@ class SQLPlanner:
                 ctes[name.lower()] = sub
                 if not self._kw(","):
                     break
-        left = self._select(ctes)
+        left = self._select_operand(ctes)
         while self._peek_kw("UNION") or self._peek_kw("INTERSECT") \
                 or self._peek_kw("EXCEPT"):
             if self._kw("UNION"):
                 all_ = self._kw("ALL")
-                right = self._select(ctes)
+                right = self._select_operand(ctes)
                 left = left.union_all(right) if all_ else left.union(right)
             elif self._kw("INTERSECT"):
-                right = self._select(ctes)
+                right = self._select_operand(ctes)
                 left = left.intersect(right)
             else:
                 self._kw("EXCEPT")
-                right = self._select(ctes)
+                right = self._select_operand(ctes)
                 left = left.except_distinct(right)
         return left
+
+    def _select_operand(self, ctes):
+        """One set-operation operand: a SELECT, or a parenthesized query
+        — ``(SELECT …) EXCEPT (SELECT …)`` — which may itself hold set
+        ops."""
+        if self._peek().text == "(" and \
+                self._peek(1).text.upper() in ("SELECT", "WITH", "("):
+            self._kw("(")
+            sub = self._query(dict(ctes))
+            self._expect(")")
+            return sub
+        return self._select(ctes)
 
     def _select(self, ctes):
         from ..dataframe import DataFrame
@@ -447,12 +461,39 @@ class SQLPlanner:
         if self._kw("WHERE"):
             where = self._expr(scope)
         group_by = []
+        grouping_sets = None  # list of key-lists when ROLLUP/CUBE/SETS used
         if self._kw("GROUP"):
             self._expect("BY")
+            items = []
             while True:
-                group_by.append(self._expr(scope))
+                if self._peek_kw("ROLLUP", "("):
+                    self._kw("ROLLUP", "(")
+                    items.append(("rollup", self._expr_list(scope)))
+                    self._expect(")")
+                elif self._peek_kw("CUBE", "("):
+                    self._kw("CUBE", "(")
+                    items.append(("cube", self._expr_list(scope)))
+                    self._expect(")")
+                elif self._peek_kw("GROUPING", "SETS", "("):
+                    self._kw("GROUPING", "SETS", "(")
+                    sets = []
+                    while True:
+                        if self._kw("("):
+                            ks = [] if self._peek().text == ")" \
+                                else self._expr_list(scope)
+                            self._expect(")")
+                            sets.append(ks)
+                        else:
+                            sets.append([self._expr(scope)])
+                        if not self._kw(","):
+                            break
+                    self._expect(")")
+                    items.append(("sets", sets))
+                else:
+                    items.append(("plain", [self._expr(scope)]))
                 if not self._kw(","):
                     break
+            group_by, grouping_sets = _expand_group_items(items)
         having = None
         if self._kw("HAVING"):
             having = self._expr(scope)
@@ -531,49 +572,11 @@ class SQLPlanner:
                         if c not in names:
                             exprs.append(col(c))
                             names.add(c)
-        if agg_mode:
-            gb_keys = []
-            gb_out_names = []
-            out_order = []
-            for g in group_by:
-                gb_keys.append(g)
-                gb_out_names.append(g.name())
-            agg_exprs = []
-            post_names = []
-            for e in exprs:
-                inner = e._unalias()
-                if not _has_agg(e):
-                    # must be a group key (or expression thereof)
-                    post_names.append(e.name())
-                    if not any(e.structurally_eq(g) or
-                               inner.structurally_eq(g) for g in gb_keys):
-                        # allow aliased group keys
-                        pass
-                else:
-                    agg_exprs.append(e)
-                    post_names.append(e.name())
-            if having is not None:
-                agg_exprs.append(having.alias("__having__"))
-            # aliased group keys: rename via select later
-            gdf = df.groupby(*gb_keys).agg(*agg_exprs) if gb_keys \
-                else df.agg(*agg_exprs)
-            if having is not None:
-                gdf = gdf.where(col("__having__"))
-            sel = []
-            for e in exprs:
-                if _has_agg(e):
-                    sel.append(col(e.name()))
-                else:
-                    inner = e._unalias()
-                    matched = None
-                    for g in gb_keys:
-                        if inner.structurally_eq(g):
-                            matched = g.name()
-                            break
-                    sel.append(col(matched).alias(e.name()) if matched and
-                               matched != e.name() else col(e.name()
-                               if matched is None else matched))
-            df = gdf.select(*sel)
+        if agg_mode and grouping_sets is not None:
+            df = self._lower_grouping_sets(df, group_by, grouping_sets,
+                                           exprs, having)
+        elif agg_mode:
+            df = self._lower_aggregate(df, group_by, exprs, having)
         else:
             # hidden sort keys: SQL allows ordering by non-projected inputs
             hidden = []
@@ -609,6 +612,96 @@ class SQLPlanner:
             df = df.offset(offset)
         return df
 
+    def _expr_list(self, scope) -> List[Expression]:
+        out = [self._expr(scope)]
+        while self._kw(","):
+            out.append(self._expr(scope))
+        return out
+
+    def _lower_aggregate(self, df, gb_keys, exprs, having):
+        """GROUP BY lowering for ONE grouping-key set: groupby + aggregate
+        + HAVING filter + output projection (group keys by name, aggregates
+        by alias, residual expressions — literals from ROLLUP null-fill or
+        expressions over key columns — evaluated over the grouped frame)."""
+        agg_exprs = [e for e in exprs if _has_agg(e)]
+        if having is not None:
+            agg_exprs = agg_exprs + [having.alias("__having__")]
+        gdf = df.groupby(*gb_keys).agg(*agg_exprs) if gb_keys \
+            else df.agg(*agg_exprs)
+        if having is not None:
+            gdf = gdf.where(col("__having__"))
+        sel = []
+        for e in exprs:
+            if _has_agg(e):
+                sel.append(col(e.name()))
+                continue
+            inner = e._unalias()
+            matched = None
+            for g in gb_keys:
+                if inner.structurally_eq(g) or e.structurally_eq(g):
+                    matched = g.name()
+                    break
+            if matched is not None:
+                sel.append(col(matched).alias(e.name())
+                           if matched != e.name() else col(matched))
+            elif inner.op == "col" and inner.params[0] in \
+                    [g.name() for g in gb_keys]:
+                sel.append(e)  # references an aliased group key by name
+            else:
+                # literal (ROLLUP null-fill) or expression over group-key
+                # columns: evaluate against the grouped frame
+                sel.append(e)
+        return gdf.select(*sel)
+
+    def _lower_grouping_sets(self, df, all_keys, sets, exprs, having):
+        """ROLLUP / CUBE / GROUPING SETS → union of per-set aggregates
+        (reference: planner.rs:390-401 lowers ROLLUP the same way). Keys
+        absent from a set surface as typed NULLs — SQL's super-aggregate
+        rows — and ``GROUPING(key)`` resolves to a literal 0/1 per branch,
+        composing with any downstream expression for free."""
+        schema = df.schema()
+        frames = []
+        for S in sets:
+            present = list(S)
+            exprs_b = [self._subst_rollup(e, all_keys, present, schema)
+                       for e in exprs]
+            having_b = self._subst_rollup(having, all_keys, present,
+                                          schema) if having is not None \
+                else None
+            frames.append(self._lower_aggregate(df, list(S), exprs_b,
+                                                having_b))
+        out = frames[0]
+        for f in frames[1:]:
+            out = out.union_all_by_name(f)
+        return out
+
+    def _subst_rollup(self, e, all_keys, present, schema):
+        """Per-branch rewrite: GROUPING(k) → 0/1 literal; references to
+        keys OUTSIDE this grouping set → NULL cast to the key's type.
+
+        The NULL substitution applies only to PROJECTED key references —
+        never inside aggregate arguments: SQL's super-aggregate row
+        computes ``count(a)`` over the real rows (nulling there returned
+        count=0 on the grand total)."""
+        if e.op == "sql.grouping":
+            k = e.args[0]._unalias()
+            is_present = any(k.structurally_eq(p._unalias())
+                             for p in present)
+            return lit(0 if is_present else 1)
+        if e.op.startswith("agg."):
+            return e
+        u = e._unalias()
+        if any(u.structurally_eq(k._unalias()) for k in all_keys):
+            if not any(u.structurally_eq(p._unalias()) for p in present):
+                dtype = u.to_field(schema).dtype
+                return lit(None).cast(dtype).alias(e.name())
+            return e
+        if not e.args:
+            return e
+        return e.with_children([
+            self._subst_rollup(a, all_keys, present, schema)
+            for a in e.args])
+
     def _apply_where(self, df, where, sub_ctx):
         """Apply a WHERE clause: realize subquery nodes via unnest joins,
         and — inside a subquery — lift equality conjuncts that reference
@@ -638,26 +731,32 @@ class SQLPlanner:
                 plain.append(conj)
                 continue
             u = conj._unalias()
-            if sub_ctx is not None and outer and u.op == "eq" \
+            if sub_ctx is not None and outer \
                     and not subq.contains_subquery(u):
-                a, b = u.args
-                for inner, outer_e in ((a, b), (b, a)):
-                    if has_outer(inner):
+                if u.op == "eq":
+                    a, b = u.args
+                    lifted = False
+                    for inner, outer_e in ((a, b), (b, a)):
+                        if has_outer(inner):
+                            continue
+                        if subq.free_columns(inner) <= avail \
+                                and has_outer(outer_e) \
+                                and not subq.free_columns(outer_e):
+                            sub_ctx.corr.append((inner, unmark(outer_e)))
+                            lifted = True
+                            break
+                    if lifted:
                         continue
-                    if subq.free_columns(inner) <= avail \
-                            and has_outer(outer_e) \
-                            and not subq.free_columns(outer_e):
-                        sub_ctx.corr.append((inner, unmark(outer_e)))
-                        break
-                else:
-                    raise NotImplementedError(
-                        f"correlated predicate {conj!r}: only equality "
-                        "correlation (inner = outer) is supported")
-                continue
+                # non-equality correlation (e.g. EXISTS … AND inner.wh <>
+                # outer.wh, TPC-DS Q16/Q94): kept as a residual conjunct,
+                # applied by the rowid-join rewrite in logical/subquery.py
+                if subq.free_columns(conj) <= avail:
+                    sub_ctx.resid.append(conj)
+                    continue
             raise NotImplementedError(
-                f"correlated predicate {conj!r}: only equality "
-                "correlation (inner = outer, no nested subquery) is "
-                "supported")
+                f"correlated predicate {conj!r}: equality correlation or "
+                "single-level non-equality residuals (no nested subquery) "
+                "are supported")
         if not plain:
             return df
         return subq.apply_where(df, subq.and_all(plain))
@@ -675,7 +774,8 @@ class SQLPlanner:
             self._sub_stack.pop()
         return subq.SubqueryInfo(
             df, ctx.corr, ctx.deferred_aggs,
-            ctx.value_names if ctx.value_names else list(df.column_names))
+            ctx.value_names if ctx.value_names else list(df.column_names),
+            resid=ctx.resid)
 
     def _resolve_col(self, scope, name, alias=None) -> Expression:
         """Scope resolution with correlated fallback: a name unknown to the
@@ -1300,9 +1400,56 @@ def _sql_type(name: str, planner: SQLPlanner) -> DataType:
     return m[n]()
 
 
+def _expand_group_items(items):
+    """GROUP BY item list → (all keys in first-appearance order, grouping
+    sets or None). A plain-only list returns ``(keys, None)`` — the single
+    groupby fast path. Mixed items cross-product per SQL:
+    ``GROUP BY x, ROLLUP(a, b)`` → sets {x,a,b}, {x,a}, {x}."""
+    if all(kind == "plain" for kind, _ in items):
+        return [p[0] for _, p in items], None
+    import itertools as it
+    base: List[List[Expression]] = [[]]
+    for kind, payload in items:
+        if kind == "plain":
+            opts = [[payload[0]]]
+        elif kind == "rollup":
+            opts = [list(payload[:i]) for i in range(len(payload), -1, -1)]
+        elif kind == "cube":
+            opts = [list(c) for r in range(len(payload), -1, -1)
+                    for c in it.combinations(payload, r)]
+        else:  # explicit GROUPING SETS
+            opts = [list(s) for s in payload]
+        base = [b + o for b in base for o in opts]
+
+    def dedupe(ks):
+        out = []
+        for k in ks:
+            if not any(k._unalias().structurally_eq(x._unalias())
+                       for x in out):
+                out.append(k)
+        return out
+
+    all_keys = dedupe([k for kind, payload in items
+                       for k in (payload if kind != "sets"
+                                 else [x for s in payload for x in s])])
+    uniq, seen = [], set()
+    for S in base:
+        S = dedupe(S)
+        sk = tuple(sorted(a._unalias()._key() for a in S))
+        if sk not in seen:
+            seen.add(sk)
+            uniq.append(S)
+    return all_keys, uniq
+
+
 def _apply_function(fn: str, args: List[Expression],
                     distinct: bool) -> Expression:
     a = args[0] if args else None
+    if fn == "grouping":
+        # GROUPING(key) marker: resolved to a per-branch literal (0 = key
+        # grouped, 1 = super-aggregate NULL) by the ROLLUP/CUBE/GROUPING
+        # SETS lowering; reaching execution unresolved is an error.
+        return Expression("sql.grouping", (a,))
     if fn in ("sum",):
         return a.sum()
     if fn in ("avg", "mean"):

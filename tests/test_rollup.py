@@ -1,0 +1,82 @@
+"""ROLLUP / CUBE / GROUPING SETS lowering (union-of-groupbys with typed
+super-aggregate NULLs and per-branch GROUPING() literals).
+
+Reference: ``src/daft-sql/src/planner.rs:390-401`` handles ROLLUP in the
+SQL frontend; grouping-null semantics follow the SQL spec.
+"""
+
+import daft_tpu as dt
+
+
+def _t():
+    return dt.from_pydict({
+        "cat": ["a", "a", "b", "b", "b"],
+        "cls": ["x", "y", "x", "x", "y"],
+        "v": [1.0, 2.0, 3.0, 4.0, 5.0],
+    })
+
+
+def test_rollup_hierarchy_and_grouping_fn():
+    out = dt.sql(
+        "SELECT cat, cls, SUM(v) AS s, "
+        "GROUPING(cat) + GROUPING(cls) AS lvl "
+        "FROM t GROUP BY ROLLUP(cat, cls) ORDER BY lvl, cat, cls",
+        t=_t()).to_pydict()
+    rows = list(zip(out["cat"], out["cls"], out["s"], out["lvl"]))
+    assert rows == [
+        ("a", "x", 1.0, 0), ("a", "y", 2.0, 0),
+        ("b", "x", 7.0, 0), ("b", "y", 5.0, 0),
+        ("a", None, 3.0, 1), ("b", None, 12.0, 1),
+        (None, None, 15.0, 2)]
+
+
+def test_cube_all_subsets():
+    out = dt.sql("SELECT cat, cls, SUM(v) AS s FROM t "
+                 "GROUP BY CUBE(cat, cls) ORDER BY s",
+                 t=_t()).to_pydict()
+    # 4 detail + 2 cat supers + 2 cls supers + 1 grand total
+    assert len(out["s"]) == 9
+    assert max(out["s"]) == 15.0
+    assert out["cat"].count(None) == 3  # (cls-only) x2 + grand total
+
+
+def test_grouping_sets_explicit():
+    out = dt.sql("SELECT cat, cls, SUM(v) AS s FROM t "
+                 "GROUP BY GROUPING SETS ((cat), (cls), ()) ORDER BY s",
+                 t=_t()).to_pydict()
+    assert sorted(s for s in out["s"]) == [3.0, 7.0, 8.0, 12.0, 15.0]
+    # the () set contributes the grand total with both keys NULL
+    i = out["s"].index(15.0)
+    assert out["cat"][i] is None and out["cls"][i] is None
+
+
+def test_rollup_with_plain_key_cross_product():
+    out = dt.sql("SELECT cat, cls, COUNT(*) AS n FROM t "
+                 "GROUP BY cat, ROLLUP(cls) ORDER BY cat, cls",
+                 t=_t()).to_pydict()
+    # per-(cat,cls) rows plus one (cat, NULL) subtotal per cat
+    assert out["cls"].count(None) == 2
+    total = sum(n for n, c in zip(out["n"], out["cls"]) if c is None)
+    assert total == 5
+
+
+def test_rollup_having_applies_per_branch():
+    out = dt.sql("SELECT cat, SUM(v) AS s FROM t "
+                 "GROUP BY ROLLUP(cat) HAVING SUM(v) > 4 ORDER BY s",
+                 t=_t()).to_pydict()
+    assert out["s"] == [12.0, 15.0]
+
+
+def test_super_aggregate_counts_real_rows():
+    """Aggregating a column that is ALSO a rollup key: the grand-total row
+    counts real rows (the substitution must stop at agg boundaries)."""
+    out = dt.sql("SELECT cat, COUNT(cat) AS c FROM t "
+                 "GROUP BY ROLLUP(cat) ORDER BY cat", t=_t()).to_pydict()
+    assert out["c"] == [2, 3, 5]
+    assert out["cat"] == ["a", "b", None]
+
+
+def test_plain_group_by_unchanged():
+    out = dt.sql("SELECT cat, SUM(v) AS s FROM t GROUP BY cat ORDER BY cat",
+                 t=_t()).to_pydict()
+    assert out == {"cat": ["a", "b"], "s": [3.0, 12.0]}
