@@ -183,7 +183,14 @@ def _semi_anti_residual(df, info: SubqueryInfo, anti: bool,
 
     def fix_inner(e: Expression) -> Expression:
         if e.op == "col":
-            return col(ren.get(e.params[0], e.params[0]))
+            if e.params[0] not in ren:
+                # a silent fall-through here would resolve against the
+                # OUTER frame and compare a column to itself
+                raise ValueError(
+                    f"residual-correlation rewrite: inner column "
+                    f"{e.params[0]!r} missing from the subquery's "
+                    f"projection {sorted(ren)}")
+            return col(ren[e.params[0]])
         if e.op == "outer_col":
             return col(e.params[0])
         if not e.args:
@@ -281,6 +288,20 @@ def _guard_single_row(rdf, name: str):
     return one.select(_check_single(col(name), col(cnt)).alias(name))
 
 
+def realize_scalars(df, e: Expression) -> Tuple[object, Expression]:
+    """Attach every scalar subquery nested in ``e`` onto ``df`` (cross
+    join for uncorrelated, grouped left join for correlated) and return
+    (new df, e with each subquery node replaced by its attached column).
+    The single entry point for scalar realization — WHERE conjuncts, the
+    SELECT list, and post-aggregation projections all route here."""
+    while True:
+        node = _find_scalar(e)
+        if node is None:
+            return df, e
+        df, name = _attach_scalar(df, node)
+        e = _replace_node(e, node, col(name))
+
+
 def _rewrite_conjunct(df, conj: Expression) -> Tuple[Optional[Expression],
                                                      object]:
     """Realize the subquery nodes of one conjunct against df. Returns
@@ -297,13 +318,7 @@ def _rewrite_conjunct(df, conj: Expression) -> Tuple[Optional[Expression],
             raise NotImplementedError("subquery inside IN's left operand")
         return None, _semi_anti(df, u.params[0], anti=neg, lhs=u.args[0])
     # scalar subqueries nested anywhere in the conjunct
-    out = conj
-    while True:
-        node = _find_scalar(out)
-        if node is None:
-            break
-        df, name = _attach_scalar(df, node)
-        out = _replace_node(out, node, col(name))
+    df, out = realize_scalars(df, conj)
     return out, df
 
 

@@ -542,10 +542,13 @@ class SQLPlanner:
         from ..logical import subquery as subq
         if where is not None:
             df = self._apply_where(df, where, sub_ctx)
-        if having is not None and subq.contains_subquery(having):
-            raise NotImplementedError("subquery in HAVING")
         agg_mode = bool(group_by) or any(_has_agg(e) for e in exprs) \
             or (having is not None and _has_agg(having))
+        if having is not None and not agg_mode:
+            # HAVING binds to a grouped query; without GROUP BY or any
+            # aggregate, silently dropping it would return unfiltered rows
+            raise NotImplementedError(
+                "HAVING without GROUP BY or aggregates")
         if sub_ctx is not None:
             sub_ctx.value_names = [e.name() for e in exprs]
             if sub_ctx.corr and agg_mode:
@@ -563,21 +566,49 @@ class SQLPlanner:
                         "HAVING/DISTINCT/ORDER BY/LIMIT")
                 sub_ctx.deferred_aggs = exprs
                 return df
-            if sub_ctx.corr and not agg_mode:
-                # the correlation keys must survive the projection for the
-                # unnest join (e.g. EXISTS(SELECT 1 FROM t WHERE k = outer))
+            if (sub_ctx.corr or sub_ctx.resid) and not agg_mode:
+                # the correlation keys AND any inner columns the residual
+                # predicates reference must survive the projection for the
+                # unnest join (e.g. EXISTS(SELECT 1 FROM t WHERE k = outer
+                # AND t.wh <> outer.wh) needs t.wh)
                 names = {e.name() for e in exprs}
+                needed = set()
                 for inner, _ in sub_ctx.corr:
-                    for c in sorted(subq.free_columns(inner)):
-                        if c not in names:
-                            exprs.append(col(c))
-                            names.add(c)
+                    needed |= subq.free_columns(inner)
+                for r in sub_ctx.resid:
+                    needed |= subq.free_columns(r)  # col() refs only —
+                    # outer_col markers are a distinct op, not collected
+                avail_here = set(df.column_names)
+                for c in sorted(needed):
+                    if c not in names and c in avail_here:
+                        exprs.append(col(c))
+                        names.add(c)
         if agg_mode and grouping_sets is not None:
             df = self._lower_grouping_sets(df, group_by, grouping_sets,
                                            exprs, having)
         elif agg_mode:
-            df = self._lower_aggregate(df, group_by, exprs, having)
+            # select-list scalar subqueries in an aggregating query attach
+            # POST-aggregation (they are uncorrelated 1-row values; a
+            # correlated one would need the pre-agg frame — unsupported)
+            sub_exprs = [e for e in exprs if subq.contains_subquery(e)]
+            if sub_exprs:
+                for e in sub_exprs:
+                    if _has_agg(e):
+                        raise NotImplementedError(
+                            "select item mixing aggregates and scalar "
+                            "subqueries")
+                placeholders = {id(e): lit(None).alias(e.name())
+                                for e in sub_exprs}
+                df = self._lower_aggregate(
+                    df, group_by,
+                    [placeholders.get(id(e), e) for e in exprs], having)
+                df = self._attach_select_subqueries(
+                    df, exprs, only_ids={id(e) for e in sub_exprs})
+            else:
+                df = self._lower_aggregate(df, group_by, exprs, having)
         else:
+            if any(subq.contains_subquery(e) for e in exprs):
+                df, exprs = self._inline_select_subqueries(df, exprs)
             # hidden sort keys: SQL allows ordering by non-projected inputs
             hidden = []
             if order_by:
@@ -612,6 +643,36 @@ class SQLPlanner:
             df = df.offset(offset)
         return df
 
+    def _attach_select_subqueries(self, df, exprs, only_ids):
+        """Post-aggregation realization of select-list scalar subqueries:
+        the aggregate was lowered with NULL placeholders for these items;
+        attach each subquery's 1-row value (cross join) and re-project the
+        output in order (reference: subqueries are plain Expr variants
+        usable anywhere, ``src/daft-dsl/src/expr/mod.rs:213-292``)."""
+        from ..logical import subquery as subq
+        final = []
+        for e in exprs:
+            if id(e) in only_ids:
+                name = e.name()
+                df, e = subq.realize_scalars(df, e)
+                final.append(e._unalias().alias(name))
+            else:
+                final.append(col(e.name()))
+        return df.select(*final)
+
+    def _inline_select_subqueries(self, df, exprs):
+        """Pre-projection realization for non-aggregating selects:
+        supports correlated subqueries too (the outer frame is intact)."""
+        from ..logical import subquery as subq
+        out = []
+        for e in exprs:
+            if subq.contains_subquery(e):
+                name = e.name()
+                df, e = subq.realize_scalars(df, e)
+                e = e._unalias().alias(name)
+            out.append(e)
+        return df, out
+
     def _expr_list(self, scope) -> List[Expression]:
         out = [self._expr(scope)]
         while self._kw(","):
@@ -622,13 +683,37 @@ class SQLPlanner:
         """GROUP BY lowering for ONE grouping-key set: groupby + aggregate
         + HAVING filter + output projection (group keys by name, aggregates
         by alias, residual expressions — literals from ROLLUP null-fill or
-        expressions over key columns — evaluated over the grouped frame)."""
+        expressions over key columns — evaluated over the grouped frame).
+
+        A HAVING with subqueries (TPC-H Q11's ``HAVING SUM(…) > (SELECT
+        …)``) splits: its aggregate subtrees become hidden agg outputs and
+        the residual predicate — subqueries included — applies as a WHERE
+        over the grouped frame via the unnest machinery."""
+        from ..logical import subquery as subq
         agg_exprs = [e for e in exprs if _has_agg(e)]
+        having_resid = None
         if having is not None:
-            agg_exprs = agg_exprs + [having.alias("__having__")]
+            if subq.contains_subquery(having):
+                hidden_aggs: List[Expression] = []
+
+                def pull_aggs(e):
+                    if e.op.startswith("agg."):
+                        nm = f"__hv{len(hidden_aggs)}__"
+                        hidden_aggs.append(e.alias(nm))
+                        return col(nm)
+                    if not e.args:
+                        return e
+                    return e.with_children([pull_aggs(a) for a in e.args])
+
+                having_resid = pull_aggs(having)
+                agg_exprs = agg_exprs + hidden_aggs
+            else:
+                agg_exprs = agg_exprs + [having.alias("__having__")]
         gdf = df.groupby(*gb_keys).agg(*agg_exprs) if gb_keys \
             else df.agg(*agg_exprs)
-        if having is not None:
+        if having_resid is not None:
+            gdf = subq.apply_where(gdf, having_resid)
+        elif having is not None:
             gdf = gdf.where(col("__having__"))
         sel = []
         for e in exprs:
@@ -914,6 +999,26 @@ class SQLPlanner:
         unrename = {v: k for k, v in (rename or {}).items()}
         ro_names = [e.name() for e in ro]
         lo_names = [e.name() for e in lo]
+        if residual is not None and how in ("left", "right", "outer"):
+            # an outer join's ON residual filters the MATCH, not the rows:
+            # a side-local residual pre-filters that side (equivalent);
+            # a both-sides residual would need true theta-join support
+            resid_cols = set(residual.column_names())
+            if how == "left" and resid_cols <= set(rdf.column_names):
+                rdf = rdf.where(residual)
+                residual = None
+            elif how == "right" and resid_cols <= set(df.column_names):
+                df = df.where(residual)
+                residual = None
+            else:
+                which = "both sides" if not (
+                    set(residual.column_names()) <= set(rdf.column_names)
+                    or set(residual.column_names())
+                    <= set(df.column_names)) else "the preserved side"
+                raise NotImplementedError(
+                    f"{how} join ON residual referencing {which} — needs "
+                    f"true theta-join support (a residual on the "
+                    f"filtered side pre-applies; this one cannot)")
         if how == "cross":
             out = df.join(rdf, how="cross")
         else:

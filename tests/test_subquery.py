@@ -105,10 +105,44 @@ def test_nested_in_with_correlated_scalar(shop):
     assert out == {"c_name": ["ann", "cat"]}
 
 
-def test_subquery_in_select_list_raises(shop):
-    with pytest.raises((ValueError, NotImplementedError)):
-        dt.sql("SELECT (SELECT max(o_total) FROM orders) FROM cust",
-               **shop).to_pydict()
+def test_subquery_in_select_list(shop):
+    out = dt.sql("SELECT c_name, (SELECT max(o_total) FROM orders) AS m "
+                 "FROM cust ORDER BY c_name", **shop).to_pydict()
+    assert out["m"] == [55.0] * 4
+    assert out["c_name"] == ["ann", "bob", "cat", "dan"]
+
+
+def test_correlated_subquery_in_select_list(shop):
+    out = dt.sql(
+        "SELECT c_name, (SELECT SUM(o_total) FROM orders "
+        "WHERE o_cust = c_id) AS t FROM cust ORDER BY c_name",
+        **shop).to_pydict()
+    assert out["t"] == [50.0, 7.0, 60.0, None]
+
+
+def test_subquery_in_select_list_of_aggregate(shop):
+    out = dt.sql(
+        "SELECT COUNT(*) AS n, (SELECT max(o_total) FROM orders) AS m "
+        "FROM cust", **shop).to_pydict()
+    assert out == {"n": [4], "m": [55.0]}
+
+
+def test_having_subquery(shop):
+    out = dt.sql(
+        "SELECT o_cust, SUM(o_total) AS s FROM orders GROUP BY o_cust "
+        "HAVING SUM(o_total) > (SELECT AVG(o_total) FROM orders) "
+        "ORDER BY o_cust", **shop).to_pydict()
+    assert out == {"o_cust": [1, 3], "s": [50.0, 60.0]}
+
+
+def test_exists_with_nonequality_residual(shop):
+    # another order by the SAME customer with a different total
+    out = dt.sql(
+        "SELECT o_id FROM orders o1 WHERE EXISTS ("
+        "SELECT 1 FROM orders o2 WHERE o2.o_cust = o1.o_cust "
+        "AND o2.o_total <> o1.o_total) ORDER BY o_id",
+        **shop).to_pydict()
+    assert out["o_id"] == [10, 11, 13, 14]
 
 
 def test_exists_nested_in_or_raises(shop):

@@ -1,0 +1,47 @@
+"""All 22 TPC-H queries as SQL text match their DataFrame forms
+(reference ships the SQL set in ``benchmarking/tpch/queries/*.sql``;
+here ``benchmarking/tpch/sql_queries.py``). The two frontends share
+parameters, so row values must agree exactly (floats to 1e-6)."""
+
+import pytest
+
+import daft_tpu as dt
+from benchmarking.tpch import queries as DFQ, sql_queries as SQ
+from benchmarking.tpch.datagen import generate_tpch
+
+
+@pytest.fixture(scope="module")
+def tpch(tmp_path_factory):
+    root = tmp_path_factory.mktemp("tpch_sql")
+    generate_tpch(str(root), 0.05, 2)
+
+    def get_df(name):
+        return dt.read_parquet(f"{root}/{name}/*.parquet")
+
+    return get_df
+
+
+def _rows(d):
+    cols = list(d.values())
+    return [tuple(c[i] for c in cols) for i in range(len(cols[0]))] \
+        if cols else []
+
+
+def _close(a, b):
+    if isinstance(a, float) and isinstance(b, float):
+        return a == pytest.approx(b, rel=1e-6, abs=1e-6)
+    return a == b
+
+
+@pytest.mark.parametrize("qnum", sorted(SQ.ALL))
+def test_sql_matches_dataframe(tpch, qnum):
+    sql_out = SQ.run(qnum, tpch).to_pydict()
+    df_out = getattr(DFQ, f"q{qnum}")(tpch).to_pydict()
+    srows, drows = _rows(sql_out), _rows(df_out)
+    assert len(srows) == len(drows), \
+        f"q{qnum}: {len(srows)} SQL rows vs {len(drows)} DataFrame rows"
+    # same column COUNT (names may differ; the spec fixes the order)
+    for i, (sr, dr) in enumerate(zip(srows, drows)):
+        assert len(sr) == len(dr), f"q{qnum} row {i}: width {sr} vs {dr}"
+        for a, b in zip(sr, dr):
+            assert _close(a, b), f"q{qnum} row {i}: {sr} vs {dr}"
