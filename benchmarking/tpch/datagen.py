@@ -197,7 +197,12 @@ def generate_tpch(root: str, scale_factor: float = 0.01,
     write("lineitem", pa.table({
         "l_orderkey": l_orderkey,
         "l_partkey": partkey,
-        "l_suppkey": ((partkey + linenumber) % n_supp) + 1,
+        # spec 4.2.3: a lineitem's supplier is one of its part's FOUR
+        # partsupp suppliers (same formula as ps_supp with j = ln % 4);
+        # an independent draw made (l_partkey, l_suppkey) match partsupp
+        # with probability ~0 and emptied every partsupp⨝lineitem join
+        "l_suppkey": ((partkey - 1 + (linenumber % 4)
+                       * (n_supp // 4 + 1)) % n_supp) + 1,
         "l_linenumber": linenumber,
         "l_quantity": qty,
         "l_extendedprice": price,

@@ -72,6 +72,10 @@ class LocalExecutor:
         # stage-input bindings for distributed stage fragments
         self.stage_inputs = {}
         self._aqe_planner = None
+        # shared-subplan result buffers (multi-consumer physical nodes)
+        import threading as _th
+        self._shared = {}
+        self._shared_lock = _th.Lock()
 
     def _aqe(self):
         if self._aqe_planner is None:
@@ -107,6 +111,11 @@ class LocalExecutor:
 
     # ------------------------------------------------------------------
     def _exec(self, node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
+        if getattr(node, "shared_consumers", 1) > 1:
+            return self._shared_stream(node)
+        return self._exec_node(node)
+
+    def _exec_node(self, node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         h = getattr(self, "_exec_" + type(node).__name__, None)
         if h is None:
             raise NotImplementedError(f"executor for {type(node).__name__}")
@@ -114,6 +123,35 @@ class LocalExecutor:
         if self.stats is not None:
             it = self.stats.instrument(node, it)
         return it
+
+    def _shared_stream(self, node) -> Iterator[MicroPartition]:
+        """A subplan with multiple consumers executes ONCE into a
+        breaker-budget buffer; every consumer streams the buffered
+        partitions (reference: common-subplan reuse in the physical
+        planner). Thread-safe: the push executor's consumer stages race
+        here — the first builds, the rest wait on its completion."""
+        import threading
+        from . import memory
+        with self._shared_lock:
+            ent = self._shared.get(id(node))
+            build = ent is None
+            if build:
+                ent = {"done": threading.Event(), "buf": None, "err": None}
+                self._shared[id(node)] = ent
+        if build:
+            try:
+                ent["buf"] = memory.materialize(
+                    self._exec_node(node), memory.breaker_budget_bytes())
+            except BaseException as exc:  # noqa: BLE001
+                ent["err"] = exc
+                raise
+            finally:
+                ent["done"].set()
+        else:
+            ent["done"].wait()
+            if ent["err"] is not None:
+                raise ent["err"]
+        return iter(ent["buf"])
 
     # sources ----------------------------------------------------------
     def _morselize(self, stream: Iterator) -> Iterator:
@@ -612,7 +650,7 @@ class LocalExecutor:
                 for i, piece in enumerate(
                         mp.partition_by_range(by, boundaries, desc)):
                     if len(piece):
-                        store.push(i, piece.combined().to_arrow_table())
+                        store.push(i, piece.combined())
             buf.close()  # input spill frees before bucket reads begin
             store.finalize()
             yield from self._emit_buckets(store, schema)
@@ -621,20 +659,16 @@ class LocalExecutor:
 
     def _emit_buckets(self, store, schema, groups=None):
         """One MicroPartition per bucket (or per GROUP of consecutive
-        buckets, for AQE-coalesced shuffles)."""
-        import pyarrow as pa
-        arrow_schema = schema.to_arrow()
+        buckets, for AQE-coalesced shuffles). Resident batches pass
+        through without any Arrow round-trip; consumers combine lazily."""
         for grp in (groups if groups is not None
                     else [[i] for i in range(store.n)]):
-            tables = []
+            batches = []
             for i in grp:
-                tables.extend(store.bucket_tables(i))
-            tables = [t for t in tables if t.num_rows]
-            if tables:
-                t = pa.concat_tables(tables, promote_options="permissive") \
-                    if len(tables) > 1 else tables[0]
-                yield MicroPartition.from_recordbatch(
-                    RecordBatch.from_arrow_table(t).cast_to_schema(schema))
+                batches.extend(store.bucket_batches(i))
+            batches = [b for b in batches if len(b)]
+            if batches:
+                yield MicroPartition.from_recordbatches(batches, schema)
             else:
                 yield MicroPartition.empty(schema)
 
@@ -728,7 +762,7 @@ class LocalExecutor:
                                    else self._exec(node.children[0])):
                 for j, piece in enumerate(fan(mp, i)):
                     if len(piece):
-                        store.push(j, piece.combined().to_arrow_table())
+                        store.push(j, piece.combined())
             store.finalize()
             groups = None
             if self.cfg.enable_aqe \
@@ -970,7 +1004,7 @@ class LocalExecutor:
         for mp in stream:
             for j, piece in enumerate(mp.partition_by_hash(by, n)):
                 if len(piece):
-                    store.push(j, piece.combined().to_arrow_table())
+                    store.push(j, piece.combined())
         store.finalize()
         return store
 

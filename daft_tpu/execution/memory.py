@@ -242,16 +242,20 @@ class PartitionedSpillStore:
             self._writers[i] = w
         return w[0]
 
-    def push(self, i: int, table) -> None:
-        nb = table.nbytes
+    def push(self, i: int, batch) -> None:
+        """Append a RecordBatch to bucket i. Resident batches stay AS-IS
+        (no Arrow conversion on the hot path); conversion happens only
+        when a bucket spills."""
+        nb = batch.size_bytes()
         with self._lock:
-            self.rows[i] += table.num_rows
+            self.rows[i] += len(batch)
             self.nbytes[i] += nb
             if self._spilled[i]:
-                self._writer(i, table.schema).write_table(table)
+                t = batch.to_arrow_table()
+                self._writer(i, t.schema).write_table(t)
                 self.bytes_spilled += nb
                 return
-            self._mem[i].append(table)
+            self._mem[i].append(batch)
             self._mem_bytes_per[i] += nb
             self._mem_bytes += nb
             while self._mem_bytes > self.budget:
@@ -261,7 +265,8 @@ class PartitionedSpillStore:
                 self._spill_bucket(j)
 
     def _spill_bucket(self, j: int) -> None:
-        for t in self._mem[j]:
+        for b in self._mem[j]:
+            t = b.to_arrow_table()
             self._writer(j, t.schema).write_table(t)
         self.bytes_spilled += self._mem_bytes_per[j]
         self._mem_bytes -= self._mem_bytes_per[j]
@@ -278,9 +283,10 @@ class PartitionedSpillStore:
             self._writers = [None] * self.n
             self._sealed = True
 
-    def bucket_tables(self, i: int) -> List:
-        """All of bucket i's tables, disk batches first then resident ones
+    def bucket_batches(self, i: int) -> List:
+        """All of bucket i's RecordBatches, disk ones first then resident
         (push order: a bucket spills wholly before disk appends begin)."""
+        from ..recordbatch import RecordBatch
         assert self._sealed, "finalize() before reading buckets"
         out = []
         if self._spilled[i] and os.path.exists(self._path(i)):
@@ -290,7 +296,7 @@ class PartitionedSpillStore:
                         r = paipc.open_stream(f)
                     except Exception:
                         break
-                    out.append(r.read_all())
+                    out.append(RecordBatch.from_arrow_table(r.read_all()))
         out.extend(self._mem[i])
         return out
 

@@ -252,7 +252,9 @@ class PushExecutor(LocalExecutor):
         return gen()
 
     # ------------------------------------------------------------ stages
-    def _exec(self, node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
+    # _exec (inherited) routes multi-consumer nodes through the shared
+    # buffer; everything else lands here and becomes a stage
+    def _exec_node(self, node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         kernel = _map_kernel(node)
         if kernel is not None:
             out = self._map_stage(node, kernel)

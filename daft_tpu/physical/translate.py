@@ -23,12 +23,44 @@ _NON_DECOMPOSABLE = {"count_distinct", "approx_count_distinct",
                      "approx_percentiles", "skew", "set"}
 
 
+import threading as _threading
+
+_tl = _threading.local()
+
+
 def translate(plan: lp.LogicalPlan) -> pp.PhysicalPlan:
+    """Logical → physical, deduplicating SHARED subplans: logically equal
+    subtrees (by ``semantic_id``) map to one physical node whose
+    ``shared_consumers`` counts its parents — the executor materializes it
+    once and streams the buffer to every consumer (TPC-H Q21's ``base``
+    chain and late-lineitem dedup otherwise execute 2-3× each)."""
     cfg = get_context().execution_config
-    return _t(plan, cfg)
+    fresh = not getattr(_tl, "active", False)
+    if fresh:
+        _tl.active = True
+        _tl.memo = {}
+    try:
+        return _t(plan, cfg)
+    finally:
+        if fresh:
+            _tl.active = False
+            _tl.memo = {}
 
 
 def _t(node: lp.LogicalPlan, cfg) -> pp.PhysicalPlan:
+    if getattr(_tl, "active", False):
+        key = node.semantic_id()
+        hit = _tl.memo.get(key)
+        if hit is not None:
+            hit.shared_consumers = getattr(hit, "shared_consumers", 1) + 1
+            return hit
+        out = _t_node(node, cfg)
+        _tl.memo[key] = out
+        return out
+    return _t_node(node, cfg)
+
+
+def _t_node(node: lp.LogicalPlan, cfg) -> pp.PhysicalPlan:
     if isinstance(node, lp.Source):
         if node.partitions is not None:
             return pp.InMemorySource(node.partitions, node.schema())
@@ -131,9 +163,15 @@ def _estimate_size(node: lp.LogicalPlan) -> Optional[int]:
         return None if base is None else int(base * 0.2)
     if isinstance(node, lp.Limit):
         return 1024 * node.limit  # rough
-    if isinstance(node, (lp.Aggregate, lp.Distinct)):
+    if isinstance(node, lp.Aggregate):
         base = _estimate_size(node.children[0])
         return None if base is None else max(int(base * 0.05), 1024)
+    if isinstance(node, lp.Distinct):
+        # DISTINCT on key columns often barely reduces (TPC-H Q21's
+        # (orderkey, suppkey) pairs: 6M → 6M rows); pricing it like an
+        # aggregation mispredicted a 100MB build side as broadcastable
+        base = _estimate_size(node.children[0])
+        return None if base is None else max(int(base * 0.5), 1024)
     if node.children:
         sizes = [_estimate_size(c) for c in node.children]
         if any(s is None for s in sizes):

@@ -583,29 +583,29 @@ class SQLPlanner:
                     if c not in names and c in avail_here:
                         exprs.append(col(c))
                         names.add(c)
-        if agg_mode and grouping_sets is not None:
-            df = self._lower_grouping_sets(df, group_by, grouping_sets,
-                                           exprs, having)
-        elif agg_mode:
+        if agg_mode:
             # select-list scalar subqueries in an aggregating query attach
             # POST-aggregation (they are uncorrelated 1-row values; a
-            # correlated one would need the pre-agg frame — unsupported)
+            # correlated one would need the pre-agg frame — unsupported).
+            # Applies to plain GROUP BY and ROLLUP/GROUPING SETS alike.
             sub_exprs = [e for e in exprs if subq.contains_subquery(e)]
+            for e in sub_exprs:
+                if _has_agg(e):
+                    raise NotImplementedError(
+                        "select item mixing aggregates and scalar "
+                        "subqueries")
+            placeholders = {id(e): lit(None).alias(e.name())
+                            for e in sub_exprs}
+            lower_exprs = [placeholders.get(id(e), e) for e in exprs]
+            if grouping_sets is not None:
+                df = self._lower_grouping_sets(df, group_by, grouping_sets,
+                                               lower_exprs, having)
+            else:
+                df = self._lower_aggregate(df, group_by, lower_exprs,
+                                           having)
             if sub_exprs:
-                for e in sub_exprs:
-                    if _has_agg(e):
-                        raise NotImplementedError(
-                            "select item mixing aggregates and scalar "
-                            "subqueries")
-                placeholders = {id(e): lit(None).alias(e.name())
-                                for e in sub_exprs}
-                df = self._lower_aggregate(
-                    df, group_by,
-                    [placeholders.get(id(e), e) for e in exprs], having)
                 df = self._attach_select_subqueries(
                     df, exprs, only_ids={id(e) for e in sub_exprs})
-            else:
-                df = self._lower_aggregate(df, group_by, exprs, having)
         else:
             if any(subq.contains_subquery(e) for e in exprs):
                 df, exprs = self._inline_select_subqueries(df, exprs)

@@ -11,8 +11,13 @@ reruns everything on the pure host tier.
 backend instead (no CPU forcing, no virtual mesh): an opt-in pass that
 catches TPU-only numerics (f32 accumulation, int64 emulation) the CPU
 backend hides. Budget warning: first compiles of each shape are remote
-(10–160 s) — run a targeted subset, e.g.
-``DAFT_TPU_REAL_DEVICE=1 pytest tests/test_tpch.py tests/test_exchange.py``.
+(10–160 s; amortized across processes by the persistent XLA compilation
+cache, ``daft_tpu/device/backend.py``) — the standard opt-in set is::
+
+    DAFT_TPU_REAL_DEVICE=1 pytest tests/test_tpch.py \
+        tests/test_exchange.py tests/test_device_join.py \
+        tests/test_bigint_device.py tests/test_window_device.py \
+        tests/test_datatypes.py
 """
 
 import os
