@@ -40,13 +40,17 @@ def _probe_body() -> None:
     try:
         import jax
 
+        _backend = jax.default_backend()
+
         # persistent XLA compilation cache: suite runs stop paying the
         # (remote, 10-160s) compile for every (bucket, dtype, op) shape a
         # fresh process touches — the round-3 device suite lost to its own
-        # host fallback largely on warm-compile tax. Opt out with
-        # DAFT_TPU_COMPILATION_CACHE=0 or point it elsewhere via =path.
+        # host fallback largely on warm-compile tax. TPU-only: CPU AOT
+        # artifacts are machine-feature-pinned and reload with SIGILL-risk
+        # warnings across hosts. Opt out with DAFT_TPU_COMPILATION_CACHE=0
+        # or point it elsewhere via =path.
         cache = os.environ.get("DAFT_TPU_COMPILATION_CACHE", "")
-        if cache != "0":
+        if cache != "0" and _backend == "tpu":
             path = cache or os.path.join(
                 os.path.expanduser("~"), ".cache", "daft_tpu_xla")
             try:
@@ -56,8 +60,6 @@ def _probe_body() -> None:
                     "jax_persistent_cache_min_compile_time_secs", 0.5)
             except Exception:
                 pass  # older jax without the knob: in-memory cache only
-
-        _backend = jax.default_backend()
     except Exception:
         _failed = True
     finally:

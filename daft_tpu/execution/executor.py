@@ -136,7 +136,8 @@ class LocalExecutor:
             ent = self._shared.get(id(node))
             build = ent is None
             if build:
-                ent = {"done": threading.Event(), "buf": None, "err": None}
+                ent = {"done": threading.Event(), "buf": None, "err": None,
+                       "remaining": getattr(node, "shared_consumers", 2)}
                 self._shared[id(node)] = ent
         if build:
             try:
@@ -151,7 +152,22 @@ class LocalExecutor:
             ent["done"].wait()
             if ent["err"] is not None:
                 raise ent["err"]
-        return iter(ent["buf"])
+
+        def serve():
+            # each consumer decrements on completion (or abandonment —
+            # GeneratorExit lands in the finally); the LAST one frees the
+            # buffer's memory and spill files mid-query instead of at GC
+            try:
+                yield from iter(ent["buf"])
+            finally:
+                with self._shared_lock:
+                    ent["remaining"] -= 1
+                    last = ent["remaining"] <= 0
+                    if last:
+                        self._shared.pop(id(node), None)
+                if last:
+                    ent["buf"].close()
+        return serve()
 
     # sources ----------------------------------------------------------
     def _morselize(self, stream: Iterator) -> Iterator:
@@ -666,7 +682,11 @@ class LocalExecutor:
             batches = []
             for i in grp:
                 batches.extend(store.bucket_batches(i))
-            batches = [b for b in batches if len(b)]
+            # normalize dtype drift (a spilled batch round-trips through
+            # Arrow IPC; Series.concat later casts everything to the FIRST
+            # batch's dtype, so each batch must match the declared schema)
+            batches = [b if b.schema == schema else b.cast_to_schema(schema)
+                       for b in batches if len(b)]
             if batches:
                 yield MicroPartition.from_recordbatches(batches, schema)
             else:

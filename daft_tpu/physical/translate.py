@@ -47,6 +47,15 @@ def translate(plan: lp.LogicalPlan) -> pp.PhysicalPlan:
             _tl.memo = {}
 
 
+def _nondeterministic(node: lp.LogicalPlan) -> bool:
+    """True when the subtree's output is not a pure function of its
+    inputs — e.g. an unseeded Sample. Such subtrees must never merge:
+    two identical .sample() calls are independent draws."""
+    if isinstance(node, lp.Sample) and node.seed is None:
+        return True
+    return any(_nondeterministic(c) for c in node.children)
+
+
 def _t(node: lp.LogicalPlan, cfg) -> pp.PhysicalPlan:
     if getattr(_tl, "active", False):
         key = node.semantic_id()
@@ -55,7 +64,8 @@ def _t(node: lp.LogicalPlan, cfg) -> pp.PhysicalPlan:
             hit.shared_consumers = getattr(hit, "shared_consumers", 1) + 1
             return hit
         out = _t_node(node, cfg)
-        _tl.memo[key] = out
+        if not _nondeterministic(node):
+            _tl.memo[key] = out
         return out
     return _t_node(node, cfg)
 
