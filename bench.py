@@ -49,8 +49,9 @@ sys.path.insert(0, REPO)
 
 SF = float(os.environ.get("BENCH_SF", "1"))
 PARTS = int(os.environ.get("BENCH_PARTS", "8"))
-DATA = os.path.join(REPO, ".cache", f"tpch_sf{SF}")
-SF10_DATA = os.path.join(REPO, ".cache", "tpch_sf10.0")
+# _v2: chunked datagen (different RNG streams) — old caches are a different dataset
+DATA = os.path.join(REPO, ".cache", f"tpch_sf{SF}_v2")
+SF10_DATA = os.path.join(REPO, ".cache", "tpch_sf10.0_v2")
 # version-stamped: regenerates when the datagen schema grows
 TPCDS_DATA = os.path.join(REPO, ".cache", "tpcds_s1_v3")
 LAION_DATA = os.path.join(REPO, ".cache", "laion_4k")

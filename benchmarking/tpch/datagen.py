@@ -1,20 +1,31 @@
-"""Vectorized TPC-H data generator (dbgen-compatible schemas).
+"""Vectorized, chunked TPC-H data generator (dbgen-compatible schemas).
 
 Row counts and value domains follow the TPC-H specification; value
 *distributions* are uniform via seeded numpy, which is sufficient for
 correctness tests (validated against an independent pandas implementation of
 each query on the same data) and for throughput benchmarking.
-Reference analogue: ``benchmarking/tpch`` data generation pipeline.
+
+Memory-bounded by construction: every table is generated in key-range
+chunks (one parquet file per chunk) with a per-chunk RNG seeded by
+``[seed, table_id, chunk_id]`` — output is deterministic for a given
+``(seed, num_parts)`` pair (chunk boundaries derive from ``num_parts``,
+so different part counts are different datasets; regenerate rather than
+mixing). String columns are built with pyarrow compute kernels
+(``binary_join_element_wise`` / ``utf8_lpad``) instead of Python loops, so
+SF100 (~600M lineitem rows) generates in bounded RAM at C speed.
+Reference analogue: ``benchmarking/tpch`` data generation pipeline
+(the reference shells out to dbgen; we synthesize spec-shaped data).
 """
 
 from __future__ import annotations
 
 import datetime
 import os
-from typing import Dict, Optional
+from typing import Dict
 
 import numpy as np
 import pyarrow as pa
+import pyarrow.compute as pc
 import pyarrow.parquet as pq
 
 _EPOCH = datetime.date(1970, 1, 1)
@@ -50,6 +61,10 @@ P_NAME_WORDS = ["almond", "antique", "aquamarine", "azure", "beige", "bisque",
                 "honeydew", "hot", "hazel", "indian", "ivory", "khaki",
                 "lace", "lavender", "lawn", "lemon", "light", "lime", "linen"]
 
+# Orders per generation chunk; bounds peak RAM at SF100 to a few GB
+# (each chunk carries ~4x lineitem rows).
+_CHUNK_ORDERS = 3_000_000
+
 
 def _dates(rng, n, lo=_START, hi=_END):
     return rng.integers(lo, hi, n).astype("datetime64[D]")
@@ -59,180 +74,253 @@ def _money(rng, n, lo, hi):
     return np.round(rng.uniform(lo, hi, n), 2)
 
 
+def _pick(rng, choices, n):
+    """Dictionary-encoded draw from a small choice list (C-speed, compact)."""
+    idx = rng.integers(0, len(choices), n).astype(np.int32)
+    return pa.DictionaryArray.from_arrays(pa.array(idx), pa.array(choices)).cast(pa.string())
+
+
 def _comment(rng, n, words=8):
-    w = np.array(P_NAME_WORDS)
-    picks = rng.integers(0, len(w), (n, words))
-    return [" ".join(row) for row in w[picks]]
+    """n random word-salad comments, built entirely with arrow kernels."""
+    w = pa.array(P_NAME_WORDS)
+    cols = [pc.take(w, pa.array(rng.integers(0, len(P_NAME_WORDS), n).astype(np.int32)))
+            for _ in range(words)]
+    return pc.binary_join_element_wise(*cols, " ")
+
+
+def _tagged(prefix: str, keys: np.ndarray) -> pa.Array:
+    """'Prefix#000000123'-style names via arrow lpad (no Python loop)."""
+    padded = pc.utf8_lpad(pc.cast(pa.array(keys), pa.string()), 9, "0")
+    return pc.binary_join_element_wise(
+        pa.nulls(len(keys), pa.string()).fill_null(prefix + "#"), padded, "")
+
+
+def _phone(rng, n, lo=0) -> pa.Array:
+    i = np.arange(lo, lo + n, dtype=np.int64)
+    cc = pc.cast(pa.array(rng.integers(10, 35, n)), pa.string())
+    p1 = pc.utf8_lpad(pc.cast(pa.array(i % 999), pa.string()), 3, "0")
+    p2 = pc.utf8_lpad(pc.cast(pa.array((i * 7) % 999), pa.string()), 3, "0")
+    p3 = pc.utf8_lpad(pc.cast(pa.array((i * 13) % 9999), pa.string()), 4, "0")
+    return pc.binary_join_element_wise(cc, p1, p2, p3, "-")
+
+
+def _mark(base: pa.Array, rng, n, prob: float, marker: str) -> pa.Array:
+    """Append `marker` to ~prob of the rows (spec'd LIKE-pattern planting)."""
+    marks = pa.array(rng.random(n) < prob)
+    marked = pc.binary_join_element_wise(base, pa.nulls(n, pa.string()).fill_null(marker), " ")
+    return pc.if_else(marks, marked, base)
+
+
+def _chunks(total: int, per: int):
+    lo = 0
+    cid = 0
+    while lo < total:
+        hi = min(lo + per, total)
+        yield cid, lo, hi
+        lo = hi
+        cid += 1
 
 
 def generate_tpch(root: str, scale_factor: float = 0.01,
                   num_parts: int = 4, seed: int = 42,
-                  fmt: str = "parquet") -> Dict[str, str]:
-    """Generate all 8 tables under root/<table>/*.parquet; returns paths."""
+                  fmt: str = "parquet", verbose: bool = False) -> Dict[str, str]:
+    """Generate all 8 tables under root/<table>/*.parquet; returns paths.
+
+    ``num_parts`` is the *minimum* file count per large table; tables whose
+    generation chunks exceed it produce one file per chunk instead (more
+    files = more scan partitions, never less).
+    """
     os.makedirs(root, exist_ok=True)
-    rng = np.random.default_rng(seed)
     sf = scale_factor
     out: Dict[str, str] = {}
 
-    def write(name: str, table: pa.Table, parts: int = 1):
+    def _dir(name: str) -> str:
         d = os.path.join(root, name)
         os.makedirs(d, exist_ok=True)
+        out[name] = d
+        return d
+
+    def write_chunk(name: str, idx: int, table: pa.Table):
+        pq.write_table(table, os.path.join(_dir(name), f"{name}.{idx}.parquet"))
+        if verbose:
+            import sys
+            print(f"  {name}.{idx}: {table.num_rows} rows", file=sys.stderr, flush=True)
+
+    def write_parts(name: str, table: pa.Table, parts: int):
         n = table.num_rows
         parts = max(1, min(parts, n or 1))
         step = (n + parts - 1) // parts if n else 1
         for i in range(parts):
-            chunk = table.slice(i * step, step)
-            pq.write_table(chunk, os.path.join(d, f"{name}.{i}.parquet"))
-        out[name] = d
+            write_chunk(name, i, table.slice(i * step, step))
+
+    rng = np.random.default_rng([seed, 0])
 
     # region / nation ---------------------------------------------------
-    write("region", pa.table({
+    write_parts("region", pa.table({
         "r_regionkey": pa.array(range(5), pa.int64()),
         "r_name": REGIONS,
         "r_comment": _comment(rng, 5),
-    }))
-    write("nation", pa.table({
+    }), 1)
+    write_parts("nation", pa.table({
         "n_nationkey": pa.array(range(25), pa.int64()),
         "n_name": [n for n, _ in NATIONS],
         "n_regionkey": pa.array([r for _, r in NATIONS], pa.int64()),
         "n_comment": _comment(rng, 25),
-    }))
+    }), 1)
+
+    n_supp = max(int(10_000 * sf), 10)
+    n_cust = max(int(150_000 * sf), 30)
+    n_part = max(int(200_000 * sf), 40)
+    n_ord = max(int(1_500_000 * sf), 150)
+    n_clerk = max(int(1000 * sf), 10)
 
     # supplier -----------------------------------------------------------
-    n_supp = max(int(10_000 * sf), 10)
-    sk = np.arange(1, n_supp + 1)
-    write("supplier", pa.table({
-        "s_suppkey": sk,
-        "s_name": [f"Supplier#{k:09d}" for k in sk],
-        "s_address": _comment(rng, n_supp, 3),
-        "s_nationkey": rng.integers(0, 25, n_supp),
-        "s_phone": [f"{rng2:02d}-{i % 999:03d}-{(i * 7) % 999:03d}-{(i * 13) % 9999:04d}"
-                    for i, rng2 in enumerate(rng.integers(10, 35, n_supp))],
-        "s_acctbal": _money(rng, n_supp, -999.99, 9999.99),
-        "s_comment": _supplier_comments(rng, n_supp),
-    }), num_parts)
+    per = max((n_supp + num_parts - 1) // num_parts, 1)
+    per = min(per, 10_000_000)
+    for cid, lo, hi in _chunks(n_supp, per):
+        r = np.random.default_rng([seed, 1, cid])
+        sk = np.arange(lo + 1, hi + 1)
+        m = hi - lo
+        write_chunk("supplier", cid, pa.table({
+            "s_suppkey": sk,
+            "s_name": _tagged("Supplier", sk),
+            "s_address": _comment(r, m, 3),
+            "s_nationkey": r.integers(0, 25, m),
+            "s_phone": _phone(r, m, lo),
+            "s_acctbal": _money(r, m, -999.99, 9999.99),
+            # spec'd Q16 "Customer Complaints" marker in ~0.05% of rows
+            "s_comment": _mark(_comment(r, m, 6), r, m, 0.0005,
+                               "Customer Complaints"),
+        }))
 
     # customer -----------------------------------------------------------
-    n_cust = max(int(150_000 * sf), 30)
-    ck = np.arange(1, n_cust + 1)
-    write("customer", pa.table({
-        "c_custkey": ck,
-        "c_name": [f"Customer#{k:09d}" for k in ck],
-        "c_address": _comment(rng, n_cust, 3),
-        "c_nationkey": rng.integers(0, 25, n_cust),
-        "c_phone": [f"{p:02d}-{i % 999:03d}-{(i * 3) % 999:03d}-{(i * 11) % 9999:04d}"
-                    for i, p in enumerate(rng.integers(10, 35, n_cust))],
-        "c_acctbal": _money(rng, n_cust, -999.99, 9999.99),
-        "c_mktsegment": np.array(SEGMENTS)[rng.integers(0, 5, n_cust)],
-        "c_comment": _customer_comments(rng, n_cust),
-    }), num_parts)
+    per = max((n_cust + num_parts - 1) // num_parts, 1)
+    per = min(per, 10_000_000)
+    for cid, lo, hi in _chunks(n_cust, per):
+        r = np.random.default_rng([seed, 2, cid])
+        ck = np.arange(lo + 1, hi + 1)
+        m = hi - lo
+        write_chunk("customer", cid, pa.table({
+            "c_custkey": ck,
+            "c_name": _tagged("Customer", ck),
+            "c_address": _comment(r, m, 3),
+            "c_nationkey": r.integers(0, 25, m),
+            "c_phone": _phone(r, m, lo),
+            "c_acctbal": _money(r, m, -999.99, 9999.99),
+            "c_mktsegment": _pick(r, SEGMENTS, m),
+            "c_comment": _comment(r, m, 6),
+        }))
 
-    # part ---------------------------------------------------------------
-    n_part = max(int(200_000 * sf), 40)
-    pk = np.arange(1, n_part + 1)
-    wnames = np.array(P_NAME_WORDS)
-    name_picks = rng.integers(0, len(wnames), (n_part, 5))
-    write("part", pa.table({
-        "p_partkey": pk,
-        "p_name": [" ".join(r) for r in wnames[name_picks]],
-        "p_mfgr": [f"Manufacturer#{m}" for m in rng.integers(1, 6, n_part)],
-        "p_brand": [f"Brand#{m}{x}" for m, x in
-                    zip(rng.integers(1, 6, n_part), rng.integers(1, 6, n_part))],
-        "p_type": np.array(TYPES)[rng.integers(0, len(TYPES), n_part)],
-        "p_size": rng.integers(1, 51, n_part),
-        "p_container": np.array(CONTAINERS)[rng.integers(0, len(CONTAINERS), n_part)],
-        "p_retailprice": _money(rng, n_part, 900, 2000),
-        "p_comment": _comment(rng, n_part, 3),
-    }), num_parts)
+    # part + partsupp ----------------------------------------------------
+    per = max((n_part + num_parts - 1) // num_parts, 1)
+    per = min(per, 5_000_000)
+    wnames = pa.array(P_NAME_WORDS)
+    for cid, lo, hi in _chunks(n_part, per):
+        r = np.random.default_rng([seed, 3, cid])
+        pk = np.arange(lo + 1, hi + 1)
+        m = hi - lo
+        name_cols = [pc.take(wnames, pa.array(
+            r.integers(0, len(P_NAME_WORDS), m).astype(np.int32)))
+            for _ in range(5)]
+        brand = pc.binary_join_element_wise(
+            pa.nulls(m, pa.string()).fill_null("Brand#"),
+            pc.cast(pa.array(r.integers(1, 6, m)), pa.string()),
+            pc.cast(pa.array(r.integers(1, 6, m)), pa.string()), "")
+        mfgr = pc.binary_join_element_wise(
+            pa.nulls(m, pa.string()).fill_null("Manufacturer#"),
+            pc.cast(pa.array(r.integers(1, 6, m)), pa.string()), "")
+        write_chunk("part", cid, pa.table({
+            "p_partkey": pk,
+            "p_name": pc.binary_join_element_wise(*name_cols, " "),
+            "p_mfgr": mfgr,
+            "p_brand": brand,
+            "p_type": _pick(r, TYPES, m),
+            "p_size": r.integers(1, 51, m),
+            "p_container": _pick(r, CONTAINERS, m),
+            "p_retailprice": _money(r, m, 900, 2000),
+            "p_comment": _comment(r, m, 3),
+        }))
+        # partsupp rows for this part range (4 suppliers per part,
+        # same formula lineitem uses so (l_partkey,l_suppkey) joins hit)
+        ps_part = np.repeat(pk, 4)
+        n_ps = len(ps_part)
+        ps_supp = ((ps_part - 1 + (np.tile(np.arange(4), m)
+                                   * (n_supp // 4 + 1))) % n_supp) + 1
+        write_chunk("partsupp", cid, pa.table({
+            "ps_partkey": ps_part,
+            "ps_suppkey": ps_supp,
+            "ps_availqty": r.integers(1, 10_000, n_ps),
+            "ps_supplycost": _money(r, n_ps, 1.0, 1000.0),
+            "ps_comment": _comment(r, n_ps, 10),
+        }))
 
-    # partsupp -----------------------------------------------------------
-    ps_part = np.repeat(pk, 4)
-    n_ps = len(ps_part)
-    ps_supp = ((ps_part - 1 + (np.tile(np.arange(4), n_part)
-                               * (n_supp // 4 + 1))) % n_supp) + 1
-    write("partsupp", pa.table({
-        "ps_partkey": ps_part,
-        "ps_suppkey": ps_supp,
-        "ps_availqty": rng.integers(1, 10_000, n_ps),
-        "ps_supplycost": _money(rng, n_ps, 1.0, 1000.0),
-        "ps_comment": _comment(rng, n_ps, 10),
-    }), num_parts)
-
-    # orders -------------------------------------------------------------
-    n_ord = max(int(1_500_000 * sf), 150)
-    ok = np.arange(1, n_ord + 1) * 4 - 3  # sparse keys like dbgen
-    o_date = _dates(rng, n_ord, _START, _END - 151)
-    write("orders", pa.table({
-        "o_orderkey": ok,
-        "o_custkey": rng.integers(1, n_cust + 1, n_ord),
-        "o_orderstatus": np.array(["F", "O", "P"])[rng.integers(0, 3, n_ord)],
-        "o_totalprice": _money(rng, n_ord, 1000, 500_000),
-        "o_orderdate": o_date,
-        "o_orderpriority": np.array(PRIORITIES)[rng.integers(0, 5, n_ord)],
-        "o_clerk": [f"Clerk#{c:09d}" for c in rng.integers(1, max(int(1000 * sf), 10), n_ord)],
-        "o_shippriority": np.zeros(n_ord, dtype=np.int32),
-        "o_comment": _comment(rng, n_ord, 6),
-    }), num_parts)
-
-    # lineitem -----------------------------------------------------------
-    per_order = rng.integers(1, 8, n_ord)
-    l_orderkey = np.repeat(ok, per_order)
-    l_odate = np.repeat(o_date.astype(np.int64), per_order)
-    n_li = len(l_orderkey)
-    linenumber = np.concatenate([np.arange(1, c + 1) for c in per_order])
-    qty = rng.integers(1, 51, n_li).astype(np.float64)
-    partkey = rng.integers(1, n_part + 1, n_li)
-    price = np.round(qty * (90_000 + (partkey % 20_001) + 100 *
-                            (partkey % 1000)) / 100.0 / 50.0, 2)
-    ship_delta = rng.integers(1, 122, n_li)
-    commit_delta = rng.integers(30, 91, n_li)
-    receipt_delta = rng.integers(1, 31, n_li)
-    l_ship = l_odate + ship_delta
-    l_receipt = l_ship + receipt_delta
+    # orders + lineitem (generated together per chunk so lineitem can
+    # derive from its orders' dates without cross-chunk state) ----------
+    per = max((n_ord + num_parts - 1) // num_parts, 1)
+    per = min(per, _CHUNK_ORDERS)
     today = (datetime.date(1995, 6, 17) - _EPOCH).days
-    returnflag = np.where(
-        l_receipt <= today,
-        np.array(["R", "A"])[rng.integers(0, 2, n_li)], "N")
-    linestatus = np.where(l_ship > today, "O", "F")
-    write("lineitem", pa.table({
-        "l_orderkey": l_orderkey,
-        "l_partkey": partkey,
-        # spec 4.2.3: a lineitem's supplier is one of its part's FOUR
-        # partsupp suppliers (same formula as ps_supp with j = ln % 4);
-        # an independent draw made (l_partkey, l_suppkey) match partsupp
-        # with probability ~0 and emptied every partsupp⨝lineitem join
-        "l_suppkey": ((partkey - 1 + (linenumber % 4)
-                       * (n_supp // 4 + 1)) % n_supp) + 1,
-        "l_linenumber": linenumber,
-        "l_quantity": qty,
-        "l_extendedprice": price,
-        "l_discount": np.round(rng.integers(0, 11, n_li) / 100.0, 2),
-        "l_tax": np.round(rng.integers(0, 9, n_li) / 100.0, 2),
-        "l_returnflag": returnflag,
-        "l_linestatus": linestatus,
-        "l_shipdate": l_ship.astype("datetime64[D]"),
-        "l_commitdate": (l_odate + commit_delta).astype("datetime64[D]"),
-        "l_receiptdate": l_receipt.astype("datetime64[D]"),
-        "l_shipinstruct": np.array(INSTRUCTS)[rng.integers(0, 4, n_li)],
-        "l_shipmode": np.array(SHIPMODES)[rng.integers(0, 7, n_li)],
-        "l_comment": _comment(rng, n_li, 4),
-    }), num_parts)
+    for cid, lo, hi in _chunks(n_ord, per):
+        r = np.random.default_rng([seed, 4, cid])
+        m = hi - lo
+        ok = (np.arange(lo + 1, hi + 1)) * 4 - 3  # sparse keys like dbgen
+        o_date = _dates(r, m, _START, _END - 151)
+        write_chunk("orders", cid, pa.table({
+            "o_orderkey": ok,
+            "o_custkey": r.integers(1, n_cust + 1, m),
+            "o_orderstatus": _pick(r, ["F", "O", "P"], m),
+            "o_totalprice": _money(r, m, 1000, 500_000),
+            "o_orderdate": o_date,
+            "o_orderpriority": _pick(r, PRIORITIES, m),
+            "o_clerk": _tagged("Clerk", r.integers(1, n_clerk, m)),
+            "o_shippriority": np.zeros(m, dtype=np.int32),
+            # spec'd Q13 marker: ~1% of orders carry "special requests"
+            "o_comment": _mark(_comment(r, m, 6), r, m, 0.01,
+                               "special requests"),
+        }))
+
+        per_order = r.integers(1, 8, m)
+        l_orderkey = np.repeat(ok, per_order)
+        l_odate = np.repeat(o_date.astype(np.int64), per_order)
+        n_li = len(l_orderkey)
+        starts = np.repeat(np.cumsum(per_order) - per_order, per_order)
+        linenumber = np.arange(n_li, dtype=np.int64) - starts + 1
+        qty = r.integers(1, 51, n_li).astype(np.float64)
+        partkey = r.integers(1, n_part + 1, n_li)
+        price = np.round(qty * (90_000 + (partkey % 20_001) + 100 *
+                                (partkey % 1000)) / 100.0 / 50.0, 2)
+        ship_delta = r.integers(1, 122, n_li)
+        commit_delta = r.integers(30, 91, n_li)
+        receipt_delta = r.integers(1, 31, n_li)
+        l_ship = l_odate + ship_delta
+        l_receipt = l_ship + receipt_delta
+        returnflag = np.where(
+            l_receipt <= today,
+            np.array(["R", "A"])[r.integers(0, 2, n_li)], "N")
+        linestatus = np.where(l_ship > today, "O", "F")
+        write_chunk("lineitem", cid, pa.table({
+            "l_orderkey": l_orderkey,
+            "l_partkey": partkey,
+            # spec 4.2.3: a lineitem's supplier is one of its part's FOUR
+            # partsupp suppliers (same formula as ps_supp with j = ln % 4);
+            # an independent draw made (l_partkey, l_suppkey) match partsupp
+            # with probability ~0 and emptied every partsupp⨝lineitem join
+            "l_suppkey": ((partkey - 1 + (linenumber % 4)
+                           * (n_supp // 4 + 1)) % n_supp) + 1,
+            "l_linenumber": linenumber,
+            "l_quantity": qty,
+            "l_extendedprice": price,
+            "l_discount": np.round(r.integers(0, 11, n_li) / 100.0, 2),
+            "l_tax": np.round(r.integers(0, 9, n_li) / 100.0, 2),
+            "l_returnflag": pa.array(returnflag),
+            "l_linestatus": pa.array(linestatus),
+            "l_shipdate": l_ship.astype("datetime64[D]"),
+            "l_commitdate": (l_odate + commit_delta).astype("datetime64[D]"),
+            "l_receiptdate": l_receipt.astype("datetime64[D]"),
+            "l_shipinstruct": _pick(r, INSTRUCTS, n_li),
+            "l_shipmode": _pick(r, SHIPMODES, n_li),
+            "l_comment": _comment(r, n_li, 4),
+        }))
     return out
-
-
-def _supplier_comments(rng, n):
-    base = _comment(rng, n, 6)
-    # plant the spec'd Q16 "Customer Complaints" marker in ~0.05% of rows
-    marks = rng.random(n) < 0.0005
-    return [(c + " Customer Complaints") if m else c
-            for c, m in zip(base, marks)]
-
-
-def _customer_comments(rng, n):
-    base = _comment(rng, n, 6)
-    marks = rng.random(n) < 0.01
-    return [(c + " special requests") if m else c
-            for c, m in zip(base, marks)]
 
 
 if __name__ == "__main__":
@@ -241,5 +329,7 @@ if __name__ == "__main__":
     ap.add_argument("--root", default="/tmp/tpch")
     ap.add_argument("--sf", type=float, default=0.01)
     ap.add_argument("--parts", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=42)
     args = ap.parse_args()
-    print(generate_tpch(args.root, args.sf, args.parts))
+    print(generate_tpch(args.root, args.sf, args.parts, seed=args.seed,
+                        verbose=True))
