@@ -455,18 +455,41 @@ class EliminateCrossJoin(Rule):
     name = "eliminate_cross_join"
 
     def apply(self, plan):
+        # one bottom-up pass peels ONE cross layer: converting an upper
+        # cross creates the Filter(CrossJoin) pattern below it only after
+        # that lower node was already visited. A 17-relation comma join
+        # (TPC-DS Q64) needs ~n passes — iterate to a local fixed point
+        # rather than relying on the batch's bounded sweep count.
+        for _ in range(64):
+            new = self._apply_once(plan)
+            if new.semantic_id() == plan.semantic_id():
+                return new
+            plan = new
+        return plan
+
+    def _apply_once(self, plan):
         def fn(node):
             if not isinstance(node, lp.Filter):
                 return node
+            # collapse a stack of Filters (apply_where and the subquery
+            # rewrites emit separate .where() calls) so every conjunct is
+            # visible to the conversion at once — Q64's 17-relation comma
+            # join leaves Filter(Filter(CrossJoin)) otherwise
+            preds = [node.predicate]
             child = node.children[0]
+            while isinstance(child, lp.Filter):
+                preds.append(child.predicate)
+                child = child.children[0]
             if not (isinstance(child, lp.Join) and child.how == "cross"):
                 return node
+            predicate = combine_conjuncts(
+                [c for p in preds for c in split_conjuncts(p)])
             lchild, rchild = child.children
             l_names = set(lchild.schema().column_names)
             r_names = set(rchild.schema().column_names)
             left_on, right_on = [], []
             l_only, r_only, rest = [], [], []
-            for c in split_conjuncts(node.predicate):
+            for c in split_conjuncts(predicate):
                 if c.op == "eq":
                     a, b = c.args
                     if a.op == "col" and b.op == "col":
@@ -520,8 +543,17 @@ class ReorderJoins(Rule):
         # top-down, acting only at MAXIMAL inner-join roots: reordering an
         # inner subtree first would wrap it in a Project that blocks
         # flattening at every ancestor join, leaving 4+-relation chains
-        # only partially ordered.
+        # only partially ordered. A Filter directly above the join tree
+        # contributes its equality conjuncts as join edges — comma joins
+        # (TPC-DS Q64's 17-relation FROM) parse as crosses whose linking
+        # equalities live in WHERE, and some links only connect relations
+        # that sit far apart in the written order.
         def rec(node, parent_eligible: bool):
+            if isinstance(node, lp.Filter) and not parent_eligible \
+                    and self._eligible(node.children[0]):
+                out = self._try_reorder(node.children[0], node.predicate)
+                if out is not None:
+                    return out
             elig = self._eligible(node)
             if elig and not parent_eligible:
                 out = self._try_reorder(node)
@@ -534,28 +566,36 @@ class ReorderJoins(Rule):
 
     @staticmethod
     def _eligible(node) -> bool:
-        return (isinstance(node, lp.Join) and node.how == "inner"
+        return (isinstance(node, lp.Join)
+                and node.how in ("inner", "cross")
                 and node.strategy is None
                 and all(e.op == "col" for e in node.left_on)
                 and all(e.op == "col" for e in node.right_on))
 
     # -- flatten a maximal inner-equi-join tree ------------------------
-    def _flatten(self, node, rels, edges):
+    def _flatten(self, node, rels, edges, filters=None):
         if self._eligible(node):
-            self._flatten(node.children[0], rels, edges)
-            self._flatten(node.children[1], rels, edges)
+            self._flatten(node.children[0], rels, edges, filters)
+            self._flatten(node.children[1], rels, edges, filters)
             for le, re_ in zip(node.left_on, node.right_on):
                 edges.append((le.params[0], re_.params[0]))
+        elif filters is not None and isinstance(node, lp.Filter):
+            # look through filters interleaved in the join chain: inner
+            # joins commute with filters, their cross-relation equalities
+            # are join edges in disguise, and PushDownFilter re-sinks the
+            # single-relation remainder after the reorder
+            filters.append(node.predicate)
+            self._flatten(node.children[0], rels, edges, filters)
         else:
             rels.append(node)
 
-    def _try_reorder(self, node):
-        if not (isinstance(node, lp.Join) and node.how == "inner"
-                and node.strategy is None):
+    def _try_reorder(self, node, filter_pred: Optional[Expression] = None):
+        if not self._eligible(node):
             return None
         rels: List[lp.LogicalPlan] = []
         edges: List[tuple] = []
-        self._flatten(node, rels, edges)
+        inner_filters: List[Expression] = []
+        self._flatten(node, rels, edges, inner_filters)
         if len(rels) < 3:
             return None
         # column ownership must be unambiguous and globally disjoint
@@ -568,6 +608,27 @@ class ReorderJoins(Rule):
         for ln, rn in edges:
             if ln not in owner or rn not in owner:
                 return None
+        # harvest cross-relation equality conjuncts from the Filter above
+        # the tree and from filters interleaved inside it; everything else
+        # stays as a residual filter on top
+        had_cross = self._has_cross(node)
+        rest_conjs: List[Expression] = []
+        harvested = 0
+        preds = ([filter_pred] if filter_pred is not None else []) \
+            + inner_filters
+        for p in preds:
+            for c in split_conjuncts(p):
+                u = c._unalias()
+                if u.op == "eq":
+                    a, b = u.args
+                    if a.op == "col" and b.op == "col" \
+                            and a.params[0] in owner \
+                            and b.params[0] in owner \
+                            and owner[a.params[0]] != owner[b.params[0]]:
+                        edges.append((a.params[0], b.params[0]))
+                        harvested += 1
+                        continue
+                rest_conjs.append(c)
         from . import stats as lstats
         sizes = []
         for r in rels:
@@ -617,8 +678,11 @@ class ReorderJoins(Rule):
             in_set.add(best)
             order.append(best)
             tree_rows = max(tree_rows * sizes[best] / frontier[best], 1.0)
-        if order == list(range(n)):
-            return None  # already in this order
+        # already in this order with nothing to convert: leave residual
+        # filters alone — rebuilding would churn a Project + filter hoist
+        # for PushDownFilter to undo
+        if order == list(range(n)) and not had_cross and not harvested:
+            return None
         # rebuild left-deep (relations may hold nested join trees of their
         # own, e.g. under aggregates — reorder those independently)
         rels = [self.apply(r) for r in rels]
@@ -635,7 +699,19 @@ class ReorderJoins(Rule):
         out_names = node.schema().column_names
         if set(out_names) != set(tree.schema().column_names):
             return None  # safety: must be a pure permutation
-        return lp.Project(tree, [col(nm) for nm in out_names])
+        out = lp.Project(tree, [col(nm) for nm in out_names])
+        if rest_conjs:
+            out = lp.Filter(out, combine_conjuncts(rest_conjs))
+        return out
+
+    def _has_cross(self, node) -> bool:
+        if isinstance(node, lp.Filter):
+            return self._has_cross(node.children[0])
+        if not self._eligible(node):
+            return False
+        return node.how == "cross" \
+            or self._has_cross(node.children[0]) \
+            or self._has_cross(node.children[1])
 
 
 def _null_rejecting_cols(conj: Expression) -> set:

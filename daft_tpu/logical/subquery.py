@@ -317,9 +317,79 @@ def _rewrite_conjunct(df, conj: Expression) -> Tuple[Optional[Expression],
         if contains_subquery(u.args[0]):
             raise NotImplementedError("subquery inside IN's left operand")
         return None, _semi_anti(df, u.params[0], anti=neg, lhs=u.args[0])
+    # EXISTS/IN nested inside the conjunct (a disjunction like TPC-DS
+    # Q10/Q35's ``EXISTS (…) OR EXISTS (…)``) → mark joins
+    df, conj = realize_marks(df, conj)
     # scalar subqueries nested anywhere in the conjunct
     df, out = realize_scalars(df, conj)
     return out, df
+
+
+def _attach_mark(df, node: Expression) -> Tuple[object, Expression]:
+    """EXISTS/IN nested in a boolean expression → a mark (boolean) column:
+    left-join the outer frame onto the DISTINCT correlation/value keys of
+    the subquery tagged TRUE; unmatched rows coalesce to FALSE. This is
+    the classic mark-join decorrelation. NULL caveat (documented like the
+    NOT IN caveat): ``x IN (…)`` yields FALSE rather than NULL for NULL
+    x / NULL-only matches, which is indistinguishable under a WHERE but
+    visible under explicit negation of the disjunction."""
+    info: SubqueryInfo = node.params[0]
+    lhs = node.args[0] if node.op == "in_subquery" else None
+    if info.resid:
+        raise NotImplementedError(
+            "EXISTS with non-equality correlation inside a disjunction")
+    if info.deferred_aggs:
+        raise NotImplementedError(
+            "aggregating subquery inside a disjunction")
+    mark = f"__mark{next(_uid)}__"
+    left_on = [o for _, o in info.corr]
+    right_on = [i for i, _ in info.corr]
+    rdf = info.df
+    if lhs is not None:
+        rdf2, val = _inner_value_expr(info)
+        rdf = rdf2
+        left_on = left_on + [lhs]
+        right_on = right_on + [val]
+    if not left_on:
+        # uncorrelated EXISTS in a disjunction: single TRUE/absent flag
+        k = f"__markk{next(_uid)}__"
+        flag = rdf.limit(1).select(lit(1).alias(k), lit(True).alias(mark))
+        out = df.with_column(k, lit(1)).join(
+            flag, left_on=[col(k)], right_on=[col(k)], how="left")
+        return out.exclude(k), col(mark).fill_null(lit(False))
+    knames = []
+    keyed_cols = []
+    for e in right_on:
+        kn = f"__markk{next(_uid)}__"
+        knames.append(kn)
+        keyed_cols.append(e.alias(kn))
+    keyed = rdf.select(*keyed_cols).distinct() \
+        .with_column(mark, lit(True))
+    out = df.join(keyed, left_on=left_on,
+                  right_on=[col(k) for k in knames], how="left")
+    return out, col(mark).fill_null(lit(False))
+
+
+def _find_setpred(e: Expression) -> Optional[Expression]:
+    if e.op in ("in_subquery", "exists"):
+        return e
+    for a in e.args:
+        found = _find_setpred(a)
+        if found is not None:
+            return found
+    return None
+
+
+def realize_marks(df, e: Expression) -> Tuple[object, Expression]:
+    """Replace every EXISTS/IN-subquery node nested in ``e`` with a mark
+    column (see ``_attach_mark``); the caller filters on the rewritten
+    predicate and the helper columns fall away at the next projection."""
+    while True:
+        node = _find_setpred(e)
+        if node is None:
+            return df, e
+        df, flag = _attach_mark(df, node)
+        e = _replace_node(e, node, flag)
 
 
 def _find_scalar(e: Expression) -> Optional[Expression]:

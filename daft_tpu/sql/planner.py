@@ -373,16 +373,31 @@ class SQLPlanner:
                 or self._peek_kw("EXCEPT"):
             if self._kw("UNION"):
                 all_ = self._kw("ALL")
-                right = self._select_operand(ctes)
+                right = self._positional(left, self._select_operand(ctes))
                 left = left.union_all(right) if all_ else left.union(right)
             elif self._kw("INTERSECT"):
-                right = self._select_operand(ctes)
+                right = self._positional(left, self._select_operand(ctes))
                 left = left.intersect(right)
             else:
                 self._kw("EXCEPT")
-                right = self._select_operand(ctes)
+                right = self._positional(left, self._select_operand(ctes))
                 left = left.except_distinct(right)
         return left
+
+    @staticmethod
+    def _positional(left, right):
+        """SQL set operations match columns by POSITION; rename the right
+        operand's columns to the left's so the engine's name-based concat
+        applies (reference resolves set-op schemas positionally too)."""
+        lc, rc = list(left.column_names), list(right.column_names)
+        if len(lc) != len(rc):
+            raise ValueError(
+                f"set operation operands have different column counts: "
+                f"{len(lc)} vs {len(rc)}")
+        if lc != rc:
+            right = right.select(*[col(r).alias(l)
+                                   for l, r in zip(lc, rc)])
+        return right
 
     def _select_operand(self, ctes):
         """One set-operation operand: a SELECT, or a parenthesized query
@@ -533,6 +548,12 @@ class SQLPlanner:
             start, end = item
             self.i = start
             e = self._expr(scope)
+            if alias is None and self.i == end - 1 \
+                    and self._peek().kind == "ident":
+                # implicit alias (``SELECT x total``): _skip_expr ran to
+                # the delimiter, so exactly one trailing bare ident inside
+                # the recorded span is the AS-less output name
+                alias = self._next().text
             if alias is not None:
                 e = e.alias(alias)
             exprs.append(e)
@@ -679,6 +700,57 @@ class SQLPlanner:
             out.append(self._expr(scope))
         return out
 
+    def _pull_window_aggs(self, exprs):
+        """Decompose select items that mix GROUP BY aggregates with OVER()
+        windows — ``SUM(SUM(x)) OVER (…)``, ``RANK() OVER (ORDER BY
+        SUM(x))``, ``SUM(x)*100/SUM(SUM(x)) OVER (PARTITION BY c)`` —
+        into hidden aggregate outputs plus a post-aggregation expression
+        that references them. Returns (new exprs, hidden agg exprs).
+        Reference treats windows-over-aggregates the same way: the inner
+        aggregate runs at the groupby, the window over the grouped frame
+        (``src/daft-sql/src/modules/window.rs``)."""
+        hidden: List[Expression] = []
+
+        def mk_hidden(a: Expression) -> Expression:
+            for h in hidden:
+                if h._unalias().structurally_eq(a):
+                    return col(h.name())
+            nm = f"__wagg{len(hidden)}__"
+            hidden.append(a.alias(nm))
+            return col(nm)
+
+        def pull_below(e):
+            if not isinstance(e, Expression):
+                return e
+            if e.op.startswith("agg."):
+                return mk_hidden(e)
+            if not e.args:
+                return e
+            return e.with_children([pull_below(a) for a in e.args])
+
+        def fix(e: Expression) -> Expression:
+            if e.op == "window":
+                inner = e.args[0]
+                # the window's own function node stays (it computes over
+                # the grouped frame); aggregates in its ARGUMENTS ran at
+                # the groupby and become hidden columns
+                if inner.args:
+                    inner = inner.with_children(
+                        [pull_below(a) for a in inner.args])
+                spec = e.params[0]._copy()
+                spec._partition_by = [pull_below(p)
+                                      for p in spec._partition_by]
+                spec._order_by = [pull_below(o) for o in spec._order_by]
+                return Expression("window", (inner,), (spec,))
+            if e.op.startswith("agg."):
+                return mk_hidden(e)
+            if not e.args:
+                return e
+            return e.with_children([fix(a) for a in e.args])
+
+        out = [fix(e) if _contains_window(e) else e for e in exprs]
+        return out, hidden
+
     def _lower_aggregate(self, df, gb_keys, exprs, having):
         """GROUP BY lowering for ONE grouping-key set: groupby + aggregate
         + HAVING filter + output projection (group keys by name, aggregates
@@ -690,7 +762,8 @@ class SQLPlanner:
         the residual predicate — subqueries included — applies as a WHERE
         over the grouped frame via the unnest machinery."""
         from ..logical import subquery as subq
-        agg_exprs = [e for e in exprs if _has_agg(e)]
+        exprs, wagg_hidden = self._pull_window_aggs(exprs)
+        agg_exprs = [e for e in exprs if _has_agg(e)] + wagg_hidden
         having_resid = None
         if having is not None:
             if subq.contains_subquery(having):
@@ -743,7 +816,16 @@ class SQLPlanner:
         (reference: planner.rs:390-401 lowers ROLLUP the same way). Keys
         absent from a set surface as typed NULLs — SQL's super-aggregate
         rows — and ``GROUPING(key)`` resolves to a literal 0/1 per branch,
-        composing with any downstream expression for free."""
+        composing with any downstream expression for free.
+
+        Window items (TPC-DS Q70/Q86's ``RANK() OVER (PARTITION BY
+        GROUPING(a)+GROUPING(b) …)``) must rank over the UNION of
+        branches, so each window's inputs (aggregates, grouping literals,
+        spec expressions) are computed per branch as hidden columns and
+        the window itself evaluates after the union."""
+        if any(_contains_window(e) for e in exprs):
+            return self._lower_grouping_sets_windows(df, all_keys, sets,
+                                                     exprs, having)
         schema = df.schema()
         frames = []
         for S in sets:
@@ -759,6 +841,81 @@ class SQLPlanner:
         for f in frames[1:]:
             out = out.union_all_by_name(f)
         return out
+
+    def _lower_grouping_sets_windows(self, df, all_keys, sets, exprs,
+                                     having):
+        """Grouping-sets lowering when the select list holds window items:
+        1. pull aggregates out of window nodes (hidden agg columns),
+        2. extract each window's spec/argument expressions into hidden
+           per-branch projections (GROUPING() → per-branch literal there),
+        3. per-branch aggregate over [non-window items + hidden columns],
+        4. union branches, evaluate the rebuilt windows, project."""
+        exprs, wagg_hidden = self._pull_window_aggs(exprs)
+        subs: List[Expression] = []
+        spec_cols: set = set()  # plain columns referenced only in specs
+
+        def mk_sub(e: Expression) -> Expression:
+            if e.op == "col":
+                spec_cols.add(e.params[0])
+                return e  # already a frame column (hidden agg or key)
+            for h in subs:
+                if h._unalias().structurally_eq(e):
+                    return col(h.name())
+            nm = f"__wsub{len(subs)}__"
+            subs.append(e.alias(nm))
+            return col(nm)
+
+        def extract(e: Expression) -> Expression:
+            if e.op == "window":
+                inner = e.args[0]
+                if inner.args:
+                    inner = inner.with_children(
+                        [mk_sub(a) for a in inner.args])
+                spec = e.params[0]._copy()
+                spec._partition_by = [mk_sub(p)
+                                      for p in spec._partition_by]
+                spec._order_by = [mk_sub(o) for o in spec._order_by]
+                return Expression("window", (inner,), (spec,))
+            if not e.args:
+                return e
+            return e.with_children([extract(a) for a in e.args])
+
+        final: List[Expression] = []       # post-union projection
+        branch_items: List[Expression] = []  # per-branch select items
+        for e in exprs:
+            if _contains_window(e):
+                final.append(extract(e)._unalias().alias(e.name()))
+            else:
+                branch_items.append(e)
+                final.append(col(e.name()))
+        # window items may also reference plain columns (keys, hidden agg
+        # outputs) — ensure every free column of the rebuilt windows is in
+        # the branch output
+        have = {e.name() for e in branch_items} | \
+               {e.name() for e in wagg_hidden} | \
+               {e.name() for e in subs}
+        need = set(spec_cols)  # Expression.column_names() walks args,
+        for e in final:        # not the window spec stored in params
+            need |= set(e.column_names())
+        for c in sorted(need - have):
+            branch_items.append(col(c))
+        branch_items = branch_items + wagg_hidden + subs
+
+        schema = df.schema()
+        frames = []
+        for S in sets:
+            present = list(S)
+            exprs_b = [self._subst_rollup(e, all_keys, present, schema)
+                       for e in branch_items]
+            having_b = self._subst_rollup(having, all_keys, present,
+                                          schema) if having is not None \
+                else None
+            frames.append(self._lower_aggregate(df, list(S), exprs_b,
+                                                having_b))
+        out = frames[0]
+        for f in frames[1:]:
+            out = out.union_all_by_name(f)
+        return out.select(*final)
 
     def _subst_rollup(self, e, all_keys, present, schema):
         """Per-branch rewrite: GROUPING(k) → 0/1 literal; references to
@@ -1713,6 +1870,12 @@ def _has_agg(e: Expression) -> bool:
     return any(_has_agg(c) for c in e.args)
 
 
+def _contains_window(e: Expression) -> bool:
+    if e.op == "window":
+        return True
+    return any(_contains_window(c) for c in e.args)
+
+
 def _split_join_condition(cond: Expression, left_scope: Scope,
                           right_scope: Scope):
     """ON clause → (left_on, right_on, residual_filter)."""
@@ -1751,4 +1914,27 @@ def _rebind_order(e: Expression, proj: List[Expression]) -> Expression:
             return col(p.name())
         if e.op == "col" and e.params[0] == p.name():
             return e
+    if _contains_grouping(e):
+        # ``ORDER BY CASE WHEN GROUPING(a)+GROUPING(b) = 0 THEN a END``
+        # (TPC-DS Q70/Q86): GROUPING() exists only inside the per-branch
+        # rollup lowering — rebind any subtree that matches a projected
+        # item's body to that output column (``lochierarchy``-style)
+        def sub(x: Expression) -> Expression:
+            for p in proj:
+                if x.structurally_eq(p._unalias()):
+                    return col(p.name())
+            if not x.args:
+                return x
+            return x.with_children([sub(a) for a in x.args])
+        e = sub(e)
+        if _contains_grouping(e):
+            raise NotImplementedError(
+                "GROUPING() in ORDER BY must match a projected "
+                "expression (e.g. project it AS lochierarchy)")
     return e
+
+
+def _contains_grouping(e: Expression) -> bool:
+    if e.op == "sql.grouping":
+        return True
+    return any(_contains_grouping(c) for c in e.args)

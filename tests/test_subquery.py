@@ -145,12 +145,34 @@ def test_exists_with_nonequality_residual(shop):
     assert out["o_id"] == [10, 11, 13, 14]
 
 
-def test_exists_nested_in_or_raises(shop):
-    with pytest.raises((NotImplementedError, ValueError)):
-        dt.sql(
-            "SELECT c_name FROM cust WHERE c_bal > 1000 OR EXISTS "
-            "(SELECT * FROM orders WHERE o_cust = c_id)",
-            **shop).to_pydict()
+def test_exists_nested_in_or_mark_join(shop):
+    """EXISTS inside a disjunction lowers to a mark join (TPC-DS Q10/Q35
+    shape): customers with a high balance OR at least one order."""
+    out = dt.sql(
+        "SELECT c_name FROM cust WHERE c_bal > 50 OR EXISTS "
+        "(SELECT * FROM orders WHERE o_cust = c_id) ORDER BY c_name",
+        **shop).to_pydict()
+    # ann (bal+orders), bob (orders), cat (bal+orders); dan has neither
+    assert out["c_name"] == ["ann", "bob", "cat"]
+
+
+def test_two_exists_disjunction(shop):
+    out = dt.sql(
+        "SELECT c_name FROM cust WHERE EXISTS "
+        "(SELECT * FROM orders WHERE o_cust = c_id AND o_total > 50) "
+        "OR EXISTS (SELECT * FROM orders WHERE o_cust = c_id AND "
+        "o_total < 10) ORDER BY c_name",
+        **shop).to_pydict()
+    # bob: 7.0 < 10; cat: 55.0 > 50 and 5.0 < 10; ann: neither branch
+    assert out["c_name"] == ["bob", "cat"]
+
+
+def test_in_subquery_nested_in_or(shop):
+    out = dt.sql(
+        "SELECT c_name FROM cust WHERE c_bal < 10 OR c_id IN "
+        "(SELECT o_cust FROM orders WHERE o_total > 50) ORDER BY c_name",
+        **shop).to_pydict()
+    assert out["c_name"] == ["bob", "cat"]
 
 
 # ---------------------------------------------------------- TPC-H parity

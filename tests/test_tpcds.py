@@ -1,4 +1,4 @@
-"""TPC-DS suite (70 of 99 queries) runs end-to-end through the SQL
+"""TPC-DS suite (all 99 queries) runs end-to-end through the SQL
 frontend across all three sales channels, with pandas cross-checks for a
 query per family (dimensional agg, demographics, windows, correlated
 subqueries, weekday pivots, ROLLUP, left-join returns)."""
@@ -26,7 +26,8 @@ def tpcds(tmp_path_factory):
 def test_queries_run(tpcds, qnum):
     out = Q.run(qnum, tpcds).to_pydict()
     assert out
-    if qnum not in (2, 34, 71, 73, 91, 98):  # these have no LIMIT clause
+    if qnum not in (2, 9, 13, 24, 31, 34, 48, 64, 71, 73, 87, 88, 91,
+                    98):  # these have no LIMIT clause
         assert all(len(v) <= 100 for v in out.values())
 
 
