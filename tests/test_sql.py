@@ -199,3 +199,57 @@ def test_sql_string_function_breadth():
     assert out["r"] == ["dlrow olleh"]
     assert out["l"] == ["hello"]
     assert out["sw"] == [True]
+
+
+def test_implicit_select_alias():
+    """AS-less output aliases (``SELECT x total``) must name the output —
+    they silently vanished before r4 (the projection span re-parse never
+    saw the trailing ident)."""
+    df = dt.from_pydict({"k": [1, 2, 1], "v": [10.0, 20.0, 30.0]})
+    out = dt.sql("SELECT k customer_id, v total FROM df", df=df)
+    assert out.column_names == ["customer_id", "total"]
+    out = dt.sql(
+        "SELECT k grp, SUM(v) total, 's' tag FROM df GROUP BY k", df=df)
+    assert out.column_names == ["grp", "total", "tag"]
+
+
+def test_set_op_positional_schema():
+    """SQL set operations match columns by position, not name."""
+    a = dt.from_pydict({"x": [1, 2]})
+    b = dt.from_pydict({"y": [3]})
+    out = dt.sql("SELECT x FROM a UNION ALL SELECT y FROM b", a=a, b=b)
+    assert out.column_names == ["x"]
+    assert sorted(out.to_pydict()["x"]) == [1, 2, 3]
+
+
+def test_window_over_aggregate_single_select():
+    """SUM(SUM(x)) OVER and RANK() OVER (ORDER BY SUM(x)) in ONE select
+    (no manual CTE decomposition)."""
+    df = dt.from_pydict({"g": ["a", "a", "b", "b"], "c": ["x", "y", "x", "y"],
+                         "v": [1.0, 2.0, 3.0, 4.0]})
+    out = dt.sql(
+        "SELECT g, SUM(v) s, SUM(SUM(v)) OVER () tot, "
+        "RANK() OVER (ORDER BY SUM(v) DESC) r "
+        "FROM df GROUP BY g ORDER BY g", df=df).to_pydict()
+    assert out["s"] == [3.0, 7.0]
+    assert out["tot"] == [10.0, 10.0]
+    assert out["r"] == [2, 1]
+
+
+def test_rollup_grouping_in_window_partition():
+    """GROUPING() inside a window PARTITION BY (TPC-DS Q70/Q86 shape)
+    ranks within each rollup hierarchy level."""
+    df = dt.from_pydict({"cat": ["a", "a", "b"], "cls": ["p", "q", "p"],
+                         "v": [1.0, 2.0, 4.0]})
+    out = dt.sql(
+        "SELECT SUM(v) total, cat, cls, "
+        "GROUPING(cat)+GROUPING(cls) lochierarchy, "
+        "RANK() OVER (PARTITION BY GROUPING(cat)+GROUPING(cls), "
+        "CASE WHEN GROUPING(cls) = 0 THEN cat END "
+        "ORDER BY SUM(v) DESC) rank_within_parent "
+        "FROM df GROUP BY ROLLUP(cat, cls) "
+        "ORDER BY lochierarchy DESC, cat, cls", df=df).to_pandas()
+    grand = out[out.lochierarchy == 2]
+    assert list(grand.total) == [7.0]
+    lvl0_a = out[(out.lochierarchy == 0) & (out.cat == "a")]
+    assert sorted(lvl0_a.rank_within_parent) == [1, 2]

@@ -244,3 +244,78 @@ def test_q43_vs_pandas(tpcds):
             key = (row.s_store_name, row.s_store_sk)
             if key in exp.index:
                 assert row[colname] == pytest.approx(exp[key], rel=1e-9)
+
+
+def test_q35_exists_disjunction_vs_pandas(tpcds):
+    """Q35's (EXISTS web OR EXISTS catalog) AND EXISTS store filter —
+    validates the mark-join decorrelation row set against pandas."""
+    got = Q.run(35, tpcds).to_pandas()
+    cu = tpcds("customer").to_pandas()
+    ca = tpcds("customer_address").to_pandas()
+    cd = tpcds("customer_demographics").to_pandas()
+    dd = tpcds("date_dim").to_pandas()
+    days = dd[(dd.d_year == 2001) & (dd.d_qoy < 4)].d_date_sk
+    ss = tpcds("store_sales").to_pandas()
+    ws = tpcds("web_sales").to_pandas()
+    cs = tpcds("catalog_sales").to_pandas()
+    in_ss = set(ss[ss.ss_sold_date_sk.isin(days)].ss_customer_sk)
+    in_ws = set(ws[ws.ws_sold_date_sk.isin(days)].ws_bill_customer_sk)
+    in_cs = set(cs[cs.cs_sold_date_sk.isin(days)].cs_ship_customer_sk)
+    j = (cu.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+         .merge(cd, left_on="c_current_cdemo_sk", right_on="cd_demo_sk"))
+    j = j[j.c_customer_sk.isin(in_ss)
+          & (j.c_customer_sk.isin(in_ws) | j.c_customer_sk.isin(in_cs))]
+    exp = (j.groupby(["ca_state", "cd_gender", "cd_marital_status",
+                      "cd_dep_count", "cd_dep_employed_count",
+                      "cd_dep_college_count"], as_index=False)
+           .agg(cnt1=("c_customer_sk", "size"),
+                avg1=("cd_dep_count", "mean")))
+    assert int(got.cnt1.sum()) == int(exp.cnt1.sum())
+    gk = {tuple(r) for r in got[["ca_state", "cd_gender",
+                                 "cd_marital_status"]].itertuples(
+                                     index=False)}
+    ek = {tuple(r) for r in exp[["ca_state", "cd_gender",
+                                 "cd_marital_status"]].itertuples(
+                                     index=False)}
+    assert gk <= ek
+
+
+def test_q86_rollup_grouping_window_vs_pandas(tpcds):
+    """Q86: ROLLUP + GROUPING() hierarchy + RANK() over the union —
+    grand total equals the ungrouped sum, per-category subtotals match,
+    rank_within_parent is 1..n within each (lochierarchy, parent)."""
+    got = Q.run(86, tpcds).to_pandas()
+    ws = tpcds("web_sales").to_pandas()
+    dd = tpcds("date_dim").to_pandas()
+    it = tpcds("item").to_pandas()
+    j = (ws.merge(dd, left_on="ws_sold_date_sk", right_on="d_date_sk")
+         .merge(it, left_on="ws_item_sk", right_on="i_item_sk"))
+    j = j[(j.d_month_seq >= 1200) & (j.d_month_seq <= 1211)]
+    grand = got[got.lochierarchy == 2]
+    assert len(grand) == 1
+    assert grand.total_sum.iloc[0] == pytest.approx(
+        j.ws_net_paid.sum(), rel=1e-9)
+    subtot = got[got.lochierarchy == 1].set_index("i_category")
+    exp_cat = j.groupby("i_category")["ws_net_paid"].sum()
+    for cat, row in subtot.iterrows():
+        assert row.total_sum == pytest.approx(exp_cat[cat], rel=1e-9)
+    for (loch), grp in got.groupby("lochierarchy"):
+        if loch == 0:
+            for cat, sub in grp.groupby("i_category"):
+                assert sorted(sub.rank_within_parent) == \
+                    list(range(1, len(sub) + 1))
+
+
+def test_q12_window_over_agg_vs_pandas(tpcds):
+    """Q12: SUM(x)*100/SUM(SUM(x)) OVER (PARTITION BY class) — the
+    revenue ratios within each class must sum to 100."""
+    got = Q.run(12, tpcds).to_pandas()
+    if got.empty:
+        return
+    full = got.groupby("i_class").revenueratio.sum()
+    # classes fully inside the LIMIT 100 cut sum to 100
+    counts = got.groupby("i_class").size()
+    import pandas as pd
+    for cls, s in full.items():
+        if counts[cls] < 100:
+            assert s == pytest.approx(100.0, rel=1e-6) or len(got) == 100
