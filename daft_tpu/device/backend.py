@@ -49,7 +49,8 @@ def _probe_body() -> None:
         # artifacts are machine-feature-pinned and reload with SIGILL-risk
         # warnings across hosts. Opt out with DAFT_TPU_COMPILATION_CACHE=0
         # or point it elsewhere via =path.
-        cache = os.environ.get("DAFT_TPU_COMPILATION_CACHE", "")
+        cache = os.environ.get("DAFT_TPU_COMPILATION_CACHE") \
+            or os.environ.get("DAFT_TPU_COMPILE_CACHE") or ""
         if cache != "0" and _backend == "tpu":
             path = cache or os.path.join(
                 os.path.expanduser("~"), ".cache", "daft_tpu_xla")

@@ -7,7 +7,6 @@ live-row mask, padded to a power-of-two capacity bucket.
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -24,28 +23,13 @@ from ..series import Series
 
 jax.config.update("jax_enable_x64", True)
 
-# persistent compile cache: cold TPU compiles can take minutes (remote
-# compile); re-runs of the same (bucket, dtype, op) shapes must hit disk.
-# Default to a repo-local dir, falling back to ~/.cache when that tree is
-# read-only (installed packages).
-def _default_cache_dir() -> str:
-    repo_local = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))), ".cache", "xla")
-    try:
-        os.makedirs(repo_local, exist_ok=True)
-        if os.access(repo_local, os.W_OK):
-            return repo_local
-    except OSError:
-        pass
-    return os.path.expanduser("~/.cache/daft_tpu/xla")
-
-
-_cache_dir = os.environ.get("DAFT_TPU_COMPILE_CACHE") or _default_cache_dir()
-try:
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-except Exception:
-    pass
+# Persistent compile-cache configuration lives in backend.py (TPU-only:
+# the TPU executables survive process restarts and machine moves, while
+# CPU AOT artifacts are machine-feature-pinned — a cache written on one
+# host reloads on another with SIGILL-risk warnings and forces a native
+# recompile per (bucket, dtype, op) shape that burned minutes per SF100
+# scan before the guard). column.py must not configure it at import:
+# this module loads before the backend probe decides cpu vs tpu.
 
 _MIN_CAPACITY = 16
 

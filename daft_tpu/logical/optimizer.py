@@ -57,6 +57,10 @@ class Optimizer:
                                        PushDownProjection()],
                   "fixed_point"),
             Batch("joins", [ReorderJoins()], "once"),
+            # after the join order settles: key-set transfer into
+            # duplicate-collapsing probe sides (its semi joins then get
+            # their own pushdown sweep below)
+            Batch("semi_reduction", [SemiJoinReduction()], "once"),
             Batch("post_join_pushdowns", [EliminateCrossJoin(),
                                           PushDownFilter(),
                                           PushDownProjection()],
@@ -869,6 +873,153 @@ class FilterNullJoinKey(Rule):
                 return node
             return node.with_children([newl, newr])
         return plan.transform_up(fn)
+
+
+class SemiJoinReduction(Rule):
+    """Sideways information passing for joins whose probe side collapses
+    duplicates: ``Join(A, [Project/Filter]* Distinct/Aggregate(S))`` with
+    S estimated much larger than A → pre-filter S with a semi join on
+    A's DISTINCT join keys, so the Distinct/Aggregate processes only the
+    join-relevant fraction.
+
+    Identity-preserving for inner / semi / anti / left-preserving joins:
+    an S row dropped by the key filter can only produce reduced-side rows
+    whose join key has no partner in A — rows those join types ignore.
+    TPC-H Q21 is the motivating shape: the EXISTS/NOT-EXISTS branches
+    each run DISTINCT over the full 6M-row lineitem projection, of which
+    ~3% survive the join against the Saudi/failed-order base; with the
+    reduction the dedups see only that fraction. The duplicated A
+    subtree costs nothing extra at runtime: the executor's subplan
+    sharing streams one execution to both consumers.
+
+    Reference analogue: Daft has no sideways information passing; its
+    optimizer stops at predicate transfer across keys
+    (``optimization/rules/``) — this rule generalizes that to key-SET
+    transfer, the classic magic-sets/bloom-reduction rewrite.
+    """
+
+    name = "semi_join_reduction"
+    MIN_ROWS = 500_000      # don't churn small plans
+    RATIO = 4.0             # reduced side must be ≥4x the key side
+
+    def apply(self, plan):
+        from . import stats as lstats
+
+        def fn(node):
+            if not isinstance(node, lp.Join):
+                return node
+            # which sides may be reduced without changing semantics:
+            # the side whose unmatched rows the join DROPS
+            reducible = {"inner": (True, True), "semi": (False, True),
+                         "anti": (False, True), "left": (False, True),
+                         "right": (True, False)}.get(node.how)
+            if reducible is None:
+                return node
+            newl, newr = node.children
+            if reducible[1]:
+                newr = self._reduce(newr, node.right_on, newl,
+                                    node.left_on, lstats) or newr
+            if reducible[0]:
+                newl = self._reduce(newl, node.left_on, newr,
+                                    node.right_on, lstats) or newl
+            if newl is node.children[0] and newr is node.children[1]:
+                return node
+            return node.with_children([newl, newr])
+
+        return plan.transform_up(fn)
+
+    def _reduce(self, side, side_keys, other, other_keys, lstats):
+        """Rewrite ``side`` (the collapsing subtree) or return None."""
+        if not all(e.op == "col" for e in side_keys) \
+                or not all(e.op == "col" for e in other_keys):
+            return None
+        # walk down through col-only Projects and Filters to a
+        # Distinct / grouped Aggregate, tracking key renames
+        chain = []
+        keys = [e.params[0] for e in side_keys]
+        node = side
+        # a UDF in the chain may be stateful/nondeterministic — its
+        # values (or a filter's verdicts) over a reduced input could
+        # differ
+        def has_udf(e):
+            return e.op == "udf" or any(has_udf(a) for a in e.args)
+
+        while True:
+            if isinstance(node, lp.Filter):
+                if has_udf(node.predicate):
+                    return None
+                chain.append(node)
+                node = node.children[0]
+                continue
+            if isinstance(node, lp.Project):
+                if any(has_udf(e) for e in node.exprs):
+                    return None
+                mapped = []
+                byname = {e.name(): e._unalias() for e in node.exprs}
+                for k in keys:
+                    src = byname.get(k)
+                    if src is None or src.op != "col":
+                        return None
+                    mapped.append(src.params[0])
+                keys = mapped
+                chain.append(node)
+                node = node.children[0]
+                continue
+            break
+        if isinstance(node, lp.Distinct):
+            if node.on is not None:
+                return None  # keyed dedup: dropped rows are observable
+            collapse = node
+        elif isinstance(node, lp.Aggregate) and node.group_by:
+            # map each join key through the aggregate by OUTPUT name:
+            # an aliased group key (GROUP BY b AS a) must filter the
+            # SOURCE column b, and every key must resolve unambiguously
+            out_to_src = {}
+            for g in node.group_by:
+                u = g._unalias()
+                if u.op == "col":
+                    out_to_src.setdefault(g.name(), u.params[0])
+            mapped = []
+            for k in keys:
+                src = out_to_src.get(k)
+                if src is None:
+                    return None  # not a plain-column group key
+                mapped.append(src)
+            keys = mapped
+            collapse = node
+        else:
+            return None
+        s = collapse.children[0]
+        # the Project chain may rename ABOVE the collapse too — map keys
+        # through the collapse (Distinct/Agg group keys pass unchanged)
+        s_stats = lstats.estimate(s)
+        o_stats = lstats.estimate(other)
+        if s_stats.rows is None or o_stats.rows is None:
+            return None
+        if s_stats.rows < self.MIN_ROWS \
+                or s_stats.rows < self.RATIO * o_stats.rows:
+            return None
+        # distinct key projection of the other side, renamed to fresh
+        # names (S usually shares column names with A — Q21 self-joins).
+        # The tag derives from the CONTENT (key side + key names), not a
+        # global counter: identical reducible subtrees must rewrite to
+        # identical plans or the executor's semantic-id subplan sharing
+        # would run the shared key side once per textual copy
+        import hashlib
+        tag = hashlib.md5(repr(
+            (other.semantic_id(), [e.params[0] for e in other_keys],
+             keys)).encode()).hexdigest()[:8]
+        knames = [f"__sjr{tag}_{i}__" for i in range(len(other_keys))]
+        kproj = lp.Distinct(lp.Project(
+            other, [col(e.params[0]).alias(n)
+                    for e, n in zip(other_keys, knames)]))
+        filtered = lp.Join(s, kproj, [col(k) for k in keys],
+                           [col(n) for n in knames], "semi")
+        # rebuild the collapse + chain over the filtered source
+        out = collapse.with_children([filtered])
+        for n in reversed(chain):
+            out = n.with_children([out])
+        return out
 
 
 class PushDownJoinPredicate(Rule):

@@ -220,3 +220,42 @@ def test_push_down_join_predicate_transfers_key_filter():
     assert side_has_key_filter(j.children[1]), plan.repr_ascii()
     out = df.sort("k").to_pydict()
     assert out["k"] == [0, 1, 2, 3, 4]
+
+
+def test_semi_join_reduction_fires_and_preserves_results(monkeypatch, tmp_path):
+    """Join(A, Distinct(S)) with S >> A: the rule pre-filters S with a
+    semi join on A's distinct keys; results must be identical and the
+    optimized plan must contain the inserted semi join."""
+    from daft_tpu.logical.optimizer import SemiJoinReduction
+    monkeypatch.setattr(SemiJoinReduction, "MIN_ROWS", 10)
+    import pyarrow.parquet as pq
+    import pyarrow as pa
+    # parquet-backed so stats.estimate has real row counts
+    s = pa.table({"k": list(range(1000)) * 2,
+                  "v": [i % 7 for i in range(2000)]})
+    a = pa.table({"k": [1, 2, 3], "w": [10.0, 20.0, 30.0]})
+    pq.write_table(s, str(tmp_path / "s.parquet"))
+    pq.write_table(a, str(tmp_path / "a.parquet"))
+    S = daft_tpu.read_parquet(str(tmp_path / "s.parquet"))
+    A = daft_tpu.read_parquet(str(tmp_path / "a.parquet"))
+    joined = A.join(S.select(col("k").alias("sk"), col("v")).distinct(),
+                    left_on="k", right_on="sk").sort([col("k"), col("v")])
+    plan = joined._builder.optimize()._plan
+    semis = []
+
+    def walk(n):
+        if isinstance(n, lp.Join) and n.how == "semi":
+            semis.append(n)
+        for c in n.children:
+            walk(c)
+    walk(plan)
+    assert semis, "SemiJoinReduction did not fire"
+    got = joined.to_pydict()
+    monkeypatch.setattr(SemiJoinReduction, "apply",
+                        lambda self, p: p)
+    exp = joined.to_pydict()
+    assert got == exp
+    assert sorted(set(got["k"])) == [1, 2, 3]
+    # each key k appears at rows i=k and i=1000+k, giving v values k%7
+    # and (k+6)%7 — two distinct v per key
+    assert len(got["v"]) == 3 * 2
