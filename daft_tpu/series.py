@@ -65,6 +65,15 @@ class Series:
                     dtype: Optional[DataType] = None) -> "Series":
         if dtype is not None and dtype.is_python():
             return cls.from_pyobjects(data, name)
+        if dtype is not None and dtype.kind in ("tensor", "image",
+                                                "sparse_tensor"):
+            # variable-shape multimodal rows (ndarrays) → the struct
+            # physical layout (dtype.rs:307-335); a pyobject fallback here
+            # would silently disable the whole cast/kernels matrix
+            arr = _multimodal_from_rows(data, dtype)
+            if arr is not None:
+                return cls(name, dtype, arrow=arr)
+            return cls.from_pyobjects(data, name)
         try:
             arr = pa.array(data, type=dtype.to_arrow() if dtype is not None else None)
         except (pa.ArrowInvalid, pa.ArrowNotImplementedError, pa.ArrowTypeError):
@@ -140,11 +149,19 @@ class Series:
     def to_pylist(self) -> List[Any]:
         if self._pyobjs is not None:
             return list(self._pyobjs)
+        if self._dtype.kind in ("tensor", "image"):
+            return _multimodal_to_rows(self._arrow, self._dtype)
         return self._arrow.to_pylist()
 
     def to_numpy(self) -> np.ndarray:
         if self._pyobjs is not None:
             return self._pyobjs
+        if self._dtype.kind in ("tensor", "image", "sparse_tensor"):
+            # variable-shape struct storage: rows are ragged — object array
+            out = np.empty(len(self), dtype=object)
+            for i, v in enumerate(self.to_pylist()):
+                out[i] = v
+            return out
         if self._dtype.is_tensor() or self._dtype.is_embedding():
             flat = self._arrow.flatten().to_numpy(zero_copy_only=False)
             n = len(self._arrow)
@@ -249,6 +266,9 @@ class Series:
             # (pyarrow has no cast kernel for this direction)
             return Series(self._name, dtype,
                           arrow=pa.nulls(len(self._arrow)))
+        mm = _multimodal_cast(self, dtype)
+        if mm is not None:
+            return mm
         target = dtype.to_arrow()
         try:
             out = self._arrow.cast(target)
@@ -310,6 +330,328 @@ class Series:
 
     def __iter__(self):
         return iter(self.to_pylist())
+
+
+def _fsl_values_offsets(arr: pa.Array):
+    """FixedSizeList array → (flat values, per-row width, validity).
+    ``flatten()`` (not ``.values``) — it respects the array's slice
+    offset; ``.values`` spans the whole backing buffer."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    width = arr.type.list_size
+    valid = np.asarray(pc.is_valid(arr).to_numpy(zero_copy_only=False),
+                       dtype=np.bool_)
+    return arr.flatten(), width, valid
+
+
+def _list_window(data: pa.Array):
+    """List array → (values restricted to this array's window, offsets
+    REBASED to that window). ``offsets`` honors the slice but stays
+    absolute into the backing buffer; ``values`` ignores the slice —
+    this pairs them correctly for sliced arrays."""
+    offs = np.asarray(data.offsets.to_numpy(zero_copy_only=False),
+                      dtype=np.int64)
+    window = data.values.slice(int(offs[0]), int(offs[-1] - offs[0]))
+    return window, offs - offs[0]
+
+
+def _wrap_list(flat: pa.Array, counts: np.ndarray,
+               valid: np.ndarray) -> pa.Array:
+    offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    off = pa.array(offsets, pa.int64())
+    out = pa.LargeListArray.from_arrays(off, flat)
+    if not valid.all():
+        out = pc.if_else(pa.array(valid), out, pa.nulls(len(valid), out.type))
+    return out
+
+
+def _multimodal_from_rows(data: Sequence[Any],
+                          dtype: DataType) -> Optional[pa.Array]:
+    """ndarray rows → the struct physical for variable-shape tensor /
+    image / sparse-tensor columns. None when a row isn't array-like."""
+    from .datatype import ImageMode
+    rows = []
+    for v in data:
+        if v is None:
+            rows.append(None)
+            continue
+        if isinstance(v, dict):
+            if dtype.kind != "sparse_tensor":
+                return None  # dict rows only mean something for sparse
+            rows.append(v)
+            continue
+        try:
+            rows.append(np.asarray(v))
+        except Exception:
+            return None
+        if rows[-1].dtype == object:
+            return None
+    n = len(rows)
+    valid = np.array([r is not None for r in rows], dtype=np.bool_)
+    mask = pa.array(~valid) if not valid.all() else None
+    try:
+        return _multimodal_build(rows, dtype, n, valid, mask)
+    except Exception:
+        return None  # non-conforming rows → pyobject fallback
+
+
+def _multimodal_build(rows, dtype, n, valid, mask):
+    from .datatype import ImageMode
+    if dtype.kind == "tensor":
+        inner = dtype._params[0].to_physical().to_arrow()
+        flats, shapes, counts, scounts = [], [], [], []
+        for r in rows:
+            if r is None:
+                counts.append(0)
+                scounts.append(0)
+            else:
+                flats.append(r.ravel())
+                shapes.append(np.asarray(r.shape, np.uint64))
+                counts.append(r.size)
+                scounts.append(r.ndim)
+        flat = np.concatenate(flats) if flats else np.empty(0)
+        shp = np.concatenate(shapes) if shapes else np.empty(0, np.uint64)
+        data_col = _wrap_list(pa.array(flat).cast(inner),
+                              np.asarray(counts, np.int64), valid)
+        shape_col = _wrap_list(pa.array(shp, pa.uint64()),
+                               np.asarray(scounts, np.int64), valid)
+        return pa.StructArray.from_arrays([data_col, shape_col],
+                                          ["data", "shape"], mask=mask)
+    if dtype.kind == "image":
+        mode = dtype._params[0]
+        flats, counts, chans, hs, ws, modes = [], [], [], [], [], []
+        for r in rows:
+            if r is None:
+                counts.append(0)
+                chans.append(0); hs.append(0); ws.append(0); modes.append(0)
+                continue
+            if r.ndim == 2:
+                r = r[:, :, None]
+            h, w, c = r.shape
+            m = mode if mode is not None else \
+                {1: ImageMode.L, 2: ImageMode.LA, 3: ImageMode.RGB,
+                 4: ImageMode.RGBA}.get(c)
+            flats.append(r.ravel())
+            counts.append(r.size)
+            chans.append(c); hs.append(h); ws.append(w)
+            modes.append(m.value if m is not None else 0)
+        if mode is not None:
+            inner = DataType.from_numpy_dtype(mode.np_dtype).to_arrow()
+        else:
+            dts = {f.dtype for f in flats}
+            if len(dts) > 1 or (dts and next(iter(dts)) not in (
+                    np.dtype(np.uint8),)):
+                raise ValueError("mode-less image rows must be uint8")
+            inner = DataType.uint8().to_arrow()
+        flat = np.concatenate(flats) if flats else np.empty(0)
+        data_col = _wrap_list(pa.array(flat).cast(inner),
+                              np.asarray(counts, np.int64), valid)
+        return pa.StructArray.from_arrays(
+            [data_col, pa.array(chans, pa.uint16()),
+             pa.array(hs, pa.uint32()), pa.array(ws, pa.uint32()),
+             pa.array(modes, pa.uint8())],
+            ["data", "channel", "height", "width", "mode"], mask=mask)
+    if dtype.kind == "sparse_tensor":
+        inner = dtype._params[0].to_physical().to_arrow()
+        vals, idxs, shps = [], [], []
+        vcounts, icounts, scounts = [], [], []
+        for r in rows:
+            if r is None:
+                vcounts.append(0); icounts.append(0); scounts.append(0)
+                continue
+            if isinstance(r, dict):
+                v = np.asarray(r["values"]); i = np.asarray(r["indices"],
+                                                            np.uint64)
+                shp = np.asarray(r["shape"], np.uint64)
+            else:
+                flat = r.ravel()
+                nz = np.flatnonzero(flat)
+                v = flat[nz]; i = nz.astype(np.uint64)
+                shp = np.asarray(r.shape, np.uint64)
+            vals.append(v); idxs.append(i); shps.append(shp)
+            vcounts.append(len(v)); icounts.append(len(i))
+            scounts.append(len(shp))
+        def cat(parts, dt=None):
+            return np.concatenate(parts) if parts else np.empty(0, dt or np.float64)
+        values_col = _wrap_list(pa.array(cat(vals)).cast(inner),
+                                np.asarray(vcounts, np.int64), valid)
+        idx_col = _wrap_list(pa.array(cat(idxs, np.uint64), pa.uint64()),
+                             np.asarray(icounts, np.int64), valid)
+        shp_col = _wrap_list(pa.array(cat(shps, np.uint64), pa.uint64()),
+                             np.asarray(scounts, np.int64), valid)
+        return pa.StructArray.from_arrays([values_col, idx_col, shp_col],
+                                          ["values", "indices", "shape"],
+                                          mask=mask)
+    return None
+
+
+def _multimodal_to_rows(arr: pa.Array, dtype: DataType) -> List[Any]:
+    """Struct-physical tensor/image columns → ndarray rows (what users
+    put in is what they get back)."""
+    arr = _combine(arr)
+    out: List[Any] = []
+    valid = np.asarray(pc.is_valid(arr).to_numpy(zero_copy_only=False),
+                       dtype=np.bool_)
+    if dtype.kind == "tensor":
+        data = arr.field("data")
+        shape = arr.field("shape")
+        for i in range(len(arr)):
+            if not valid[i]:
+                out.append(None)
+                continue
+            d = np.asarray(data[i].as_py())
+            s = tuple(int(x) for x in (shape[i].as_py() or ()))
+            out.append(d.reshape(s) if s else d)
+        return out
+    if dtype.kind == "image":
+        data = arr.field("data")
+        hs = arr.field("height")
+        ws = arr.field("width")
+        cs = arr.field("channel")
+        for i in range(len(arr)):
+            if not valid[i]:
+                out.append(None)
+                continue
+            d = np.asarray(data[i].as_py())
+            c = int(cs[i].as_py())
+            shape = (int(hs[i].as_py()), int(ws[i].as_py())) \
+                if c == 1 else (int(hs[i].as_py()), int(ws[i].as_py()), c)
+            out.append(d.reshape(shape))  # L-mode rows stay 2-D, like PIL
+        return out
+    return arr.to_pylist()
+
+
+def _multimodal_cast(s: "Series", dtype: DataType) -> "Optional[Series]":
+    """Cast directions pyarrow has no kernels for: the multimodal matrix
+    between fixed-shape and variable-shape tensor/image types and the
+    dense↔sparse tensor pair (reference:
+    ``src/daft-core/src/array/ops/cast.rs`` — the physical layouts here
+    mirror ``dtype.rs:307-335``)."""
+    src = s.datatype()
+    arr = s.to_arrow()
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    n = len(arr)
+
+    def done(struct):
+        return Series(s.name(), dtype, arrow=struct)
+
+    # fixed-shape tensor/embedding → variable Tensor --------------------
+    if src.kind in ("fixed_shape_tensor", "embedding") \
+            and dtype.kind == "tensor":
+        inner, shape = (src._params if src.kind == "fixed_shape_tensor"
+                        else (src._params[0], (src._params[1],)))
+        flat, width, valid = _fsl_values_offsets(arr)
+        tgt_inner = dtype._params[0].to_physical().to_arrow()
+        if flat.type != pa.large_list(tgt_inner).value_type:
+            flat = flat.cast(tgt_inner)
+        # flatten() drops null rows' slots, so null rows count 0
+        counts = np.where(valid, width, 0).astype(np.int64)
+        data = _wrap_list(flat, counts, valid)
+        shape_flat = pa.array(np.tile(np.asarray(shape, np.uint64),
+                                      int(valid.sum())))
+        shapes = _wrap_list(shape_flat,
+                            np.where(valid, len(shape), 0).astype(np.int64),
+                            valid)
+        return done(pa.StructArray.from_arrays(
+            [data, shapes], ["data", "shape"],
+            mask=pa.array(~valid) if not valid.all() else None))
+
+    # FixedShapeImage → Image -------------------------------------------
+    if src.kind == "fixed_shape_image" and dtype.kind == "image":
+        mode, h, w = src._params
+        flat, width, valid = _fsl_values_offsets(arr)
+        data = _wrap_list(flat, np.where(valid, width, 0).astype(np.int64),
+                          valid)
+        mk = lambda v, t: pa.array(np.full(n, v), t)  # noqa: E731
+        return done(pa.StructArray.from_arrays(
+            [data, mk(mode.num_channels, pa.uint16()),
+             mk(h, pa.uint32()), mk(w, pa.uint32()),
+             mk(mode.value, pa.uint8())],
+            ["data", "channel", "height", "width", "mode"],
+            mask=pa.array(~valid) if not valid.all() else None))
+
+    # Image → Tensor (shape = [h, w, c] per row) ------------------------
+    if src.kind == "image" and dtype.kind == "tensor":
+        valid = np.asarray(pc.is_valid(arr).to_numpy(zero_copy_only=False),
+                           dtype=np.bool_)
+        data = arr.field("data")
+        h = arr.field("height").to_numpy(zero_copy_only=False)
+        w = arr.field("width").to_numpy(zero_copy_only=False)
+        c = arr.field("channel").to_numpy(zero_copy_only=False)
+        hwc = np.stack([np.where(valid, h, 0), np.where(valid, w, 0),
+                        np.where(valid, c, 0)], axis=1).astype(np.uint64)
+        shapes = _wrap_list(pa.array(hwc.ravel()),
+                            np.full(n, 3, np.int64), valid)
+        tgt_inner = dtype._params[0].to_physical().to_arrow()
+        if data.type.value_type != tgt_inner:
+            data = data.cast(pa.large_list(tgt_inner))
+        elif not isinstance(data.type, pa.LargeListType):
+            data = data.cast(pa.large_list(data.type.value_type))
+        return done(pa.StructArray.from_arrays(
+            [data, shapes], ["data", "shape"],
+            mask=pa.array(~valid) if not valid.all() else None))
+
+    # Tensor → SparseTensor (drop zeros, record indices) ----------------
+    if src.kind == "tensor" and dtype.kind == "sparse_tensor":
+        valid = np.asarray(pc.is_valid(arr).to_numpy(zero_copy_only=False),
+                           dtype=np.bool_)
+        data = _combine(arr.field("data"))
+        shape_col = arr.field("shape")
+        flat, offs = _list_window(data)  # slice-safe: rebased offsets
+        flat = np.asarray(flat.to_numpy(zero_copy_only=False))
+        spans = np.diff(offs)
+        nz = (flat != 0) & np.repeat(valid, spans)
+        row_of = np.repeat(np.arange(n), spans)
+        counts = np.bincount(row_of[nz], minlength=n).astype(np.int64) \
+            if len(flat) else np.zeros(n, np.int64)
+        row_base = np.repeat(offs[:-1], spans)
+        idx_all = (np.arange(len(flat)) - row_base).astype(np.uint64)
+        tgt_inner = dtype._params[0].to_physical().to_arrow()
+        values = _wrap_list(pa.array(flat[nz]).cast(tgt_inner),
+                            counts, valid)
+        indices = _wrap_list(pa.array(idx_all[nz]), counts, valid)
+        return done(pa.StructArray.from_arrays(
+            [values, indices, shape_col.cast(pa.large_list(pa.uint64()))],
+            ["values", "indices", "shape"],
+            mask=pa.array(~valid) if not valid.all() else None))
+
+    # SparseTensor → Tensor (dense reconstruction) ----------------------
+    if src.kind == "sparse_tensor" and dtype.kind == "tensor":
+        valid = np.asarray(pc.is_valid(arr).to_numpy(zero_copy_only=False),
+                           dtype=np.bool_)
+        values = _combine(arr.field("values"))
+        indices = _combine(arr.field("indices"))
+        shape_col = _combine(arr.field("shape"))
+        shp_flat_a, shp_offs = _list_window(shape_col)
+        shp_flat = np.asarray(shp_flat_a.to_numpy(zero_copy_only=False),
+                              dtype=np.int64)
+        dense_counts = np.ones(n, np.int64)
+        for i in range(n):
+            dims = shp_flat[shp_offs[i]:shp_offs[i + 1]]
+            dense_counts[i] = int(np.prod(dims)) if len(dims) else 0
+        dense_counts = np.where(valid, dense_counts, 0)
+        total = int(dense_counts.sum())
+        tgt_inner = dtype._params[0].to_physical()
+        out_flat = np.zeros(total, dtype=tgt_inner.device_repr())
+        bases = np.concatenate([[0], np.cumsum(dense_counts)])[:-1]
+        v_flat_a, v_offs = _list_window(values)
+        i_flat_a, _ = _list_window(indices)
+        v_flat = np.asarray(v_flat_a.to_numpy(zero_copy_only=False))
+        i_flat = np.asarray(i_flat_a.to_numpy(zero_copy_only=False),
+                            dtype=np.int64)
+        spans = np.diff(v_offs)
+        keep = np.repeat(valid, spans)
+        row_of = np.repeat(np.arange(n), spans)
+        if len(v_flat):
+            out_flat[bases[row_of[keep]] + i_flat[keep]] = v_flat[keep]
+        dense = _wrap_list(pa.array(out_flat), dense_counts, valid)
+        return done(pa.StructArray.from_arrays(
+            [dense, shape_col.cast(pa.large_list(pa.uint64()))],
+            ["data", "shape"],
+            mask=pa.array(~valid) if not valid.all() else None))
+
+    return None
 
 
 _FNV_OFFSET = np.uint64(14695981039346656037)

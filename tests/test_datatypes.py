@@ -74,3 +74,49 @@ def test_schema_arrow_roundtrip():
     s = Schema.from_pydict({"a": DataType.int64(), "b": DataType.string(),
                             "c": DataType.list(DataType.float64())})
     assert Schema.from_arrow(s.to_arrow()) == s
+
+
+def test_multimodal_cast_matrix():
+    """The reference's cast matrix between multimodal types
+    (``src/daft-core/src/array/ops/cast.rs``): fixed↔variable tensor and
+    image, image→tensor, dense↔sparse tensor — all columnar (no
+    Python-object fallback), null-preserving, value-exact."""
+    import numpy as np
+    from daft_tpu.series import Series
+
+    t = Series.from_pylist(
+        [np.array([[0., 2.], [5., 0.]], np.float32), None], "t",
+        dtype=DataType.tensor(DataType.float32()))
+    assert not t.is_pyobject()
+    sp = t.cast(DataType.sparse_tensor(DataType.float32()))
+    assert sp.to_pylist()[0] == {"values": [2.0, 5.0], "indices": [1, 2],
+                                 "shape": [2, 2]}
+    assert sp.to_pylist()[1] is None
+    back = sp.cast(DataType.tensor(DataType.float32()))
+    assert back.to_pylist()[0].tolist() == [[0.0, 2.0], [5.0, 0.0]]
+    assert back.to_pylist()[1] is None
+
+    img = Series.from_pylist(
+        [np.arange(12, dtype=np.uint8).reshape(2, 2, 3)], "i",
+        dtype=DataType.image("RGB"))
+    assert not img.is_pyobject()
+    it = img.cast(DataType.tensor(DataType.uint8()))
+    assert it.to_pylist()[0].shape == (2, 2, 3)
+    assert it.to_pylist()[0].ravel().tolist() == list(range(12))
+
+    ft = Series.from_pylist(
+        [np.arange(6).reshape(2, 3).astype(np.float32)], "ft",
+        dtype=DataType.tensor(DataType.float32(), (2, 3)))
+    assert ft.cast(DataType.tensor(
+        DataType.float32())).to_pylist()[0].shape == (2, 3)
+
+    fi = Series.from_pylist(
+        [np.ones((2, 2, 3), np.uint8)], "fi",
+        dtype=DataType.fixed_shape_image("RGB", 2, 2))
+    vi = fi.cast(DataType.image("RGB"))
+    assert vi.to_pylist()[0].shape == (2, 2, 3)
+
+    emb = Series.from_pylist([[1.0, 2.0, 3.0]], "e",
+                             dtype=DataType.embedding(DataType.float32(), 3))
+    assert repr(emb.cast(DataType.tensor(DataType.float32())).datatype()) \
+        == repr(DataType.tensor(DataType.float32()))
