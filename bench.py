@@ -61,7 +61,11 @@ DEVICE_TIMEOUT = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "900"))
 # timed out because per-section budgets never summed to a bound). EVERY
 # section checks the remaining budget before starting; whatever doesn't fit
 # is named in `skipped_sections` and the one JSON line is still emitted.
-TOTAL_BUDGET = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "600"))
+# 480 (not 600): sections check the budget BEFORE starting a query, so a
+# long SF10 query that starts at T-1 overruns by its own duration (~90s
+# worst observed single query). 480 + 90 stays inside every driver window
+# that 600 nominally targeted (round-3 postmortem: rc=124 twice).
+TOTAL_BUDGET = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "480"))
 _T0 = time.time()
 
 
@@ -463,10 +467,12 @@ def main():
     if os.path.isdir(os.path.join(SF10_DATA, "lineitem")) \
             and os.environ.get("BENCH_SKIP_SF10") != "1":
         # last: whatever global budget is left, queries past it are named
+        # reserve the worst observed single SF10 query (~90s) so the
+        # last query to START cannot push the emit past the window
         r = section("tpch_sf10_suite_host",
                     lambda: run_tpch_suite(SF10_DATA,
-                                           budget_s=_remaining() - 10),
-                    min_needed=30.0)
+                                           budget_s=_remaining() - 100),
+                    min_needed=110.0)
         if r is not None:
             detail["tpch_sf10_suite_host"] = r
 

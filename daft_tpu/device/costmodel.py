@@ -52,6 +52,8 @@ DEV_SORT_ROWS_PER_S = 50.0e6    # XLA multi-key sort, rows/s
 DEV_JOIN_ROWS_PER_S = 40.0e6    # sort/searchsorted/expand join, rows/s
 DEV_DISPATCH_S = 2.0e-3     # per-decision executable launch + (amortized)
 #                             shape-bucket compile overhead
+INVEST_MAX_RATIO = 64.0     # max cache-fill cost vs one host pass (see
+#                             agg_upload_wins' bounded-investment rule)
 
 
 @dataclass(frozen=True)
@@ -203,7 +205,7 @@ def argsort_wins(n_rows: int, key_bytes: float, n_keys: int) -> bool:
 
 
 def agg_upload_wins(bytes_up: float, bytes_down: float,
-                    cacheable: bool) -> bool:
+                    cacheable: bool, round_trips: float = 2.0) -> bool:
     """Aggregation whose inputs are NOT already device-resident.
 
     Cacheable inputs (stable scan-task fingerprint, fits the HBM budget) are
@@ -215,16 +217,34 @@ def agg_upload_wins(bytes_up: float, bytes_down: float,
     upload must beat the host outright.
 
     Non-cacheable inputs pay full freight against a host pass at
-    ``HOST_AGG_BPS`` over the touched bytes."""
+    ``HOST_AGG_BPS`` over the touched bytes.
+
+    The investment is BOUNDED (r4: TPC-H Q22's tiny per-task aggregates
+    were 'invested' at ~20x a host pass — 16 RTT-dominated round trips
+    the cache never paid back, 10.9s vs 2.1s host on the SF10 suite): a
+    fill may cost up to ``INVEST_MAX_RATIO``x the host pass, enough to
+    absorb genuinely profitable cache fills (Q1/Q6 measured ~8-9x fill
+    for ~10x steady-state) while rejecting fills that would need dozens
+    of repeat queries to break even."""
     f = _forced()
     if f is not None:
         return f
-    if cacheable and os.environ.get("DAFT_TPU_CACHE_INVEST", "1") != "0":
-        return True
+    lp = link_profile()
     host_s = bytes_up / HOST_AGG_BPS
     kernel_s = DEV_DISPATCH_S + bytes_up / DEV_AGG_BPS
-    return link_profile().device_seconds(
-        bytes_up, bytes_down, 2.0, kernel_s) < host_s
+    dev_s = lp.device_seconds(bytes_up, bytes_down, round_trips, kernel_s)
+    if cacheable and os.environ.get("DAFT_TPU_CACHE_INVEST", "1") != "0":
+        # invest only when residency PAYS: a resident rerun (no upload,
+        # but every dispatch still pays its — window-amortized, see
+        # _fragment_scan_tasks' single packed fetch — round trips) must
+        # beat the host pass, else the cache can never repay the fill no
+        # matter how many times the query repeats (r4: TPC-H Q22's tiny
+        # per-task aggregates burned 10.9s vs 2.1s host at SF10). The
+        # ratio bound additionally rejects pathological fill costs.
+        resident_s = lp.device_seconds(0.0, bytes_down, round_trips,
+                                       kernel_s)
+        return resident_s < host_s and dev_s < INVEST_MAX_RATIO * host_s
+    return dev_s < host_s
 
 
 def join_wins(n_left: int, n_right: int, bytes_up: float,

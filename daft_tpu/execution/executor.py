@@ -376,7 +376,7 @@ class LocalExecutor:
             finally:
                 self.mem.release(est)
 
-        def resolve(t):
+        def resolve(t, wlen=1):
             from ..device import costmodel
             fp = dcache.task_fingerprint(t)
             if fp is not None:
@@ -400,9 +400,13 @@ class LocalExecutor:
             col_bytes = drt._batch_cols_nbytes(rb, prog.compiled.needs_cols)
             est_encoded = 2 * col_bytes  # capacity bucketing ≤ doubles
             fits = est_encoded * max(n_tasks, 1) <= dcache._budget()
+            # round trips amortize across THIS window (a partial final
+            # window must not under-charge its tasks): every task's
+            # packed result comes back in ONE transfer
             if not costmodel.agg_upload_wins(
                     col_bytes, packed_out,
-                    cacheable=fp is not None and fits):
+                    cacheable=fp is not None and fits,
+                    round_trips=2.0 / max(1, wlen)):
                 return ("host", rb, t)
             try:
                 dt = dcol.encode_batch(rb, prog.compiled.needs_cols)
@@ -418,7 +422,9 @@ class LocalExecutor:
             window = list(itertools.islice(it, width))
             if not window:
                 return
-            resolved = list(_ordered_parallel(iter(window), resolve))
+            wlen = len(window)
+            resolved = list(_ordered_parallel(
+                iter(window), lambda t: resolve(t, wlen)))
             outs = fragment.run_fused_agg_tables(
                 prog, [dt for kind, dt, _ in resolved if kind == "dev"],
                 src.schema(), node.group_by, agg_cols, node.schema())
