@@ -310,9 +310,13 @@ def _device_child():
 
     out, warm, hot = run_tpch_query(DATA, "q1")
     from daft_tpu.device import backend as dbackend
+    # emit the headline BEFORE the extra spread samples: a timeout during
+    # them must only lose the spread, never the Q1 section itself
     _emit({"warm": warm, "hot": hot,
            "groups": len(next(iter(out.values()))),
            "backend": dbackend.backend_name() or "host-fallback"})
+    _, w3, h3 = run_tpch_query(DATA, "q1")  # 3 hot samples → median + spread
+    _emit({"runs": sorted(round(x, 3) for x in (hot, w3, h3))})
 
     for qn in ("q6", "q3", "q10"):
         if time.time() > deadline:
@@ -403,14 +407,25 @@ def main():
 
     base_groups, base_s = pinned_arrow_baseline()
 
-    # host tier first: hang-free, guarantees a number is always reported
+    # host tier first: hang-free, guarantees a number is always reported.
+    # Three runs (not two): the r4 postmortem showed the device-vs-host Q1
+    # margin flipping sign inside run-to-run noise, so both tiers report
+    # median-of-3 plus the spread, and a "win" is only claimed when the
+    # margin exceeds the combined spread.
     os.environ["DAFT_TPU_DEVICE"] = "0"
     out, host_warm, host_hot = run_tpch_query(DATA, "q1")
     assert len(out["l_returnflag"]) == base_groups, \
         (len(out["l_returnflag"]), base_groups)
+    _, h3w, h3h = run_tpch_query(DATA, "q1")
+    host_runs = sorted([host_hot, h3w, h3h])
 
+    host_med = host_runs[1]
+    host_spread = host_runs[-1] - host_runs[0]
     detail = {
         "host_warm_s": round(host_warm, 3), "host_hot_s": round(host_hot, 3),
+        "host_q1_runs_s": [round(x, 3) for x in host_runs],
+        "host_q1_median_s": round(host_med, 3),
+        "host_q1_spread_s": round(host_spread, 3),
         "arrow_cpu_baseline_s": round(base_s, 3), "lineitem_rows": nrows,
         "backend": "host",
         "total_budget_s": TOTAL_BUDGET,
@@ -444,6 +459,19 @@ def main():
             detail["device_warm_s"] = round(dev["warm"], 3)
             detail["device_hot_s"] = round(dev["hot"], 3)
             detail["device_backend"] = dev.get("backend")
+            dev_runs = sorted(dev.get("runs") or [dev["hot"]])
+            dev_med = dev_runs[len(dev_runs) // 2]
+            dev_spread = dev_runs[-1] - dev_runs[0]
+            detail["device_q1_runs_s"] = dev_runs
+            detail["device_q1_median_s"] = round(dev_med, 3)
+            detail["device_q1_spread_s"] = round(dev_spread, 3)
+            # variance-aware verdict: a tier only "wins" Q1 when the median
+            # margin exceeds the combined observed spread (r4: the claim
+            # flipped sign between two same-box runs inside ±5%)
+            margin = host_med - dev_med
+            noise = host_spread + dev_spread
+            detail["q1_winner"] = ("device" if margin > noise
+                                   else "host" if -margin > noise else "tie")
             if dev["hot"] < ours:
                 ours = dev["hot"]
                 detail["backend"] = dev.get("backend", "device")
@@ -481,7 +509,11 @@ def main():
         if isinstance(v, dict) and "error" in v:
             errors.setdefault(k, v["error"])
 
-    summary = {
+    # Full detail goes to a file; stdout's LAST line is a compact summary.
+    # Four rounds of driver artifacts failed to parse because the final JSON
+    # line (~10 KB) overflowed the driver's 2000-char tail window — the
+    # driver only sees the tail, so the line must stay well under that.
+    full = {
         "metric": f"tpch_q1_sf{SF}_rows_per_sec_per_chip",
         "value": round(nrows / ours, 1),
         "unit": "rows/s",
@@ -489,11 +521,66 @@ def main():
         "detail": detail,
     }
     if skipped:
-        summary["skipped_sections"] = skipped
+        full["skipped_sections"] = skipped
     if errors:
-        summary["section_errors"] = errors
-    summary["elapsed_s"] = round(time.time() - _T0, 1)
-    print(json.dumps(summary))
+        full["section_errors"] = errors
+    full["elapsed_s"] = round(time.time() - _T0, 1)
+
+    results_dir = os.path.join(REPO, "benchmarking", "results")
+    os.makedirs(results_dir, exist_ok=True)
+    artifact = os.path.join(results_dir, "r5_bench_driver.json")
+    with open(artifact, "w") as f:
+        json.dump(full, f, indent=1)
+    # progress/bulk lines first (NOT last): full detail for humans reading
+    # the whole log, then the parseable compact line closes stdout
+    print("bench detail written to " + artifact, flush=True)
+
+    def _suite_total(d):
+        return d.get("total_hot_s") if isinstance(d, dict) else None
+
+    fam: dict = {}
+    for side in ("host", "device"):
+        q1k = f"{side}_q1_median_s"
+        if q1k in detail:
+            fam.setdefault("q1_sf1", {})[side] = detail[q1k]
+            fam["q1_sf1"][f"{side}_spread"] = detail[f"{side}_q1_spread_s"]
+        s = _suite_total(detail.get(f"tpch_sf1_suite_{side}"))
+        if s is not None:
+            fam.setdefault("tpch_sf1_22q", {})[side] = s
+        s = _suite_total(detail.get(f"tpch_sf10_suite_{side}"))
+        if s is not None:
+            fam.setdefault("tpch_sf10", {})[side] = s
+        lai = detail.get(f"laion_{side}")
+        if isinstance(lai, dict) and "images_per_s" in lai:
+            fam.setdefault("laion_img_per_s", {})[side] = lai["images_per_s"]
+        ds = detail.get(f"tpcds_{side}")
+        if isinstance(ds, dict) and not ds.get("error"):
+            tot = sum(v for v in ds.values() if isinstance(v, (int, float)))
+            fam.setdefault("tpcds_trio", {})[side] = round(tot, 3)
+
+    compact = {
+        "metric": full["metric"], "value": full["value"],
+        "unit": "rows/s", "vs_baseline": full["vs_baseline"],
+        "q1_winner": detail.get("q1_winner"),
+        "families": fam,
+        "backend": detail.get("backend"),
+        "artifact": os.path.relpath(artifact, REPO),
+        "elapsed_s": full["elapsed_s"],
+    }
+    if "mfu" in detail:
+        compact["mfu"] = detail["mfu"]
+    if skipped:
+        compact["n_skipped"] = len(skipped)
+    if errors:
+        compact["n_errors"] = len(errors)
+    # hard cap: drop optional keys until the line fits the driver's window
+    for drop in ("mfu", "families", "q1_winner", "backend"):
+        if len(json.dumps(compact)) <= 1500:
+            break
+        compact.pop(drop, None)
+    line = json.dumps(compact)
+    assert len(line) <= 1500, len(line)
+    print(line)
 
 
 if __name__ == "__main__":

@@ -376,8 +376,10 @@ class LocalExecutor:
             finally:
                 self.mem.release(est)
 
-        def resolve(t, wlen=1):
-            from ..device import costmodel
+        def classify(t):
+            """Phase A: cache hits are committed device participants;
+            too-small / pyobject batches are forced host; the rest are
+            candidates for the cost gate (phase B)."""
             fp = dcache.task_fingerprint(t)
             if fp is not None:
                 dt = dcache.get_cache().get_table(fp, prog.compiled.needs_cols)
@@ -389,24 +391,33 @@ class LocalExecutor:
             for nm in prog.compiled.needs_cols:
                 if rb.get_column(nm).is_pyobject():
                     return ("host", rb, t)
-            # measured cost gate: a cacheable upload is an investment the
-            # HBM cache repays on every later scan of the same task — but
-            # only if the whole scan's working set actually FITS the budget
-            # (otherwise LRU thrash re-pays the upload every query and
-            # put_table would refuse oversized tables anyway)
+            return ("cand", rb, t, fp)
+
+        def gate(cand, n_sharing):
+            """Phase B: measured cost gate. A cacheable upload is an
+            investment the HBM cache repays on every later scan of the
+            same task — but only if the whole scan's working set actually
+            FITS the budget (otherwise LRU thrash re-pays the upload every
+            query and put_table would refuse oversized tables anyway)."""
+            from ..device import costmodel
             from ..device import fragment as dfrag
+            _, rb, t, fp = cand
             packed_out = dfrag.packed_bytes_per_group(
                 prog.nk, len(prog.ops)) * dfrag._OUT_CAP0
             col_bytes = drt._batch_cols_nbytes(rb, prog.compiled.needs_cols)
             est_encoded = 2 * col_bytes  # capacity bucketing ≤ doubles
             fits = est_encoded * max(n_tasks, 1) <= dcache._budget()
-            # round trips amortize across THIS window (a partial final
-            # window must not under-charge its tasks): every task's
-            # packed result comes back in ONE transfer
+            # the packed fetch's round trips amortize over the tasks that
+            # actually SHARE the transfer: committed cache hits + gate
+            # candidates (r4 advisor: dividing by the whole window length
+            # under-charged device tasks in mixed windows where forced-host
+            # tasks never join the fetch). Still optimistic by candidates
+            # the gate itself rejects — the safe direction, since fewer
+            # sharers only makes the gate stricter.
             if not costmodel.agg_upload_wins(
                     col_bytes, packed_out,
                     cacheable=fp is not None and fits,
-                    round_trips=2.0 / max(1, wlen)):
+                    round_trips=2.0 / max(1, n_sharing)):
                 return ("host", rb, t)
             try:
                 dt = dcol.encode_batch(rb, prog.compiled.needs_cols)
@@ -422,9 +433,14 @@ class LocalExecutor:
             window = list(itertools.islice(it, width))
             if not window:
                 return
-            wlen = len(window)
-            resolved = list(_ordered_parallel(
-                iter(window), lambda t: resolve(t, wlen)))
+            classified = list(_ordered_parallel(iter(window), classify))
+            n_sharing = sum(1 for c in classified if c[0] != "host")
+            gated = _ordered_parallel(
+                iter([c for c in classified if c[0] == "cand"]),
+                lambda c: gate(c, n_sharing))
+            gated_it = iter(list(gated))
+            resolved = [c if c[0] != "cand" else next(gated_it)
+                        for c in classified]
             outs = fragment.run_fused_agg_tables(
                 prog, [dt for kind, dt, _ in resolved if kind == "dev"],
                 src.schema(), node.group_by, agg_cols, node.schema())

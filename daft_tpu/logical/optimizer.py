@@ -583,11 +583,16 @@ class ReorderJoins(Rule):
             self._flatten(node.children[1], rels, edges, filters)
             for le, re_ in zip(node.left_on, node.right_on):
                 edges.append((le.params[0], re_.params[0]))
-        elif filters is not None and isinstance(node, lp.Filter):
+        elif (filters is not None and isinstance(node, lp.Filter)
+              and not _has_effectful(node.predicate)):
             # look through filters interleaved in the join chain: inner
             # joins commute with filters, their cross-relation equalities
             # are join edges in disguise, and PushDownFilter re-sinks the
-            # single-relation remainder after the reorder
+            # single-relation remainder after the reorder. Effectful
+            # (nondeterministic/stateful-UDF) predicates stay opaque —
+            # hoisting one above the rebuilt tree would re-evaluate it
+            # over the larger joined row set, changing results and
+            # invocation counts (same guard as PushDownFilter).
             filters.append(node.predicate)
             self._flatten(node.children[0], rels, edges, filters)
         else:

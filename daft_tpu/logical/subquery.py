@@ -328,11 +328,11 @@ def _rewrite_conjunct(df, conj: Expression) -> Tuple[Optional[Expression],
 def _attach_mark(df, node: Expression) -> Tuple[object, Expression]:
     """EXISTS/IN nested in a boolean expression → a mark (boolean) column:
     left-join the outer frame onto the DISTINCT correlation/value keys of
-    the subquery tagged TRUE; unmatched rows coalesce to FALSE. This is
-    the classic mark-join decorrelation. NULL caveat (documented like the
-    NOT IN caveat): ``x IN (…)`` yields FALSE rather than NULL for NULL
-    x / NULL-only matches, which is indistinguishable under a WHERE but
-    visible under explicit negation of the disjunction."""
+    the subquery tagged TRUE; unmatched rows coalesce to FALSE (exact for
+    EXISTS — it never yields NULL). IN-subquery marks route to
+    :func:`_attach_in_mark`, which preserves SQL's three-valued logic
+    (NULL lhs / NULL-bearing sets yield NULL, visible under negation of
+    the enclosing disjunction)."""
     info: SubqueryInfo = node.params[0]
     lhs = node.args[0] if node.op == "in_subquery" else None
     if info.resid:
@@ -341,15 +341,13 @@ def _attach_mark(df, node: Expression) -> Tuple[object, Expression]:
     if info.deferred_aggs:
         raise NotImplementedError(
             "aggregating subquery inside a disjunction")
+    if lhs is not None:
+        rdf2, val = _inner_value_expr(info)
+        return _attach_in_mark(df, info, lhs, rdf2, val)
     mark = f"__mark{next(_uid)}__"
     left_on = [o for _, o in info.corr]
     right_on = [i for i, _ in info.corr]
     rdf = info.df
-    if lhs is not None:
-        rdf2, val = _inner_value_expr(info)
-        rdf = rdf2
-        left_on = left_on + [lhs]
-        right_on = right_on + [val]
     if not left_on:
         # uncorrelated EXISTS in a disjunction: single TRUE/absent flag
         k = f"__markk{next(_uid)}__"
@@ -368,6 +366,58 @@ def _attach_mark(df, node: Expression) -> Tuple[object, Expression]:
     out = df.join(keyed, left_on=left_on,
                   right_on=[col(k) for k in knames], how="left")
     return out, col(mark).fill_null(lit(False))
+
+
+def _attach_in_mark(df, info: SubqueryInfo, lhs: Expression, rdf,
+                    val: Expression) -> Tuple[object, Expression]:
+    """Null-aware mark for ``lhs IN (SELECT val …)`` nested in a boolean
+    expression. SQL three-valued semantics, exactly:
+
+      TRUE  — some element of the (correlation-filtered) set equals lhs
+      FALSE — the set is empty, or nothing matches and neither lhs nor
+              the set contains NULL
+      NULL  — no match, set non-empty, and lhs IS NULL or set has NULL
+
+    Realized as two left joins: one on (corr keys + value) for the match
+    mark, one on corr keys alone carrying per-group (row count, has-NULL)
+    so unmatched rows can distinguish FALSE from NULL. ``fill_null(False)``
+    alone collapses the NULL outcomes to FALSE, which flips rows kept by a
+    negated disjunction like ``NOT (p OR x IN (SELECT …))``."""
+    mark = f"__mark{next(_uid)}__"
+    gnull = f"__markn{next(_uid)}__"
+    gcnt = f"__markc{next(_uid)}__"
+    vn = f"__markv{next(_uid)}__"
+    left_keys = [o for _, o in info.corr]
+    inner_keys = [i for i, _ in info.corr]
+
+    def _aliased(exprs):
+        names = [f"__markk{next(_uid)}__" for _ in exprs]
+        return names, [e.alias(n) for e, n in zip(exprs, names)]
+
+    knames, keyed_cols = _aliased(inner_keys + [val])
+    keyed = rdf.select(*keyed_cols).distinct().with_column(mark, lit(True))
+    out = df.join(keyed, left_on=left_keys + [lhs],
+                  right_on=[col(k) for k in knames], how="left")
+
+    gnames, gcols = _aliased(inner_keys)
+    ginfo = rdf.select(*(gcols + [val.alias(vn)]))
+    if gnames:
+        ginfo = ginfo.groupby(*[col(g) for g in gnames]).agg(
+            col(vn).is_null().bool_or().alias(gnull),
+            col(vn).count("all").alias(gcnt))
+        out = out.join(ginfo, left_on=left_keys,
+                       right_on=[col(g) for g in gnames], how="left")
+    else:
+        ginfo = ginfo.agg(col(vn).is_null().bool_or().alias(gnull),
+                          col(vn).count("all").alias(gcnt))
+        out = out.join(ginfo, how="cross")
+
+    matched = col(mark).fill_null(lit(False))
+    nonempty = col(gcnt).fill_null(lit(0)) > lit(0)
+    unknown = lhs.is_null() | col(gnull).fill_null(lit(False))
+    flag = matched.if_else(
+        lit(True), (nonempty & unknown).if_else(lit(None), lit(False)))
+    return out, flag
 
 
 def _find_setpred(e: Expression) -> Optional[Expression]:

@@ -175,6 +175,59 @@ def test_in_subquery_nested_in_or(shop):
     assert out["c_name"] == ["bob", "cat"]
 
 
+# ----------------------------- three-valued logic for IN marks (r4 advice)
+
+@pytest.fixture(scope="module")
+def nullish():
+    t = dt.from_pydict({
+        "k": [1, 2, None, 4],
+        "p": [False, False, False, True],
+        "name": ["one", "two", "nul", "four"],
+    })
+    s = dt.from_pydict({"v": [1, 3], "vn": [1, None],
+                        "g": [1, 1]})
+    return {"t": t, "s": s}
+
+
+def test_negated_disjunction_in_mark_null_lhs(nullish):
+    """NOT (p OR k IN (S)): a NULL k yields NULL (not FALSE) for the IN,
+    so the whole predicate is NULL and the row is dropped — fill_null(False)
+    used to keep it (r4 advisor repro)."""
+    out = dt.sql(
+        "SELECT name FROM t WHERE NOT (p OR k IN (SELECT v FROM s))",
+        **nullish).to_pydict()
+    # k=1 matches (TRUE→drop), k=2 no match (keep), k=NULL → NULL (drop),
+    # k=4 has p TRUE (drop)
+    assert out["name"] == ["two"]
+
+
+def test_negated_disjunction_in_mark_null_in_set(nullish):
+    """Set contains NULL: any non-matching k gets NULL, not FALSE."""
+    out = dt.sql(
+        "SELECT name FROM t WHERE NOT (p OR k IN (SELECT vn FROM s))",
+        **nullish).to_pydict()
+    # k=1 matches (drop); k=2/NULL → NULL (drop); k=4 p TRUE (drop)
+    assert out["name"] == []
+
+
+def test_negated_disjunction_in_mark_empty_set(nullish):
+    """Empty set: k IN () is FALSE for every k incl. NULL → rows kept."""
+    out = dt.sql(
+        "SELECT name FROM t WHERE NOT (p OR k IN "
+        "(SELECT v FROM s WHERE v > 100)) ORDER BY name",
+        **nullish).to_pydict()
+    assert out["name"] == ["nul", "one", "two"]
+
+
+def test_positive_disjunction_in_mark_null_unchanged(nullish):
+    """Under a plain WHERE (no negation) NULL and FALSE filter alike —
+    the null-aware mark must not change the positive-path results."""
+    out = dt.sql(
+        "SELECT name FROM t WHERE p OR k IN (SELECT vn FROM s) "
+        "ORDER BY name", **nullish).to_pydict()
+    assert out["name"] == ["four", "one"]
+
+
 # ---------------------------------------------------------- TPC-H parity
 
 @pytest.fixture(scope="module")
