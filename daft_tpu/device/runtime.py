@@ -90,9 +90,11 @@ def _row_output_profitable(batch, needs_cols, n_outputs: int,
     ov = _min_rows_override(n_rows)
     if ov is not None:
         return ov
-    bytes_up = _batch_cols_nbytes(batch, needs_cols)
+    bytes_up = dcol.encoded_nbytes(batch, needs_cols)
     bytes_down = n_rows * out_bytes_per_row * max(n_outputs, 1)
-    return costmodel.row_output_op_wins(bytes_up, bytes_down)
+    return costmodel.row_output_op_wins(
+        bytes_up, bytes_down,
+        host_bytes=_batch_cols_nbytes(batch, needs_cols))
 
 
 _projection_cache: Dict[Tuple, compiler.Compiled] = {}
@@ -305,8 +307,9 @@ def try_agg(batch, to_agg: List[Expression], group_by: List[Expression]):
     packed_out = packed_bytes_per_group(len(group_by),
                                         len(to_agg)) * _OUT_CAP0
     if not costmodel.agg_upload_wins(
-            _batch_cols_nbytes(batch, c.needs_cols),
-            packed_out, cacheable=False):
+            dcol.encoded_nbytes(batch, c.needs_cols),
+            packed_out, cacheable=False,
+            host_bytes=_batch_cols_nbytes(batch, c.needs_cols)):
         return None
 
     dt, outs = _run_compiled(c, batch, proj)

@@ -314,11 +314,14 @@ class LocalExecutor:
                 return None
             # in-memory batch: the upload is one-shot, it must beat the
             # host outright (no HBM-cache identity to invest in)
+            from ..device import column as dcol
             packed_out = fragment.packed_bytes_per_group(
                 len(node.group_by), len(ops)) * fragment._OUT_CAP0
             if not costmodel.agg_upload_wins(
-                    drt._batch_cols_nbytes(rb, prog.compiled.needs_cols),
-                    packed_out, cacheable=False):
+                    dcol.encoded_nbytes(rb, prog.compiled.needs_cols),
+                    packed_out, cacheable=False,
+                    host_bytes=drt._batch_cols_nbytes(
+                        rb, prog.compiled.needs_cols)):
                 return None
             try:
                 out = fragment.run_fused_agg(prog, rb, node.group_by,
@@ -404,9 +407,8 @@ class LocalExecutor:
             _, rb, t, fp = cand
             packed_out = dfrag.packed_bytes_per_group(
                 prog.nk, len(prog.ops)) * dfrag._OUT_CAP0
-            col_bytes = drt._batch_cols_nbytes(rb, prog.compiled.needs_cols)
-            est_encoded = 2 * col_bytes  # capacity bucketing ≤ doubles
-            fits = est_encoded * max(n_tasks, 1) <= dcache._budget()
+            col_bytes = dcol.encoded_nbytes(rb, prog.compiled.needs_cols)
+            fits = col_bytes * max(n_tasks, 1) <= dcache._budget()
             # the packed fetch's round trips amortize over the tasks that
             # actually SHARE the transfer: committed cache hits + gate
             # candidates (r4 advisor: dividing by the whole window length
@@ -417,7 +419,9 @@ class LocalExecutor:
             if not costmodel.agg_upload_wins(
                     col_bytes, packed_out,
                     cacheable=fp is not None and fits,
-                    round_trips=2.0 / max(1, n_sharing)):
+                    round_trips=2.0 / max(1, n_sharing),
+                    host_bytes=drt._batch_cols_nbytes(
+                        rb, prog.compiled.needs_cols)):
                 return ("host", rb, t)
             try:
                 dt = dcol.encode_batch(rb, prog.compiled.needs_cols)

@@ -1,0 +1,122 @@
+"""Dispatch cost model: measured-link decisions, bounded investment,
+persisted link profile, decision logging.
+
+Reference seam: the per-operator dispatch decision the reference makes
+implicitly by construction (CUDA ops run where the data lives); here the
+tunnel/local-chip split forces an explicit model (SURVEY.md §7 hard-part
+#2, ``daft_tpu/device/costmodel.py``)."""
+
+import json
+import os
+
+import pytest
+
+from daft_tpu.device import costmodel as cm
+
+
+@pytest.fixture
+def slow_link(monkeypatch):
+    """A 10 MB/s, 80 ms RTT tunnel — the r5 measured worst case."""
+    monkeypatch.setenv("DAFT_TPU_LINK_RTT_MS", "80")
+    monkeypatch.setenv("DAFT_TPU_LINK_UP_MBPS", "10")
+    monkeypatch.setenv("DAFT_TPU_LINK_DOWN_MBPS", "10")
+    cm.reset_for_tests()
+    yield
+    cm.reset_for_tests()
+
+
+@pytest.fixture
+def fast_link(monkeypatch):
+    """A ~100 MB/s link — the r4 good-day tunnel."""
+    monkeypatch.setenv("DAFT_TPU_LINK_RTT_MS", "40")
+    monkeypatch.setenv("DAFT_TPU_LINK_UP_MBPS", "100")
+    monkeypatch.setenv("DAFT_TPU_LINK_DOWN_MBPS", "100")
+    cm.reset_for_tests()
+    yield
+    cm.reset_for_tests()
+
+
+def test_invest_refused_on_slow_link(slow_link):
+    """A 210 MB cache fill at 10 MB/s is ~21 s against a ~1.1 s host pass
+    (ratio ~19): no workload re-runs the scan 19 times, so the bounded
+    investment rule must refuse (r4's 64× bound let these through and
+    one-shot suites never amortized them)."""
+    assert not cm.agg_upload_wins(
+        bytes_up=210e6, bytes_down=1e5, cacheable=True,
+        host_bytes=336e6)
+
+
+def test_invest_accepted_on_fast_link(fast_link):
+    """Same fill at 100 MB/s is ~2 s (ratio ~2): residency repays within
+    a couple of queries — invest."""
+    assert cm.agg_upload_wins(
+        bytes_up=210e6, bytes_down=1e5, cacheable=True,
+        host_bytes=336e6)
+
+
+def test_noncacheable_upload_must_beat_host_outright(fast_link):
+    # 210MB upload at 100MB/s = 2.1s vs 1.1s host pass: refuse
+    assert not cm.agg_upload_wins(
+        bytes_up=210e6, bytes_down=1e5, cacheable=False, host_bytes=336e6)
+
+
+def test_rtt_bound_tiny_aggregates_stay_host(slow_link):
+    """TPC-H Q22 shape: tiny per-task aggregates are RTT-bound even when
+    resident — the resident-pays check must refuse investment."""
+    assert not cm.agg_upload_wins(
+        bytes_up=2e5, bytes_down=1e5, cacheable=True,
+        round_trips=2.0, host_bytes=3e5)
+
+
+def test_host_bytes_defaults_to_bytes_up(fast_link):
+    a = cm.agg_upload_wins(1e6, 1e4, cacheable=False)
+    b = cm.agg_upload_wins(1e6, 1e4, cacheable=False, host_bytes=1e6)
+    assert a == b
+
+
+def test_decision_counts_and_jsonl_log(tmp_path, slow_link, monkeypatch):
+    log = tmp_path / "dispatch.jsonl"
+    monkeypatch.setenv("DAFT_TPU_DISPATCH_LOG", str(log))
+    cm.row_output_op_wins(1e6, 1e6)
+    cm.agg_upload_wins(1e6, 1e4, cacheable=True, host_bytes=1e6)
+    cm.join_wins(1000, 1000, 1e5, 1e5)
+    assert cm.decision_counts["row_output"]["host"] == 1
+    recs = [json.loads(x) for x in log.read_text().splitlines()]
+    assert [r["kind"] for r in recs] == \
+        ["row_output", "agg_upload_invest", "join"]
+    assert all({"device", "host_s", "dev_s"} <= set(r) for r in recs)
+
+
+def test_link_profile_persistence_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("DAFT_TPU_LINK_CACHE_PATH",
+                       str(tmp_path / "link.json"))
+    p = cm.LinkProfile(rtt_s=0.05, up_bps=2e7, down_bps=1e7)
+    cm._store("tpu", p)
+    got, age = cm._load_stored("tpu")
+    assert got == p and age is not None and age < 5
+    # backend mismatch → miss
+    assert cm._load_stored("other") == (None, None)
+
+
+def test_link_profile_cpu_is_shared_memory(monkeypatch):
+    for k in ("DAFT_TPU_LINK_RTT_MS", "DAFT_TPU_LINK_UP_MBPS",
+              "DAFT_TPU_LINK_DOWN_MBPS"):
+        monkeypatch.delenv(k, raising=False)
+    cm.reset_for_tests()
+    lp = cm.link_profile()  # tests run on the CPU backend
+    assert lp.rtt_s == 0.0 and lp.up_bps == float("inf")
+    cm.reset_for_tests()
+
+
+def test_encoded_nbytes_compacts_f64():
+    import daft_tpu as dt
+    from daft_tpu.device import column as dcol
+    from daft_tpu.recordbatch import RecordBatch
+    rb = RecordBatch.from_pydict({
+        "f": [1.0] * 1000, "s": ["ab"] * 1000, "i": [1] * 1000})
+    enc = dcol.encoded_nbytes(rb, ["f", "s", "i"])
+    cap = dcol.bucket_capacity(1000)
+    # f64→f32 (4) on f64-less chips or 8 locally; strings→codes (4);
+    # i64 stays 8; +1 validity each
+    f_item = 4 if not dcol.supports_f64() else 8
+    assert enc == cap * ((f_item + 1) + (4 + 1) + (8 + 1))
