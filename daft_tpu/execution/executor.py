@@ -427,7 +427,11 @@ class LocalExecutor:
                 dt = dcol.encode_batch(rb, prog.compiled.needs_cols)
             except (ValueError, TypeError):
                 return ("host", rb, t)
-            if fp is not None:
+            if fp is not None and fits:
+                # only cache working sets that FIT the budget: caching a
+                # slice of an oversized scan just LRU-evicts entries other
+                # queries still repay (SF10 thrash, r4) — the upload then
+                # streams through as a one-shot morsel instead
                 dcache.get_cache().put_table(fp, dt)
             return ("dev", dt, t)
 
@@ -522,8 +526,8 @@ class LocalExecutor:
         vplanes = _encode_plane_lists(encode, val_names)
         if kplanes is None or vplanes is None:
             return None
-        keys, kvalids = kplanes
-        vals, vvalids = vplanes
+        keys, kvalids, kdicts = kplanes
+        vals, vvalids, vdicts = vplanes
         mask = np.arange(cap) < total
         try:
             sb = lambda a: exchange.shard_blocks(mesh, a)
@@ -538,9 +542,9 @@ class LocalExecutor:
         fk, fkv, fv, fvv, gmask = [
             [np.asarray(a) for a in grp] if isinstance(grp, (list, tuple))
             else np.asarray(grp) for grp in host]
-        spec = [(nm, node.schema()[nm].dtype, fk[i], fkv[i])
+        spec = [(nm, node.schema()[nm].dtype, fk[i], fkv[i], kdicts[i])
                 for i, nm in enumerate(key_names)]
-        spec += [(nm, node.schema()[nm].dtype, fv[j], fvv[j])
+        spec += [(nm, node.schema()[nm].dtype, fv[j], fvv[j], vdicts[j])
                  for j, nm in enumerate(out_names)]
         return _decode_mesh_shards(n, gmask, spec, node.schema())
 
@@ -548,8 +552,9 @@ class LocalExecutor:
                                ) -> Optional[List[MicroPartition]]:
         """Hash repartition as one all_to_all over the device mesh — chosen
         when the target partition count equals the mesh width and every
-        column is plain device-representable (no variable-width payloads:
-        those ride the host exchange, SURVEY.md §7 hard-part #2)."""
+        column either round-trips the device encoding bit-exactly or is
+        string/binary (those ride shared-dictionary codes built from the
+        single concatenated batch; see _np_plane_encoder)."""
         import jax
         from ..device import column as dcol, runtime as drt
         from ..parallel import exchange, mesh as pmesh
@@ -562,9 +567,13 @@ class LocalExecutor:
             if len(parts) > 1 else parts[0].combined()
         schema = rb.schema
         # pure data movement must be bit-exact: every column must round-trip
-        # the device encoding losslessly (no decimals-as-floats, no f64→f32)
+        # the device encoding losslessly (no decimals-as-floats, no f64→f32).
+        # String/binary columns qualify: the whole input is concatenated
+        # into one batch, so their dictionary codes are shared across every
+        # output shard and decode back exactly (see _np_plane_encoder).
         for f in schema:
-            if not dcol.is_lossless_device_dtype(f.dtype):
+            if not (dcol.is_lossless_device_dtype(f.dtype)
+                    or f.dtype.is_string() or f.dtype.is_binary()):
                 return None
         if len(rb) == 0:
             return [MicroPartition.from_recordbatch(RecordBatch.empty(schema))
@@ -589,7 +598,7 @@ class LocalExecutor:
         enc = _encode_plane_lists(encode, names)
         if enc is None:
             return None
-        planes, valids = enc
+        planes, valids, dicts = enc
         mask = np.arange(cap) < total
         try:
             sb = lambda a: exchange.shard_blocks(mesh, a)
@@ -602,7 +611,7 @@ class LocalExecutor:
         op, ov, om = [[np.asarray(a) for a in grp]
                       if isinstance(grp, (list, tuple)) else np.asarray(grp)
                       for grp in host]
-        spec = [(nm, schema[nm].dtype, op[j], ov[j])
+        spec = [(nm, schema[nm].dtype, op[j], ov[j], dicts[j])
                 for j, nm in enumerate(names)]
         return _decode_mesh_shards(n, om, spec, schema)
 
@@ -1227,22 +1236,25 @@ def _lit_true() -> Expression:
 
 
 def _encode_plane_lists(encode, names):
-    """Encode columns into parallel (values, valids) plane lists; None when
-    any column lacks a plain device representation."""
-    vals, valids = [], []
+    """Encode columns into parallel (values, valids, dictionaries) plane
+    lists; None when any column lacks a plain device representation."""
+    vals, valids, dicts = [], [], []
     for nm in names:
         enc = encode(nm)
         if enc is None:
             return None
         vals.append(enc[0])
         valids.append(enc[1])
-    return vals, valids
+        dicts.append(enc[2])
+    return vals, valids, dicts
 
 
 def _decode_mesh_shards(n: int, live_mask: np.ndarray, cols_spec, schema
                         ) -> List[MicroPartition]:
     """Slice exchanged [n*C'] blocks into per-shard MicroPartitions.
-    cols_spec: ordered (name, dtype, values_plane, valids_plane) tuples."""
+    cols_spec: ordered (name, dtype, values_plane, valids_plane, dictionary)
+    tuples — dictionary non-None for string/binary columns riding shared
+    dictionary codes."""
     from ..device import column as dcol
     shard_len = live_mask.shape[0] // n
     outs = []
@@ -1251,8 +1263,8 @@ def _decode_mesh_shards(n: int, live_mask: np.ndarray, cols_spec, schema
         live = live_mask[sl]
         cnt = int(live.sum())
         cols = []
-        for nm, dtype, v, m in cols_spec:
-            dc = dcol.DeviceColumn(v[sl][live], m[sl][live], dtype, None)
+        for nm, dtype, v, m, d in cols_spec:
+            dc = dcol.DeviceColumn(v[sl][live], m[sl][live], dtype, d)
             cols.append(dcol.decode_column(nm, dc, cnt))
         outs.append(MicroPartition.from_recordbatch(
             RecordBatch.from_series(cols).cast_to_schema(schema)))
@@ -1279,8 +1291,14 @@ def _load_with_retry(task, tries: int = 2) -> MicroPartition:
 
 
 def _np_plane_encoder(rb: RecordBatch, cap: int):
-    """Column name → (values, validity) numpy planes zero-padded to cap, or
-    None when the column has no plain device representation."""
+    """Column name → (values, validity, dictionary) numpy planes zero-padded
+    to cap, or None when the column has no plain device representation.
+
+    String/binary columns ride dictionary codes. That is SOUND here even
+    across shards: every mesh path concatenates its partitions into ONE
+    RecordBatch before encoding, so all shards share a single dictionary —
+    and ``_np_encode`` assigns rank codes over the SORTED dictionary, so
+    code order is lexicographic order (min/max on codes is correct)."""
     import pyarrow as pa
     from ..device import column as dcol
 
@@ -1289,14 +1307,12 @@ def _np_plane_encoder(rb: RecordBatch, cap: int):
             vals, valid, dictionary = dcol._np_encode(rb.get_column(name))
         except (ValueError, TypeError, pa.ArrowInvalid):
             return None
-        if dictionary is not None:
-            return None
         if len(vals) < cap:
             vals = np.concatenate(
                 [vals, np.zeros(cap - len(vals), dtype=vals.dtype)])
             valid = np.concatenate(
                 [valid, np.zeros(cap - len(valid), dtype=np.bool_)])
-        return vals, valid
+        return vals, valid, dictionary
 
     return encode
 

@@ -49,6 +49,7 @@ from ..physical import plan as pp
 from .executor import LocalExecutor
 
 _POLL_S = 0.05  # cancellation latency bound for blocked channel ops
+_REAGG_ROWS = 1 << 17  # partitioned-agg reducer: merge state every N rows
 
 
 class PipelineCancelled(Exception):
@@ -199,6 +200,46 @@ def _map_workers(node) -> int:
     return _default_workers()
 
 
+#: final-stage agg ops that are associative self-merges: re-applying the op
+#: over its own output column merges two partial states correctly. This is
+#: what makes the reference's Partitioned dispatcher + grouped_aggregate
+#: sink sound (``dispatcher.rs:24-60``, ``sinks/grouped_aggregate.rs:54-151``)
+_MERGE_FINAL_OPS = ("agg.sum", "agg.min", "agg.max", "agg.any_value",
+                    "agg.bool_and", "agg.bool_or", "agg.concat")
+
+
+def _partitioned_agg_info(node):
+    """When ``node`` is a final grouped Aggregate over an engine-inserted
+    hash Exchange whose final aggs are associative self-merges, return
+    (exchange_child, key_exprs, merge_aggs) for the fused partitioned-agg
+    stage; else None. ``merge_aggs`` re-merge two batches of FINAL-schema
+    state: for a final agg ``op(col(p)).alias(out)``, the merge is
+    ``op(col(out)).alias(out)``."""
+    from ..expressions.expressions import Expression, col
+    if not (isinstance(node, pp.Aggregate) and node.mode == "final"
+            and node.group_by):
+        return None
+    ch = node.children[0]
+    if not (isinstance(ch, pp.Exchange) and ch.kind == "hash"
+            and ch.engine_inserted):
+        return None
+    # shared subplans stream through the executor's shared buffer — the
+    # fusion would bypass it
+    if getattr(ch, "shared_consumers", 1) > 1 \
+            or getattr(node, "shared_consumers", 1) > 1:
+        return None
+    merge = []
+    for a in node.aggs:
+        u = a._unalias()
+        if u.op not in _MERGE_FINAL_OPS or len(u.args) != 1:
+            return None
+        if u.args[0]._unalias().op != "col":
+            return None
+        merge.append(Expression(u.op, (col(a.name()),), u.params)
+                     .alias(a.name()))
+    return ch.children[0], list(ch.by), merge
+
+
 class PushExecutor(LocalExecutor):
     """Push-dataflow executor: every plan node is an always-running stage.
 
@@ -255,11 +296,15 @@ class PushExecutor(LocalExecutor):
     # _exec (inherited) routes multi-consumer nodes through the shared
     # buffer; everything else lands here and becomes a stage
     def _exec_node(self, node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
-        kernel = _map_kernel(node)
-        if kernel is not None:
-            out = self._map_stage(node, kernel)
+        pagg = _partitioned_agg_info(node)
+        if pagg is not None:
+            out = self._partitioned_agg_stage(node, *pagg)
         else:
-            out = self._driver_stage(node)
+            kernel = _map_kernel(node)
+            if kernel is not None:
+                out = self._map_stage(node, kernel)
+            else:
+                out = self._driver_stage(node)
         if self.stats is not None:
             return self.stats.instrument(node, iter(out))
         return iter(out)
@@ -289,6 +334,82 @@ class PushExecutor(LocalExecutor):
             finally:
                 out.close()
         self.pipe.spawn(drive, name=f"drv-{type(node).__name__}")
+        return out
+
+    def _partitioned_agg_stage(self, node, exchange_child, by,
+                               merge_aggs) -> Channel:
+        """Partitioned-by-hash dispatcher fused with the final grouped
+        aggregation (reference ``dispatcher.rs:24-60`` Partitioned +
+        ``sinks/grouped_aggregate.rs:54-151``): the dispatcher hashes each
+        incoming partial-agg morsel into k slices, worker i streams
+        partition i, incrementally merging its state every
+        ``_REAGG_ROWS`` buffered rows, and emits its final state at
+        close. Replaces Exchange(hash) + per-bucket map agg: no
+        materialization barrier, k concurrent reducers, and the final agg
+        starts before the child finishes.
+
+        Memory: the un-merged buffer is bounded by the re-agg threshold;
+        the merged state is bounded by that worker's group cardinality
+        (like the reference's sink — the spill-bounded exchange path
+        remains the interpreter tier's behavior)."""
+        k = _default_workers()
+        if self.stats is not None:
+            self.stats.register(node).workers = k
+        child = self._exec(exchange_child)
+        in_q = [Channel(self.pipe, 2) for _ in range(k)]
+        out = Channel(self.pipe, self.CHANNEL_CAPACITY, producers=k)
+        name = type(node).__name__
+
+        def dispatch():
+            try:
+                for mp in child:
+                    for i, part in enumerate(mp.partition_by_hash(by, k)):
+                        if len(part):
+                            in_q[i].put(part)
+            except PipelineCancelled:
+                pass
+            except BaseException as exc:  # noqa: BLE001
+                self.pipe.fail(exc)  # before close — see _driver_stage
+            finally:
+                for q in in_q:
+                    q.close()
+
+        def reducer(i):
+            state: Optional[MicroPartition] = None
+            buf: List[MicroPartition] = []
+            rows = 0
+
+            def merge():
+                nonlocal state, buf, rows
+                if not buf:
+                    return
+                fresh = buf[0].concat(buf[1:]) if len(buf) > 1 else buf[0]
+                fresh = fresh.agg(node.aggs, node.group_by) \
+                    .cast_to_schema(node.schema())
+                state = fresh if state is None else \
+                    state.concat([fresh]).agg(merge_aggs, node.group_by) \
+                    .cast_to_schema(node.schema())
+                buf, rows = [], 0
+
+            try:
+                for mp in in_q[i]:
+                    buf.append(mp)
+                    rows += len(mp)
+                    if rows >= _REAGG_ROWS:
+                        merge()
+                merge()
+                if state is not None and len(state):
+                    out.put(state)
+            except PipelineCancelled:
+                pass
+            except BaseException as exc:  # noqa: BLE001
+                self.pipe.fail(exc)
+            finally:
+                out.close()
+
+        self.pipe.spawn(dispatch, name=f"dsp-{name}")
+        for i in range(k):
+            self.pipe.spawn(lambda i=i: reducer(i), name=f"red-{name}-{i}")
         return out
 
     def _map_stage(self, node, kernel) -> Channel:

@@ -53,7 +53,8 @@ class SubqueryInfo:
                         subquery was fully built by the normal path).
     """
 
-    def __init__(self, df, corr, deferred_aggs, value_cols, resid=None):
+    def __init__(self, df, corr, deferred_aggs, value_cols, resid=None,
+                 deferred_group_by=None):
         self.df = df
         self.corr = list(corr)
         self.deferred_aggs = list(deferred_aggs or [])
@@ -61,6 +62,9 @@ class SubqueryInfo:
         # correlated NON-equality conjuncts (outer refs as ``outer_col``
         # markers): realized by the rowid-join rewrite in _semi_anti
         self.resid = list(resid or [])
+        # the subquery's OWN GROUP BY keys when its aggregation is
+        # deferred: the rewrite groups by correlation keys ∪ these
+        self.deferred_group_by = list(deferred_group_by or [])
 
     def __repr__(self):
         return (f"SubqueryInfo(corr={len(self.corr)}, "
@@ -132,12 +136,41 @@ def _inner_value_expr(info: SubqueryInfo) -> Tuple[object, Expression]:
     return info.df, col(info.value_cols[0])
 
 
+def _realize_deferred(info: SubqueryInfo):
+    """Materialize a correlated AGGREGATING subquery: group the inner frame
+    by correlation keys ∪ its own GROUP BY keys, apply the deferred select
+    aggregate, and project (correlation keys, value). Returns
+    (df, corr_key_names, value_name). The GROUP BY keys fall away after
+    grouping — each (corr, group) cell contributes one candidate row."""
+    rdf, val = _inner_value_expr(info)
+    name = f"__subq{next(_uid)}__"
+    key_names, keys = [], []
+    for inner, _ in info.corr:
+        kn = f"__subqk{next(_uid)}__"
+        key_names.append(kn)
+        keys.append(inner.alias(kn))
+    extra = [g.alias(f"__subqg{next(_uid)}__")
+             for g in info.deferred_group_by]
+    agg = rdf.groupby(*(keys + extra)).agg(val.alias(name))
+    agg = agg.select(*([col(k) for k in key_names] + [col(name)]))
+    return agg, key_names, name
+
+
 def _semi_anti(df, info: SubqueryInfo, anti: bool,
                lhs: Optional[Expression] = None):
     """EXISTS/IN → semi join; NOT variants → anti join."""
     if info.resid:
         return _semi_anti_residual(df, info, anti, lhs)
     how = "anti" if anti else "semi"
+    if lhs is not None and info.deferred_aggs:
+        # lhs IN (SELECT agg(x) … WHERE corr [GROUP BY g]): aggregate
+        # first (per corr ∪ g cell), then semi/anti join on
+        # (corr keys, aggregated value)
+        rdf, key_names, vn = _realize_deferred(info)
+        return df.join(rdf,
+                       left_on=[o for _, o in info.corr] + [lhs],
+                       right_on=[col(k) for k in key_names] + [col(vn)],
+                       how=how)
     left_on = [o for _, o in info.corr]
     right_on = [i for i, _ in info.corr]
     rdf = info.df
@@ -226,17 +259,24 @@ def _attach_scalar(df, node: Expression) -> Tuple[object, str]:
                 "correlated scalar subquery must aggregate (e.g. "
                 "SELECT avg(x) …); a bare correlated column select has no "
                 "single-value semantics the rewrite can preserve")
-        rdf, val = _inner_value_expr(info)
-        key_names = []
-        keys = []
-        outers = []
-        for i, (inner, outer) in enumerate(info.corr):
-            kn = f"__subqk{next(_uid)}__"
-            key_names.append(kn)
-            keys.append(inner.alias(kn))
-            outers.append(outer)
-        agg = rdf.groupby(*keys).agg(val.alias(name))
-        agg = agg.select(*([col(k) for k in key_names] + [col(name)]))
+        agg, key_names, vn = _realize_deferred(info)
+        outers = [outer for _, outer in info.corr]
+        if info.deferred_group_by:
+            # GROUP BY inside the subquery can yield several rows per
+            # correlation key; SQL's scalar context requires exactly one —
+            # collapse with a runtime cardinality guard (the grouped LEFT
+            # JOIN below would otherwise silently duplicate outer rows,
+            # which is what the reference's UnnestScalarSubquery does).
+            # SQL evaluates the subquery PER OUTER ROW, so the guard only
+            # applies to correlation keys some outer row actually holds —
+            # semi-join down to those first.
+            ref_keys = df.select(
+                *[o.alias(k) for o, k in zip(outers, key_names)]).distinct()
+            agg = agg.join(ref_keys,
+                           left_on=[col(k) for k in key_names],
+                           right_on=[col(k) for k in key_names], how="semi")
+            agg = _guard_one_per_key(agg, key_names, vn)
+        agg = agg.select(*([col(k) for k in key_names] + [col(vn).alias(name)]))
         out = df.join(agg, left_on=outers,
                       right_on=[col(k) for k in key_names], how="left")
         return out, name
@@ -263,6 +303,30 @@ def _provably_single_row(plan) -> bool:
     while isinstance(node, (lp.Project, lp.Sort)):
         node = node.children[0]
     return isinstance(node, lp.Aggregate) and not node.group_by
+
+
+def _guard_one_per_key(agg, key_names: List[str], vn: str):
+    """Collapse a (keys…, value) frame to one row per key tuple, raising
+    SQL's scalar-cardinality error at execution time when any key holds
+    more than one row."""
+    from ..datatype import DataType
+    from ..udf import udf
+    dtype = agg.schema()[vn].dtype
+    cnt = f"__subqcnt{next(_uid)}__"
+    one = agg.groupby(*[col(k) for k in key_names]).agg(
+        col(vn).any_value().alias(vn), col(vn).count("all").alias(cnt))
+
+    @udf(return_dtype=dtype)
+    def _check_one(vals, counts):
+        if any(c is not None and c > 1 for c in counts.to_pylist()):
+            raise ValueError(
+                "correlated scalar subquery produced more than one row "
+                "for an outer row (its GROUP BY is finer than the "
+                "correlation)")
+        return vals.to_pylist()
+
+    return one.select(*([col(k) for k in key_names]
+                        + [_check_one(col(vn), col(cnt)).alias(vn)]))
 
 
 def _guard_single_row(rdf, name: str):

@@ -212,6 +212,37 @@ def sharded_grouped_agg(mesh: Mesh, keys, kvalids, vals, vvalids, mask,
     return fk, fkv, fv, fvv, flat[-1]
 
 
+def sharded_broadcast_join(mesh: Mesh, l_key, l_valid, l_mask,
+                           r_key, r_valid, r_mask,
+                           out_capacity_per_shard: int, axis: str = "data"):
+    """Broadcast equi-join over the mesh: the left key plane is sharded on
+    the mesh axis; the small right side is REPLICATED to every device (the
+    strategy the planner picks when one side is under the broadcast
+    threshold — no all_to_all at all, the build side rides one broadcast).
+    Each shard sort-merges its local block against the replicated build
+    side in one XLA program (``kernels.join_phase_*``).
+
+    Returns per-shard (left_idx, right_idx, valid) gather-index blocks
+    stacked to [n_shards * out_capacity_per_shard]; left indices are
+    SHARD-LOCAL (caller adds ``shard * C`` to globalize).
+    """
+    from jax import shard_map
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(axis), P(axis), P(axis), P(), P(), P()),
+             out_specs=(P(axis), P(axis), P(axis)), check_vma=False)
+    def run(lk, lv, lm, rk, rv, rm):
+        lk = lk.reshape(-1)
+        lv = lv.reshape(-1)
+        lm = lm.reshape(-1)
+        rs, rperm, rcnt = kernels.join_phase_sort(rk, rv, rm)
+        counts, starts, _ = kernels.join_phase_count(lk, lv, lm, rs, rcnt)
+        return kernels.join_phase_expand(counts, starts, rperm,
+                                         out_capacity_per_shard)
+
+    return run(l_key, l_valid, l_mask, r_key, r_valid, r_mask)
+
+
 def sharded_hash_repartition(mesh: Mesh, planes, valids, mask, pid,
                              axis: str = "data"):
     """Hash-repartition row blocks across the mesh with one all_to_all: shard

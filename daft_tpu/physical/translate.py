@@ -285,9 +285,10 @@ def _translate_agg(node: lp.Aggregate, cfg) -> pp.PhysicalPlan:
 def _try_mesh_exchange_agg(p1, final_aggs, gb2, f_schema: Schema,
                            p1_schema: Schema) -> Optional[pp.PhysicalPlan]:
     """Choose the ICI-collective shuffle+merge when statically sound: a
-    multi-device mesh is up, every group key / partial value is a plain
-    device-representable column (no dictionary columns — codes aren't
-    comparable across partitions), and every final op merges with itself."""
+    multi-device mesh is up, every group key / partial value either
+    round-trips the device encoding bit-exactly or is string/binary (those
+    ride shared-dictionary codes — see ``_exchangeable``), and every final
+    op merges with itself."""
     from ..aggs import split_agg_expr
     from ..device import column as dcol, runtime as drt
     from ..parallel import mesh as pmesh
@@ -296,9 +297,16 @@ def _try_mesh_exchange_agg(p1, final_aggs, gb2, f_schema: Schema,
         return None  # global aggs gather a handful of scalars — host wins
     if not drt.device_enabled() or pmesh.mesh_size() < 2:
         return None
+    def _exchangeable(dtype) -> bool:
+        # bit-exact round trip, or string/binary riding shared dictionary
+        # codes (the executor concats all partitions into one batch before
+        # encoding, so every shard shares one sorted dictionary — codes are
+        # comparable AND lexicographically ordered; see _np_plane_encoder)
+        return (dcol.is_lossless_device_dtype(dtype)
+                or dtype.is_string() or dtype.is_binary())
+
     for g in gb2:
-        # keys must round-trip the device encoding bit-exactly
-        if not dcol.is_lossless_device_dtype(p1_schema[g.name()].dtype):
+        if not _exchangeable(p1_schema[g.name()].dtype):
             return None
     for a in final_aggs:
         op, child_e, name, params = split_agg_expr(a)
@@ -306,8 +314,7 @@ def _try_mesh_exchange_agg(p1, final_aggs, gb2, f_schema: Schema,
             return None
         if child_e is None or child_e._unalias().op != "col":
             return None
-        if not dcol.is_lossless_device_dtype(
-                p1_schema[child_e._unalias().params[0]].dtype):
+        if not _exchangeable(p1_schema[child_e._unalias().params[0]].dtype):
             return None
     return pp.DeviceExchangeAgg(p1, final_aggs, gb2, f_schema)
 

@@ -122,7 +122,7 @@ class _SubCtx:
     ``logical/subquery.py``)."""
 
     __slots__ = ("outer_scope", "corr", "resid", "deferred_aggs",
-                 "value_names", "owned", "cte_depth")
+                 "deferred_group_by", "value_names", "owned", "cte_depth")
 
     def __init__(self, outer_scope: Scope, cte_depth: int = 0):
         self.outer_scope = outer_scope
@@ -130,6 +130,7 @@ class _SubCtx:
         self.resid = []           # correlated NON-equality conjuncts
         #                           (outer_col markers intact)
         self.deferred_aggs = []   # select exprs when agg is deferred
+        self.deferred_group_by = []  # the subquery's own GROUP BY keys
         self.value_names = []     # projected output names of the sub root
         self.owned = False        # claimed by the subquery's root SELECT
         self.cte_depth = cte_depth  # root select lives at this CTE depth
@@ -574,18 +575,20 @@ class SQLPlanner:
             sub_ctx.value_names = [e.name() for e in exprs]
             if sub_ctx.corr and agg_mode:
                 # correlated aggregating subquery: the unnesting rewrite
-                # re-aggregates grouped by the correlation keys — defer.
-                # Clauses that would apply AFTER the aggregate cannot be
-                # deferred faithfully: refuse rather than silently drop.
-                if group_by:
+                # re-aggregates grouped by the correlation keys ∪ the
+                # subquery's own GROUP BY keys — defer both. Clauses that
+                # would apply AFTER the aggregate cannot be deferred
+                # faithfully: refuse rather than silently drop.
+                if group_by and grouping_sets is not None:
                     raise NotImplementedError(
-                        "correlated subquery with GROUP BY")
+                        "correlated subquery with ROLLUP/GROUPING SETS")
                 if having is not None or distinct or order_by \
                         or limit is not None or offset:
                     raise NotImplementedError(
                         "correlated aggregating subquery with "
                         "HAVING/DISTINCT/ORDER BY/LIMIT")
                 sub_ctx.deferred_aggs = exprs
+                sub_ctx.deferred_group_by = list(group_by)
                 return df
             if (sub_ctx.corr or sub_ctx.resid) and not agg_mode:
                 # the correlation keys AND any inner columns the residual
@@ -1017,7 +1020,7 @@ class SQLPlanner:
         return subq.SubqueryInfo(
             df, ctx.corr, ctx.deferred_aggs,
             ctx.value_names if ctx.value_names else list(df.column_names),
-            resid=ctx.resid)
+            resid=ctx.resid, deferred_group_by=ctx.deferred_group_by)
 
     def _resolve_col(self, scope, name, alias=None) -> Expression:
         """Scope resolution with correlated fallback: a name unknown to the
@@ -1156,10 +1159,12 @@ class SQLPlanner:
         unrename = {v: k for k, v in (rename or {}).items()}
         ro_names = [e.name() for e in ro]
         lo_names = [e.name() for e in lo]
+        out = None
         if residual is not None and how in ("left", "right", "outer"):
             # an outer join's ON residual filters the MATCH, not the rows:
             # a side-local residual pre-filters that side (equivalent);
-            # a both-sides residual would need true theta-join support
+            # one touching the preserved side (or both) needs true
+            # theta-join semantics — lowered via row identity below
             resid_cols = set(residual.column_names())
             if how == "left" and resid_cols <= set(rdf.column_names):
                 rdf = rdf.where(residual)
@@ -1168,15 +1173,13 @@ class SQLPlanner:
                 df = df.where(residual)
                 residual = None
             else:
-                which = "both sides" if not (
-                    set(residual.column_names()) <= set(rdf.column_names)
-                    or set(residual.column_names())
-                    <= set(df.column_names)) else "the preserved side"
-                raise NotImplementedError(
-                    f"{how} join ON residual referencing {which} — needs "
-                    f"true theta-join support (a residual on the "
-                    f"filtered side pre-applies; this one cannot)")
-        if how == "cross":
+                out = self._theta_outer_join(df, rdf, lo, ro, residual,
+                                             how)
+                residual = None
+        theta = out is not None
+        if theta:
+            pass
+        elif how == "cross":
             out = df.join(rdf, how="cross")
         else:
             out = df.join(rdf, left_on=lo, right_on=ro, how=how)
@@ -1185,7 +1188,11 @@ class SQLPlanner:
             for sqlname, act in right_scope.tables[alias].items():
                 if how in ("semi", "anti"):
                     continue
-                if act in ro_names and how not in ("outer",):
+                # theta lowering keeps BOTH key copies with exact SQL
+                # semantics (each side's copy is NULL on the other side's
+                # missing piece) — the merged-key remap would resolve the
+                # preserved side's key to a NULL left copy
+                if act in ro_names and how not in ("outer",) and not theta:
                     ki = ro_names.index(act)
                     orig = unrename.get(act, act)
                     if ki < len(lo_names) and lo_names[ki] == orig:
@@ -1196,6 +1203,51 @@ class SQLPlanner:
             scope.order.append(alias)
         if residual is not None:
             out = out.where(residual)
+        return out
+
+    def _theta_outer_join(self, df, rdf, lo, ro, residual, how):
+        """LEFT/RIGHT/FULL OUTER join whose ON residual touches the
+        preserved side (or both sides) — true theta-join semantics via row
+        identity: the match set is the inner equi-join filtered by the
+        residual; preserved rows with no surviving match re-enter with
+        NULLs on the other side. The reference covers these through
+        plan-level join predicates
+        (``src/daft-logical-plan/src/optimization/rules/`` — the
+        EliminateCrossJoin / join-predicate push family)."""
+        from ..logical.subquery import _uid
+        left_cols = list(df.column_names)
+        right_cols = list(rdf.column_names)
+        lrid = f"__thrid{next(_uid)}__"
+        rrid = f"__thrid{next(_uid)}__"
+        tl = df.add_monotonically_increasing_id(lrid)
+        tr = rdf.add_monotonically_increasing_id(rrid)
+        if lo:
+            inner = tl.join(tr, left_on=lo, right_on=ro, how="inner")
+        else:
+            inner = tl.join(tr, how="cross")
+        inner = inner.where(residual)
+        lsch, rsch = df.schema(), rdf.schema()
+        both = [col(c) for c in left_cols + right_cols]
+        pieces = [inner.select(*both)]
+        if how in ("left", "outer"):
+            missing = tl.join(inner.select(col(lrid)).distinct(),
+                              left_on=[col(lrid)], right_on=[col(lrid)],
+                              how="anti")
+            pieces.append(missing.select(
+                *([col(c) for c in left_cols]
+                  + [lit(None).cast(rsch[c].dtype).alias(c)
+                     for c in right_cols])))
+        if how in ("right", "outer"):
+            missing = tr.join(inner.select(col(rrid)).distinct(),
+                              left_on=[col(rrid)], right_on=[col(rrid)],
+                              how="anti")
+            pieces.append(missing.select(
+                *([lit(None).cast(lsch[c].dtype).alias(c)
+                   for c in left_cols]
+                  + [col(c) for c in right_cols])))
+        out = pieces[0]
+        for p in pieces[1:]:
+            out = out.concat(p)
         return out
 
     def _table_factor(self, ctes, scope: Scope):

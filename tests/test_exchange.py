@@ -118,16 +118,133 @@ def test_groupby_multi_key_through_mesh_exchange():
     assert got == want
 
 
-def test_string_keys_fall_back_to_host_exchange():
-    # dictionary-coded keys must NOT take the mesh path (codes aren't
-    # comparable across partitions) — result must still be correct
-    df = daft_tpu.from_pydict({"k": ["x", "y", "x", "z"] * 50,
-                               "v": list(range(200))})
+def test_string_keys_through_mesh_exchange():
+    """String group keys ride SHARED-dictionary codes through the mesh
+    exchange (r5): the executor concats all partitions into one batch
+    before encoding, so codes are comparable — and rank-ordered — across
+    shards. The plan must choose DeviceExchangeAgg and match the host."""
+    df = daft_tpu.from_pydict({"k": ["x", "y", "x", "z", None] * 50,
+                               "v": list(range(250))})
+    builder = df.groupby("k").agg(col("v").sum().alias("s")) \
+        ._builder.optimize()
+    phys = pt.translate(builder.plan)
+
+    def has(node, t):
+        return isinstance(node, t) or any(has(c, t) for c in node.children)
+    assert has(phys, pp.DeviceExchangeAgg), \
+        "string keys no longer lower onto the mesh exchange"
     q = lambda d: _sorted_pydict(
         d.groupby("k").agg(col("v").sum().alias("s")), ["k"])
     got = q(df)
     want = _oracle(lambda: q(df))
     assert got == want
+
+
+def test_string_min_max_through_mesh_exchange():
+    """min/max over STRING VALUES: dictionary codes are rank codes over
+    the sorted dictionary, so code order is lexicographic order."""
+    df = daft_tpu.from_pydict({
+        "g": [i % 4 for i in range(200)],
+        "s": [f"w{i % 23:03d}" for i in range(200)]})
+    q = lambda d: _sorted_pydict(
+        d.groupby("g").agg(col("s").min().alias("lo"),
+                           col("s").max().alias("hi")), ["g"])
+    got = q(df)
+    want = _oracle(lambda: q(df))
+    assert got == want
+
+
+def test_mesh_range_partitioned_sort():
+    """Range repartition = the same routing collective fed a
+    searchsorted(boundaries) pid plane; local sort per shard must yield a
+    globally ordered concatenation (the distributed sort composition)."""
+    import jax
+    mesh = pmesh.get_mesh()
+    n = pmesh.mesh_size()
+    rng = np.random.default_rng(11)
+    C = 64
+    skeys = rng.uniform(0, 1000, n * C)
+    boundaries = np.quantile(skeys, [i / n for i in range(1, n)])
+    pid = np.searchsorted(boundaries, skeys).astype(np.int32)
+    ones = np.ones(n * C, dtype=bool)
+    (pk2,), _, m2 = exchange.sharded_hash_repartition(
+        mesh, (exchange.shard_blocks(mesh, skeys),),
+        (exchange.shard_blocks(mesh, ones),),
+        exchange.shard_blocks(mesh, ones),
+        exchange.shard_blocks(mesh, pid))
+    pk2, m2 = map(np.asarray, jax.device_get((pk2, m2)))
+    shard_len = pk2.shape[0] // n
+    merged = np.concatenate([
+        np.sort(pk2[i * shard_len:(i + 1) * shard_len]
+                [m2[i * shard_len:(i + 1) * shard_len]])
+        for i in range(n)])
+    assert merged.shape[0] == n * C
+    assert np.all(np.diff(merged) >= 0)
+    np.testing.assert_allclose(merged, np.sort(skeys))
+
+
+def test_broadcast_join_collective():
+    """Sharded probe side × replicated build side, no all_to_all."""
+    import jax
+    import jax.numpy as jnp
+    mesh = pmesh.get_mesh()
+    n = pmesh.mesh_size()
+    rng = np.random.default_rng(5)
+    C = 32
+    lkeys = rng.integers(0, 16, n * C).astype(np.int64)
+    rkeys = np.arange(0, 16, 2, dtype=np.int64)
+    ones_l = np.ones(n * C, dtype=bool)
+    ones_r = np.ones(rkeys.shape[0], dtype=bool)
+    out_cap = 2 * C
+    li, ri, ok = map(np.asarray, jax.device_get(
+        exchange.sharded_broadcast_join(
+            mesh, exchange.shard_blocks(mesh, lkeys),
+            exchange.shard_blocks(mesh, ones_l),
+            exchange.shard_blocks(mesh, ones_l),
+            jnp.asarray(rkeys), jnp.asarray(ones_r), jnp.asarray(ones_r),
+            out_cap)))
+    matched = 0
+    for i in range(n):
+        sl = slice(i * out_cap, (i + 1) * out_cap)
+        for lo, ro, good in zip(li[sl], ri[sl], ok[sl]):
+            if good:
+                assert lkeys[i * C + lo] == rkeys[ro]
+                matched += 1
+    assert matched == int(np.isin(lkeys, rkeys).sum())
+
+
+def test_window_over_mesh_exchange():
+    """partition_by repartition rides the mesh all_to_all, then the window
+    runs per partition — engine path with a repartition spy."""
+    from daft_tpu.execution import executor as ex_mod
+    n = pmesh.mesh_size()
+    rng = np.random.default_rng(9)
+    keys = rng.integers(0, 8, 400)
+    vals = rng.uniform(0, 10, 400)
+    calls = {"n": 0}
+    orig = ex_mod.LocalExecutor._mesh_hash_repartition
+
+    def spy(self, parts, by, k):
+        out = orig(self, parts, by, k)
+        if out is not None:
+            calls["n"] += 1
+        return out
+    ex_mod.LocalExecutor._mesh_hash_repartition = spy
+    try:
+        df = daft_tpu.from_pydict({"k": keys.tolist(), "v": vals.tolist()}) \
+            .repartition(n, "k")
+        out = df.select(
+            col("k"), col("v"),
+            col("v").sum().over(daft_tpu.Window().partition_by("k"))
+            .alias("tot")).sort([col("k"), col("v")]).to_pydict()
+    finally:
+        ex_mod.LocalExecutor._mesh_hash_repartition = orig
+    assert calls["n"] >= 1
+    expect = {}
+    for k, v in zip(keys, vals):
+        expect[int(k)] = expect.get(int(k), 0.0) + float(v)
+    for k, tot in zip(out["k"], out["tot"]):
+        assert tot == pytest.approx(expect[k])
 
 
 def test_repartition_hash_through_mesh():

@@ -228,6 +228,131 @@ def test_positive_disjunction_in_mark_null_unchanged(nullish):
     assert out["name"] == ["four", "one"]
 
 
+# ------------------------------ correlated agg subqueries with GROUP BY
+
+def test_correlated_agg_subquery_with_group_by_scalar(shop):
+    """Scalar comparison against a correlated aggregating subquery whose
+    GROUP BY equals the correlation key (the common shape): one row per
+    outer row, no duplication (r4 fence at sql/planner.py:581)."""
+    out = dt.sql(
+        "SELECT c_name FROM cust WHERE c_bal > "
+        "(SELECT sum(o_total) FROM orders WHERE o_cust = c_id "
+        " GROUP BY o_cust) ORDER BY c_name", **shop).to_pydict()
+    # ann: 100 > 50; bob: 5 > 7 no; cat: 60 <= 60 no; dan: no orders → NULL
+    assert out == {"c_name": ["ann"]}
+
+
+def test_correlated_agg_subquery_group_by_finer_raises(shop):
+    """GROUP BY finer than the correlation can yield several rows per
+    outer row — SQL's scalar-cardinality error, raised at runtime rather
+    than silently duplicating outer rows."""
+    with pytest.raises(Exception, match="more than one row"):
+        dt.sql(
+            "SELECT c_name FROM cust WHERE c_bal > "
+            "(SELECT sum(o_total) FROM orders WHERE o_cust = c_id "
+            " GROUP BY o_id)", **shop).to_pydict()
+
+
+def test_correlated_agg_subquery_group_by_in(shop):
+    """IN against a correlated aggregating subquery with GROUP BY: each
+    (correlation, group) cell contributes a candidate value."""
+    out = dt.sql(
+        "SELECT c_name FROM cust WHERE c_bal IN "
+        "(SELECT sum(o_total) * 2 FROM orders WHERE o_cust = c_id "
+        " GROUP BY o_id) ORDER BY c_name", **shop).to_pydict()
+    # per-order doubled sums: ann {40,60}, bob {14}, cat {110,10}, dan {}
+    # balances: ann 100, bob 5, cat 60, dan 40 → only ann's 100? no —
+    # ann: 100 not in {40,60}; bob: 5 not in {14}; cat: 60 not in {110,10}
+    assert out == {"c_name": []}
+
+
+def test_correlated_agg_subquery_group_by_in_match(shop):
+    out = dt.sql(
+        "SELECT c_name FROM cust WHERE c_bal IN "
+        "(SELECT sum(o_total) FROM orders WHERE o_cust = c_id "
+        " GROUP BY o_cust) ORDER BY c_name", **shop).to_pydict()
+    # totals: ann 50, bob 7, cat 60, dan none → cat's 60 matches c_bal 60
+    assert out == {"c_name": ["cat"]}
+
+
+# ----------------------------------------- theta residuals on outer joins
+
+@pytest.fixture(scope="module")
+def theta():
+    t1 = dt.from_pydict({"a": [1, 2, 3, 4], "x": [10, 20, 30, 40]})
+    t2 = dt.from_pydict({"b": [1, 2, 3, 5], "y": [5, 25, 35, 55]})
+    return {"t1": t1, "t2": t2}
+
+
+def test_left_join_residual_on_preserved_side(theta):
+    """LEFT JOIN ... ON a = b AND x > 15: the residual touches the
+    PRESERVED side, so it filters the match, not the rows — rows with
+    x <= 15 keep a NULL right side (r4 fence at sql/planner.py:1175)."""
+    out = dt.sql(
+        "SELECT a, x, y FROM t1 LEFT JOIN t2 ON a = b AND x > 15 "
+        "ORDER BY a", **theta).to_pydict()
+    assert out["a"] == [1, 2, 3, 4]
+    assert out["y"] == [None, 25, 35, None]  # a=1 fails x>15, a=4 no match
+
+
+def test_left_join_residual_both_sides(theta):
+    out = dt.sql(
+        "SELECT a, x, y FROM t1 LEFT JOIN t2 ON a = b AND x > y "
+        "ORDER BY a", **theta).to_pydict()
+    # a=1: 10 > 5 match; a=2: 20 > 25 no; a=3: 30 > 35 no; a=4: no b
+    assert out["a"] == [1, 2, 3, 4]
+    assert out["y"] == [5, None, None, None]
+
+
+def test_right_join_residual_on_preserved_side(theta):
+    out = dt.sql(
+        "SELECT a, x, y FROM t1 RIGHT JOIN t2 ON a = b AND y > 20 "
+        "ORDER BY y", **theta).to_pydict()
+    # preserved right rows: y=5 (no match, y>20 false), 25→a=2, 35→a=3,
+    # 55 (no match)
+    assert out["y"] == [5, 25, 35, 55]
+    assert out["a"] == [None, 2, 3, None]
+
+
+def test_right_join_theta_same_named_key():
+    """The preserved side's key must survive with its own values — the
+    merged-key scope remap would resolve it to the NULL left copy."""
+    t1 = dt.from_pydict({"k": [1, 2], "v": [10, 20]})
+    t2 = dt.from_pydict({"k": [1, 3], "w": [5, 30]})
+    out = dt.sql(
+        "SELECT t2.k AS kk, w FROM t1 RIGHT JOIN t2 "
+        "ON t1.k = t2.k AND v > w ORDER BY w", t1=t1, t2=t2).to_pydict()
+    # k=1: 10 > 5 matches; k=3: preserved with no match
+    assert out == {"kk": [1, 3], "w": [5, 30]}
+
+
+def test_correlated_agg_group_by_guard_only_referenced_keys():
+    """The cardinality guard applies per OUTER row: inner keys no outer
+    row references must not trip it (r5 review finding)."""
+    o = dt.from_pydict({"k": [1], "name": ["only"]})
+    t = dt.from_pydict({"k": [1, 2, 2], "g": [1, 1, 2],
+                        "v": [7.0, 1.0, 2.0]})
+    out = dt.sql(
+        "SELECT name FROM o WHERE 5 < "
+        "(SELECT sum(v) FROM t WHERE t.k = o.k GROUP BY t.g)",
+        o=o, t=t).to_pydict()
+    # k=1 has ONE (g=1) group with sum 7 > 5; k=2's two groups are never
+    # referenced by an outer row and must not raise
+    assert out == {"name": ["only"]}
+
+
+def test_full_outer_join_residual_both_sides(theta):
+    out = dt.sql(
+        "SELECT a, x, y FROM t1 FULL OUTER JOIN t2 ON a = b AND x > y "
+        "ORDER BY a, y", **theta).to_pydict()
+    # matches: only a=1/b=1 (10>5). Unmatched left: 2,3,4; right: 25,35,55
+    rows = sorted(zip(out["a"], out["x"], out["y"]),
+                  key=lambda r: (r[0] is None, r[0] or 0, r[2] or 0))
+    assert (1, 10, 5) in rows
+    assert sum(1 for a, _, y in rows if a is None) == 3  # right-only
+    assert sum(1 for a, _, y in rows if y is None and a is not None) == 3
+
+
 # ---------------------------------------------------------- TPC-H parity
 
 @pytest.fixture(scope="module")

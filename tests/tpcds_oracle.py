@@ -1,0 +1,557 @@
+"""Independent pandas oracles for the TPC-DS corpus (answer validation).
+
+Each ``qNN(T)`` transcribes ``benchmarking/tpcds`` query NN directly from
+its SQL text into pandas and returns ``(expected_df, meta)`` where meta
+carries the ORDER BY spec so the checker can honor LIMIT-with-ties:
+
+    meta = {"keys": [...], "asc": [...], "limit": N or None,
+            "approx": [float cols], "unordered": bool}
+
+The oracles deliberately use a different execution substrate (pandas
+merges/groupbys) than the engine (its own planner + kernels), so a
+planner/lowering bug shows as a mismatch rather than being mirrored.
+Reference analogue: ``benchmarking/tpch/answers.py`` +
+``tests/integration/test_tpch.py`` validate TPC-H the same way.
+
+NULL-sum semantics: SQL SUM over an empty/all-NULL set is NULL, pandas
+``sum()`` is 0 — transcriptions use ``_sum`` (min_count=1) wherever the
+distinction can surface.
+"""
+
+import numpy as np
+import pandas as pd
+
+
+class Tables:
+    """Lazy pandas view over the generated TPC-DS dataset."""
+
+    def __init__(self, get_df):
+        self._get = get_df
+        self._cache = {}
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._cache:
+            self._cache[name] = self._get(name).to_pandas()
+        return self._cache[name]
+
+
+def _sum(s):
+    return s.sum(min_count=1)
+
+
+def sql_sort(df, keys, ascending):
+    """Engine semantics: ASC → NULLS LAST, DESC → NULLS FIRST."""
+    out = df
+    for k, asc in reversed(list(zip(keys, ascending))):
+        out = out.sort_values(k, ascending=asc, kind="stable",
+                              na_position="last" if asc else "first")
+    return out.reset_index(drop=True)
+
+
+def meta(keys=(), asc=None, limit=100, approx=(), unordered=False):
+    keys = list(keys)
+    return {"keys": keys,
+            "asc": list(asc) if asc is not None else [True] * len(keys),
+            "limit": limit, "approx": list(approx), "unordered": unordered}
+
+
+# ---------------------------------------------------------------- helpers
+
+def _star(ss, *joins):
+    """Inner-merge a fact frame through (dim_frame, left_key, right_key)."""
+    out = ss
+    for dim, lk, rk in joins:
+        out = out.merge(dim, left_on=lk, right_on=rk)
+    return out
+
+
+def _dates_between(dd, lo, hi):
+    d = pd.to_datetime(dd.d_date)
+    return dd[(d >= pd.Timestamp(lo)) & (d <= pd.Timestamp(hi))]
+
+
+# ---------------------------------------------------------------- oracles
+
+def q3(T):
+    j = _star(T.store_sales,
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.item, "ss_item_sk", "i_item_sk"))
+    j = j[(j.i_manufact_id == 128) & (j.d_moy == 11)]
+    out = (j.groupby(["d_year", "i_brand_id", "i_brand"], as_index=False)
+           .agg(sum_agg=("ss_ext_sales_price", _sum)))
+    return out, meta(["d_year", "sum_agg", "i_brand_id"],
+                     [True, False, True], 100, ["sum_agg"])
+
+
+def q7(T):
+    j = _star(T.store_sales,
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.item, "ss_item_sk", "i_item_sk"),
+              (T.customer_demographics, "ss_cdemo_sk", "cd_demo_sk"),
+              (T.promotion, "ss_promo_sk", "p_promo_sk"))
+    j = j[(j.cd_gender == "M") & (j.cd_marital_status == "S")
+          & (j.cd_education_status == "College")
+          & ((j.p_channel_email == "N") | (j.p_channel_event == "N"))
+          & (j.d_year == 2000)]
+    out = (j.groupby("i_item_id", as_index=False)
+           .agg(agg1=("ss_quantity", "mean"),
+                agg2=("ss_list_price", "mean"),
+                agg3=("ss_coupon_amt", "mean"),
+                agg4=("ss_sales_price", "mean")))
+    return out, meta(["i_item_id"], None, 100,
+                     ["agg1", "agg2", "agg3", "agg4"])
+
+
+def q19(T):
+    j = _star(T.store_sales,
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.item, "ss_item_sk", "i_item_sk"),
+              (T.customer, "ss_customer_sk", "c_customer_sk"),
+              (T.customer_address, "c_current_addr_sk", "ca_address_sk"),
+              (T.store, "ss_store_sk", "s_store_sk"))
+    j = j[(j.i_manager_id.between(1, 40)) & (j.d_moy == 11)
+          & (j.d_year == 1999)]
+    out = (j.groupby(["i_brand_id", "i_brand", "i_manufact_id"],
+                     as_index=False)
+           .agg(ext_price=("ss_ext_sales_price", _sum)))
+    return out, meta(["ext_price", "i_brand_id", "i_manufact_id"],
+                     [False, True, True], 100, ["ext_price"])
+
+
+def q26(T):
+    j = _star(T.store_sales,
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.item, "ss_item_sk", "i_item_sk"),
+              (T.customer_demographics, "ss_cdemo_sk", "cd_demo_sk"),
+              (T.promotion, "ss_promo_sk", "p_promo_sk"))
+    j = j[(j.cd_gender == "F") & (j.cd_marital_status == "W")
+          & (j.cd_education_status == "Primary")
+          & ((j.p_channel_email == "N") | (j.p_channel_event == "N"))
+          & (j.d_year == 2000)]
+    out = (j.groupby("i_item_id", as_index=False)
+           .agg(agg1=("ss_quantity", "mean"),
+                agg2=("ss_list_price", "mean"),
+                agg3=("ss_coupon_amt", "mean"),
+                agg4=("ss_sales_price", "mean")))
+    return out, meta(["i_item_id"], None, 100,
+                     ["agg1", "agg2", "agg3", "agg4"])
+
+
+def q42(T):
+    j = _star(T.store_sales,
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.item, "ss_item_sk", "i_item_sk"))
+    j = j[(j.i_manager_id == 1) & (j.d_moy == 11) & (j.d_year == 2000)]
+    out = (j.groupby(["d_year", "i_category_id", "i_category"],
+                     as_index=False)
+           .agg(sum_sales=("ss_ext_sales_price", _sum)))
+    return out, meta(["sum_sales", "d_year", "i_category_id", "i_category"],
+                     [False, True, True, True], 100, ["sum_sales"])
+
+
+def q52(T):
+    j = _star(T.store_sales,
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.item, "ss_item_sk", "i_item_sk"))
+    j = j[(j.i_manager_id == 1) & (j.d_moy == 11) & (j.d_year == 2000)]
+    out = (j.groupby(["d_year", "i_brand_id", "i_brand"], as_index=False)
+           .agg(ext_price=("ss_ext_sales_price", _sum)))
+    return out, meta(["d_year", "ext_price", "i_brand_id"],
+                     [True, False, True], 100, ["ext_price"])
+
+
+def q55(T):
+    j = _star(T.store_sales,
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.item, "ss_item_sk", "i_item_sk"))
+    j = j[(j.i_manager_id == 28) & (j.d_moy == 11) & (j.d_year == 1999)]
+    out = (j.groupby(["i_brand_id", "i_brand"], as_index=False)
+           .agg(ext_price=("ss_ext_sales_price", _sum)))
+    return out, meta(["ext_price", "i_brand_id"], [False, True], 100,
+                     ["ext_price"])
+
+
+def q96(T):
+    j = _star(T.store_sales,
+              (T.household_demographics, "ss_hdemo_sk", "hd_demo_sk"),
+              (T.time_dim, "ss_sold_time_sk", "t_time_sk"),
+              (T.store, "ss_store_sk", "s_store_sk"))
+    n = len(j[(j.t_hour == 20) & (j.t_minute >= 30) & (j.hd_dep_count == 7)])
+    return pd.DataFrame({"cnt": [n]}), meta([], None, 100)
+
+
+def q13(T):
+    j = _star(T.store_sales,
+              (T.store, "ss_store_sk", "s_store_sk"),
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.customer_demographics, "ss_cdemo_sk", "cd_demo_sk"),
+              (T.household_demographics, "ss_hdemo_sk", "hd_demo_sk"),
+              (T.customer_address, "ss_addr_sk", "ca_address_sk"))
+    j = j[j.d_year == 2001]
+    demo = (((j.cd_marital_status == "M")
+             & (j.cd_education_status == "Advanced Degree")
+             & j.ss_sales_price.between(100.0, 150.0)
+             & (j.hd_dep_count == 3))
+            | ((j.cd_marital_status == "S")
+               & (j.cd_education_status == "College")
+               & j.ss_sales_price.between(50.0, 100.0)
+               & (j.hd_dep_count == 1))
+            | ((j.cd_marital_status == "W")
+               & (j.cd_education_status == "Secondary")
+               & j.ss_sales_price.between(150.0, 200.0)
+               & (j.hd_dep_count == 1)))
+    addr = ((j.ca_country == "United States")
+            & ((j.ca_state.isin(["TX", "OR", "WA"])
+                & j.ss_net_profit.between(100, 200))
+               | (j.ca_state.isin(["CA", "NY", "TN"])
+                  & j.ss_net_profit.between(150, 300))
+               | (j.ca_state.isin(["SD", "GA", "KY"])
+                  & j.ss_net_profit.between(50, 250))))
+    j = j[demo & addr]
+    out = pd.DataFrame({
+        "avg_q": [j.ss_quantity.mean() if len(j) else None],
+        "avg_esp": [j.ss_ext_sales_price.mean() if len(j) else None],
+        "avg_ewc": [j.ss_ext_wholesale_cost.mean() if len(j) else None],
+        "sum_ewc": [_sum(j.ss_ext_wholesale_cost) if len(j) else None]})
+    return out, meta([], None, None,
+                     ["avg_q", "avg_esp", "avg_ewc", "sum_ewc"])
+
+
+def q48(T):
+    j = _star(T.store_sales,
+              (T.store, "ss_store_sk", "s_store_sk"),
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.customer_demographics, "ss_cdemo_sk", "cd_demo_sk"),
+              (T.customer_address, "ss_addr_sk", "ca_address_sk"))
+    j = j[j.d_year == 2000]
+    demo = (((j.cd_marital_status == "M")
+             & (j.cd_education_status == "College")
+             & j.ss_sales_price.between(100.0, 150.0))
+            | ((j.cd_marital_status == "D")
+               & (j.cd_education_status == "Primary")
+               & j.ss_sales_price.between(50.0, 100.0))
+            | ((j.cd_marital_status == "W")
+               & (j.cd_education_status == "Secondary")
+               & j.ss_sales_price.between(150.0, 200.0)))
+    addr = ((j.ca_country == "United States")
+            & ((j.ca_state.isin(["TX", "NM", "OR"])
+                & j.ss_net_profit.between(0, 2000))
+               | (j.ca_state.isin(["CA", "NY", "WA"])
+                  & j.ss_net_profit.between(150, 3000))
+               | (j.ca_state.isin(["TN", "GA", "KY"])
+                  & j.ss_net_profit.between(50, 25000))))
+    j = j[demo & addr]
+    return pd.DataFrame({"total_q": [_sum(j.ss_quantity)]}), \
+        meta([], None, None, ["total_q"])
+
+
+def q43(T):
+    j = _star(T.store_sales,
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.store, "ss_store_sk", "s_store_sk"))
+    j = j[(j.d_year == 2000) & (j.s_gmt_offset == -5.0)]
+    days = {"sun_sales": "Sunday", "mon_sales": "Monday",
+            "fri_sales": "Friday", "sat_sales": "Saturday"}
+    gb = j.groupby(["s_store_name", "s_store_sk"])
+    out = gb.size().reset_index().drop(columns=0)
+    for cname, day in days.items():
+        s = (j[j.d_day_name == day]
+             .groupby(["s_store_name", "s_store_sk"])["ss_sales_price"]
+             .apply(_sum).rename(cname).reset_index())
+        out = out.merge(s, on=["s_store_name", "s_store_sk"], how="left")
+    return out, meta(["s_store_name", "s_store_sk"], None, 100,
+                     list(days))
+
+
+def q34(T):
+    j = _star(T.store_sales,
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.store, "ss_store_sk", "s_store_sk"),
+              (T.household_demographics, "ss_hdemo_sk", "hd_demo_sk"))
+    j = j[j.d_dom.between(1, 3) & (j.hd_vehicle_count > 0)
+          & (j.d_year == 2000)]
+    t = (j.groupby(["ss_ticket_number", "ss_customer_sk"], as_index=False)
+         .size().rename(columns={"size": "cnt"}))
+    t = t[t.cnt.between(15, 20)]
+    out = t.merge(T.customer, left_on="ss_customer_sk",
+                  right_on="c_customer_sk")
+    out = out[["c_last_name", "c_first_name", "ss_ticket_number", "cnt"]]
+    return out, meta(["c_last_name", "c_first_name", "ss_ticket_number"],
+                     [True, True, False], None)
+
+
+def q73(T):
+    j = _star(T.store_sales,
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.store, "ss_store_sk", "s_store_sk"),
+              (T.household_demographics, "ss_hdemo_sk", "hd_demo_sk"))
+    j = j[j.d_dom.between(1, 2)
+          & j.hd_buy_potential.isin([">10000", "Unknown"])
+          & (j.hd_vehicle_count > 0) & (j.d_year == 2000)]
+    t = (j.groupby(["ss_ticket_number", "ss_customer_sk"], as_index=False)
+         .size().rename(columns={"size": "cnt"}))
+    t = t[t.cnt.between(1, 5)]
+    out = t.merge(T.customer, left_on="ss_customer_sk",
+                  right_on="c_customer_sk")
+    out = out[["c_last_name", "c_first_name", "ss_ticket_number", "cnt"]]
+    return out, meta(["cnt", "c_last_name"], [False, True], None)
+
+
+def q15(T):
+    j = _star(T.catalog_sales,
+              (T.customer, "cs_bill_customer_sk", "c_customer_sk"),
+              (T.customer_address, "c_current_addr_sk", "ca_address_sk"),
+              (T.date_dim, "cs_sold_date_sk", "d_date_sk"))
+    zips = ("85669", "86197", "88274", "83405", "86475", "85392", "85460",
+            "80348", "81792")
+    j = j[(j.ca_zip.astype(str).str[:5].isin(zips)
+           | j.ca_state.isin(["CA", "WA", "GA"]) | (j.cs_sales_price > 500))
+          & (j.d_qoy == 2) & (j.d_year == 2000)]
+    out = (j.groupby("ca_zip", as_index=False)
+           .agg(total_sales=("cs_sales_price", _sum)))
+    return out, meta(["ca_zip"], None, 100, ["total_sales"])
+
+
+def q45(T):
+    it = T.item
+    wanted_ids = set(it[it.i_item_sk.isin(
+        [2, 3, 5, 7, 11, 13, 17, 19, 23, 29])].i_item_id)
+    j = _star(T.web_sales,
+              (T.customer, "ws_bill_customer_sk", "c_customer_sk"),
+              (T.customer_address, "c_current_addr_sk", "ca_address_sk"),
+              (it, "ws_item_sk", "i_item_sk"),
+              (T.date_dim, "ws_sold_date_sk", "d_date_sk"))
+    zips = ("85669", "86197", "88274", "83405", "86475", "85392", "85460",
+            "80348", "81792")
+    j = j[(j.ca_zip.astype(str).str[:5].isin(zips)
+           | j.i_item_id.isin(wanted_ids))
+          & (j.d_qoy == 2) & (j.d_year == 2000)]
+    out = (j.groupby(["ca_zip", "ca_city"], as_index=False)
+           .agg(total_sales=("ws_sales_price", _sum)))
+    return out, meta(["ca_zip", "ca_city"], None, 100, ["total_sales"])
+
+
+def q61(T):
+    base = _star(T.store_sales,
+                 (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+                 (T.store, "ss_store_sk", "s_store_sk"),
+                 (T.customer, "ss_customer_sk", "c_customer_sk"),
+                 (T.customer_address, "c_current_addr_sk", "ca_address_sk"),
+                 (T.item, "ss_item_sk", "i_item_sk"))
+    base = base[(base.ca_gmt_offset == -5) & (base.s_gmt_offset == -5)
+                & (base.i_category == "Jewelry") & (base.d_year == 2000)
+                & (base.d_moy == 11)]
+    promo = base.merge(T.promotion, left_on="ss_promo_sk",
+                       right_on="p_promo_sk")
+    promo = promo[(promo.p_channel_dmail == "Y")
+                  | (promo.p_channel_email == "Y")
+                  | (promo.p_channel_tv == "Y")]
+    p = _sum(promo.ss_ext_sales_price)
+    t = _sum(base.ss_ext_sales_price)
+    out = pd.DataFrame({"promotions": [p], "total": [t],
+                        "ratio": [float(p) / float(t) * 100
+                                  if t and not pd.isna(t) else None]})
+    return out, meta([], None, 100, ["promotions", "total", "ratio"])
+
+
+def q88(T):
+    j = _star(T.store_sales,
+              (T.household_demographics, "ss_hdemo_sk", "hd_demo_sk"),
+              (T.time_dim, "ss_sold_time_sk", "t_time_sk"),
+              (T.store, "ss_store_sk", "s_store_sk"))
+    j = j[(((j.hd_dep_count == 4) & (j.hd_vehicle_count <= 6))
+           | ((j.hd_dep_count == 2) & (j.hd_vehicle_count <= 4))
+           | ((j.hd_dep_count == 0) & (j.hd_vehicle_count <= 2)))
+          & (j.s_store_name == "ese")]
+    out = pd.DataFrame({
+        "h8_30_to_9": [len(j[(j.t_hour == 8) & (j.t_minute >= 30)])],
+        "h9_to_9_30": [len(j[(j.t_hour == 9) & (j.t_minute < 30)])],
+        "h9_30_to_10": [len(j[(j.t_hour == 9) & (j.t_minute >= 30)])],
+        "h10_to_10_30": [len(j[(j.t_hour == 10) & (j.t_minute < 30)])]})
+    return out, meta([], None, None)
+
+
+def q90(T):
+    j = _star(T.web_sales,
+              (T.household_demographics, "ws_ship_hdemo_sk", "hd_demo_sk"),
+              (T.time_dim, "ws_sold_time_sk", "t_time_sk"),
+              (T.web_page, "ws_web_page_sk", "wp_web_page_sk"))
+    j = j[(j.hd_dep_count == 6) & j.wp_char_count.between(5000, 5200)]
+    amc = len(j[j.t_hour.between(8, 9)])
+    pmc = len(j[j.t_hour.between(19, 20)])
+    out = pd.DataFrame(
+        {"am_pm_ratio": [float(amc) / float(pmc) if pmc else None]})
+    return out, meta([], None, 100, ["am_pm_ratio"])
+
+
+def q9(T):
+    ss = T.store_sales
+    vals = []
+    for lo, hi in ((1, 20), (21, 40), (41, 60), (61, 80), (81, 100)):
+        b = ss[ss.ss_quantity.between(lo, hi)]
+        vals.append(b.ss_ext_discount_amt.mean() if len(b) > 1000
+                    else b.ss_net_paid.mean())
+    out = pd.DataFrame({f"bucket{i + 1}": [v] for i, v in enumerate(vals)})
+    return out, meta([], None, None, [f"bucket{i}" for i in range(1, 6)])
+
+
+def q28(T):
+    ss = T.store_sales
+    specs = [((0, 5), (8, 18), (459, 1459), (57, 77)),
+             ((6, 10), (90, 100), (2323, 3323), (31, 51)),
+             ((11, 15), (142, 152), (12214, 13214), (79, 99)),
+             ((16, 20), (135, 145), (6071, 7071), (38, 58)),
+             ((21, 25), (122, 132), (836, 1836), (17, 37)),
+             ((26, 30), (154, 164), (7326, 8326), (7, 27))]
+    cols = {}
+    for i, (q, lp, cp, wc) in enumerate(specs, 1):
+        b = ss[ss.ss_quantity.between(*q)
+               & (ss.ss_list_price.between(*lp)
+                  | ss.ss_coupon_amt.between(*cp)
+                  | ss.ss_wholesale_cost.between(*wc))]
+        cols[f"b{i}_lp"] = [b.ss_list_price.mean()]
+        cols[f"b{i}_cnt"] = [int(b.ss_list_price.count())]
+        cols[f"b{i}_cntd"] = [int(b.ss_list_price.nunique())]
+    return pd.DataFrame(cols), meta(
+        [], None, 100, [f"b{i}_lp" for i in range(1, 7)])
+
+
+def q62(T):
+    j = _star(T.web_sales,
+              (T.date_dim, "ws_ship_date_sk", "d_date_sk"),
+              (T.warehouse, "ws_warehouse_sk", "w_warehouse_sk"),
+              (T.ship_mode, "ws_ship_mode_sk", "sm_ship_mode_sk"),
+              (T.web_site, "ws_web_site_sk", "web_site_sk"))
+    j = j[j.d_month_seq.between(1212, 1223)]
+    j = j.assign(wh=j.w_warehouse_name.astype(str).str[:20],
+                 lag=j.ws_ship_date_sk - j.ws_sold_date_sk)
+    out = (j.groupby(["wh", "sm_type", "web_name"], as_index=False)
+           .agg(days_30=("lag", lambda s: int((s <= 30).sum())),
+                days_31_60=("lag", lambda s: int(((s > 30) & (s <= 60)).sum())),
+                days_61_90=("lag", lambda s: int(((s > 60) & (s <= 90)).sum())),
+                days_91_120=("lag",
+                             lambda s: int(((s > 90) & (s <= 120)).sum())),
+                days_over_120=("lag", lambda s: int((s > 120).sum()))))
+    return out, meta(["wh", "sm_type", "web_name"], None, 100)
+
+
+def q99(T):
+    j = _star(T.catalog_sales,
+              (T.date_dim, "cs_ship_date_sk", "d_date_sk"),
+              (T.warehouse, "cs_warehouse_sk", "w_warehouse_sk"),
+              (T.ship_mode, "cs_ship_mode_sk", "sm_ship_mode_sk"),
+              (T.call_center, "cs_call_center_sk", "cc_call_center_sk"))
+    j = j[j.d_month_seq.between(1212, 1223)]
+    j = j.assign(wh=j.w_warehouse_name.astype(str).str[:20],
+                 lag=j.cs_ship_date_sk - j.cs_sold_date_sk)
+    out = (j.groupby(["wh", "sm_type", "cc_name"], as_index=False)
+           .agg(days_30=("lag", lambda s: int((s <= 30).sum())),
+                days_31_60=("lag", lambda s: int(((s > 30) & (s <= 60)).sum())),
+                days_61_90=("lag", lambda s: int(((s > 60) & (s <= 90)).sum())),
+                days_91_120=("lag",
+                             lambda s: int(((s > 90) & (s <= 120)).sum())),
+                days_over_120=("lag", lambda s: int((s > 120).sum()))))
+    return out, meta(["wh", "sm_type", "cc_name"], None, 100)
+
+
+def q50(T):
+    ss = T.store_sales
+    sr = T.store_returns
+    j = ss.merge(sr, left_on=["ss_ticket_number", "ss_item_sk",
+                              "ss_customer_sk"],
+                 right_on=["sr_ticket_number", "sr_item_sk",
+                           "sr_customer_sk"])
+    d2 = T.date_dim[(T.date_dim.d_year == 2000) & (T.date_dim.d_moy == 8)]
+    j = j.merge(d2, left_on="sr_returned_date_sk", right_on="d_date_sk")
+    j = j.merge(T.store, left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.assign(lag=j.sr_returned_date_sk - j.ss_sold_date_sk)
+    out = (j.groupby(["s_store_name", "s_company_id", "s_street_number",
+                      "s_street_name"], as_index=False)
+           .agg(days_30=("lag", lambda s: int((s <= 30).sum())),
+                days_31_60=("lag", lambda s: int(((s > 30) & (s <= 60)).sum())),
+                days_61_90=("lag", lambda s: int(((s > 60) & (s <= 90)).sum())),
+                days_91_120=("lag",
+                             lambda s: int(((s > 90) & (s <= 120)).sum())),
+                days_over_120=("lag", lambda s: int((s > 120).sum()))))
+    return out, meta(["s_store_name", "s_company_id"], None, 100)
+
+
+def q41(T):
+    it = T.item
+    inner = it[((it.i_category == "Women")
+                & it.i_color.isin(["powder", "orchid"])
+                & it.i_units.isin(["Oz", "Each"])
+                & it.i_size.isin(["medium", "N/A"]))
+               | ((it.i_category == "Men")
+                  & it.i_color.isin(["slate", "navy"])
+                  & it.i_units.isin(["Bunch", "Ton"])
+                  & it.i_size.isin(["large", "petite"]))]
+    manufs = set(inner.i_manufact)
+    j = it[it.i_manufact_id.between(70, 110) & it.i_manufact.isin(manufs)]
+    out = pd.DataFrame(
+        {"i_product_name": sorted(j.i_product_name.unique())})
+    return out, meta(["i_product_name"], None, 100)
+
+
+def q93(T):
+    ss = T.store_sales
+    sr = T.store_returns
+    j = ss.merge(sr, left_on=["ss_item_sk", "ss_ticket_number"],
+                 right_on=["sr_item_sk", "sr_ticket_number"], how="left")
+    j = j.merge(T.reason, left_on="sr_reason_sk", right_on="r_reason_sk")
+    j = j[j.r_reason_desc == "reason 1"]
+    act = np.where(j.sr_return_quantity.notna(),
+                   (j.ss_quantity - j.sr_return_quantity) * j.ss_sales_price,
+                   j.ss_quantity * j.ss_sales_price)
+    j = j.assign(act_sales=act)
+    out = (j.groupby("ss_customer_sk", as_index=False, dropna=False)
+           .agg(sumsales=("act_sales", _sum)))
+    out = out[["ss_customer_sk", "sumsales"]]
+    return out, meta(["sumsales", "ss_customer_sk"], None, 100,
+                     ["sumsales"])
+
+
+def q84(T):
+    j = T.customer.merge(T.customer_address[
+        T.customer_address.ca_city == "hilltop"],
+        left_on="c_current_addr_sk", right_on="ca_address_sk")
+    ib = T.income_band[(T.income_band.ib_lower_bound >= 30000)
+                       & (T.income_band.ib_upper_bound <= 80000)]
+    hd = T.household_demographics.merge(
+        ib, left_on="hd_income_band_sk", right_on="ib_income_band_sk")
+    j = j.merge(hd, left_on="c_current_hdemo_sk", right_on="hd_demo_sk")
+    j = j.merge(T.customer_demographics, left_on="c_current_cdemo_sk",
+                right_on="cd_demo_sk")
+    j = j.merge(T.store_returns, left_on="cd_demo_sk",
+                right_on="sr_cdemo_sk")
+    out = pd.DataFrame({
+        "customer_id": j.c_customer_id,
+        "customername": j.c_last_name + ", " + j.c_first_name})
+    return out, meta(["customer_id"], None, 100)
+
+
+def q91(T):
+    j = _star(T.catalog_returns,
+              (T.call_center, "cr_call_center_sk", "cc_call_center_sk"),
+              (T.date_dim, "cr_returned_date_sk", "d_date_sk"),
+              (T.customer, "cr_returning_customer_sk", "c_customer_sk"),
+              (T.customer_demographics, "c_current_cdemo_sk", "cd_demo_sk"),
+              (T.household_demographics, "c_current_hdemo_sk",
+               "hd_demo_sk"))
+    j = j[(j.d_year == 2000) & (j.d_moy == 11)
+          & (((j.cd_marital_status == "M")
+              & (j.cd_education_status == "Unknown"))
+             | ((j.cd_marital_status == "W")
+                & (j.cd_education_status == "Advanced Degree")))
+          & j.hd_buy_potential.astype(str).str.startswith("Unknown")]
+    out = (j.groupby(["cc_call_center_id", "cc_name", "cc_manager",
+                      "cd_marital_status", "cd_education_status"],
+                     as_index=False)
+           .agg(returns_loss=("cr_net_loss", _sum)))
+    out = out.rename(columns={"cc_call_center_id": "call_center",
+                              "cc_name": "center_name",
+                              "cc_manager": "manager"})
+    out = out[["call_center", "center_name", "manager", "returns_loss"]]
+    return out, meta(["returns_loss"], [False], None, ["returns_loss"])
