@@ -318,6 +318,13 @@ def _device_child():
     _, w3, h3 = run_tpch_query(DATA, "q1")  # 3 hot samples → median + spread
     _emit({"runs": sorted(round(x, 3) for x in (hot, w3, h3))})
 
+    # single-chip kernel efficiency: MFU for the MXU grouped agg, HBM
+    # roofline % for the memory-bound families (BASELINE's efficiency
+    # currency; cheap — a few hundred ms of kernel time)
+    if time.time() < deadline:
+        from daft_tpu.device import mfu
+        _emit({"mfu": mfu.report(n=1 << 20)})
+
     for qn in ("q6", "q3", "q10"):
         if time.time() > deadline:
             return
@@ -452,7 +459,8 @@ def main():
         for k in ("q6_hot", "q3_hot", "q10_hot"):
             if k in dev:
                 detail[f"{k.split('_')[0]}_device_hot_s"] = dev[k]
-        for k in ("tpch_sf1_suite", "tpcds", "laion", "tpch_sf10_suite"):
+        for k in ("tpch_sf1_suite", "tpcds", "laion", "tpch_sf10_suite",
+                  "mfu"):
             if k in dev:
                 detail[f"{k}_device"] = dev[k]
         if dev.get("groups") == base_groups:
@@ -567,8 +575,16 @@ def main():
         "artifact": os.path.relpath(artifact, REPO),
         "elapsed_s": full["elapsed_s"],
     }
-    if "mfu" in detail:
-        compact["mfu"] = detail["mfu"]
+    m = detail.get("mfu_device")
+    if isinstance(m, dict) and "error" not in m:
+        compact["mfu"] = {
+            "agg_mfu_pct": m.get("grouped_agg", {}).get("mfu_pct"),
+            "agg_roofline_pct": m.get("grouped_agg", {}).get(
+                "roofline_pct"),
+            "join_roofline_pct": m.get("join", {}).get("roofline_pct"),
+            "argsort_roofline_pct": m.get("argsort", {}).get(
+                "roofline_pct"),
+        }
     if skipped:
         compact["n_skipped"] = len(skipped)
     if errors:

@@ -1,0 +1,130 @@
+"""TPC-DS answer validation: every query's engine output row-checked
+against an independent pandas oracle (``tests/tpcds_oracle.py``).
+
+The checker is LIMIT-and-tie aware: the oracle computes the FULL result
+plus the query's ORDER BY spec; the engine rows must (a) have the right
+count, (b) match the oracle's sorted key sequence position-by-position
+(ties leave the key sequence unambiguous even when row order inside a tie
+group is not), and (c) be drawn from the oracle's row multiset.
+
+Reference analogue: ``tests/integration/test_tpch.py`` +
+``benchmarking/tpch/answers.py`` (dbgen-derived expected answers)."""
+
+import datetime
+import math
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import daft_tpu as dt
+from benchmarking.tpcds import queries as Q
+from benchmarking.tpcds.datagen import generate_tpcds
+
+from tpcds_oracle import Tables, sql_sort
+import tpcds_oracle as O
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("tpcds_ans")
+    generate_tpcds(str(root), scale=0.04)
+
+    def get_df(name):
+        return dt.read_parquet(f"{root}/{name}/*.parquet")
+
+    return get_df, Tables(get_df)
+
+
+def _norm(v):
+    """Comparison-normalize one value: numerics → floats, dates → ISO
+    strings, NaN/None → None. Floats keep full precision — equality is
+    decided by ``_val_eq``'s tolerance, never by rounding (rounding flips
+    at digit boundaries when the two sides sum in different orders)."""
+    if v is None:
+        return None
+    if isinstance(v, (float, np.floating)):
+        f = float(v)
+        return None if math.isnan(f) else f
+    if isinstance(v, (int, np.integer)):
+        return float(v)
+    if isinstance(v, np.bool_):
+        return bool(v)
+    if isinstance(v, (pd.Timestamp, datetime.date, datetime.datetime)):
+        return str(v)[:10]
+    if v is pd.NaT:
+        return None
+    return v
+
+
+def _val_eq(a, b):
+    a, b = _norm(a), _norm(b)
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) and isinstance(b, float):
+        return math.isclose(a, b, rel_tol=1e-6, abs_tol=1e-6)
+    return a == b
+
+
+def _row_eq(g, e):
+    return len(g) == len(e) and all(_val_eq(a, b) for a, b in zip(g, e))
+
+
+def _rows(df, cols):
+    return [tuple(row) for row in df[cols].itertuples(index=False)]
+
+
+def _match_multiset(got_rows, exp_rows):
+    """Greedy bipartite match of got rows into the oracle's row pool with
+    per-value tolerance. Returns the unmatched got rows."""
+    pool = list(exp_rows)
+    unmatched = []
+    for g in got_rows:
+        for i, e in enumerate(pool):
+            if _row_eq(g, e):
+                pool.pop(i)
+                break
+        else:
+            unmatched.append(g)
+    return unmatched
+
+
+def assert_matches(got: pd.DataFrame, exp: pd.DataFrame, m: dict,
+                   qnum: int):
+    cols = [c for c in got.columns]
+    missing = [c for c in cols if c not in exp.columns]
+    assert not missing, f"q{qnum}: oracle lacks columns {missing}"
+    limit = m["limit"]
+    n_expected = len(exp) if limit is None else min(limit, len(exp))
+    assert len(got) == n_expected, \
+        f"q{qnum}: row count {len(got)} != expected {n_expected} " \
+        f"(oracle total {len(exp)})"
+    if n_expected == 0:
+        return
+    if m.get("unordered") or not m["keys"]:
+        bad = _match_multiset(_rows(got, cols), _rows(exp, cols))
+        assert not bad, \
+            f"q{qnum}: {len(bad)} rows not in the oracle result: {bad[:3]}"
+        return
+    exp_sorted = sql_sort(exp, m["keys"], m["asc"]).head(n_expected)
+    # (b) key sequence must match (with tolerance), position by position
+    key_cols = [k for k in m["keys"] if k in cols]
+    for k in key_cols:
+        gk, ek = list(got[k]), list(exp_sorted[k])
+        diffs = [(i, a, b) for i, (a, b) in enumerate(zip(gk, ek))
+                 if not _val_eq(a, b)]
+        assert not diffs, \
+            f"q{qnum}: ORDER BY key {k!r} sequence differs: {diffs[:3]}"
+    # (c) full rows must come from the oracle's multiset (tie-safe)
+    bad = _match_multiset(_rows(got, cols), _rows(exp, cols))
+    assert not bad, \
+        f"q{qnum}: {len(bad)} rows not in the oracle result: {bad[:3]}"
+
+
+@pytest.mark.parametrize("qnum", sorted(Q.ALL))
+def test_answers(env, qnum):
+    get_df, T = env
+    oracle = getattr(O, f"q{qnum}")
+    got = Q.run(qnum, get_df).to_pandas()
+    exp, m = oracle(T)
+    assert_matches(got, exp, m, qnum)

@@ -555,3 +555,2190 @@ def q91(T):
                               "cc_manager": "manager"})
     out = out[["call_center", "center_name", "manager", "returns_loss"]]
     return out, meta(["returns_loss"], [False], None, ["returns_loss"])
+
+
+# ------------------------------------------------- windows / ratios
+
+def _q47_v1(T):
+    j = _star(T.store_sales,
+              (T.item, "ss_item_sk", "i_item_sk"),
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.store, "ss_store_sk", "s_store_sk"))
+    j = j[(j.d_year == 2000) | ((j.d_year == 1999) & (j.d_moy == 12))
+          | ((j.d_year == 2001) & (j.d_moy == 1))]
+    keys = ["i_category", "i_brand", "s_store_name", "s_company_name"]
+    v1 = (j.groupby(keys + ["d_year", "d_moy"], as_index=False)
+          .agg(sum_sales=("ss_sales_price", _sum)))
+    v1["avg_monthly_sales"] = v1.groupby(keys + ["d_year"])[
+        "sum_sales"].transform("mean")
+    v1 = v1.sort_values(keys + ["d_year", "d_moy"], kind="stable")
+    v1["rn"] = v1.groupby(keys).cumcount() + 1
+    return v1, keys
+
+
+def q47(T):
+    v1, keys = _q47_v1(T)
+    lag = v1[keys + ["rn", "sum_sales"]].assign(rn=v1.rn + 1) \
+        .rename(columns={"sum_sales": "psum"})
+    lead = v1[keys + ["rn", "sum_sales"]].assign(rn=v1.rn - 1) \
+        .rename(columns={"sum_sales": "nsum"})
+    v2 = v1.merge(lag, on=keys + ["rn"]).merge(lead, on=keys + ["rn"])
+    v2 = v2[(v2.d_year == 2000) & (v2.avg_monthly_sales > 0)]
+    dev = (v2.sum_sales - v2.avg_monthly_sales).abs() / v2.avg_monthly_sales
+    v2 = v2[dev > 0.1]
+    out = v2[keys + ["d_year", "d_moy", "avg_monthly_sales", "sum_sales",
+                     "psum", "nsum"]].copy()
+    out["__delta"] = out.sum_sales - out.avg_monthly_sales
+    return out, meta(
+        ["__delta"] + keys + ["d_year", "d_moy"], None, 100,
+        ["avg_monthly_sales", "sum_sales", "psum", "nsum", "__delta"])
+
+
+def q57(T):
+    j = _star(T.catalog_sales,
+              (T.item, "cs_item_sk", "i_item_sk"),
+              (T.date_dim, "cs_sold_date_sk", "d_date_sk"),
+              (T.call_center, "cs_call_center_sk", "cc_call_center_sk"))
+    j = j[(j.d_year == 2000) | ((j.d_year == 1999) & (j.d_moy == 12))
+          | ((j.d_year == 2001) & (j.d_moy == 1))]
+    keys = ["i_category", "i_brand", "cc_name"]
+    v1 = (j.groupby(keys + ["d_year", "d_moy"], as_index=False)
+          .agg(sum_sales=("cs_sales_price", _sum)))
+    v1["avg_monthly_sales"] = v1.groupby(keys + ["d_year"])[
+        "sum_sales"].transform("mean")
+    v1 = v1.sort_values(keys + ["d_year", "d_moy"], kind="stable")
+    v1["rn"] = v1.groupby(keys).cumcount() + 1
+    lag = v1[keys + ["rn", "sum_sales"]].assign(rn=v1.rn + 1) \
+        .rename(columns={"sum_sales": "psum"})
+    lead = v1[keys + ["rn", "sum_sales"]].assign(rn=v1.rn - 1) \
+        .rename(columns={"sum_sales": "nsum"})
+    v2 = v1.merge(lag, on=keys + ["rn"]).merge(lead, on=keys + ["rn"])
+    v2 = v2[(v2.d_year == 2000) & (v2.avg_monthly_sales > 0)]
+    dev = (v2.sum_sales - v2.avg_monthly_sales).abs() / v2.avg_monthly_sales
+    v2 = v2[dev > 0.1]
+    out = v2[keys + ["d_year", "d_moy", "avg_monthly_sales", "sum_sales",
+                     "psum", "nsum"]].copy()
+    out["__delta"] = out.sum_sales - out.avg_monthly_sales
+    return out, meta(["__delta", "cc_name"], None, 100,
+                     ["avg_monthly_sales", "sum_sales", "psum", "nsum",
+                      "__delta"])
+
+
+def q63(T):
+    j = _star(T.store_sales,
+              (T.item, "ss_item_sk", "i_item_sk"),
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.store, "ss_store_sk", "s_store_sk"))
+    j = j[j.d_month_seq.isin(range(1200, 1212))]
+    g1 = (j.i_category.isin(["Books", "Children", "Electronics"])
+          & j.i_class.isin(["personal", "portable", "reference",
+                            "self-help"]))
+    g2 = (j.i_category.isin(["Women", "Music", "Men"])
+          & j.i_class.isin(["accessories", "classical", "fragrances",
+                            "pants"]))
+    j = j[g1 | g2]
+    m = (j.groupby(["i_manager_id", "d_moy"], as_index=False)
+         .agg(sum_sales=("ss_sales_price", _sum)))
+    m["avg_monthly_sales"] = m.groupby("i_manager_id")[
+        "sum_sales"].transform("mean")
+    dev = (m.sum_sales - m.avg_monthly_sales).abs() / m.avg_monthly_sales
+    m = m[(m.avg_monthly_sales > 0) & (dev > 0.1)]
+    out = m[["i_manager_id", "sum_sales", "avg_monthly_sales"]]
+    return out, meta(["i_manager_id", "avg_monthly_sales", "sum_sales"],
+                     None, 100, ["sum_sales", "avg_monthly_sales"])
+
+
+def q89(T):
+    j = _star(T.store_sales,
+              (T.item, "ss_item_sk", "i_item_sk"),
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.store, "ss_store_sk", "s_store_sk"))
+    j = j[j.d_year == 2000]
+    g1 = (j.i_category.isin(["Books", "Electronics", "Sports"])
+          & j.i_class.isin(["computers", "stereo", "football"]))
+    g2 = (j.i_category.isin(["Men", "Jewelry", "Women"])
+          & j.i_class.isin(["shirts", "birdal", "dresses"]))
+    j = j[g1 | g2]
+    keys = ["i_category", "i_class", "i_brand", "s_store_name",
+            "s_company_name"]
+    m = (j.groupby(keys + ["d_moy"], as_index=False)
+         .agg(sum_sales=("ss_sales_price", _sum)))
+    m["avg_monthly_sales"] = m.groupby(keys)["sum_sales"].transform("mean")
+    dev = (m.sum_sales - m.avg_monthly_sales).abs() / m.avg_monthly_sales
+    m = m[(m.avg_monthly_sales != 0) & (dev > 0.1)]
+    out = m[keys + ["d_moy", "sum_sales", "avg_monthly_sales"]].copy()
+    out["__delta"] = out.sum_sales - out.avg_monthly_sales
+    return out, meta(
+        ["__delta", "s_store_name", "i_category", "i_class", "i_brand",
+         "d_moy"], None, 100,
+        ["sum_sales", "avg_monthly_sales", "__delta"])
+
+
+def q53(T):
+    j = _star(T.store_sales,
+              (T.item, "ss_item_sk", "i_item_sk"),
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"))
+    j = j[(j.d_year == 2000)
+          & j.i_category.isin(["Books", "Home", "Electronics"])]
+    q = (j.groupby(["i_manufact_id", "d_qoy"], as_index=False)
+         .agg(sum_sales=("ss_sales_price", _sum)))
+    q["avg_quarterly_sales"] = q.groupby("i_manufact_id")[
+        "sum_sales"].transform("mean")
+    out = q[["i_manufact_id", "sum_sales", "avg_quarterly_sales"]]
+    return out, meta(["avg_quarterly_sales", "sum_sales", "i_manufact_id"],
+                     [False, True, True], 100,
+                     ["sum_sales", "avg_quarterly_sales"])
+
+
+def _revenue_ratio(j, price_col, limit):
+    rev = (j.groupby(["i_item_id", "i_item_desc", "i_category", "i_class",
+                      "i_current_price"], as_index=False)
+           .agg(itemrevenue=(price_col, _sum)))
+    rev["revenueratio"] = rev.itemrevenue * 100.0 / rev.groupby(
+        "i_class")["itemrevenue"].transform("sum")
+    return rev, meta(["i_category", "i_class", "i_item_id", "i_item_desc",
+                      "revenueratio"], None, limit,
+                     ["itemrevenue", "revenueratio"])
+
+
+def q98(T):
+    j = _star(T.store_sales,
+              (T.item, "ss_item_sk", "i_item_sk"),
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"))
+    j = j[j.i_category.isin(["Sports", "Books", "Home"])
+          & (j.d_year == 2000) & j.d_moy.between(2, 4)]
+    return _revenue_ratio(j, "ss_ext_sales_price", None)
+
+
+def q20(T):
+    j = _star(T.catalog_sales,
+              (T.item, "cs_item_sk", "i_item_sk"),
+              (T.date_dim, "cs_sold_date_sk", "d_date_sk"))
+    j = j[j.i_category.isin(["Sports", "Books", "Home"])]
+    d = pd.to_datetime(j.d_date)
+    j = j[(d >= "1999-02-22") & (d <= "1999-03-24")]
+    return _revenue_ratio(j, "cs_ext_sales_price", 100)
+
+
+def q12(T):
+    j = _star(T.web_sales,
+              (T.item, "ws_item_sk", "i_item_sk"),
+              (T.date_dim, "ws_sold_date_sk", "d_date_sk"))
+    j = j[j.i_category.isin(["Sports", "Books", "Home"])]
+    d = pd.to_datetime(j.d_date)
+    j = j[(d >= "1999-02-22") & (d <= "1999-03-24")]
+    return _revenue_ratio(j, "ws_ext_sales_price", 100)
+
+
+# ------------------------------------------- correlated scalar subqueries
+
+def q1(T):
+    j = T.store_returns.merge(T.date_dim, left_on="sr_returned_date_sk",
+                              right_on="d_date_sk")
+    j = j[j.d_year == 2000]
+    ctr = (j.groupby(["sr_customer_sk", "sr_store_sk"], as_index=False)
+           .agg(ctr_total_return=("sr_return_amt", _sum)))
+    ctr["avg_r"] = ctr.groupby("sr_store_sk")[
+        "ctr_total_return"].transform("mean")
+    ctr = ctr[ctr.ctr_total_return > ctr.avg_r * 1.2]
+    ctr = ctr.merge(T.store[T.store.s_state == "TN"],
+                    left_on="sr_store_sk", right_on="s_store_sk")
+    ctr = ctr.merge(T.customer, left_on="sr_customer_sk",
+                    right_on="c_customer_sk")
+    return ctr[["c_customer_id"]], meta(["c_customer_id"], None, 100)
+
+
+def q30(T):
+    j = _star(T.web_returns,
+              (T.date_dim, "wr_returned_date_sk", "d_date_sk"),
+              (T.customer_address, "wr_returning_addr_sk", "ca_address_sk"))
+    j = j[j.d_year == 2000]
+    ctr = (j.groupby(["wr_returning_customer_sk", "ca_state"],
+                     as_index=False)
+           .agg(ctr_total_return=("wr_return_amt", _sum)))
+    ctr["avg_r"] = ctr.groupby("ca_state")[
+        "ctr_total_return"].transform("mean")
+    ctr = ctr[ctr.ctr_total_return > ctr.avg_r * 1.2]
+    cu = T.customer.merge(
+        T.customer_address[T.customer_address.ca_state == "CA"],
+        left_on="c_current_addr_sk", right_on="ca_address_sk")
+    out = ctr.merge(cu, left_on="wr_returning_customer_sk",
+                    right_on="c_customer_sk")
+    cols = ["c_customer_id", "c_salutation", "c_first_name", "c_last_name",
+            "c_preferred_cust_flag", "c_birth_day", "c_birth_month",
+            "c_birth_year", "c_birth_country", "c_login", "c_email_address",
+            "ctr_total_return"]
+    return out[cols], meta(
+        ["c_customer_id", "c_salutation", "c_first_name", "c_last_name"],
+        None, 100, ["ctr_total_return"])
+
+
+def q81(T):
+    j = _star(T.catalog_returns,
+              (T.date_dim, "cr_returned_date_sk", "d_date_sk"),
+              (T.customer_address, "cr_returning_addr_sk", "ca_address_sk"))
+    j = j[j.d_year == 2000]
+    ctr = (j.groupby(["cr_returning_customer_sk", "ca_state"],
+                     as_index=False)
+           .agg(ctr_total_return=("cr_return_amt_inc_tax", _sum)))
+    ctr["avg_r"] = ctr.groupby("ca_state")[
+        "ctr_total_return"].transform("mean")
+    ctr = ctr[ctr.ctr_total_return > ctr.avg_r * 1.2]
+    ca = T.customer_address[T.customer_address.ca_state == "CA"]
+    cu = T.customer.merge(ca, left_on="c_current_addr_sk",
+                          right_on="ca_address_sk")
+    out = ctr.merge(cu, left_on="cr_returning_customer_sk",
+                    right_on="c_customer_sk")
+    cols = ["c_customer_id", "c_salutation", "c_first_name", "c_last_name",
+            "ca_street_number", "ca_street_name", "ca_street_type",
+            "ca_suite_number", "ca_city", "ca_county", "ca_state", "ca_zip",
+            "ca_country", "ca_gmt_offset", "ca_location_type",
+            "ctr_total_return"]
+    return out[cols], meta(
+        ["c_customer_id", "c_salutation", "c_first_name", "c_last_name"],
+        None, 100, ["ctr_total_return"])
+
+
+def q32(T):
+    dd = _dates_between(T.date_dim, "2000-01-27", "2000-04-26")
+    j = _star(T.catalog_sales,
+              (T.item, "cs_item_sk", "i_item_sk"),
+              (dd, "cs_sold_date_sk", "d_date_sk"))
+    per_item = j.groupby("cs_item_sk")["cs_ext_discount_amt"] \
+        .transform("mean")
+    j = j[(j.i_manufact_id == 77) & (j.cs_ext_discount_amt > 1.3 * per_item)]
+    return pd.DataFrame(
+        {"excess_discount_amount": [_sum(j.cs_ext_discount_amt)]}), \
+        meta([], None, 100, ["excess_discount_amount"])
+
+
+def q92(T):
+    dd = _dates_between(T.date_dim, "2000-01-27", "2000-04-26")
+    j = _star(T.web_sales,
+              (T.item, "ws_item_sk", "i_item_sk"),
+              (dd, "ws_sold_date_sk", "d_date_sk"))
+    per_item = j.groupby("ws_item_sk")["ws_ext_discount_amt"] \
+        .transform("mean")
+    j = j[(j.i_manufact_id == 77) & (j.ws_ext_discount_amt > 1.3 * per_item)]
+    return pd.DataFrame(
+        {"excess_discount_amount": [_sum(j.ws_ext_discount_amt)]}), \
+        meta([], None, 100, ["excess_discount_amount"])
+
+
+def q6(T):
+    dd = T.date_dim
+    m = dd[(dd.d_year == 2000) & (dd.d_moy == 1)].d_month_seq.iloc[0]
+    it = T.item.copy()
+    cat_avg = it.groupby("i_category")["i_current_price"].transform("mean")
+    hot = set(it[it.i_current_price > 1.2 * cat_avg].i_item_sk)
+    j = _star(T.store_sales,
+              (dd[dd.d_month_seq == m], "ss_sold_date_sk", "d_date_sk"),
+              (T.customer, "ss_customer_sk", "c_customer_sk"),
+              (T.customer_address, "c_current_addr_sk", "ca_address_sk"))
+    j = j[j.ss_item_sk.isin(hot)]
+    out = (j.groupby("ca_state", dropna=False, as_index=False)
+           .size().rename(columns={"size": "cnt", "ca_state": "state"}))
+    out = out[out.cnt >= 10]
+    return out, meta(["cnt", "state"], None, 100)
+
+
+def q65(T):
+    j = T.store_sales.merge(
+        T.date_dim[T.date_dim.d_month_seq.between(1200, 1211)],
+        left_on="ss_sold_date_sk", right_on="d_date_sk")
+    sa = (j.groupby(["ss_store_sk", "ss_item_sk"], as_index=False)
+          .agg(revenue=("ss_sales_price", _sum)))
+    sb = sa.groupby("ss_store_sk", as_index=False) \
+        .agg(ave=("revenue", "mean"))
+    m = sa.merge(sb, on="ss_store_sk")
+    m = m[m.revenue <= 0.1 * m.ave]
+    m = m.merge(T.store, left_on="ss_store_sk", right_on="s_store_sk")
+    m = m.merge(T.item, left_on="ss_item_sk", right_on="i_item_sk")
+    out = m[["s_store_name", "i_item_desc", "revenue", "i_current_price",
+             "i_wholesale_cost", "i_brand"]]
+    return out, meta(["s_store_name", "i_item_desc"], None, 100,
+                     ["revenue"])
+
+
+# ----------------------------------------------- EXISTS / set operations
+
+def _active_customers(T, year, cond):
+    dd = T.date_dim
+    days = set(dd[(dd.d_year == year) & cond(dd)].d_date_sk)
+    ss = set(T.store_sales[T.store_sales.ss_sold_date_sk.isin(days)]
+             .ss_customer_sk)
+    ws = set(T.web_sales[T.web_sales.ws_sold_date_sk.isin(days)]
+             .ws_bill_customer_sk)
+    cs = set(T.catalog_sales[T.catalog_sales.cs_sold_date_sk.isin(days)]
+             .cs_ship_customer_sk)
+    return ss, ws, cs
+
+
+def q10(T):
+    ss, ws, cs = _active_customers(
+        T, 2001, lambda d: d.d_moy.between(1, 4))
+    j = T.customer.merge(T.customer_address, left_on="c_current_addr_sk",
+                         right_on="ca_address_sk")
+    j = j[j.ca_county.isin(["Ziebach County", "Williamson County",
+                            "Walker County"])]
+    j = j.merge(T.customer_demographics, left_on="c_current_cdemo_sk",
+                right_on="cd_demo_sk")
+    j = j[j.c_customer_sk.isin(ss)
+          & (j.c_customer_sk.isin(ws) | j.c_customer_sk.isin(cs))]
+    keys = ["cd_gender", "cd_marital_status", "cd_education_status",
+            "cd_purchase_estimate", "cd_credit_rating", "cd_dep_count",
+            "cd_dep_employed_count", "cd_dep_college_count"]
+    out = j.groupby(keys, dropna=False, as_index=False).size()
+    for c in ("cnt1", "cnt2", "cnt3", "cnt4", "cnt5", "cnt6"):
+        out[c] = out["size"]
+    out = out.drop(columns="size")
+    cols = ["cd_gender", "cd_marital_status", "cd_education_status",
+            "cnt1", "cd_purchase_estimate", "cnt2", "cd_credit_rating",
+            "cnt3", "cd_dep_count", "cnt4", "cd_dep_employed_count",
+            "cnt5", "cd_dep_college_count", "cnt6"]
+    return out[cols], meta(keys, None, 100)
+
+
+def q35(T):
+    ss, ws, cs = _active_customers(T, 2001, lambda d: d.d_qoy < 4)
+    j = T.customer.merge(T.customer_address, left_on="c_current_addr_sk",
+                         right_on="ca_address_sk")
+    j = j.merge(T.customer_demographics, left_on="c_current_cdemo_sk",
+                right_on="cd_demo_sk")
+    j = j[j.c_customer_sk.isin(ss)
+          & (j.c_customer_sk.isin(ws) | j.c_customer_sk.isin(cs))]
+    keys = ["ca_state", "cd_gender", "cd_marital_status", "cd_dep_count",
+            "cd_dep_employed_count", "cd_dep_college_count"]
+    g = j.groupby(keys, dropna=False, as_index=False)
+    out = g.size().rename(columns={"size": "cnt1"})
+    for src, (mn, mx, av) in (("cd_dep_count", ("min1", "max1", "avg1")),
+                              ("cd_dep_employed_count",
+                               ("min2", "max2", "avg2")),
+                              ("cd_dep_college_count",
+                               ("min3", "max3", "avg3"))):
+        agg = g.agg(**{mn: (src, "min"), mx: (src, "max"),
+                       av: (src, "mean")})
+        out = out.merge(agg, on=keys)
+    out["cnt2"] = out.cnt1
+    out["cnt3"] = out.cnt1
+    cols = ["ca_state", "cd_gender", "cd_marital_status", "cd_dep_count",
+            "cnt1", "min1", "max1", "avg1", "cd_dep_employed_count",
+            "cnt2", "min2", "max2", "avg2", "cd_dep_college_count",
+            "cnt3", "min3", "max3", "avg3"]
+    return out[cols], meta(keys, None, 100, ["avg1", "avg2", "avg3"])
+
+
+def q69(T):
+    ss, ws, cs = _active_customers(
+        T, 2000, lambda d: d.d_moy.between(1, 3))
+    j = T.customer.merge(T.customer_address, left_on="c_current_addr_sk",
+                         right_on="ca_address_sk")
+    j = j[j.ca_state.isin(["CA", "TX", "NY"])]
+    j = j.merge(T.customer_demographics, left_on="c_current_cdemo_sk",
+                right_on="cd_demo_sk")
+    j = j[j.c_customer_sk.isin(ss) & ~j.c_customer_sk.isin(ws)
+          & ~j.c_customer_sk.isin(cs)]
+    keys = ["cd_gender", "cd_marital_status", "cd_education_status",
+            "cd_purchase_estimate", "cd_credit_rating"]
+    out = j.groupby(keys, dropna=False, as_index=False).size()
+    out["cnt1"] = out["size"]
+    out["cnt2"] = out["size"]
+    out["cnt3"] = out["size"]
+    out = out.drop(columns="size")
+    cols = ["cd_gender", "cd_marital_status", "cd_education_status",
+            "cnt1", "cd_purchase_estimate", "cnt2", "cd_credit_rating",
+            "cnt3"]
+    return out[cols], meta(keys, None, 100)
+
+
+def q8(T):
+    ca = T.customer_address
+    z5 = ca.ca_zip.astype(str).str[:5]
+    a = set(z5[ca.ca_zip.astype(str).str[:2].isin(
+        ["10", "22", "35", "47", "58", "63"])])
+    pref = T.customer[T.customer.c_preferred_cust_flag == "Y"]
+    b = set(ca.merge(pref, left_on="ca_address_sk",
+                     right_on="c_current_addr_sk")
+            .ca_zip.astype(str).str[:5])
+    two = {z[:2] for z in (a & b)}
+    j = _star(T.store_sales,
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.store, "ss_store_sk", "s_store_sk"))
+    j = j[(j.d_qoy == 2) & (j.d_year == 2000)
+          & j.s_zip.astype(str).str[:2].isin(two)]
+    out = (j.groupby("s_store_name", as_index=False)
+           .agg(profit=("ss_net_profit", _sum)))
+    return out, meta(["s_store_name"], None, 100, ["profit"])
+
+
+def _channel_daysets(T):
+    dd = T.date_dim[T.date_dim.d_month_seq.between(1200, 1211)]
+    ss = (T.store_sales.merge(dd, left_on="ss_sold_date_sk",
+                              right_on="d_date_sk")
+          .merge(T.customer, left_on="ss_customer_sk",
+                 right_on="c_customer_sk"))
+    cs = (T.catalog_sales.merge(dd, left_on="cs_sold_date_sk",
+                                right_on="d_date_sk")
+          .merge(T.customer, left_on="cs_bill_customer_sk",
+                 right_on="c_customer_sk"))
+    ws = (T.web_sales.merge(dd, left_on="ws_sold_date_sk",
+                            right_on="d_date_sk")
+          .merge(T.customer, left_on="ws_bill_customer_sk",
+                 right_on="c_customer_sk"))
+    key = ["c_last_name", "c_first_name", "d_date"]
+    return (set(map(tuple, ss[key].drop_duplicates().itertuples(index=False))),
+            set(map(tuple, cs[key].drop_duplicates().itertuples(index=False))),
+            set(map(tuple, ws[key].drop_duplicates().itertuples(index=False))))
+
+
+def q38(T):
+    s, c, w = _channel_daysets(T)
+    return pd.DataFrame({"cnt": [len(s & c & w)]}), meta([], None, 100)
+
+
+def q87(T):
+    s, c, w = _channel_daysets(T)
+    return pd.DataFrame({"cnt": [len((s - c) - w)]}), meta([], None, None)
+
+
+# --------------------------------------------- cross-channel aggregates
+
+def _by_cat_sales(T, fact, item_col, date_col, addr_col, price_col,
+                  key_src, keys, moy):
+    it = T.item
+    wanted = set(it[key_src(it)][keys])
+    dd = T.date_dim[(T.date_dim.d_year == 2000) & (T.date_dim.d_moy == moy)]
+    ca = T.customer_address[T.customer_address.ca_gmt_offset == -5]
+    j = _star(fact, (it, item_col, "i_item_sk"),
+              (dd, date_col, "d_date_sk"),
+              (ca, addr_col, "ca_address_sk"))
+    j = j[j[keys].isin(wanted)]
+    return (j.groupby(keys, as_index=False)
+            .agg(total_sales=(price_col, _sum)))
+
+
+def q33(T):
+    src = lambda it: it.i_category.isin(["Books"])
+    parts = [
+        _by_cat_sales(T, T.store_sales, "ss_item_sk", "ss_sold_date_sk",
+                      "ss_addr_sk", "ss_ext_sales_price", src,
+                      "i_manufact_id", 1),
+        _by_cat_sales(T, T.catalog_sales, "cs_item_sk", "cs_sold_date_sk",
+                      "cs_bill_addr_sk", "cs_ext_sales_price", src,
+                      "i_manufact_id", 1),
+        _by_cat_sales(T, T.web_sales, "ws_item_sk", "ws_sold_date_sk",
+                      "ws_bill_addr_sk", "ws_ext_sales_price", src,
+                      "i_manufact_id", 1)]
+    out = (pd.concat(parts).groupby("i_manufact_id", as_index=False)
+           .agg(total_sales=("total_sales", _sum)))
+    return out, meta(["total_sales"], None, 100, ["total_sales"])
+
+
+def _q56ish(T, colors_or_cat, moy, order_keys, asc=None):
+    src = colors_or_cat
+    parts = [
+        _by_cat_sales(T, T.store_sales, "ss_item_sk", "ss_sold_date_sk",
+                      "ss_addr_sk", "ss_ext_sales_price", src,
+                      "i_item_id", moy),
+        _by_cat_sales(T, T.catalog_sales, "cs_item_sk", "cs_sold_date_sk",
+                      "cs_bill_addr_sk", "cs_ext_sales_price", src,
+                      "i_item_id", moy),
+        _by_cat_sales(T, T.web_sales, "ws_item_sk", "ws_sold_date_sk",
+                      "ws_bill_addr_sk", "ws_ext_sales_price", src,
+                      "i_item_id", moy)]
+    out = (pd.concat(parts).groupby("i_item_id", as_index=False)
+           .agg(total_sales=("total_sales", _sum)))
+    return out, meta(order_keys, asc, 100, ["total_sales"])
+
+
+def q56(T):
+    return _q56ish(
+        T, lambda it: it.i_color.isin(["slate", "blanched", "burnished"]),
+        2, ["total_sales", "i_item_id"])
+
+
+def q60(T):
+    return _q56ish(T, lambda it: it.i_category.isin(["Music"]), 9,
+                   ["i_item_id", "total_sales"])
+
+
+def q71(T):
+    dd = T.date_dim[(T.date_dim.d_moy == 11) & (T.date_dim.d_year == 2000)]
+    pieces = []
+    for fact, price, date_sk, item_sk, time_sk in (
+            (T.web_sales, "ws_ext_sales_price", "ws_sold_date_sk",
+             "ws_item_sk", "ws_sold_time_sk"),
+            (T.catalog_sales, "cs_ext_sales_price", "cs_sold_date_sk",
+             "cs_item_sk", "cs_sold_time_sk"),
+            (T.store_sales, "ss_ext_sales_price", "ss_sold_date_sk",
+             "ss_item_sk", "ss_sold_time_sk")):
+        p = fact.merge(dd, left_on=date_sk, right_on="d_date_sk")
+        pieces.append(pd.DataFrame({
+            "ext_price": p[price], "sold_item_sk": p[item_sk],
+            "time_sk": p[time_sk]}))
+    u = pd.concat(pieces)
+    it = T.item[T.item.i_manager_id == 1]
+    td = T.time_dim[T.time_dim.t_meal_time.isin(["breakfast", "dinner"])]
+    j = (u.merge(it, left_on="sold_item_sk", right_on="i_item_sk")
+         .merge(td, left_on="time_sk", right_on="t_time_sk"))
+    out = (j.groupby(["i_brand", "i_brand_id", "t_hour", "t_minute"],
+                     as_index=False)
+           .agg(ext_price=("ext_price", _sum)))
+    out = out.rename(columns={"i_brand_id": "brand_id", "i_brand": "brand"})
+    out = out[["brand_id", "brand", "t_hour", "t_minute", "ext_price"]]
+    return out, meta(["ext_price", "brand_id"], [False, True], None,
+                     ["ext_price"])
+
+
+def q76(T):
+    pieces = []
+    for fact, chan, cname, null_col, date_sk, item_sk, price in (
+            (T.store_sales, "store", "ss_store_sk", "ss_store_sk",
+             "ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price"),
+            (T.web_sales, "web", "ws_ship_customer_sk",
+             "ws_ship_customer_sk", "ws_sold_date_sk", "ws_item_sk",
+             "ws_ext_sales_price"),
+            (T.catalog_sales, "catalog", "cs_ship_addr_sk",
+             "cs_ship_addr_sk", "cs_sold_date_sk", "cs_item_sk",
+             "cs_ext_sales_price")):
+        p = fact[fact[null_col].isna()]
+        p = _star(p, (T.item, item_sk, "i_item_sk"),
+                  (T.date_dim, date_sk, "d_date_sk"))
+        pieces.append(pd.DataFrame({
+            "channel": chan, "col_name": cname, "d_year": p.d_year,
+            "d_qoy": p.d_qoy, "i_category": p.i_category,
+            "ext_sales_price": p[price]}))
+    u = pd.concat(pieces)
+    g = u.groupby(["channel", "col_name", "d_year", "d_qoy", "i_category"],
+                  dropna=False, as_index=False)
+    out = g.agg(sales_cnt=("ext_sales_price", "size"),
+                sales_amt=("ext_sales_price", _sum))
+    return out, meta(["channel", "col_name", "d_year", "d_qoy",
+                      "i_category"], None, 100, ["sales_amt"])
+
+
+def q2(T):
+    u = pd.concat([
+        pd.DataFrame({"sold_date_sk": T.web_sales.ws_sold_date_sk,
+                      "sales_price": T.web_sales.ws_ext_sales_price}),
+        pd.DataFrame({"sold_date_sk": T.catalog_sales.cs_sold_date_sk,
+                      "sales_price": T.catalog_sales.cs_ext_sales_price})])
+    j = u.merge(T.date_dim, left_on="sold_date_sk", right_on="d_date_sk")
+    days = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+            "Friday", "Saturday"]
+    piv = {}
+    for d in days:
+        piv[d] = (j[j.d_day_name == d].groupby("d_week_seq")
+                  ["sales_price"].apply(_sum))
+    wk = pd.DataFrame(piv)
+    dd = T.date_dim
+    y = wk.loc[wk.index.isin(set(dd[dd.d_year == 1999].d_week_seq))]
+    z = wk.loc[wk.index.isin(set(dd[dd.d_year == 2000].d_week_seq))]
+    z = z.copy()
+    z.index = z.index - 52
+    m = y.join(z, how="inner", lsuffix="_1", rsuffix="_2")
+    out = pd.DataFrame({"d_week_seq1": m.index})
+    for d, nm in zip(days, ["r_sun", "r_mon", "r_tue", "r_wed", "r_thu",
+                            "r_fri", "r_sat"]):
+        out[nm] = (m[f"{d}_1"] / m[f"{d}_2"]).round(2).values
+    return out.reset_index(drop=True), meta(
+        ["d_week_seq1"], None, None,
+        ["r_sun", "r_mon", "r_tue", "r_wed", "r_thu", "r_fri", "r_sat"])
+
+
+def q59(T):
+    j = T.store_sales.merge(T.date_dim, left_on="ss_sold_date_sk",
+                            right_on="d_date_sk")
+    days = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+            "Friday", "Saturday"]
+    piv = {}
+    for d in days:
+        piv[d] = (j[j.d_day_name == d]
+                  .groupby(["d_week_seq", "ss_store_sk"])["ss_sales_price"]
+                  .apply(_sum))
+    wss = pd.DataFrame(piv).reset_index()
+    dd = T.date_dim
+    w1 = set(dd[dd.d_month_seq.between(1200, 1211)].d_week_seq)
+    w2 = set(dd[dd.d_month_seq.between(1212, 1223)].d_week_seq)
+    st = T.store
+    y = wss[wss.d_week_seq.isin(w1)].merge(
+        st, left_on="ss_store_sk", right_on="s_store_sk")
+    x = wss[wss.d_week_seq.isin(w2)].merge(
+        st, left_on="ss_store_sk", right_on="s_store_sk")
+    x = x.assign(join_seq=x.d_week_seq - 52)
+    m = y.merge(x, left_on=["s_store_id", "d_week_seq"],
+                right_on=["s_store_id", "join_seq"],
+                suffixes=("_1", "_2"))
+    out = pd.DataFrame({
+        "s_store_name1": m.s_store_name_1, "s_store_id1": m.s_store_id,
+        "d_week_seq1": m.d_week_seq_1})
+    for d, nm in zip(days, ["r_sun", "r_mon", "r_tue", "r_wed", "r_thu",
+                            "r_fri", "r_sat"]):
+        out[nm] = (m[f"{d}_1"] / m[f"{d}_2"]).values
+    return out, meta(["s_store_name1", "s_store_id1", "d_week_seq1"],
+                     None, 100, ["r_sun", "r_mon", "r_tue", "r_wed",
+                                 "r_thu", "r_fri", "r_sat"])
+
+
+def _dn_ticket(T, cities):
+    j = _star(T.store_sales,
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.store, "ss_store_sk", "s_store_sk"),
+              (T.household_demographics, "ss_hdemo_sk", "hd_demo_sk"),
+              (T.customer_address, "ss_addr_sk", "ca_address_sk"))
+    j = j[((j.hd_dep_count == 4) | (j.hd_vehicle_count == 3))
+          & (j.d_year == 2000) & j.s_city.isin(cities)]
+    return j
+
+
+def q46(T):
+    j = _dn_ticket(T, ["rivertown", "lakeside"])
+    j = j[j.d_dow.isin([5, 6])]
+    dn = (j.groupby(["ss_ticket_number", "ss_customer_sk", "ca_city"],
+                    as_index=False)
+          .agg(amt=("ss_coupon_amt", _sum),
+               profit=("ss_net_profit", _sum))
+          .rename(columns={"ca_city": "bought_city"}))
+    out = (dn.merge(T.customer, left_on="ss_customer_sk",
+                    right_on="c_customer_sk")
+           .merge(T.customer_address, left_on="c_current_addr_sk",
+                  right_on="ca_address_sk"))
+    out = out[out.ca_city != out.bought_city]
+    out = out.rename(columns={"ca_city": "current_city"})
+    out = out[["c_last_name", "c_first_name", "current_city",
+               "bought_city", "ss_ticket_number", "amt", "profit"]]
+    return out, meta(["c_last_name", "c_first_name", "current_city",
+                      "bought_city", "ss_ticket_number"], None, 100,
+                     ["amt", "profit"])
+
+
+def q68(T):
+    j = _dn_ticket(T, ["rivertown", "hilltop"])
+    j = j[j.d_dom.between(1, 2)]
+    dn = (j.groupby(["ss_ticket_number", "ss_customer_sk", "ca_city"],
+                    as_index=False)
+          .agg(extended_price=("ss_ext_sales_price", _sum),
+               list_price=("ss_ext_list_price", _sum),
+               extended_tax=("ss_ext_tax", _sum))
+          .rename(columns={"ca_city": "bought_city"}))
+    out = (dn.merge(T.customer, left_on="ss_customer_sk",
+                    right_on="c_customer_sk")
+           .merge(T.customer_address, left_on="c_current_addr_sk",
+                  right_on="ca_address_sk"))
+    out = out[out.ca_city != out.bought_city]
+    out = out.rename(columns={"ca_city": "current_city"})
+    out = out[["c_last_name", "c_first_name", "current_city",
+               "bought_city", "ss_ticket_number", "extended_price",
+               "extended_tax", "list_price"]]
+    return out, meta(["c_last_name", "ss_ticket_number"], None, 100,
+                     ["extended_price", "extended_tax", "list_price"])
+
+
+def q79(T):
+    j = _star(T.store_sales,
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.store, "ss_store_sk", "s_store_sk"),
+              (T.household_demographics, "ss_hdemo_sk", "hd_demo_sk"))
+    j = j[((j.hd_dep_count == 6) | (j.hd_vehicle_count > 2))
+          & (j.d_dow == 1) & j.d_year.isin([1999, 2000, 2001])
+          & j.s_number_employees.between(200, 295)]
+    ms = (j.groupby(["ss_ticket_number", "ss_customer_sk", "ss_addr_sk",
+                     "s_city"], dropna=False, as_index=False)
+          .agg(amt=("ss_coupon_amt", _sum),
+               profit=("ss_net_profit", _sum)))
+    out = ms.merge(T.customer, left_on="ss_customer_sk",
+                   right_on="c_customer_sk")
+    out = out.assign(city=out.s_city.astype(str).str[:30])
+    out = out[["c_last_name", "c_first_name", "city", "ss_ticket_number",
+               "amt", "profit"]]
+    return out, meta(["c_last_name", "c_first_name", "city", "profit"],
+                     None, 100, ["amt", "profit"])
+
+
+# ----------------------------------------------------- inventory family
+
+def q21(T):
+    dd = _dates_between(T.date_dim, "2000-02-10", "2000-04-10")
+    j = _star(T.inventory,
+              (T.warehouse, "inv_warehouse_sk", "w_warehouse_sk"),
+              (T.item, "inv_item_sk", "i_item_sk"),
+              (dd, "inv_date_sk", "d_date_sk"))
+    j = j[j.i_current_price.between(0.99, 1.49)]
+    before = pd.to_datetime(j.d_date) < pd.Timestamp("2000-03-11")
+    j = j.assign(
+        inv_before=np.where(before, j.inv_quantity_on_hand, 0),
+        inv_after=np.where(~before, j.inv_quantity_on_hand, 0))
+    g = (j.groupby(["w_warehouse_name", "i_item_id"], as_index=False)
+         .agg(inv_before=("inv_before", "sum"),
+              inv_after=("inv_after", "sum")))
+    ratio = np.where(g.inv_before > 0, g.inv_after / g.inv_before, np.nan)
+    g = g[(ratio >= 0.666667) & (ratio <= 1.5)]
+    return g, meta(["w_warehouse_name", "i_item_id"], None, 100)
+
+
+def q37(T):
+    dd = _dates_between(T.date_dim, "2000-02-01", "2000-04-01")
+    j = _star(T.inventory,
+              (T.item, "inv_item_sk", "i_item_sk"),
+              (dd, "inv_date_sk", "d_date_sk"))
+    j = j[j.i_current_price.between(20, 50)
+          & j.i_manufact_id.isin([100, 120, 140, 160])
+          & j.inv_quantity_on_hand.between(100, 500)]
+    j = j[j.i_item_sk.isin(set(T.catalog_sales.cs_item_sk))]
+    out = j[["i_item_id", "i_item_desc", "i_current_price"]] \
+        .drop_duplicates()
+    return out, meta(["i_item_id"], None, 100, ["i_current_price"])
+
+
+def q82(T):
+    dd = _dates_between(T.date_dim, "2000-05-25", "2000-07-24")
+    j = _star(T.inventory,
+              (T.item, "inv_item_sk", "i_item_sk"),
+              (dd, "inv_date_sk", "d_date_sk"))
+    j = j[j.i_current_price.between(30, 60)
+          & j.i_manufact_id.isin([50, 70, 90, 110])
+          & j.inv_quantity_on_hand.between(100, 500)]
+    j = j[j.i_item_sk.isin(set(T.store_sales.ss_item_sk))]
+    out = j[["i_item_id", "i_item_desc", "i_current_price"]] \
+        .drop_duplicates()
+    return out, meta(["i_item_id"], None, 100, ["i_current_price"])
+
+
+def q39(T):
+    j = _star(T.inventory,
+              (T.item, "inv_item_sk", "i_item_sk"),
+              (T.warehouse, "inv_warehouse_sk", "w_warehouse_sk"),
+              (T.date_dim, "inv_date_sk", "d_date_sk"))
+    j = j[j.d_year == 2000]
+    g = (j.groupby(["w_warehouse_name", "w_warehouse_sk", "i_item_sk",
+                    "d_moy"], as_index=False)
+         .agg(stdev=("inv_quantity_on_hand", "std"),
+              mean=("inv_quantity_on_hand", "mean")))
+    cov_f = np.where(g["mean"] == 0, 0, g.stdev / g["mean"])
+    g = g[cov_f > 1].copy()
+    g["cov"] = np.where(g["mean"] == 0, np.nan, g.stdev / g["mean"])
+    inv1 = g[g.d_moy == 1]
+    inv2 = g[g.d_moy == 2]
+    m = inv1.merge(inv2, on=["i_item_sk", "w_warehouse_sk"],
+                   suffixes=("_1", "_2"))
+    out = pd.DataFrame({
+        "wsk1": m.w_warehouse_sk, "isk1": m.i_item_sk, "moy1": m.d_moy_1,
+        "mean1": m.mean_1, "cov1": m.cov_1, "wsk2": m.w_warehouse_sk,
+        "isk2": m.i_item_sk, "moy2": m.d_moy_2, "mean2": m.mean_2,
+        "cov2": m.cov_2})
+    return out, meta(["wsk1", "isk1", "moy1", "mean1", "cov1"], None, 100,
+                     ["mean1", "cov1", "mean2", "cov2"])
+
+
+def q40(T):
+    dd = _dates_between(T.date_dim, "2000-02-10", "2000-04-10")
+    j = T.catalog_sales.merge(
+        T.catalog_returns[["cr_order_number", "cr_item_sk",
+                           "cr_refunded_cash"]],
+        left_on=["cs_order_number", "cs_item_sk"],
+        right_on=["cr_order_number", "cr_item_sk"], how="left")
+    j = _star(j, (T.warehouse, "cs_warehouse_sk", "w_warehouse_sk"),
+              (T.item, "cs_item_sk", "i_item_sk"),
+              (dd, "cs_sold_date_sk", "d_date_sk"))
+    j = j[j.i_current_price.between(0.99, 1.49)]
+    before = pd.to_datetime(j.d_date) < pd.Timestamp("2000-03-11")
+    val = j.cs_sales_price - j.cr_refunded_cash.fillna(0)
+    j = j.assign(sales_before=np.where(before, val, 0.0),
+                 sales_after=np.where(~before, val, 0.0))
+    out = (j.groupby(["w_state", "i_item_id"], as_index=False)
+           .agg(sales_before=("sales_before", "sum"),
+                sales_after=("sales_after", "sum")))
+    return out, meta(["w_state", "i_item_id"], None, 100,
+                     ["sales_before", "sales_after"])
+
+
+# ------------------------------------------------- returns / shipments
+
+def _returns_trio(T, d1_cond, d2_cond, d3_cond, aggs):
+    j = T.store_sales.merge(
+        T.date_dim[d1_cond(T.date_dim)].add_prefix("d1_"),
+        left_on="ss_sold_date_sk", right_on="d1_d_date_sk")
+    j = j.merge(T.store_returns,
+                left_on=["ss_customer_sk", "ss_item_sk",
+                         "ss_ticket_number"],
+                right_on=["sr_customer_sk", "sr_item_sk",
+                          "sr_ticket_number"])
+    j = j.merge(T.date_dim[d2_cond(T.date_dim)].add_prefix("d2_"),
+                left_on="sr_returned_date_sk", right_on="d2_d_date_sk")
+    j = j.merge(T.catalog_sales,
+                left_on=["sr_customer_sk", "sr_item_sk"],
+                right_on=["cs_bill_customer_sk", "cs_item_sk"])
+    j = j.merge(T.date_dim[d3_cond(T.date_dim)].add_prefix("d3_"),
+                left_on="cs_sold_date_sk", right_on="d3_d_date_sk")
+    j = j.merge(T.store, left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(T.item, left_on="ss_item_sk", right_on="i_item_sk")
+    g = j.groupby(["i_item_id", "i_item_desc", "s_store_id",
+                   "s_store_name"], as_index=False)
+    return g.agg(**aggs)
+
+
+def q25(T):
+    out = _returns_trio(
+        T, lambda d: (d.d_moy == 4) & (d.d_year == 2000),
+        lambda d: d.d_moy.between(4, 10) & (d.d_year == 2000),
+        lambda d: d.d_moy.between(4, 10) & (d.d_year == 2000),
+        dict(store_sales_profit=("ss_net_profit", _sum),
+             store_returns_loss=("sr_net_loss", _sum),
+             catalog_sales_profit=("cs_net_profit", _sum)))
+    return out, meta(["i_item_id", "i_item_desc", "s_store_id",
+                      "s_store_name"], None, 100,
+                     ["store_sales_profit", "store_returns_loss",
+                      "catalog_sales_profit"])
+
+
+def q29(T):
+    out = _returns_trio(
+        T, lambda d: (d.d_moy == 4) & (d.d_year == 1999),
+        lambda d: d.d_moy.between(4, 7) & (d.d_year == 1999),
+        lambda d: d.d_year.isin([1999, 2000, 2001]),
+        dict(store_sales_quantity=("ss_quantity", _sum),
+             store_returns_quantity=("sr_return_quantity", _sum),
+             catalog_sales_quantity=("cs_quantity", _sum)))
+    return out, meta(["i_item_id", "i_item_desc", "s_store_id",
+                      "s_store_name"], None, 100)
+
+
+def q17(T):
+    j = T.store_sales.merge(
+        T.date_dim[T.date_dim.d_quarter_name == "2000Q1"].add_prefix("d1_"),
+        left_on="ss_sold_date_sk", right_on="d1_d_date_sk")
+    j = j.merge(T.store_returns,
+                left_on=["ss_customer_sk", "ss_item_sk",
+                         "ss_ticket_number"],
+                right_on=["sr_customer_sk", "sr_item_sk",
+                          "sr_ticket_number"])
+    q123 = ["2000Q1", "2000Q2", "2000Q3"]
+    j = j.merge(T.date_dim[T.date_dim.d_quarter_name.isin(q123)]
+                .add_prefix("d2_"),
+                left_on="sr_returned_date_sk", right_on="d2_d_date_sk")
+    j = j.merge(T.catalog_sales,
+                left_on=["sr_customer_sk", "sr_item_sk"],
+                right_on=["cs_bill_customer_sk", "cs_item_sk"])
+    j = j.merge(T.date_dim[T.date_dim.d_quarter_name.isin(q123)]
+                .add_prefix("d3_"),
+                left_on="cs_sold_date_sk", right_on="d3_d_date_sk")
+    j = j.merge(T.store, left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(T.item, left_on="ss_item_sk", right_on="i_item_sk")
+    g = j.groupby(["i_item_id", "i_item_desc", "s_state"], as_index=False)
+
+    def block(col, prefix):
+        return {f"{prefix}count": (col, "count"),
+                f"{prefix}ave": (col, "mean"),
+                f"{prefix}stdev": (col, "std")}
+
+    out = g.agg(**block("ss_quantity", "store_sales_quantity"),
+                **block("sr_return_quantity", "store_returns_quantity"),
+                **block("cs_quantity", "catalog_sales_quantity"))
+    out["store_sales_quantitycov"] = \
+        out.store_sales_quantitystdev / out.store_sales_quantityave
+    out["store_returns_quantitycov"] = \
+        out.store_returns_quantitystdev / out.store_returns_quantityave
+    out["catalog_sales_quantitycov"] = \
+        out.catalog_sales_quantitystdev / out.catalog_sales_quantityave
+    cols = ["i_item_id", "i_item_desc", "s_state",
+            "store_sales_quantitycount", "store_sales_quantityave",
+            "store_sales_quantitystdev", "store_sales_quantitycov",
+            "store_returns_quantitycount", "store_returns_quantityave",
+            "store_returns_quantitystdev", "store_returns_quantitycov",
+            "catalog_sales_quantitycount", "catalog_sales_quantityave",
+            "catalog_sales_quantitystdev", "catalog_sales_quantitycov"]
+    return out[cols], meta(["i_item_id", "i_item_desc", "s_state"], None,
+                           100, cols[3:])
+
+
+def q16(T):
+    dd = _dates_between(T.date_dim, "2000-02-01", "2000-04-01")
+    cs1 = _star(T.catalog_sales,
+                (dd, "cs_ship_date_sk", "d_date_sk"),
+                (T.customer_address[T.customer_address.ca_state == "CA"],
+                 "cs_ship_addr_sk", "ca_address_sk"),
+                (T.call_center, "cs_call_center_sk", "cc_call_center_sk"))
+    wh_count = T.catalog_sales.groupby("cs_order_number")[
+        "cs_warehouse_sk"].nunique()
+    multi = set(wh_count[wh_count > 1].index)
+    returned = set(T.catalog_returns.cr_order_number)
+    cs1 = cs1[cs1.cs_order_number.isin(multi)
+              & ~cs1.cs_order_number.isin(returned)]
+    out = pd.DataFrame({
+        "order_count": [cs1.cs_order_number.nunique()],
+        "total_shipping_cost": [_sum(cs1.cs_ext_ship_cost)],
+        "total_net_profit": [_sum(cs1.cs_net_profit)]})
+    return out, meta([], None, 100,
+                     ["total_shipping_cost", "total_net_profit"])
+
+
+def q94(T):
+    dd = _dates_between(T.date_dim, "2000-02-01", "2000-04-01")
+    ws1 = _star(T.web_sales,
+                (dd, "ws_ship_date_sk", "d_date_sk"),
+                (T.customer_address[T.customer_address.ca_state == "CA"],
+                 "ws_ship_addr_sk", "ca_address_sk"),
+                (T.web_site[T.web_site.web_company_name == "pri"],
+                 "ws_web_site_sk", "web_site_sk"))
+    wh_count = T.web_sales.groupby("ws_order_number")[
+        "ws_warehouse_sk"].nunique()
+    multi = set(wh_count[wh_count > 1].index)
+    returned = set(T.web_returns.wr_order_number)
+    ws1 = ws1[ws1.ws_order_number.isin(multi)
+              & ~ws1.ws_order_number.isin(returned)]
+    out = pd.DataFrame({
+        "order_count": [ws1.ws_order_number.nunique()],
+        "total_shipping_cost": [_sum(ws1.ws_ext_ship_cost)],
+        "total_net_profit": [_sum(ws1.ws_net_profit)]})
+    return out, meta([], None, 100,
+                     ["total_shipping_cost", "total_net_profit"])
+
+
+def q95(T):
+    dd = _dates_between(T.date_dim, "2000-02-01", "2000-04-01")
+    ws1 = _star(T.web_sales,
+                (dd, "ws_ship_date_sk", "d_date_sk"),
+                (T.customer_address[T.customer_address.ca_state == "CA"],
+                 "ws_ship_addr_sk", "ca_address_sk"),
+                (T.web_site[T.web_site.web_company_name == "pri"],
+                 "ws_web_site_sk", "web_site_sk"))
+    wh_count = T.web_sales.groupby("ws_order_number")[
+        "ws_warehouse_sk"].nunique()
+    multi = set(wh_count[wh_count > 1].index)
+    returned_multi = set(T.web_returns[
+        T.web_returns.wr_order_number.isin(multi)].wr_order_number)
+    ws1 = ws1[ws1.ws_order_number.isin(multi)
+              & ws1.ws_order_number.isin(returned_multi)]
+    out = pd.DataFrame({
+        "order_count": [ws1.ws_order_number.nunique()],
+        "total_shipping_cost": [_sum(ws1.ws_ext_ship_cost)],
+        "total_net_profit": [_sum(ws1.ws_net_profit)]})
+    return out, meta([], None, 100,
+                     ["total_shipping_cost", "total_net_profit"])
+
+
+def q97(T):
+    dd = T.date_dim[T.date_dim.d_month_seq.between(1200, 1211)]
+    ssci = (T.store_sales.merge(dd, left_on="ss_sold_date_sk",
+                                right_on="d_date_sk")
+            [["ss_customer_sk", "ss_item_sk"]].drop_duplicates())
+    csci = (T.catalog_sales.merge(dd, left_on="cs_sold_date_sk",
+                                  right_on="d_date_sk")
+            [["cs_bill_customer_sk", "cs_item_sk"]].drop_duplicates())
+    m = ssci.merge(csci, left_on=["ss_customer_sk", "ss_item_sk"],
+                   right_on=["cs_bill_customer_sk", "cs_item_sk"],
+                   how="outer")
+    out = pd.DataFrame({
+        "store_only": [int((m.ss_customer_sk.notna()
+                            & m.cs_bill_customer_sk.isna()).sum())],
+        "catalog_only": [int((m.ss_customer_sk.isna()
+                              & m.cs_bill_customer_sk.notna()).sum())],
+        "store_and_catalog": [int((m.ss_customer_sk.notna()
+                                   & m.cs_bill_customer_sk.notna()).sum())]})
+    return out, meta([], None, 100)
+
+
+# ------------------------------------------------------- ROLLUP family
+
+def _rollup(df, keys, aggspec):
+    """GROUP BY ROLLUP(keys): one grouped frame per prefix level, rolled
+    keys as NaN/None."""
+    pieces = []
+    for lvl in range(len(keys), -1, -1):
+        ks = keys[:lvl]
+        if ks:
+            g = df.groupby(ks, dropna=False, as_index=False).agg(**aggspec)
+        else:
+            g = pd.DataFrame({k: [v[1](df[v[0]]) if callable(v[1])
+                                  else getattr(df[v[0]], v[1])()]
+                              for k, v in aggspec.items()})
+        for k in keys[lvl:]:
+            g[k] = None
+        g["__lvl"] = len(keys) - lvl
+        pieces.append(g)
+    return pd.concat(pieces, ignore_index=True)
+
+
+def _agg_call(df, col, how):
+    if how == "sum":
+        return _sum(df[col])
+    return getattr(df[col], how)()
+
+
+def q18(T):
+    j = _star(T.catalog_sales,
+              (T.date_dim, "cs_sold_date_sk", "d_date_sk"),
+              (T.item, "cs_item_sk", "i_item_sk"),
+              (T.customer_demographics.add_prefix("cd1_"),
+               "cs_bill_cdemo_sk", "cd1_cd_demo_sk"),
+              (T.customer, "cs_bill_customer_sk", "c_customer_sk"),
+              (T.customer_demographics.add_prefix("cd2_"),
+               "c_current_cdemo_sk", "cd2_cd_demo_sk"),
+              (T.customer_address, "c_current_addr_sk", "ca_address_sk"))
+    j = j[(j.cd1_cd_gender == "F") & (j.cd1_cd_education_status == "Unknown")
+          & j.c_birth_month.isin([1, 6, 8, 9, 12, 2]) & (j.d_year == 2000)
+          & j.ca_state.isin(["CA", "NY", "TX", "WA", "OR", "TN", "SD"])]
+    spec = {f"agg{i + 1}": (c, "mean") for i, c in enumerate(
+        ["cs_quantity", "cs_list_price", "cs_coupon_amt", "cs_sales_price",
+         "cs_net_profit", "c_birth_year", "cd1_cd_dep_count"])}
+    out = _rollup(j, ["i_item_id", "ca_country", "ca_state", "ca_county"],
+                  spec).drop(columns="__lvl")
+    return out, meta(["ca_country", "ca_state", "ca_county", "i_item_id"],
+                     None, 100, [f"agg{i}" for i in range(1, 8)])
+
+
+def q22(T):
+    j = _star(T.inventory,
+              (T.date_dim, "inv_date_sk", "d_date_sk"),
+              (T.item, "inv_item_sk", "i_item_sk"))
+    j = j[j.d_month_seq.between(1212, 1223)]
+    out = _rollup(j, ["i_product_name", "i_brand", "i_class", "i_category"],
+                  dict(qoh=("inv_quantity_on_hand", "mean"))) \
+        .drop(columns="__lvl")
+    return out, meta(["qoh", "i_product_name", "i_brand", "i_class",
+                      "i_category"], None, 100, ["qoh"])
+
+
+def q27(T):
+    j = _star(T.store_sales,
+              (T.customer_demographics, "ss_cdemo_sk", "cd_demo_sk"),
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.store, "ss_store_sk", "s_store_sk"),
+              (T.item, "ss_item_sk", "i_item_sk"))
+    j = j[(j.cd_gender == "M") & (j.cd_marital_status == "S")
+          & (j.cd_education_status == "College") & (j.d_year == 2000)
+          & j.s_state.isin(["TN", "SD", "CA"])]
+    spec = {f"agg{i + 1}": (c, "mean") for i, c in enumerate(
+        ["ss_quantity", "ss_list_price", "ss_coupon_amt",
+         "ss_sales_price"])}
+    out = _rollup(j, ["i_item_id", "s_state"], spec)
+    out["g_state"] = (out.__lvl >= 1).astype(int)
+    out = out.drop(columns="__lvl")
+    cols = ["i_item_id", "s_state", "g_state"] + [f"agg{i}"
+                                                  for i in range(1, 5)]
+    return out[cols], meta(["i_item_id", "s_state"], None, 100,
+                           [f"agg{i}" for i in range(1, 5)])
+
+
+def q5(T):
+    lo, hi = "2000-08-23", "2000-09-06"
+    dd = _dates_between(T.date_dim, lo, hi)
+    ss = pd.concat([
+        pd.DataFrame({"store_sk": T.store_sales.ss_store_sk,
+                      "date_sk": T.store_sales.ss_sold_date_sk,
+                      "sales_price": T.store_sales.ss_ext_sales_price,
+                      "profit": T.store_sales.ss_net_profit,
+                      "return_amt": 0.0, "net_loss": 0.0}),
+        pd.DataFrame({"store_sk": T.store_returns.sr_store_sk,
+                      "date_sk": T.store_returns.sr_returned_date_sk,
+                      "sales_price": 0.0, "profit": 0.0,
+                      "return_amt": T.store_returns.sr_return_amt,
+                      "net_loss": T.store_returns.sr_net_loss})])
+    ssr = (_star(ss, (dd, "date_sk", "d_date_sk"),
+                 (T.store, "store_sk", "s_store_sk"))
+           .groupby("s_store_id", as_index=False)
+           .agg(sales=("sales_price", _sum), profit=("profit", _sum),
+                returns_=("return_amt", _sum),
+                profit_loss=("net_loss", _sum)))
+    cs = pd.concat([
+        pd.DataFrame({"page_sk": T.catalog_sales.cs_catalog_page_sk,
+                      "date_sk": T.catalog_sales.cs_sold_date_sk,
+                      "sales_price": T.catalog_sales.cs_ext_sales_price,
+                      "profit": T.catalog_sales.cs_net_profit,
+                      "return_amt": 0.0, "net_loss": 0.0}),
+        pd.DataFrame({"page_sk": T.catalog_returns.cr_catalog_page_sk,
+                      "date_sk": T.catalog_returns.cr_returned_date_sk,
+                      "sales_price": 0.0, "profit": 0.0,
+                      "return_amt": T.catalog_returns.cr_return_amount,
+                      "net_loss": T.catalog_returns.cr_net_loss})])
+    csr = (_star(cs, (dd, "date_sk", "d_date_sk"),
+                 (T.catalog_page, "page_sk", "cp_catalog_page_sk"))
+           .groupby("cp_catalog_page_id", as_index=False)
+           .agg(sales=("sales_price", _sum), profit=("profit", _sum),
+                returns_=("return_amt", _sum),
+                profit_loss=("net_loss", _sum)))
+    wr_j = T.web_returns.merge(
+        T.web_sales[["ws_item_sk", "ws_order_number", "ws_web_site_sk"]],
+        left_on=["wr_item_sk", "wr_order_number"],
+        right_on=["ws_item_sk", "ws_order_number"], how="left")
+    ws = pd.concat([
+        pd.DataFrame({"site_sk": T.web_sales.ws_web_site_sk,
+                      "date_sk": T.web_sales.ws_sold_date_sk,
+                      "sales_price": T.web_sales.ws_ext_sales_price,
+                      "profit": T.web_sales.ws_net_profit,
+                      "return_amt": 0.0, "net_loss": 0.0}),
+        pd.DataFrame({"site_sk": wr_j.ws_web_site_sk,
+                      "date_sk": wr_j.wr_returned_date_sk,
+                      "sales_price": 0.0, "profit": 0.0,
+                      "return_amt": wr_j.wr_return_amt,
+                      "net_loss": wr_j.wr_net_loss})])
+    wsr = (_star(ws, (dd, "date_sk", "d_date_sk"),
+                 (T.web_site, "site_sk", "web_site_sk"))
+           .groupby("web_site_id", as_index=False)
+           .agg(sales=("sales_price", _sum), profit=("profit", _sum),
+                returns_=("return_amt", _sum),
+                profit_loss=("net_loss", _sum)))
+    u = pd.concat([
+        pd.DataFrame({"channel": "store channel",
+                      "id": "store" + ssr.s_store_id.astype(str),
+                      "sales": ssr.sales, "returns_": ssr.returns_,
+                      "profit": ssr.profit - ssr.profit_loss}),
+        pd.DataFrame({"channel": "catalog channel",
+                      "id": "catalog_page"
+                      + csr.cp_catalog_page_id.astype(str),
+                      "sales": csr.sales, "returns_": csr.returns_,
+                      "profit": csr.profit - csr.profit_loss}),
+        pd.DataFrame({"channel": "web channel",
+                      "id": "web_site" + wsr.web_site_id.astype(str),
+                      "sales": wsr.sales, "returns_": wsr.returns_,
+                      "profit": wsr.profit - wsr.profit_loss})])
+    out = _rollup(u, ["channel", "id"],
+                  dict(sales=("sales", "sum"), returns_=("returns_", "sum"),
+                       profit=("profit", "sum"))).drop(columns="__lvl")
+    return out, meta(["channel", "id"], None, 100,
+                     ["sales", "returns_", "profit"])
+
+
+def q77(T):
+    lo, hi = "2000-08-23", "2000-09-22"
+    dd = _dates_between(T.date_dim, lo, hi)
+    ss = (_star(T.store_sales, (dd, "ss_sold_date_sk", "d_date_sk"),
+                (T.store, "ss_store_sk", "s_store_sk"))
+          .groupby("s_store_sk", as_index=False)
+          .agg(sales=("ss_ext_sales_price", _sum),
+               profit=("ss_net_profit", _sum)))
+    sr = (_star(T.store_returns, (dd, "sr_returned_date_sk", "d_date_sk"),
+                (T.store, "sr_store_sk", "s_store_sk"))
+          .groupby("sr_store_sk", as_index=False)
+          .agg(returns_=("sr_return_amt", _sum),
+               profit_loss=("sr_net_loss", _sum)))
+    store = ss.merge(sr, left_on="s_store_sk", right_on="sr_store_sk",
+                     how="left")
+    cs = (T.catalog_sales.merge(dd, left_on="cs_sold_date_sk",
+                                right_on="d_date_sk")
+          .groupby("cs_call_center_sk", as_index=False)
+          .agg(sales=("cs_ext_sales_price", _sum),
+               profit=("cs_net_profit", _sum)))
+    cr = (T.catalog_returns.merge(dd, left_on="cr_returned_date_sk",
+                                  right_on="d_date_sk")
+          .groupby("cr_call_center_sk", as_index=False)
+          .agg(returns_=("cr_return_amount", _sum),
+               profit_loss=("cr_net_loss", _sum)))
+    cat = cs.merge(cr, left_on="cs_call_center_sk",
+                   right_on="cr_call_center_sk", how="left")
+    ws = (_star(T.web_sales, (dd, "ws_sold_date_sk", "d_date_sk"),
+                (T.web_page, "ws_web_page_sk", "wp_web_page_sk"))
+          .groupby("wp_web_page_sk", as_index=False)
+          .agg(sales=("ws_ext_sales_price", _sum),
+               profit=("ws_net_profit", _sum)))
+    wr = (_star(T.web_returns, (dd, "wr_returned_date_sk", "d_date_sk"),
+                (T.web_page, "wr_web_page_sk", "wp_web_page_sk"))
+          .groupby("wp_web_page_sk", as_index=False)
+          .agg(returns_=("wr_return_amt", _sum),
+               profit_loss=("wr_net_loss", _sum)))
+    web = ws.merge(wr, on="wp_web_page_sk", how="left",
+                   suffixes=("", "_r"))
+    u = pd.concat([
+        pd.DataFrame({"channel": "store channel", "id": store.s_store_sk,
+                      "sales": store.sales,
+                      "returns_": store.returns_.fillna(0),
+                      "profit": store.profit
+                      - store.profit_loss.fillna(0)}),
+        pd.DataFrame({"channel": "catalog channel",
+                      "id": cat.cs_call_center_sk, "sales": cat.sales,
+                      "returns_": cat.returns_.fillna(0),
+                      "profit": cat.profit - cat.profit_loss.fillna(0)}),
+        pd.DataFrame({"channel": "web channel", "id": web.wp_web_page_sk,
+                      "sales": web.sales,
+                      "returns_": web.returns_.fillna(0),
+                      "profit": web.profit - web.profit_loss.fillna(0)})])
+    out = _rollup(u, ["channel", "id"],
+                  dict(sales=("sales", "sum"), returns_=("returns_", "sum"),
+                       profit=("profit", "sum"))).drop(columns="__lvl")
+    return out, meta(["channel", "id"], None, 100,
+                     ["sales", "returns_", "profit"])
+
+
+def q80(T):
+    lo, hi = "2000-08-23", "2000-09-22"
+    dd = _dates_between(T.date_dim, lo, hi)
+    promo = T.promotion[T.promotion.p_channel_tv == "N"]
+    hot_items = T.item[T.item.i_current_price > 50]
+
+    def chan(fact, ret, sale_keys, ret_keys, date_col, store_join, price,
+             profit, ret_amt, ret_loss, group_id):
+        j = fact.merge(ret[ret_keys + [ret_amt, ret_loss]],
+                       left_on=sale_keys, right_on=ret_keys, how="left")
+        j = j.merge(dd, left_on=date_col, right_on="d_date_sk")
+        j = j.merge(store_join[0], left_on=store_join[1],
+                    right_on=store_join[2])
+        j = j.merge(hot_items, left_on=sale_keys[0], right_on="i_item_sk")
+        j = j.merge(promo, left_on=group_id[2], right_on="p_promo_sk")
+        g = j.groupby(group_id[0], as_index=False).agg(
+            sales=(price, _sum),
+            returns_=(ret_amt, lambda s: s.fillna(0).sum()),
+            profit_amt=(profit, _sum),
+            loss=(ret_loss, lambda s: s.fillna(0).sum()))
+        g["profit"] = g.profit_amt - g.loss
+        return g
+
+    ssr = chan(T.store_sales, T.store_returns,
+               ["ss_item_sk", "ss_ticket_number"],
+               ["sr_item_sk", "sr_ticket_number"], "ss_sold_date_sk",
+               (T.store, "ss_store_sk", "s_store_sk"),
+               "ss_ext_sales_price", "ss_net_profit", "sr_return_amt",
+               "sr_net_loss", ("s_store_id", None, "ss_promo_sk"))
+    csr = chan(T.catalog_sales, T.catalog_returns,
+               ["cs_item_sk", "cs_order_number"],
+               ["cr_item_sk", "cr_order_number"], "cs_sold_date_sk",
+               (T.catalog_page, "cs_catalog_page_sk",
+                "cp_catalog_page_sk"),
+               "cs_ext_sales_price", "cs_net_profit", "cr_return_amount",
+               "cr_net_loss", ("cp_catalog_page_id", None, "cs_promo_sk"))
+    wsr = chan(T.web_sales, T.web_returns,
+               ["ws_item_sk", "ws_order_number"],
+               ["wr_item_sk", "wr_order_number"], "ws_sold_date_sk",
+               (T.web_site, "ws_web_site_sk", "web_site_sk"),
+               "ws_ext_sales_price", "ws_net_profit", "wr_return_amt",
+               "wr_net_loss", ("web_site_id", None, "ws_promo_sk"))
+    u = pd.concat([
+        pd.DataFrame({"channel": "store channel",
+                      "id": "store" + ssr.s_store_id.astype(str),
+                      "sales": ssr.sales, "returns_": ssr.returns_,
+                      "profit": ssr.profit}),
+        pd.DataFrame({"channel": "catalog channel",
+                      "id": "catalog_page"
+                      + csr.cp_catalog_page_id.astype(str),
+                      "sales": csr.sales, "returns_": csr.returns_,
+                      "profit": csr.profit}),
+        pd.DataFrame({"channel": "web channel",
+                      "id": "web_site" + wsr.web_site_id.astype(str),
+                      "sales": wsr.sales, "returns_": wsr.returns_,
+                      "profit": wsr.profit})])
+    out = _rollup(u, ["channel", "id"],
+                  dict(sales=("sales", "sum"), returns_=("returns_", "sum"),
+                       profit=("profit", "sum"))).drop(columns="__lvl")
+    return out, meta(["channel", "id"], None, 100,
+                     ["sales", "returns_", "profit"])
+
+
+def q67(T):
+    j = _star(T.store_sales,
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.store, "ss_store_sk", "s_store_sk"),
+              (T.item, "ss_item_sk", "i_item_sk"))
+    j = j[j.d_month_seq.between(1212, 1223)]
+    j = j.assign(val=(j.ss_sales_price * j.ss_quantity).fillna(0))
+    keys = ["i_category", "i_class", "i_brand", "i_product_name", "d_year",
+            "d_qoy", "d_moy", "s_store_id"]
+    dw1 = _rollup(j, keys, dict(sumsales=("val", "sum"))) \
+        .drop(columns="__lvl")
+    dw1["rk"] = dw1.groupby("i_category", dropna=False)["sumsales"] \
+        .rank(method="min", ascending=False).astype(int)
+    out = dw1[dw1.rk <= 100]
+    return out[keys + ["sumsales", "rk"]], meta(
+        keys + ["sumsales", "rk"], None, 100, ["sumsales"])
+
+
+def q86(T):
+    j = _star(T.web_sales,
+              (T.date_dim, "ws_sold_date_sk", "d_date_sk"),
+              (T.item, "ws_item_sk", "i_item_sk"))
+    j = j[j.d_month_seq.between(1200, 1211)]
+    r = _rollup(j, ["i_category", "i_class"],
+                dict(total_sum=("ws_net_paid", "sum")))
+    r["lochierarchy"] = r.__lvl
+    r["rank_within_parent"] = r.groupby(
+        ["__lvl", np.where(r.__lvl == 0, r.i_category, None)],
+        dropna=False)["total_sum"] \
+        .rank(method="min", ascending=False).astype(int)
+    out = r[["total_sum", "i_category", "i_class", "lochierarchy",
+             "rank_within_parent"]]
+    return out, meta([], None, 100, ["total_sum"], unordered=True)
+
+
+def q70(T):
+    dd = T.date_dim[T.date_dim.d_month_seq.between(1200, 1211)]
+    base = _star(T.store_sales, (dd, "ss_sold_date_sk", "d_date_sk"),
+                 (T.store, "ss_store_sk", "s_store_sk"))
+    # top-5 states by profit (rank within a single-state partition is
+    # always 1, so every state with sales qualifies — spec quirk kept)
+    st_rank = base.groupby("s_state")["ss_net_profit"].sum()
+    states = set(st_rank.index)
+    j = base[base.s_state.isin(states)]
+    r = _rollup(j, ["s_state", "s_county"],
+                dict(total_sum=("ss_net_profit", "sum")))
+    r["lochierarchy"] = r.__lvl
+    r["rank_within_parent"] = r.groupby(
+        ["__lvl", np.where(r.__lvl == 0, r.s_state, None)],
+        dropna=False)["total_sum"] \
+        .rank(method="min", ascending=False).astype(int)
+    out = r[["total_sum", "s_state", "s_county", "lochierarchy",
+             "rank_within_parent"]]
+    return out, meta([], None, 100, ["total_sum"], unordered=True)
+
+
+def q36(T):
+    j = _star(T.store_sales,
+              (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+              (T.item, "ss_item_sk", "i_item_sk"),
+              (T.store, "ss_store_sk", "s_store_sk"))
+    j = j[(j.d_year == 2000) & (j.s_state == "TN")]
+    res = (j.groupby(["i_category", "i_class"], as_index=False)
+           .agg(np_=("ss_net_profit", "sum"),
+                esp=("ss_ext_sales_price", "sum")))
+    lvl0 = pd.DataFrame({
+        "gross_margin": res.np_ / res.esp, "i_category": res.i_category,
+        "i_class": res.i_class, "lochierarchy": 0})
+    bycat = res.groupby("i_category", as_index=False).agg(
+        np_=("np_", "sum"), esp=("esp", "sum"))
+    lvl1 = pd.DataFrame({
+        "gross_margin": bycat.np_ / bycat.esp,
+        "i_category": bycat.i_category, "i_class": None, "lochierarchy": 1})
+    lvl2 = pd.DataFrame({
+        "gross_margin": [res.np_.sum() / res.esp.sum()],
+        "i_category": [None], "i_class": [None], "lochierarchy": [2]})
+    r = pd.concat([lvl0, lvl1, lvl2], ignore_index=True)
+    r["rank_within_parent"] = r.groupby(
+        ["lochierarchy", np.where(r.lochierarchy == 0, r.i_category,
+                                  None)], dropna=False)["gross_margin"] \
+        .rank(method="min", ascending=True).astype(int)
+    return r, meta([], None, 100, ["gross_margin"], unordered=True)
+
+
+def q14(T):
+    dd3 = T.date_dim[T.date_dim.d_year.between(1999, 2001)]
+
+    def chan_keys(fact, item_sk, date_sk):
+        j = _star(fact, (T.item, item_sk, "i_item_sk"),
+                  (dd3, date_sk, "d_date_sk"))
+        return set(map(tuple, j[["i_brand_id", "i_class_id",
+                                 "i_category_id"]]
+                       .drop_duplicates().itertuples(index=False)))
+
+    common = (chan_keys(T.store_sales, "ss_item_sk", "ss_sold_date_sk")
+              & chan_keys(T.catalog_sales, "cs_item_sk", "cs_sold_date_sk")
+              & chan_keys(T.web_sales, "ws_item_sk", "ws_sold_date_sk"))
+    it = T.item
+    cross_items = set(it[[tuple(r) in common for r in
+                          zip(it.i_brand_id, it.i_class_id,
+                              it.i_category_id)]].i_item_sk)
+    avg_parts = []
+    for fact, q, lp, date_sk in (
+            (T.store_sales, "ss_quantity", "ss_list_price",
+             "ss_sold_date_sk"),
+            (T.catalog_sales, "cs_quantity", "cs_list_price",
+             "cs_sold_date_sk"),
+            (T.web_sales, "ws_quantity", "ws_list_price",
+             "ws_sold_date_sk")):
+        p = fact.merge(dd3, left_on=date_sk, right_on="d_date_sk")
+        avg_parts.append(p[q] * p[lp])
+    average_sales = pd.concat(avg_parts).mean()
+    pieces = []
+    for fact, chan, item_sk, q, lp, date_sk in (
+            (T.store_sales, "store", "ss_item_sk", "ss_quantity",
+             "ss_list_price", "ss_sold_date_sk"),
+            (T.catalog_sales, "catalog", "cs_item_sk", "cs_quantity",
+             "cs_list_price", "cs_sold_date_sk"),
+            (T.web_sales, "web", "ws_item_sk", "ws_quantity",
+             "ws_list_price", "ws_sold_date_sk")):
+        p = fact[fact[item_sk].isin(cross_items)]
+        p = _star(p, (T.item, item_sk, "i_item_sk"),
+                  (T.date_dim, date_sk, "d_date_sk"))
+        p = p[(p.d_year == 2001) & (p.d_moy == 11)]
+        p = p.assign(val=p[q] * p[lp])
+        g = (p.groupby(["i_brand_id", "i_class_id", "i_category_id"],
+                       as_index=False)
+             .agg(sales=("val", _sum), number_sales=("val", "size")))
+        g = g[g.sales > average_sales]
+        g.insert(0, "channel", chan)
+        pieces.append(g)
+    u = pd.concat(pieces, ignore_index=True)
+    out = _rollup(u, ["channel", "i_brand_id", "i_class_id",
+                      "i_category_id"],
+                  dict(sum_sales=("sales", "sum"),
+                       sum_number_sales=("number_sales", "sum"))) \
+        .drop(columns="__lvl")
+    return out, meta(["channel", "i_brand_id", "i_class_id",
+                      "i_category_id"], None, 100, ["sum_sales"])
+
+
+# --------------------------------------------- customer-growth self-joins
+
+def _year_total(T, fact, cust_col, date_sk, val_fn, sale_type):
+    j = _star(fact, (T.customer, cust_col, "c_customer_sk"),
+              (T.date_dim, date_sk, "d_date_sk"))
+    j = j.assign(__v=val_fn(j))
+    g = (j.groupby(["c_customer_id", "c_first_name", "c_last_name",
+                    "c_preferred_cust_flag", "d_year"], dropna=False,
+                   as_index=False)
+         .agg(year_total=("__v", _sum)))
+    g["sale_type"] = sale_type
+    return g
+
+
+def _growth(yt, chans, y1=2000, y2=2001):
+    """Customers whose chanB ratio (y2/y1) beats the chanA (store) ratio
+    for every non-store channel in ``chans``."""
+    frames = {}
+    for st in {c for pair in chans for c in pair}:
+        sub = yt[yt.sale_type == st]
+        frames[(st, y1)] = sub[sub.d_year == y1].set_index("c_customer_id")
+        frames[(st, y2)] = sub[sub.d_year == y2].set_index("c_customer_id")
+    s1, s2 = frames[("s", y1)], frames[("s", y2)]
+    ids = set(s1[s1.year_total > 0].index) & set(s2.index)
+    ok = []
+    for cid in ids:
+        s_ratio = s2.year_total.get(cid, np.nan) / s1.year_total[cid]
+        good = True
+        for (other, _) in chans:
+            if other == "s":
+                continue
+            o1, o2 = frames[(other, y1)], frames[(other, y2)]
+            if cid not in o1.index or o1.year_total[cid] <= 0 \
+                    or cid not in o2.index:
+                good = False
+                break
+            o_ratio = o2.year_total[cid] / o1.year_total[cid]
+            if not (o_ratio > s_ratio):
+                good = False
+                break
+        if good:
+            ok.append(cid)
+    out = s2.loc[sorted(ok)].reset_index()
+    return out
+
+
+def q11(T):
+    yt = pd.concat([
+        _year_total(T, T.store_sales, "ss_customer_sk", "ss_sold_date_sk",
+                    lambda j: j.ss_ext_list_price - j.ss_ext_discount_amt,
+                    "s"),
+        _year_total(T, T.web_sales, "ws_bill_customer_sk",
+                    "ws_sold_date_sk",
+                    lambda j: j.ws_ext_list_price - j.ws_ext_discount_amt,
+                    "w")])
+    out = _growth(yt, [("s", "s"), ("w", "w")])
+    out = out[["c_customer_id", "c_first_name", "c_last_name",
+               "c_preferred_cust_flag"]]
+    out.columns = ["customer_id", "customer_first_name",
+                   "customer_last_name", "customer_preferred_cust_flag"]
+    return out, meta(["customer_id", "customer_first_name",
+                      "customer_last_name",
+                      "customer_preferred_cust_flag"], None, 100)
+
+
+def q74(T):
+    yt = pd.concat([
+        _year_total(T, T.store_sales[
+            T.store_sales.ss_sold_date_sk.isin(
+                set(T.date_dim[T.date_dim.d_year.isin([2000, 2001])]
+                    .d_date_sk))],
+            "ss_customer_sk", "ss_sold_date_sk",
+            lambda j: j.ss_net_paid, "s"),
+        _year_total(T, T.web_sales[
+            T.web_sales.ws_sold_date_sk.isin(
+                set(T.date_dim[T.date_dim.d_year.isin([2000, 2001])]
+                    .d_date_sk))],
+            "ws_bill_customer_sk", "ws_sold_date_sk",
+            lambda j: j.ws_net_paid, "w")])
+    out = _growth(yt, [("s", "s"), ("w", "w")])
+    out = out[["c_customer_id", "c_first_name", "c_last_name"]]
+    out.columns = ["customer_id", "customer_first_name",
+                   "customer_last_name"]
+    return out, meta(["customer_id"], None, 100)
+
+
+def q4(T):
+    def val_s(j):
+        return ((j.ss_ext_list_price - j.ss_ext_wholesale_cost
+                 - j.ss_ext_discount_amt) + j.ss_ext_sales_price) / 2
+
+    def val_c(j):
+        return ((j.cs_ext_list_price - j.cs_ext_wholesale_cost
+                 - j.cs_ext_discount_amt) + j.cs_ext_sales_price) / 2
+
+    def val_w(j):
+        return ((j.ws_ext_list_price - j.ws_ext_wholesale_cost
+                 - j.ws_ext_discount_amt) + j.ws_ext_sales_price) / 2
+
+    yt = pd.concat([
+        _year_total(T, T.store_sales, "ss_customer_sk", "ss_sold_date_sk",
+                    val_s, "s"),
+        _year_total(T, T.catalog_sales, "cs_bill_customer_sk",
+                    "cs_sold_date_sk", val_c, "c"),
+        _year_total(T, T.web_sales, "ws_bill_customer_sk",
+                    "ws_sold_date_sk", val_w, "w")])
+    # c ratio > s ratio AND c ratio > w ratio, with s/c/w firstyear > 0
+    f = {}
+    for st in "scw":
+        sub = yt[yt.sale_type == st]
+        f[(st, 2000)] = sub[sub.d_year == 2000].set_index("c_customer_id")
+        f[(st, 2001)] = sub[sub.d_year == 2001].set_index("c_customer_id")
+    ids = set(f[("s", 2000)].index) & set(f[("s", 2001)].index) \
+        & set(f[("c", 2000)].index) & set(f[("c", 2001)].index) \
+        & set(f[("w", 2000)].index) & set(f[("w", 2001)].index)
+    ok = []
+    for cid in ids:
+        s1 = f[("s", 2000)].year_total[cid]
+        c1 = f[("c", 2000)].year_total[cid]
+        w1 = f[("w", 2000)].year_total[cid]
+        if not (s1 > 0 and c1 > 0 and w1 > 0):
+            continue
+        c_ratio = f[("c", 2001)].year_total[cid] / c1
+        s_ratio = f[("s", 2001)].year_total[cid] / s1
+        w_ratio = f[("w", 2001)].year_total[cid] / w1
+        if c_ratio > s_ratio and c_ratio > w_ratio:
+            ok.append(cid)
+    out = f[("s", 2001)].loc[sorted(ok)].reset_index()
+    out = out[["c_customer_id", "c_first_name", "c_last_name",
+               "c_preferred_cust_flag"]]
+    out.columns = ["customer_id", "customer_first_name",
+                   "customer_last_name", "customer_preferred_cust_flag"]
+    return out, meta(["customer_id", "customer_first_name",
+                      "customer_last_name",
+                      "customer_preferred_cust_flag"], None, 100)
+
+
+# ----------------------------------------------------------- the rest
+
+def q23(T):
+    dd3 = T.date_dim[T.date_dim.d_year.isin([1999, 2000, 2001])]
+    j = _star(T.store_sales, (dd3, "ss_sold_date_sk", "d_date_sk"),
+              (T.item, "ss_item_sk", "i_item_sk"))
+    j = j.assign(itemdesc=j.i_item_desc.astype(str).str[:30])
+    freq = (j.groupby(["itemdesc", "i_item_sk", "d_date"], as_index=False)
+            .size())
+    # one row per qualifying (item, sold-date): the SQL inner join FANS
+    # OUT sales of an item that was frequent on several days — keep the
+    # frame, not a set, so the oracle fans out identically
+    freq_rows = freq[freq["size"] > 4][["i_item_sk"]].rename(
+        columns={"i_item_sk": "freq_item_sk"})
+    sales_by_cust = (T.store_sales.merge(
+        dd3, left_on="ss_sold_date_sk", right_on="d_date_sk")
+        .merge(T.customer, left_on="ss_customer_sk",
+               right_on="c_customer_sk"))
+    sales_by_cust = sales_by_cust.assign(
+        csales=sales_by_cust.ss_quantity * sales_by_cust.ss_sales_price)
+    cmax = sales_by_cust.groupby("c_customer_sk")["csales"].sum().max()
+    all_cust = (T.store_sales.merge(
+        T.customer, left_on="ss_customer_sk", right_on="c_customer_sk"))
+    all_cust = all_cust.assign(
+        ssales=all_cust.ss_quantity * all_cust.ss_sales_price)
+    tot = all_cust.groupby("c_customer_sk")["ssales"].sum()
+    best = set(tot[tot > 0.5 * cmax].index)
+    dd_feb = T.date_dim[(T.date_dim.d_year == 2000)
+                        & (T.date_dim.d_moy == 2)]
+    pieces = []
+    for fact, cust, item, date_sk, q, lp in (
+            (T.catalog_sales, "cs_bill_customer_sk", "cs_item_sk",
+             "cs_sold_date_sk", "cs_quantity", "cs_list_price"),
+            (T.web_sales, "ws_bill_customer_sk", "ws_item_sk",
+             "ws_sold_date_sk", "ws_quantity", "ws_list_price")):
+        p = fact.merge(dd_feb, left_on=date_sk, right_on="d_date_sk")
+        p = p[p[cust].isin(best)]
+        p = p.merge(freq_rows, left_on=item, right_on="freq_item_sk")
+        p = p.merge(T.customer, left_on=cust, right_on="c_customer_sk")
+        p = p.assign(val=p[q] * p[lp])
+        g = (p.groupby(["c_last_name", "c_first_name"], dropna=False,
+                       as_index=False).agg(sales=("val", _sum)))
+        pieces.append(g)
+    out = pd.concat(pieces, ignore_index=True)
+    return out, meta(["c_last_name", "c_first_name", "sales"], None, 100,
+                     ["sales"])
+
+
+def q24(T):
+    j = T.store_sales.merge(
+        T.store_returns, left_on=["ss_ticket_number", "ss_item_sk"],
+        right_on=["sr_ticket_number", "sr_item_sk"])
+    j = j.merge(T.customer, left_on="ss_customer_sk",
+                right_on="c_customer_sk")
+    j = j.merge(T.item, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j.merge(T.store[T.store.s_market_id == 8], left_on="ss_store_sk",
+                right_on="s_store_sk")
+    j = j.merge(T.customer_address, left_on="c_current_addr_sk",
+                right_on="ca_address_sk")
+    j = j[j.c_birth_country != j.ca_country.astype(str).str.upper()]
+    keys = ["c_last_name", "c_first_name", "s_store_name", "ca_state",
+            "s_state", "i_color", "i_current_price", "i_manager_id",
+            "i_units", "i_size"]
+    ssales = (j.groupby(keys, dropna=False, as_index=False)
+              .agg(netpaid=("ss_net_paid", _sum)))
+    thresh = 0.05 * ssales.netpaid.mean()
+    peach = ssales[ssales.i_color == "peach"]
+    out = (peach.groupby(["c_last_name", "c_first_name", "s_store_name"],
+                         dropna=False, as_index=False)
+           .agg(paid=("netpaid", _sum)))
+    out = out[out.paid > thresh]
+    return out, meta(["c_last_name", "c_first_name", "s_store_name"],
+                     None, None, ["paid"])
+
+
+def q31(T):
+    ss = (_star(T.store_sales, (T.date_dim, "ss_sold_date_sk", "d_date_sk"),
+                (T.customer_address, "ss_addr_sk", "ca_address_sk"))
+          .groupby(["ca_county", "d_qoy", "d_year"], as_index=False)
+          .agg(store_sales=("ss_ext_sales_price", _sum)))
+    ws = (_star(T.web_sales, (T.date_dim, "ws_sold_date_sk", "d_date_sk"),
+                (T.customer_address, "ws_bill_addr_sk", "ca_address_sk"))
+          .groupby(["ca_county", "d_qoy", "d_year"], as_index=False)
+          .agg(web_sales=("ws_ext_sales_price", _sum)))
+
+    def pick(df, col, q):
+        p = df[(df.d_qoy == q) & (df.d_year == 2000)]
+        return p.set_index("ca_county")[col]
+
+    s1, s2, s3 = (pick(ss, "store_sales", q) for q in (1, 2, 3))
+    w1, w2, w3 = (pick(ws, "web_sales", q) for q in (1, 2, 3))
+    counties = (set(s1.index) & set(s2.index) & set(s3.index)
+                & set(w1.index) & set(w2.index) & set(w3.index))
+    rows = []
+    for c in sorted(counties):
+        wg1 = w2[c] / w1[c] if w1[c] > 0 else np.nan
+        sg1 = s2[c] / s1[c] if s1[c] > 0 else np.nan
+        wg2 = w3[c] / w2[c] if w2[c] > 0 else np.nan
+        sg2 = s3[c] / s2[c] if s2[c] > 0 else np.nan
+        if not (np.isnan(wg1) or np.isnan(sg1)) and wg1 > sg1 \
+                and not (np.isnan(wg2) or np.isnan(sg2)) and wg2 > sg2:
+            rows.append((c, 2000, wg1, sg1, wg2, sg2))
+    out = pd.DataFrame(rows, columns=[
+        "ca_county", "d_year", "web_q1_q2_increase",
+        "store_q1_q2_increase", "web_q2_q3_increase",
+        "store_q2_q3_increase"])
+    return out, meta(["ca_county"], None, None,
+                     ["web_q1_q2_increase", "store_q1_q2_increase",
+                      "web_q2_q3_increase", "store_q2_q3_increase"])
+
+
+def q44(T):
+    ss4 = T.store_sales[T.store_sales.ss_store_sk == 4]
+    base = ss4[ss4.ss_addr_sk.isna()].ss_net_profit.mean()
+    byitem = (ss4.groupby("ss_item_sk", as_index=False)
+              .agg(rank_col=("ss_net_profit", "mean")))
+    byitem = byitem[byitem.rank_col > 0.9 * base]
+    asc = byitem.sort_values("rank_col", ascending=True, kind="stable")
+    asc = asc.assign(rnk=byitem.rank_col.rank(method="min"))
+    desc = byitem.assign(
+        rnk=byitem.rank_col.rank(method="min", ascending=False))
+    a = asc[asc.rnk < 11].merge(T.item, left_on="ss_item_sk",
+                                right_on="i_item_sk")
+    d = desc[desc.rnk < 11].merge(T.item, left_on="ss_item_sk",
+                                  right_on="i_item_sk")
+    m = a.merge(d, on="rnk", suffixes=("_a", "_d"))
+    out = pd.DataFrame({
+        "rnk": m.rnk.astype(int),
+        "best_performing": m.i_product_name_a,
+        "worst_performing": m.i_product_name_d})
+    return out, meta(["rnk"], None, 100)
+
+
+def q49(T):
+    dd = T.date_dim[(T.date_dim.d_year == 2000) & (T.date_dim.d_moy == 12)]
+    pieces = []
+    for chan, fact, ret, sk, rk, q, rq, amt, paid, profit, date_sk in (
+            ("web", T.web_sales, T.web_returns,
+             ["ws_order_number", "ws_item_sk"],
+             ["wr_order_number", "wr_item_sk"], "ws_quantity",
+             "wr_return_quantity", "wr_return_amt", "ws_net_paid",
+             "ws_net_profit", "ws_sold_date_sk"),
+            ("catalog", T.catalog_sales, T.catalog_returns,
+             ["cs_order_number", "cs_item_sk"],
+             ["cr_order_number", "cr_item_sk"], "cs_quantity",
+             "cr_return_quantity", "cr_return_amount", "cs_net_paid",
+             "cs_net_profit", "cs_sold_date_sk"),
+            ("store", T.store_sales, T.store_returns,
+             ["ss_ticket_number", "ss_item_sk"],
+             ["sr_ticket_number", "sr_item_sk"], "ss_quantity",
+             "sr_return_quantity", "sr_return_amt", "ss_net_paid",
+             "ss_net_profit", "ss_sold_date_sk")):
+        j = fact.merge(ret[rk + [rq, amt]], left_on=sk, right_on=rk,
+                       how="left")
+        j = j.merge(dd, left_on=date_sk, right_on="d_date_sk")
+        j = j[(j[amt] > 100) & (j[profit] > 1) & (j[paid] > 0)
+              & (j[q] > 0)]
+        item_col = sk[1]
+        g = (j.groupby(item_col, as_index=False)
+             .agg(rq_sum=(rq, lambda s: s.fillna(0).sum()),
+                  q_sum=(q, lambda s: s.fillna(0).sum()),
+                  amt_sum=(amt, lambda s: s.fillna(0).sum()),
+                  paid_sum=(paid, lambda s: s.fillna(0).sum())))
+        g["return_ratio"] = g.rq_sum / g.q_sum
+        g["currency_ratio"] = g.amt_sum / g.paid_sum
+        g["return_rank"] = g.return_ratio.rank(method="min")
+        g["currency_rank"] = g.currency_ratio.rank(method="min")
+        g = g[(g.return_rank <= 10) | (g.currency_rank <= 10)]
+        out = pd.DataFrame({
+            "channel": chan, "item": g[item_col],
+            "return_ratio": g.return_ratio,
+            "return_rank": g.return_rank.astype(int),
+            "currency_rank": g.currency_rank.astype(int)})
+        pieces.append(out)
+    u = pd.concat(pieces, ignore_index=True).drop_duplicates()
+    return u, meta(["channel", "return_rank", "currency_rank", "item"],
+                   None, 100, ["return_ratio"])
+
+
+def q51(T):
+    dd = T.date_dim[T.date_dim.d_month_seq.between(1200, 1211)]
+
+    def cume(fact, item, date_sk, price):
+        j = fact[fact[item].notna()].merge(dd, left_on=date_sk,
+                                           right_on="d_date_sk")
+        g = (j.groupby([item, "d_date"], as_index=False)
+             .agg(s=(price, _sum)))
+        g = g.sort_values([item, "d_date"], kind="stable")
+        g["cume_sales"] = g.groupby(item)["s"].cumsum()
+        return g.rename(columns={item: "item_sk"})[
+            ["item_sk", "d_date", "cume_sales"]]
+
+    web = cume(T.web_sales, "ws_item_sk", "ws_sold_date_sk",
+               "ws_sales_price")
+    store = cume(T.store_sales, "ss_item_sk", "ss_sold_date_sk",
+                 "ss_sales_price")
+    m = web.merge(store, on=["item_sk", "d_date"], how="outer",
+                  suffixes=("_w", "_s"))
+    m = m.rename(columns={"cume_sales_w": "web_sales",
+                          "cume_sales_s": "store_sales"})
+    m = m.sort_values(["item_sk", "d_date"], kind="stable")
+    m["web_cumulative"] = m.groupby("item_sk")["web_sales"].cummax()
+    m["store_cumulative"] = m.groupby("item_sk")["store_sales"].cummax()
+    out = m[m.web_cumulative > m.store_cumulative]
+    out = out[["item_sk", "d_date", "web_sales", "store_sales",
+               "web_cumulative", "store_cumulative"]]
+    return out, meta(["item_sk", "d_date"], None, 100,
+                     ["web_sales", "store_sales", "web_cumulative",
+                      "store_cumulative"])
+
+
+def q54(T):
+    dd = T.date_dim
+    u = pd.concat([
+        pd.DataFrame({"sold_date_sk": T.catalog_sales.cs_sold_date_sk,
+                      "customer_sk": T.catalog_sales.cs_bill_customer_sk,
+                      "item_sk": T.catalog_sales.cs_item_sk}),
+        pd.DataFrame({"sold_date_sk": T.web_sales.ws_sold_date_sk,
+                      "customer_sk": T.web_sales.ws_bill_customer_sk,
+                      "item_sk": T.web_sales.ws_item_sk})])
+    it = T.item[(T.item.i_category == "Women")
+                & (T.item.i_class == "dresses")]
+    dd_dec = dd[(dd.d_moy == 12) & (dd.d_year == 1999)]
+    j = (u.merge(it, left_on="item_sk", right_on="i_item_sk")
+         .merge(dd_dec, left_on="sold_date_sk", right_on="d_date_sk")
+         .merge(T.customer, left_on="customer_sk",
+                right_on="c_customer_sk"))
+    my_customers = j[["c_customer_sk", "c_current_addr_sk"]] \
+        .drop_duplicates()
+    mseq = dd_dec.d_month_seq.iloc[0]
+    dd_win = dd[dd.d_month_seq.between(mseq + 1, mseq + 3)]
+    rev = (my_customers
+           .merge(T.customer_address, left_on="c_current_addr_sk",
+                  right_on="ca_address_sk")
+           .merge(T.store, left_on=["ca_county", "ca_state"],
+                  right_on=["s_county", "s_state"])
+           .merge(T.store_sales, left_on="c_customer_sk",
+                  right_on="ss_customer_sk")
+           .merge(dd_win, left_on="ss_sold_date_sk",
+                  right_on="d_date_sk"))
+    g = (rev.groupby("c_customer_sk", as_index=False)
+         .agg(revenue=("ss_ext_sales_price", _sum)))
+    seg = (g.revenue / 50).round().astype(int)
+    out = (pd.DataFrame({"segment": seg}).groupby("segment",
+                                                  as_index=False)
+           .size().rename(columns={"size": "num_customers"}))
+    out["segment_base"] = out.segment * 50
+    return out, meta(["segment", "num_customers", "segment_base"],
+                     None, 100)
+
+
+def q58(T):
+    dd = T.date_dim
+    wk = dd[dd.d_date.astype(str) == "2000-01-03"].d_week_seq.iloc[0]
+    days = set(dd[dd.d_week_seq == wk].d_date)
+
+    def rev(fact, item_sk, date_sk, price, name):
+        j = _star(fact, (T.item, item_sk, "i_item_sk"),
+                  (dd[dd.d_date.isin(days)], date_sk, "d_date_sk"))
+        return (j.groupby("i_item_id", as_index=False)
+                .agg(**{name: (price, _sum)}))
+
+    s = rev(T.store_sales, "ss_item_sk", "ss_sold_date_sk",
+            "ss_ext_sales_price", "ss_item_rev")
+    c = rev(T.catalog_sales, "cs_item_sk", "cs_sold_date_sk",
+            "cs_ext_sales_price", "cs_item_rev")
+    w = rev(T.web_sales, "ws_item_sk", "ws_sold_date_sk",
+            "ws_ext_sales_price", "ws_item_rev")
+    m = s.merge(c, on="i_item_id").merge(w, on="i_item_id")
+    m = m[m.ss_item_rev.between(0.9 * m.cs_item_rev, 1.1 * m.cs_item_rev)
+          & m.ss_item_rev.between(0.9 * m.ws_item_rev, 1.1 * m.ws_item_rev)
+          & m.cs_item_rev.between(0.9 * m.ss_item_rev, 1.1 * m.ss_item_rev)
+          & m.cs_item_rev.between(0.9 * m.ws_item_rev, 1.1 * m.ws_item_rev)
+          & m.ws_item_rev.between(0.9 * m.ss_item_rev, 1.1 * m.ss_item_rev)
+          & m.ws_item_rev.between(0.9 * m.cs_item_rev,
+                                  1.1 * m.cs_item_rev)]
+    avg3 = (m.ss_item_rev + m.cs_item_rev + m.ws_item_rev) / 3
+    out = pd.DataFrame({
+        "item_id": m.i_item_id, "ss_item_rev": m.ss_item_rev,
+        "ss_dev": m.ss_item_rev / avg3 * 100, "cs_item_rev": m.cs_item_rev,
+        "cs_dev": m.cs_item_rev / avg3 * 100, "ws_item_rev": m.ws_item_rev,
+        "ws_dev": m.ws_item_rev / avg3 * 100, "average": avg3})
+    return out, meta(["item_id", "ss_item_rev"], None, 100,
+                     ["ss_item_rev", "ss_dev", "cs_item_rev", "cs_dev",
+                      "ws_item_rev", "ws_dev", "average"])
+
+
+def q83(T):
+    dd = T.date_dim
+    wks = set(dd[dd.d_date.astype(str).isin(
+        ["2000-06-30", "2000-09-27", "2000-11-17"])].d_week_seq)
+    days = set(dd[dd.d_week_seq.isin(wks)].d_date)
+
+    def qty(ret, item_sk, date_sk, col, name):
+        j = _star(ret, (T.item, item_sk, "i_item_sk"),
+                  (dd[dd.d_date.isin(days)], date_sk, "d_date_sk"))
+        return (j.groupby("i_item_id", as_index=False)
+                .agg(**{name: (col, _sum)}))
+
+    s = qty(T.store_returns, "sr_item_sk", "sr_returned_date_sk",
+            "sr_return_quantity", "sr_item_qty")
+    c = qty(T.catalog_returns, "cr_item_sk", "cr_returned_date_sk",
+            "cr_return_quantity", "cr_item_qty")
+    w = qty(T.web_returns, "wr_item_sk", "wr_returned_date_sk",
+            "wr_return_quantity", "wr_item_qty")
+    m = s.merge(c, on="i_item_id").merge(w, on="i_item_id")
+    tot = m.sr_item_qty + m.cr_item_qty + m.wr_item_qty
+    out = pd.DataFrame({
+        "item_id": m.i_item_id, "sr_item_qty": m.sr_item_qty,
+        "sr_dev": m.sr_item_qty / tot / 3.0 * 100,
+        "cr_item_qty": m.cr_item_qty,
+        "cr_dev": m.cr_item_qty / tot / 3.0 * 100,
+        "wr_item_qty": m.wr_item_qty,
+        "wr_dev": m.wr_item_qty / tot / 3.0 * 100,
+        "average": tot / 3.0})
+    return out, meta(["item_id", "sr_item_qty"], None, 100,
+                     ["sr_dev", "cr_dev", "wr_dev", "average"])
+
+
+def q66(T):
+    td = T.time_dim[T.time_dim.t_time.between(30838, 30838 + 28800)]
+    sm = T.ship_mode[T.ship_mode.sm_carrier.isin(["DHL", "UPS"])]
+    dd = T.date_dim[T.date_dim.d_year == 2000]
+
+    def chan(fact, wh_sk, date_sk, time_sk, mode_sk, price, net, q):
+        j = _star(fact, (T.warehouse, wh_sk, "w_warehouse_sk"),
+                  (dd, date_sk, "d_date_sk"), (td, time_sk, "t_time_sk"),
+                  (sm, mode_sk, "sm_ship_mode_sk"))
+        j = j.assign(val=j[price] * j[q], net=j[net] * j[q])
+        keys = ["w_warehouse_name", "w_warehouse_sq_ft", "w_city",
+                "w_county", "w_state", "w_country"]
+        spec = {}
+        for m_ in range(1, 13):
+            nm = ["jan", "feb", "mar", "apr", "may", "jun", "jul", "aug",
+                  "sep", "oct", "nov", "dec"][m_ - 1]
+            j[f"{nm}_sales"] = np.where(j.d_moy == m_, j.val, 0.0)
+            spec[f"{nm}_sales"] = (f"{nm}_sales", "sum")
+        j["jan_net"] = np.where(j.d_moy == 1, j.net, 0.0)
+        j["dec_net"] = np.where(j.d_moy == 12, j.net, 0.0)
+        spec["jan_net"] = ("jan_net", "sum")
+        spec["dec_net"] = ("dec_net", "sum")
+        g = j.groupby(keys, dropna=False, as_index=False).agg(**spec)
+        g["year_"] = 2000
+        g["ship_carriers"] = "DHL,UPS"
+        return g
+
+    w = chan(T.web_sales, "ws_warehouse_sk", "ws_sold_date_sk",
+             "ws_sold_time_sk", "ws_ship_mode_sk", "ws_ext_sales_price",
+             "ws_net_paid", "ws_quantity")
+    c = chan(T.catalog_sales, "cs_warehouse_sk", "cs_sold_date_sk",
+             "cs_sold_time_sk", "cs_ship_mode_sk", "cs_sales_price",
+             "cs_net_paid_inc_tax", "cs_quantity")
+    u = pd.concat([w, c], ignore_index=True)
+    keys = ["w_warehouse_name", "w_warehouse_sq_ft", "w_city", "w_county",
+            "w_state", "w_country", "ship_carriers", "year_"]
+    months = ["jan", "feb", "mar", "apr", "may", "jun", "jul", "aug",
+              "sep", "oct", "nov", "dec"]
+    u["jan_per_sqft"] = u.jan_sales / u.w_warehouse_sq_ft
+    u["dec_per_sqft"] = u.dec_sales / u.w_warehouse_sq_ft
+    spec = {f"{m_}_sales": (f"{m_}_sales", "sum") for m_ in months}
+    spec.update(jan_sales_per_sq_foot=("jan_per_sqft", "sum"),
+                dec_sales_per_sq_foot=("dec_per_sqft", "sum"),
+                jan_net=("jan_net", "sum"), dec_net=("dec_net", "sum"))
+    out = u.groupby(keys, dropna=False, as_index=False).agg(**spec)
+    return out, meta(["w_warehouse_name"], None, 100,
+                     [f"{m_}_sales" for m_ in months]
+                     + ["jan_sales_per_sq_foot", "dec_sales_per_sq_foot",
+                        "jan_net", "dec_net"])
+
+
+def q72(T):
+    j = T.catalog_sales.merge(T.inventory, left_on="cs_item_sk",
+                              right_on="inv_item_sk")
+    j = j.merge(T.warehouse, left_on="inv_warehouse_sk",
+                right_on="w_warehouse_sk")
+    j = j.merge(T.item, left_on="cs_item_sk", right_on="i_item_sk")
+    j = j.merge(T.customer_demographics[
+        T.customer_demographics.cd_marital_status == "D"],
+        left_on="cs_bill_cdemo_sk", right_on="cd_demo_sk")
+    j = j.merge(T.household_demographics[
+        T.household_demographics.hd_buy_potential == ">10000"],
+        left_on="cs_bill_hdemo_sk", right_on="hd_demo_sk")
+    d1 = T.date_dim.add_prefix("d1_")
+    d2 = T.date_dim.add_prefix("d2_")
+    d3 = T.date_dim.add_prefix("d3_")
+    j = j.merge(d1[d1.d1_d_year == 2000], left_on="cs_sold_date_sk",
+                right_on="d1_d_date_sk")
+    j = j.merge(d2, left_on="inv_date_sk", right_on="d2_d_date_sk")
+    j = j.merge(d3, left_on="cs_ship_date_sk", right_on="d3_d_date_sk")
+    j = j[(j.d1_d_week_seq == j.d2_d_week_seq)
+          & (j.inv_quantity_on_hand < j.cs_quantity)
+          & (pd.to_datetime(j.d3_d_date)
+             > pd.to_datetime(j.d1_d_date) + pd.Timedelta(days=5))]
+    j = j.merge(T.promotion, left_on="cs_promo_sk", right_on="p_promo_sk",
+                how="left")
+    j = j.merge(T.catalog_returns[["cr_item_sk", "cr_order_number"]],
+                left_on=["cs_item_sk", "cs_order_number"],
+                right_on=["cr_item_sk", "cr_order_number"], how="left")
+    g = (j.groupby(["i_item_desc", "w_warehouse_name", "d1_d_week_seq"],
+                   as_index=False)
+         .agg(no_promo=("p_promo_sk", lambda s: int(s.isna().sum())),
+              promo=("p_promo_sk", lambda s: int(s.notna().sum())),
+              total_cnt=("p_promo_sk", "size")))
+    g = g.rename(columns={"d1_d_week_seq": "d_week_seq"})
+    return g, meta(["total_cnt", "i_item_desc", "w_warehouse_name",
+                    "d_week_seq"], [False, True, True, True], 100)
+
+
+def q75(T):
+    def chan(fact, ret, item_sk, date_sk, sale_keys, ret_keys, q, price,
+             rq, ramt):
+        j = fact.merge(T.item[T.item.i_category == "Books"],
+                       left_on=item_sk, right_on="i_item_sk")
+        j = j.merge(T.date_dim, left_on=date_sk, right_on="d_date_sk")
+        j = j.merge(ret[ret_keys + [rq, ramt]], left_on=sale_keys,
+                    right_on=ret_keys, how="left")
+        out = pd.DataFrame({
+            "d_year": j.d_year, "i_brand_id": j.i_brand_id,
+            "i_class_id": j.i_class_id, "i_category_id": j.i_category_id,
+            "i_manufact_id": j.i_manufact_id,
+            "sales_cnt": j[q] - j[rq].fillna(0),
+            "sales_amt": j[price] - j[ramt].fillna(0.0)})
+        return out.drop_duplicates()
+
+    u = pd.concat([
+        chan(T.catalog_sales, T.catalog_returns, "cs_item_sk",
+             "cs_sold_date_sk", ["cs_order_number", "cs_item_sk"],
+             ["cr_order_number", "cr_item_sk"], "cs_quantity",
+             "cs_ext_sales_price", "cr_return_quantity",
+             "cr_return_amount"),
+        chan(T.store_sales, T.store_returns, "ss_item_sk",
+             "ss_sold_date_sk", ["ss_ticket_number", "ss_item_sk"],
+             ["sr_ticket_number", "sr_item_sk"], "ss_quantity",
+             "ss_ext_sales_price", "sr_return_quantity", "sr_return_amt"),
+        chan(T.web_sales, T.web_returns, "ws_item_sk", "ws_sold_date_sk",
+             ["ws_order_number", "ws_item_sk"],
+             ["wr_order_number", "wr_item_sk"], "ws_quantity",
+             "ws_ext_sales_price", "wr_return_quantity",
+             "wr_return_amt")]).drop_duplicates()
+    g = (u.groupby(["d_year", "i_brand_id", "i_class_id", "i_category_id",
+                    "i_manufact_id"], dropna=False, as_index=False)
+         .agg(sales_cnt=("sales_cnt", "sum"),
+              sales_amt=("sales_amt", "sum")))
+    cur = g[g.d_year == 2001]
+    prev = g[g.d_year == 2000]
+    m = cur.merge(prev, on=["i_brand_id", "i_class_id", "i_category_id",
+                            "i_manufact_id"], suffixes=("_c", "_p"))
+    m = m[m.sales_cnt_c / m.sales_cnt_p < 0.9]
+    out = pd.DataFrame({
+        "prev_year": m.d_year_p, "year_": m.d_year_c,
+        "i_brand_id": m.i_brand_id, "i_class_id": m.i_class_id,
+        "i_category_id": m.i_category_id, "i_manufact_id": m.i_manufact_id,
+        "prev_yr_cnt": m.sales_cnt_p, "curr_yr_cnt": m.sales_cnt_c,
+        "sales_cnt_diff": m.sales_cnt_c - m.sales_cnt_p,
+        "sales_amt_diff": m.sales_amt_c - m.sales_amt_p})
+    return out, meta(["sales_cnt_diff", "sales_amt_diff"], None, 100,
+                     ["sales_amt_diff"])
+
+
+def q78(T):
+    def chan(fact, ret, sale_keys, ret_key_cols, date_sk, cust, item, q,
+             wc, sp, prefix):
+        j = fact.merge(ret[ret_key_cols], left_on=sale_keys,
+                       right_on=ret_key_cols, how="left")
+        j = j[j[ret_key_cols[0]].isna()]
+        j = j.merge(T.date_dim, left_on=date_sk, right_on="d_date_sk")
+        g = (j.groupby(["d_year", item, cust], dropna=False,
+                       as_index=False)
+             .agg(**{f"{prefix}_qty": (q, _sum),
+                     f"{prefix}_wc": (wc, _sum),
+                     f"{prefix}_sp": (sp, _sum)}))
+        return g
+
+    ss = chan(T.store_sales, T.store_returns,
+              ["ss_ticket_number", "ss_item_sk"],
+              ["sr_ticket_number", "sr_item_sk"], "ss_sold_date_sk",
+              "ss_customer_sk", "ss_item_sk", "ss_quantity",
+              "ss_wholesale_cost", "ss_sales_price", "ss")
+    ws = chan(T.web_sales, T.web_returns,
+              ["ws_order_number", "ws_item_sk"],
+              ["wr_order_number", "wr_item_sk"], "ws_sold_date_sk",
+              "ws_bill_customer_sk", "ws_item_sk", "ws_quantity",
+              "ws_wholesale_cost", "ws_sales_price", "ws")
+    cs = chan(T.catalog_sales, T.catalog_returns,
+              ["cs_order_number", "cs_item_sk"],
+              ["cr_order_number", "cr_item_sk"], "cs_sold_date_sk",
+              "cs_bill_customer_sk", "cs_item_sk", "cs_quantity",
+              "cs_wholesale_cost", "cs_sales_price", "cs")
+    m = ss.merge(ws, left_on=["d_year", "ss_item_sk", "ss_customer_sk"],
+                 right_on=["d_year", "ws_item_sk",
+                           "ws_bill_customer_sk"], how="left")
+    m = m.merge(cs, left_on=["d_year", "ss_item_sk", "ss_customer_sk"],
+                right_on=["d_year", "cs_item_sk",
+                          "cs_bill_customer_sk"], how="left")
+    m = m[(m.ws_qty.fillna(0) > 0) | (m.cs_qty.fillna(0) > 0)]
+    m = m[m.d_year == 2000]
+    other_qty = m.ws_qty.fillna(0) + m.cs_qty.fillna(0)
+    out = pd.DataFrame({
+        "ss_sold_year": m.d_year, "ss_item_sk": m.ss_item_sk,
+        "ss_customer_sk": m.ss_customer_sk,
+        "ratio": (m.ss_qty / other_qty).round(2),
+        "store_qty": m.ss_qty, "store_wholesale_cost": m.ss_wc,
+        "store_sales_price": m.ss_sp, "other_chan_qty": other_qty,
+        "other_chan_wholesale_cost": m.ws_wc.fillna(0) + m.cs_wc.fillna(0),
+        "other_chan_sales_price": m.ws_sp.fillna(0) + m.cs_sp.fillna(0)})
+    return out, meta(
+        ["ss_sold_year", "ss_item_sk", "ss_customer_sk", "store_qty",
+         "store_wholesale_cost", "store_sales_price"],
+        [True, True, True, False, False, False], 100,
+        ["ratio", "store_wholesale_cost", "store_sales_price",
+         "other_chan_wholesale_cost", "other_chan_sales_price"])
+
+
+def q85(T):
+    j = T.web_sales.merge(
+        T.web_returns, left_on=["ws_item_sk", "ws_order_number"],
+        right_on=["wr_item_sk", "wr_order_number"])
+    j = j.merge(T.web_page, left_on="ws_web_page_sk",
+                right_on="wp_web_page_sk")
+    j = j.merge(T.date_dim[T.date_dim.d_year == 2000],
+                left_on="ws_sold_date_sk", right_on="d_date_sk")
+    cd1 = T.customer_demographics.add_prefix("cd1_")
+    cd2 = T.customer_demographics.add_prefix("cd2_")
+    j = j.merge(cd1, left_on="wr_refunded_cdemo_sk",
+                right_on="cd1_cd_demo_sk")
+    j = j.merge(cd2, left_on="wr_returning_cdemo_sk",
+                right_on="cd2_cd_demo_sk")
+    j = j.merge(T.customer_address, left_on="wr_refunded_addr_sk",
+                right_on="ca_address_sk")
+    j = j.merge(T.reason, left_on="wr_reason_sk", right_on="r_reason_sk")
+    same = ((j.cd1_cd_marital_status == j.cd2_cd_marital_status)
+            & (j.cd1_cd_education_status == j.cd2_cd_education_status))
+    demo = same & (
+        ((j.cd1_cd_marital_status == "M")
+         & (j.cd1_cd_education_status == "Advanced Degree")
+         & j.ws_sales_price.between(100.0, 150.0))
+        | ((j.cd1_cd_marital_status == "S")
+           & (j.cd1_cd_education_status == "College")
+           & j.ws_sales_price.between(50.0, 100.0))
+        | ((j.cd1_cd_marital_status == "W")
+           & (j.cd1_cd_education_status == "2 yr Degree")
+           & j.ws_sales_price.between(150.0, 200.0)))
+    addr = ((j.ca_country == "United States")
+            & ((j.ca_state.isin(["CA", "TX", "NY"])
+                & j.ws_net_profit.between(100, 200))
+               | (j.ca_state.isin(["WA", "OR", "TN"])
+                  & j.ws_net_profit.between(150, 300))
+               | (j.ca_state.isin(["SD", "GA", "NM"])
+                  & j.ws_net_profit.between(50, 250))))
+    j = j[demo & addr]
+    g = (j.groupby("r_reason_desc", as_index=False)
+         .agg(avg_q=("ws_quantity", "mean"),
+              avg_cash=("wr_refunded_cash", "mean"),
+              avg_fee=("wr_fee", "mean")))
+    g.insert(0, "reason_desc", g.r_reason_desc.astype(str).str[:20])
+    g = g.drop(columns="r_reason_desc")
+    return g, meta(["reason_desc", "avg_q", "avg_cash", "avg_fee"],
+                   None, 100, ["avg_q", "avg_cash", "avg_fee"])
+
+
+def q64(T):
+    cr = T.catalog_returns
+    csj = T.catalog_sales.merge(
+        cr[["cr_item_sk", "cr_order_number", "cr_refunded_cash",
+            "cr_reversed_charge", "cr_store_credit"]],
+        left_on=["cs_item_sk", "cs_order_number"],
+        right_on=["cr_item_sk", "cr_order_number"])
+    csj = csj.assign(ref=csj.cr_refunded_cash + csj.cr_reversed_charge
+                     + csj.cr_store_credit)
+    cs_ui = (csj.groupby("cs_item_sk", as_index=False)
+             .agg(sale=("cs_ext_list_price", _sum), refund=("ref", _sum)))
+    cs_ui = cs_ui[cs_ui.sale > 2 * cs_ui.refund]
+    j = T.store_sales.merge(T.store_returns[
+        ["sr_item_sk", "sr_ticket_number"]],
+        left_on=["ss_item_sk", "ss_ticket_number"],
+        right_on=["sr_item_sk", "sr_ticket_number"])
+    j = j[j.ss_item_sk.isin(set(cs_ui.cs_item_sk))]
+    j = j.merge(T.store, left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(T.customer, left_on="ss_customer_sk",
+                right_on="c_customer_sk")
+    d1 = T.date_dim.add_prefix("d1_")
+    d2 = T.date_dim.add_prefix("d2_")
+    d3 = T.date_dim.add_prefix("d3_")
+    j = j.merge(d1, left_on="ss_sold_date_sk", right_on="d1_d_date_sk")
+    j = j.merge(d2, left_on="c_first_sales_date_sk",
+                right_on="d2_d_date_sk")
+    j = j.merge(d3, left_on="c_first_shipto_date_sk",
+                right_on="d3_d_date_sk")
+    cd1 = T.customer_demographics.add_prefix("cd1_")
+    cd2 = T.customer_demographics.add_prefix("cd2_")
+    j = j.merge(cd1, left_on="ss_cdemo_sk", right_on="cd1_cd_demo_sk")
+    j = j.merge(cd2, left_on="c_current_cdemo_sk",
+                right_on="cd2_cd_demo_sk")
+    j = j[j.cd1_cd_marital_status != j.cd2_cd_marital_status]
+    hd1 = T.household_demographics.add_prefix("hd1_")
+    hd2 = T.household_demographics.add_prefix("hd2_")
+    ib1 = T.income_band.add_prefix("ib1_")
+    ib2 = T.income_band.add_prefix("ib2_")
+    j = j.merge(hd1, left_on="ss_hdemo_sk", right_on="hd1_hd_demo_sk")
+    j = j.merge(hd2, left_on="c_current_hdemo_sk",
+                right_on="hd2_hd_demo_sk")
+    j = j.merge(ib1, left_on="hd1_hd_income_band_sk",
+                right_on="ib1_ib_income_band_sk")
+    j = j.merge(ib2, left_on="hd2_hd_income_band_sk",
+                right_on="ib2_ib_income_band_sk")
+    ad1 = T.customer_address.add_prefix("ad1_")
+    ad2 = T.customer_address.add_prefix("ad2_")
+    j = j.merge(ad1, left_on="ss_addr_sk", right_on="ad1_ca_address_sk")
+    j = j.merge(ad2, left_on="c_current_addr_sk",
+                right_on="ad2_ca_address_sk")
+    j = j.merge(T.promotion, left_on="ss_promo_sk", right_on="p_promo_sk")
+    j = j.merge(T.item, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j[j.i_color.isin(["powder", "orchid", "slate", "peach", "smoke",
+                          "sienna"])
+          & j.i_current_price.between(40, 70)]
+    keys = ["i_product_name", "i_item_sk", "s_store_name", "s_zip",
+            "ad1_ca_street_number", "ad1_ca_street_name", "ad1_ca_city",
+            "ad1_ca_zip", "ad2_ca_street_number", "ad2_ca_street_name",
+            "ad2_ca_city", "ad2_ca_zip", "d1_d_year", "d2_d_year",
+            "d3_d_year"]
+    cs = (j.groupby(keys, dropna=False, as_index=False)
+          .agg(cnt=("ss_wholesale_cost", "size"),
+               s1=("ss_wholesale_cost", _sum),
+               s2=("ss_list_price", _sum), s3=("ss_coupon_amt", _sum)))
+    y1 = cs[cs.d1_d_year == 1999]
+    y2 = cs[cs.d1_d_year == 2000]
+    m = y1.merge(y2, on=["i_item_sk", "s_store_name", "s_zip"],
+                 suffixes=("_1", "_2"))
+    m = m[m.cnt_2 <= m.cnt_1]
+    out = pd.DataFrame({
+        "product_name": m.i_product_name_1, "store_name": m.s_store_name,
+        "store_zip": m.s_zip, "b_street_number": m.ad1_ca_street_number_1,
+        "b_street_name": m.ad1_ca_street_name_1, "b_city": m.ad1_ca_city_1,
+        "b_zip": m.ad1_ca_zip_1, "c_street_number":
+        m.ad2_ca_street_number_1, "c_street_name": m.ad2_ca_street_name_1,
+        "c_city": m.ad2_ca_city_1, "c_zip": m.ad2_ca_zip_1,
+        "cs1syear": m.d1_d_year_1, "cs1cnt": m.cnt_1, "s11": m.s1_1,
+        "s21": m.s2_1, "s31": m.s3_1, "s12": m.s1_2, "s22": m.s2_2,
+        "s32": m.s3_2, "syear": m.d1_d_year_2, "cnt": m.cnt_2})
+    return out, meta(["product_name", "store_name", "cnt", "s11", "s12"],
+                     None, None, ["s11", "s21", "s31", "s12", "s22",
+                                  "s32"])
