@@ -43,6 +43,8 @@ HOST_VECTOR_BPS = 2.0e9     # elementwise eval / filter, per byte touched
 HOST_AGG_BPS = 3.0e8        # hash/grouped aggregation, per byte touched
 HOST_SORT_ROWS_PER_S = 12.0e6   # multi-key argsort, rows/s
 HOST_JOIN_ROWS_PER_S = 25.0e6   # hash join build+probe, rows/s
+HOST_PIL_BPS = 85e6             # per-image PIL resize, input bytes/s
+#                                 (measured: 64x64 RGB -> 32x32, 1 core)
 
 # device-side terms: without these a zero-cost link (CPU backend, local
 # HBM) degenerates to "device always wins" no matter how slow the kernel
@@ -321,6 +323,25 @@ def row_output_op_wins(bytes_up: float, bytes_down: float,
     dev_s = link_profile().device_seconds(
         bytes_up, bytes_down, round_trips, kernel_s)
     _log("row_output", dev_s < host_s, host_s, dev_s,
+         bytes_up=bytes_up, bytes_down=bytes_down)
+    return dev_s < host_s
+
+
+def image_resize_wins(bytes_up: float, bytes_down: float) -> bool:
+    """Batched device image resize vs per-image PIL. The host alternative
+    is PIL's scalar loop (~85 MB/s single-core), far slower than a SIMD
+    vector pass — so on a local chip the batch wins by orders of
+    magnitude, while on a slow tunnel the transfer dominates and PIL
+    keeps the work (r4: the ungated device path shipped 50 MB per batch
+    over a ~10 MB/s tunnel, 6× slower than host end to end)."""
+    f = _forced()
+    if f is not None:
+        return f
+    host_s = bytes_up / HOST_PIL_BPS
+    kernel_s = DEV_DISPATCH_S + (bytes_up + bytes_down) / DEV_VECTOR_BPS
+    dev_s = link_profile().device_seconds(bytes_up, bytes_down, 2.0,
+                                          kernel_s)
+    _log("image_resize", dev_s < host_s, host_s, dev_s,
          bytes_up=bytes_up, bytes_down=bytes_down)
     return dev_s < host_s
 

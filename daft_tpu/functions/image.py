@@ -79,6 +79,11 @@ def _device_batch_resize(imgs, w: int, h: int):
     stack = np.stack(arrs)
     if len(shape) == 2:
         stack = stack[..., None]
+    from ..device import costmodel
+    ch = stack.shape[-1] if len(stack.shape) == 4 else 1
+    if not costmodel.image_resize_wins(
+            stack.nbytes, len(real) * h * w * ch * stack.dtype.itemsize):
+        return None
     import jax
     import jax.numpy as jnp
     if dtype.kind in "ui":

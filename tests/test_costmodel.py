@@ -138,3 +138,20 @@ def test_mfu_report_shape():
     assert r["argsort"]["bytes"] > 0 and r["argsort"]["time_s"] > 0
     assert {"roofline_pct", "time_s", "achieved_gbps"} <= set(r["join"])
     assert r["grouped_agg"]["flops"] == 2.0 * (1 << 12) * 256 * 3
+
+
+def test_image_resize_gate(slow_link, monkeypatch):
+    # 50MB batch over a 10MB/s tunnel (~5s) vs PIL (~0.6s): host keeps it
+    assert not cm.image_resize_wins(50e6, 12.5e6)
+
+
+def test_image_resize_gate_local_chip(monkeypatch):
+    # shared-memory link: the batched device resize wins by orders of mag
+    monkeypatch.setenv("DAFT_TPU_LINK_RTT_MS", "0.01")
+    monkeypatch.setenv("DAFT_TPU_LINK_UP_MBPS", "50000")
+    monkeypatch.setenv("DAFT_TPU_LINK_DOWN_MBPS", "50000")
+    cm.reset_for_tests()
+    try:
+        assert cm.image_resize_wins(50e6, 12.5e6)
+    finally:
+        cm.reset_for_tests()
