@@ -36,10 +36,63 @@ _VERSION = "3.5.1+daft-tpu"
 _BATCH_ROWS = 1 << 16
 
 
+class _Operation:
+    """Lifecycle record for one ExecutePlan.
+
+    Responses are buffered ONLY for reattachable executions (the client
+    opted in via ``ReattachOptions`` — Spark's own rule; buffering every
+    plain execute would pin each query's whole result in session RAM),
+    retained until the client RELEASES them, so a dropped connection can
+    REATTACH and resume from its last response id. INTERRUPT flips the
+    cancel flag, honored between streamed batches (a batch mid-kernel
+    finishes). A failure is recorded as (code, message) and re-raised to
+    reattaching clients — a truncated replay that ends cleanly would read
+    as a complete result."""
+
+    def __init__(self, op_id: str, tags, reattachable: bool):
+        self.op_id = op_id
+        self.tags = set(tags or ())
+        self.reattachable = reattachable
+        self.cancel = threading.Event()
+        self.done = threading.Event()
+        self.cond = threading.Condition()
+        self.buffer: list = []          # ExecutePlanResponse, in order
+        self.released_upto = 0          # buffer index already released
+        self.error = None               # (grpc code, message) on failure
+
+    def record(self, r) -> None:
+        if not self.reattachable:
+            return
+        with self.cond:
+            self.buffer.append(r)
+            self.cond.notify_all()
+
+    def finish(self, error=None) -> None:
+        with self.cond:
+            if error is not None and self.error is None:
+                self.error = error
+            self.done.set()
+            self.cond.notify_all()
+
+    def after(self, last_response_id: Optional[str]):
+        """Buffered responses after the given response id (all when None),
+        respecting released prefixes."""
+        with self.cond:
+            start = self.released_upto
+            if last_response_id:
+                for i in range(len(self.buffer) - 1, -1, -1):
+                    if self.buffer[i].response_id == last_response_id:
+                        start = max(start, i + 1)
+                        break
+            return list(self.buffer[start:]), len(self.buffer)
+
+
 class _SessionState:
     def __init__(self):
         self.config: Dict[str, str] = {}
         self.views: Dict[str, object] = {}
+        self.artifacts: Dict[str, bytes] = {}
+        self.operations: Dict[str, _Operation] = {}
         self.server_side_id = uuid.uuid4().hex
 
     @property
@@ -72,6 +125,25 @@ class SparkConnectServer:
                 self._config,
                 request_deserializer=pb.ConfigRequest.FromString,
                 response_serializer=pb.ConfigResponse.SerializeToString),
+            "AddArtifacts": grpc.stream_unary_rpc_method_handler(
+                self._add_artifacts,
+                request_deserializer=pb.AddArtifactsRequest.FromString,
+                response_serializer=(
+                    pb.AddArtifactsResponse.SerializeToString)),
+            "Interrupt": grpc.unary_unary_rpc_method_handler(
+                self._interrupt,
+                request_deserializer=pb.InterruptRequest.FromString,
+                response_serializer=pb.InterruptResponse.SerializeToString),
+            "ReattachExecute": grpc.unary_stream_rpc_method_handler(
+                self._reattach_execute,
+                request_deserializer=pb.ReattachExecuteRequest.FromString,
+                response_serializer=(
+                    pb.ExecutePlanResponse.SerializeToString)),
+            "ReleaseExecute": grpc.unary_unary_rpc_method_handler(
+                self._release_execute,
+                request_deserializer=pb.ReleaseExecuteRequest.FromString,
+                response_serializer=(
+                    pb.ReleaseExecuteResponse.SerializeToString)),
         }
         self._server = grpc.server(
             cf.ThreadPoolExecutor(max_workers=max_workers,
@@ -110,6 +182,13 @@ class SparkConnectServer:
                       ) -> Iterator[pb.ExecutePlanResponse]:
         st = self._session(request.session_id)
         op_id = request.operation_id or str(uuid.uuid4())
+        reattachable = any(
+            o.WhichOneof("request_option") == "reattach_options"
+            and o.reattach_options.reattachable
+            for o in request.request_options)
+        op = _Operation(op_id, request.tags, reattachable)
+        with self._lock:
+            st.operations[op_id] = op
 
         def resp() -> pb.ExecutePlanResponse:
             r = pb.ExecutePlanResponse()
@@ -119,20 +198,177 @@ class SparkConnectServer:
             r.response_id = str(uuid.uuid4())
             return r
 
+        aborting = False
         try:
             which = request.plan.WhichOneof("op_type")
             if which == "command":
-                yield from self._execute_command(request.plan.command, st,
-                                                 resp)
+                gen = self._execute_command(request.plan.command, st, resp)
             else:
                 df = st.analyzer.plan_to_df(request.plan)
-                yield from self._stream_df(df, resp)
+                gen = self._stream_df(df, resp)
+            for r in gen:
+                if op.cancel.is_set():
+                    op.finish(error=(self._grpc.StatusCode.CANCELLED,
+                                     f"operation {op_id} interrupted"))
+                    aborting = True
+                    context.abort(self._grpc.StatusCode.CANCELLED,
+                                  f"operation {op_id} interrupted")
+                op.record(r)
+                yield r
+            done = resp()
+            done.result_complete.SetInParent()
+            op.record(done)
+            op.finish()
+            yield done
         except Exception as exc:  # noqa: BLE001 - surfaced via grpc status
+            if aborting:  # context.abort's unwind exception — re-raise
+                raise
+            op.finish(error=(self._grpc.StatusCode.INTERNAL,
+                             f"{type(exc).__name__}: {exc}"))
             self._abort(context, exc)
-            return
-        done = resp()
-        done.result_complete.SetInParent()
-        yield done
+        finally:
+            # covers GeneratorExit (client disconnected mid-stream): a
+            # reattacher must never wait on an operation whose producer is
+            # gone, and a truncated buffer must not replay as a clean
+            # result — record an explicit status
+            if not op.done.is_set():
+                op.finish(error=(
+                    self._grpc.StatusCode.UNAVAILABLE,
+                    f"operation {op_id}'s producer disconnected before "
+                    f"completion"))
+            if not reattachable:
+                with self._lock:
+                    st.operations.pop(op_id, None)
+
+    # ------------------------------------------- operation-lifecycle RPCs
+    def _interrupt(self, request: pb.InterruptRequest, context
+                   ) -> pb.InterruptResponse:
+        st = self._session(request.session_id)
+        out = pb.InterruptResponse()
+        out.session_id = request.session_id
+        out.server_side_session_id = st.server_side_id
+        T = pb.InterruptRequest.InterruptType
+        with self._lock:
+            ops = list(st.operations.values())
+        for op in ops:
+            if op.done.is_set():
+                continue
+            hit = (request.interrupt_type == T.INTERRUPT_TYPE_ALL
+                   or (request.interrupt_type
+                       == T.INTERRUPT_TYPE_OPERATION_ID
+                       and op.op_id == request.operation_id)
+                   or (request.interrupt_type == T.INTERRUPT_TYPE_TAG
+                       and request.operation_tag in op.tags))
+            if hit:
+                op.cancel.set()
+                out.interrupted_ids.append(op.op_id)
+        return out
+
+    def _reattach_execute(self, request: pb.ReattachExecuteRequest, context
+                          ) -> Iterator[pb.ExecutePlanResponse]:
+        st = self._session(request.session_id)
+        with self._lock:
+            op = st.operations.get(request.operation_id)
+        if op is None:
+            context.abort(
+                self._grpc.StatusCode.NOT_FOUND,
+                f"operation {request.operation_id!r} not found "
+                f"(never started, not reattachable, or released)")
+        if not op.reattachable:
+            context.abort(
+                self._grpc.StatusCode.INVALID_ARGUMENT,
+                f"operation {request.operation_id!r} was not started with "
+                f"ReattachOptions.reattachable")
+        pending, seen = op.after(request.last_response_id or None)
+        yield from pending
+        # still running: follow the buffer via the producer's condition
+        # variable (never holding it across a yield — a slow client must
+        # not block Release/Interrupt on this operation)
+        while True:
+            with op.cond:
+                op.cond.wait_for(
+                    lambda: op.done.is_set() or len(op.buffer) > seen)
+                fresh = list(op.buffer[seen:])
+                seen = len(op.buffer)
+                finished = op.done.is_set()
+            yield from fresh
+            if finished and seen >= len(op.buffer):
+                break
+        if op.error is not None:
+            context.abort(op.error[0], op.error[1])
+
+    def _release_execute(self, request: pb.ReleaseExecuteRequest, context
+                         ) -> pb.ReleaseExecuteResponse:
+        st = self._session(request.session_id)
+        out = pb.ReleaseExecuteResponse()
+        out.session_id = request.session_id
+        out.server_side_session_id = st.server_side_id
+        out.operation_id = request.operation_id
+        with self._lock:
+            op = st.operations.get(request.operation_id)
+        if op is None:
+            return out  # releasing an unknown/already-released op is a no-op
+        if request.WhichOneof("release") == "release_until":
+            rid = request.release_until.response_id
+            with op.cond:
+                for i, r in enumerate(op.buffer):
+                    if r.response_id == rid:
+                        op.released_upto = max(op.released_upto, i + 1)
+                        break
+        else:  # release_all (and unset, which clients treat the same)
+            with self._lock:
+                st.operations.pop(request.operation_id, None)
+        return out
+
+    def _add_artifacts(self, request_iterator, context
+                       ) -> pb.AddArtifactsResponse:
+        import zlib
+        out = pb.AddArtifactsResponse()
+        cur_name: Optional[str] = None
+        cur_parts: list = []
+        cur_ok = True
+        st = None
+
+        def finish_chunked():
+            nonlocal cur_name, cur_parts, cur_ok
+            if cur_name is None:
+                return
+            if cur_ok:  # corrupt uploads are reported, never stored
+                st.artifacts[cur_name] = b"".join(cur_parts)
+            s = out.artifacts.add()
+            s.name = cur_name
+            s.is_crc_successful = cur_ok
+            cur_name, cur_parts, cur_ok = None, [], True
+
+        for req in request_iterator:
+            if st is None:
+                st = self._session(req.session_id)
+                out.session_id = req.session_id
+                out.server_side_session_id = st.server_side_id
+            which = req.WhichOneof("payload")
+            if which == "batch":
+                finish_chunked()
+                for a in req.batch.artifacts:
+                    ok = zlib.crc32(a.data.data) == a.data.crc
+                    if ok:  # corrupt uploads are reported, never stored
+                        st.artifacts[a.name] = a.data.data
+                    s = out.artifacts.add()
+                    s.name = a.name
+                    s.is_crc_successful = ok
+            elif which == "begin_chunk":
+                finish_chunked()
+                b = req.begin_chunk
+                cur_name = b.name
+                cur_parts = [b.initial_chunk.data]
+                cur_ok = zlib.crc32(b.initial_chunk.data) \
+                    == b.initial_chunk.crc
+            elif which == "chunk" and cur_name is not None:
+                cur_parts.append(req.chunk.data)
+                cur_ok = cur_ok and zlib.crc32(req.chunk.data) \
+                    == req.chunk.crc
+        if st is not None:
+            finish_chunked()
+        return out
 
     def _stream_df(self, df, resp) -> Iterator[pb.ExecutePlanResponse]:
         table = df.to_arrow()

@@ -277,3 +277,184 @@ def test_write_parquet_roundtrip(channel, tmp_path):
     back = _execute(channel, pb.Relation(read=pb.Read(
         data_source=pb.Read.DataSource(format="parquet", paths=[out]))))
     assert sorted(back.column("id").to_pylist()) == [0, 1, 2, 3, 4, 5]
+
+
+# ------------------------------------------- operation-lifecycle RPCs (r5)
+
+def _lifecycle_stubs(channel):
+    return {
+        "execute": channel.unary_stream(
+            SERVICE + "ExecutePlan",
+            request_serializer=pb.ExecutePlanRequest.SerializeToString,
+            response_deserializer=pb.ExecutePlanResponse.FromString),
+        "reattach": channel.unary_stream(
+            SERVICE + "ReattachExecute",
+            request_serializer=pb.ReattachExecuteRequest.SerializeToString,
+            response_deserializer=pb.ExecutePlanResponse.FromString),
+        "release": channel.unary_unary(
+            SERVICE + "ReleaseExecute",
+            request_serializer=pb.ReleaseExecuteRequest.SerializeToString,
+            response_deserializer=pb.ReleaseExecuteResponse.FromString),
+        "interrupt": channel.unary_unary(
+            SERVICE + "Interrupt",
+            request_serializer=pb.InterruptRequest.SerializeToString,
+            response_deserializer=pb.InterruptResponse.FromString),
+        "artifacts": channel.stream_unary(
+            SERVICE + "AddArtifacts",
+            request_serializer=pb.AddArtifactsRequest.SerializeToString,
+            response_deserializer=pb.AddArtifactsResponse.FromString),
+    }
+
+
+def _reattachable_req(session, op_id, rel):
+    req = pb.ExecutePlanRequest(session_id=session, operation_id=op_id,
+                                plan=pb.Plan(root=rel))
+    req.request_options.add().reattach_options.reattachable = True
+    return req
+
+
+def test_reattach_replays_buffered_responses(channel):
+    """A client that lost its connection reattaches by operation_id and
+    receives the buffered stream again — same rows, same terminal
+    result_complete."""
+    stubs = _lifecycle_stubs(channel)
+    rel = pb.Relation(range=pb.Range(start=0, end=50, step=1))
+    req = _reattachable_req("life-1", "op-re-1", rel)
+    first = list(stubs["execute"](req))
+    assert first[-1].WhichOneof("response_type") == "result_complete"
+    replay = list(stubs["reattach"](pb.ReattachExecuteRequest(
+        session_id="life-1", operation_id="op-re-1")))
+    assert [r.response_id for r in replay] == \
+        [r.response_id for r in first]
+    # resuming mid-stream: last_response_id skips what was delivered
+    tail = list(stubs["reattach"](pb.ReattachExecuteRequest(
+        session_id="life-1", operation_id="op-re-1",
+        last_response_id=first[0].response_id)))
+    assert [r.response_id for r in tail] == \
+        [r.response_id for r in first[1:]]
+
+
+def test_release_execute_frees_operation(channel):
+    stubs = _lifecycle_stubs(channel)
+    rel = pb.Relation(range=pb.Range(start=0, end=10, step=1))
+    req = _reattachable_req("life-2", "op-rel-1", rel)
+    list(stubs["execute"](req))
+    out = stubs["release"](pb.ReleaseExecuteRequest(
+        session_id="life-2", operation_id="op-rel-1",
+        release_all=pb.ReleaseExecuteRequest.ReleaseAll()))
+    assert out.operation_id == "op-rel-1"
+    with pytest.raises(grpc.RpcError) as err:
+        list(stubs["reattach"](pb.ReattachExecuteRequest(
+            session_id="life-2", operation_id="op-rel-1")))
+    assert err.value.code() == grpc.StatusCode.NOT_FOUND
+    # releasing again is a no-op, not an error
+    stubs["release"](pb.ReleaseExecuteRequest(
+        session_id="life-2", operation_id="op-rel-1",
+        release_all=pb.ReleaseExecuteRequest.ReleaseAll()))
+
+
+def test_release_until_trims_replay(channel):
+    stubs = _lifecycle_stubs(channel)
+    rel = pb.Relation(range=pb.Range(start=0, end=10, step=1))
+    req = _reattachable_req("life-3", "op-ru-1", rel)
+    first = list(stubs["execute"](req))
+    stubs["release"](pb.ReleaseExecuteRequest(
+        session_id="life-3", operation_id="op-ru-1",
+        release_until=pb.ReleaseExecuteRequest.ReleaseUntil(
+            response_id=first[0].response_id)))
+    replay = list(stubs["reattach"](pb.ReattachExecuteRequest(
+        session_id="life-3", operation_id="op-ru-1")))
+    assert [r.response_id for r in replay] == \
+        [r.response_id for r in first[1:]]
+
+
+def test_interrupt_completed_and_unknown_ops(channel):
+    stubs = _lifecycle_stubs(channel)
+    rel = pb.Relation(range=pb.Range(start=0, end=10, step=1))
+    req = pb.ExecutePlanRequest(session_id="life-4",
+                                operation_id="op-int-1",
+                                plan=pb.Plan(root=rel))
+    list(stubs["execute"](req))
+    T = pb.InterruptRequest.InterruptType
+    # a finished operation is not interruptible — empty id list
+    out = stubs["interrupt"](pb.InterruptRequest(
+        session_id="life-4", interrupt_type=T.INTERRUPT_TYPE_OPERATION_ID,
+        operation_id="op-int-1"))
+    assert list(out.interrupted_ids) == []
+    # unknown id: same, no error
+    out = stubs["interrupt"](pb.InterruptRequest(
+        session_id="life-4", interrupt_type=T.INTERRUPT_TYPE_OPERATION_ID,
+        operation_id="nope"))
+    assert list(out.interrupted_ids) == []
+
+
+def test_add_artifacts_batch_and_chunked(channel):
+    import zlib
+    stubs = _lifecycle_stubs(channel)
+    blob = b"x" * 100
+
+    def reqs():
+        a = pb.AddArtifactsRequest(session_id="life-5")
+        art = a.batch.artifacts.add()
+        art.name = "files/a.txt"
+        art.data.data = blob
+        art.data.crc = zlib.crc32(blob)
+        bad = a.batch.artifacts.add()
+        bad.name = "files/bad.txt"
+        bad.data.data = blob
+        bad.data.crc = 1  # wrong on purpose
+        yield a
+        b = pb.AddArtifactsRequest(session_id="life-5")
+        b.begin_chunk.name = "jars/big.jar"
+        b.begin_chunk.num_chunks = 2
+        b.begin_chunk.total_bytes = 200
+        b.begin_chunk.initial_chunk.data = blob
+        b.begin_chunk.initial_chunk.crc = zlib.crc32(blob)
+        yield b
+        c = pb.AddArtifactsRequest(session_id="life-5")
+        c.chunk.data = blob
+        c.chunk.crc = zlib.crc32(blob)
+        yield c
+
+    out = stubs["artifacts"](reqs())
+    got = {s.name: s.is_crc_successful for s in out.artifacts}
+    assert got == {"files/a.txt": True, "files/bad.txt": False,
+                   "jars/big.jar": True}
+
+
+def test_plain_execute_is_not_buffered(channel):
+    """Without ReattachOptions the server must NOT retain the result
+    stream (that would pin every query's bytes in session RAM): a later
+    reattach finds nothing."""
+    stubs = _lifecycle_stubs(channel)
+    rel = pb.Relation(range=pb.Range(start=0, end=10, step=1))
+    req = pb.ExecutePlanRequest(session_id="life-6",
+                                operation_id="op-plain-1",
+                                plan=pb.Plan(root=rel))
+    list(stubs["execute"](req))
+    with pytest.raises(grpc.RpcError) as err:
+        list(stubs["reattach"](pb.ReattachExecuteRequest(
+            session_id="life-6", operation_id="op-plain-1")))
+    assert err.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_interrupt_running_operation_cancels_stream(channel):
+    """Interrupting a RUNNING execute must surface CANCELLED to the
+    consuming client (not INTERNAL), honored between streamed batches."""
+    import threading as th
+    stubs = _lifecycle_stubs(channel)
+    rel = pb.Relation(range=pb.Range(start=0, end=3_000_000, step=1))
+    req = _reattachable_req("life-7", "op-int-run", rel)
+    it = stubs["execute"](req)
+    first = next(it)
+    assert first.operation_id == "op-int-run"
+    T = pb.InterruptRequest.InterruptType
+    out = stubs["interrupt"](pb.InterruptRequest(
+        session_id="life-7",
+        interrupt_type=T.INTERRUPT_TYPE_OPERATION_ID,
+        operation_id="op-int-run"))
+    assert list(out.interrupted_ids) == ["op-int-run"]
+    with pytest.raises(grpc.RpcError) as err:
+        for _ in it:
+            pass
+    assert err.value.code() == grpc.StatusCode.CANCELLED
