@@ -57,7 +57,10 @@ class _Operation:
         self.done = threading.Event()
         self.cond = threading.Condition()
         self.buffer: list = []          # ExecutePlanResponse, in order
-        self.released_upto = 0          # buffer index already released
+        self.base = 0                   # absolute index of buffer[0] —
+        #                                 released prefixes are DELETED
+        #                                 (a release must actually free
+        #                                 the acknowledged bytes)
         self.error = None               # (grpc code, message) on failure
 
     def record(self, r) -> None:
@@ -74,17 +77,29 @@ class _Operation:
             self.done.set()
             self.cond.notify_all()
 
+    def total(self) -> int:
+        """Absolute count of responses produced so far."""
+        return self.base + len(self.buffer)
+
     def after(self, last_response_id: Optional[str]):
-        """Buffered responses after the given response id (all when None),
-        respecting released prefixes."""
+        """(responses after the given id — all retained when None, the
+        released prefix is gone), absolute high-water mark."""
         with self.cond:
-            start = self.released_upto
+            start = self.base
             if last_response_id:
                 for i in range(len(self.buffer) - 1, -1, -1):
                     if self.buffer[i].response_id == last_response_id:
-                        start = max(start, i + 1)
+                        start = self.base + i + 1
                         break
-            return list(self.buffer[start:]), len(self.buffer)
+            return list(self.buffer[start - self.base:]), self.total()
+
+    def release_until(self, response_id: str) -> None:
+        with self.cond:
+            for i, r in enumerate(self.buffer):
+                if r.response_id == response_id:
+                    del self.buffer[:i + 1]
+                    self.base += i + 1
+                    break
 
 
 class _SessionState:
@@ -283,16 +298,17 @@ class SparkConnectServer:
         yield from pending
         # still running: follow the buffer via the producer's condition
         # variable (never holding it across a yield — a slow client must
-        # not block Release/Interrupt on this operation)
+        # not block Release/Interrupt on this operation). ``seen`` is the
+        # ABSOLUTE high-water mark; released prefixes shift op.base.
         while True:
             with op.cond:
                 op.cond.wait_for(
-                    lambda: op.done.is_set() or len(op.buffer) > seen)
-                fresh = list(op.buffer[seen:])
-                seen = len(op.buffer)
+                    lambda: op.done.is_set() or op.total() > seen)
+                fresh = list(op.buffer[max(0, seen - op.base):])
+                seen = op.total()
                 finished = op.done.is_set()
             yield from fresh
-            if finished and seen >= len(op.buffer):
+            if finished and seen >= op.total():
                 break
         if op.error is not None:
             context.abort(op.error[0], op.error[1])
@@ -309,12 +325,7 @@ class SparkConnectServer:
         if op is None:
             return out  # releasing an unknown/already-released op is a no-op
         if request.WhichOneof("release") == "release_until":
-            rid = request.release_until.response_id
-            with op.cond:
-                for i, r in enumerate(op.buffer):
-                    if r.response_id == rid:
-                        op.released_upto = max(op.released_upto, i + 1)
-                        break
+            op.release_until(request.release_until.response_id)
         else:  # release_all (and unset, which clients treat the same)
             with self._lock:
                 st.operations.pop(request.operation_id, None)
@@ -327,17 +338,24 @@ class SparkConnectServer:
         cur_name: Optional[str] = None
         cur_parts: list = []
         cur_ok = True
+        cur_expect = (0, 0)  # (num_chunks, total_bytes) promised by begin
         st = None
 
         def finish_chunked():
             nonlocal cur_name, cur_parts, cur_ok
             if cur_name is None:
                 return
-            if cur_ok:  # corrupt uploads are reported, never stored
-                st.artifacts[cur_name] = b"".join(cur_parts)
+            data = b"".join(cur_parts)
+            # a truncated upload (client died mid-stream) must not be
+            # stored as clean: the begin message promised the shape
+            complete = (len(cur_parts) == cur_expect[0]
+                        and len(data) == cur_expect[1])
+            ok = cur_ok and complete
+            if ok:  # corrupt/incomplete uploads are reported, never stored
+                st.artifacts[cur_name] = data
             s = out.artifacts.add()
             s.name = cur_name
-            s.is_crc_successful = cur_ok
+            s.is_crc_successful = ok
             cur_name, cur_parts, cur_ok = None, [], True
 
         for req in request_iterator:
@@ -360,6 +378,7 @@ class SparkConnectServer:
                 b = req.begin_chunk
                 cur_name = b.name
                 cur_parts = [b.initial_chunk.data]
+                cur_expect = (b.num_chunks, b.total_bytes)
                 cur_ok = zlib.crc32(b.initial_chunk.data) \
                     == b.initial_chunk.crc
             elif which == "chunk" and cur_name is not None:

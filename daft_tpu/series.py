@@ -227,9 +227,16 @@ class Series:
                 for s in series_list])
             return cls(first._name, DataType.python(), pyobjs=objs)
         arrays = [s.to_arrow() for s in series_list]
-        t = first._dtype.to_arrow()
+        # a NULL-typed piece (all-null batch) must never drive the target
+        # type — casting null→anything is free, anything→null impossible
+        tgt = first
+        if first._dtype.is_null():
+            tgt = next((s for s in series_list if not s._dtype.is_null()),
+                       first)
+        t = tgt._dtype.to_arrow()
         arrays = [a if a.type == t else a.cast(t) for a in arrays]
-        return cls(first._name, first._dtype, arrow=_combine(pa.chunked_array(arrays)))
+        return cls(first._name, tgt._dtype,
+                   arrow=_combine(pa.chunked_array(arrays)))
 
     # ---- null handling ---------------------------------------------------
     def is_null(self) -> "Series":

@@ -555,10 +555,26 @@ class SQLPlanner:
                 # the delimiter, so exactly one trailing bare ident inside
                 # the recorded span is the AS-less output name
                 alias = self._next().text
+            if alias is None and e.op in ("col", "outer_col") \
+                    and end - start == 3 \
+                    and self.toks[start + 1].text == "." \
+                    and e.params[0] != self.toks[end - 1].text:
+                # SQL names an unaliased qualified reference by its BARE
+                # column name (``SELECT t.customer_id`` → customer_id) —
+                # self-join collision renames must not leak internal
+                # ``right.x`` names into the output schema
+                alias = self.toks[end - 1].text
             if alias is not None:
                 e = e.alias(alias)
             exprs.append(e)
         self.i = save
+        # ORDER BY <integer> is a SELECT-list ordinal (SQL standard), not
+        # a constant sort key (which would be a silent no-op sort)
+        for j, o in enumerate(order_by):
+            u = o._unalias()
+            if u.op == "lit" and type(u.params[0]) is int \
+                    and 1 <= u.params[0] <= len(exprs):
+                order_by[j] = col(exprs[u.params[0] - 1].name())
 
         # assemble plan ----------------------------------------------------
         from ..logical import subquery as subq
@@ -1114,7 +1130,7 @@ class SQLPlanner:
                 lo = [col(scope.resolve(c)) for c in cols_u]
                 ro = [col(right_scope.resolve(c)) for c in cols_u]
                 df = self._merge_join(df, rdf, scope, right_scope, how, lo,
-                                      ro, None, rename)
+                                      ro, None, rename, using=True)
                 continue
             self._expect("ON")
             cond = self._expr_joined(scope, right_scope)
@@ -1152,7 +1168,7 @@ class SQLPlanner:
         return rdf, rename
 
     def _merge_join(self, df, rdf, scope: Scope, right_scope: Scope, how,
-                    lo, ro, residual, rename=None):
+                    lo, ro, residual, rename=None, using=False):
         """Join pre-renamed sides (see ``_rename_collisions``); the scope
         maps SQL names to the renamed actuals. Same-SQL-named equi keys
         resolve to the left copy (SQL's merged-key behavior)."""
@@ -1176,6 +1192,14 @@ class SQLPlanner:
                 out = self._theta_outer_join(df, rdf, lo, ro, residual,
                                              how)
                 residual = None
+        if out is None and how == "outer" and not using:
+            # the DataFrame tier follows the reference and COALESCES outer
+            # join keys; SQL's ON-join semantics keep both sides (a
+            # right-only row has NULL left keys — TPC-DS Q97's channel
+            # buckets depend on it), so SQL full-outer ON-joins take the
+            # row-identity lowering. USING keeps the coalesce — that IS
+            # its required semantics (COALESCE(l.k, r.k) as one column).
+            out = self._theta_outer_join(df, rdf, lo, ro, None, how)
         theta = out is not None
         if theta:
             pass
@@ -1225,7 +1249,8 @@ class SQLPlanner:
             inner = tl.join(tr, left_on=lo, right_on=ro, how="inner")
         else:
             inner = tl.join(tr, how="cross")
-        inner = inner.where(residual)
+        if residual is not None:
+            inner = inner.where(residual)
         lsch, rsch = df.schema(), rdf.schema()
         both = [col(c) for c in left_cols + right_cols]
         pieces = [inner.select(*both)]

@@ -341,6 +341,17 @@ def test_correlated_agg_group_by_guard_only_referenced_keys():
     assert out == {"name": ["only"]}
 
 
+def test_full_outer_join_keeps_both_key_sides(theta):
+    """SQL ON-join semantics: a right-only row has NULL LEFT keys (the
+    DataFrame tier coalesces outer keys like the reference — SQL must
+    not). TPC-DS Q97's channel buckets depend on this."""
+    out = dt.sql(
+        "SELECT t1.a AS la, t2.b AS rb FROM t1 FULL OUTER JOIN t2 "
+        "ON a = b ORDER BY rb", **theta).to_pydict()
+    assert out["rb"] == [1, 2, 3, 5, None]
+    assert out["la"] == [1, 2, 3, None, 4]
+
+
 def test_full_outer_join_residual_both_sides(theta):
     out = dt.sql(
         "SELECT a, x, y FROM t1 FULL OUTER JOIN t2 ON a = b AND x > y "
@@ -383,3 +394,15 @@ def test_tpch_subquery_sql_matches_dataframe(tpch, qname):
                 assert a == pytest.approx(b, rel=1e-9)
             else:
                 assert a == b
+
+
+def test_full_outer_using_coalesces_key(theta):
+    """USING's contract is the opposite of ON's: one merged key column,
+    COALESCE(l.k, r.k) — right-only rows show the right value."""
+    t1 = dt.from_pydict({"k": [1, 2, 3], "x": [10, 20, 30]})
+    t2 = dt.from_pydict({"k": [2, 3, 4], "y": [200, 300, 400]})
+    out = dt.sql("SELECT k, x, y FROM t1 FULL OUTER JOIN t2 USING (k) "
+                 "ORDER BY k", t1=t1, t2=t2).to_pydict()
+    assert out["k"] == [1, 2, 3, 4]
+    assert out["x"] == [10, 20, 30, None]
+    assert out["y"] == [None, 200, 300, 400]

@@ -381,8 +381,11 @@ def q90(T):
     j = j[(j.hd_dep_count == 6) & j.wp_char_count.between(5000, 5200)]
     amc = len(j[j.t_hour.between(8, 9)])
     pmc = len(j[j.t_hour.between(19, 20)])
-    out = pd.DataFrame(
-        {"am_pm_ratio": [float(amc) / float(pmc) if pmc else None]})
+    # float division by a zero count is +inf in the engine (IEEE), not an
+    # error — match it so sparse datagen scales stay comparable
+    ratio = float(amc) / float(pmc) if pmc else \
+        (float("inf") if amc else None)
+    out = pd.DataFrame({"am_pm_ratio": [ratio]})
     return out, meta([], None, 100, ["am_pm_ratio"])
 
 
@@ -784,6 +787,10 @@ def q81(T):
     ctr["avg_r"] = ctr.groupby("ca_state")[
         "ctr_total_return"].transform("mean")
     ctr = ctr[ctr.ctr_total_return > ctr.avg_r * 1.2]
+    # drop ctr's grouping state before merging: the OUTPUT address
+    # columns come from the customer's current address, and a colliding
+    # ca_state would suffix both away
+    ctr = ctr.drop(columns="ca_state")
     ca = T.customer_address[T.customer_address.ca_state == "CA"]
     cu = T.customer.merge(ca, left_on="c_current_addr_sk",
                           right_on="ca_address_sk")
@@ -1132,15 +1139,24 @@ def q2(T):
                   ["sales_price"].apply(_sum))
     wk = pd.DataFrame(piv)
     dd = T.date_dim
-    y = wk.loc[wk.index.isin(set(dd[dd.d_year == 1999].d_week_seq))]
-    z = wk.loc[wk.index.isin(set(dd[dd.d_year == 2000].d_week_seq))]
-    z = z.copy()
+    # the SQL joins wswscs × date_dim ON week_seq alone, so each week row
+    # duplicates once per calendar DAY of that week inside the year — the
+    # faithful oracle carries that multiplicity (m1 × m2 per week pair)
+    m1 = dd[dd.d_year == 1999].groupby("d_week_seq").size()
+    m2 = dd[dd.d_year == 2000].groupby("d_week_seq").size()
+    y = wk.loc[wk.index.isin(set(m1.index))]
+    z = wk.loc[wk.index.isin(set(m2.index))].copy()
+    mult2 = m2.copy()
+    mult2.index = mult2.index - 52
     z.index = z.index - 52
     m = y.join(z, how="inner", lsuffix="_1", rsuffix="_2")
+    dup = (m1.reindex(m.index).fillna(0)
+           * mult2.reindex(m.index).fillna(0)).astype(int)
     out = pd.DataFrame({"d_week_seq1": m.index})
     for d, nm in zip(days, ["r_sun", "r_mon", "r_tue", "r_wed", "r_thu",
                             "r_fri", "r_sat"]):
         out[nm] = (m[f"{d}_1"] / m[f"{d}_2"]).round(2).values
+    out = out.loc[out.index.repeat(dup.values)]
     return out.reset_index(drop=True), meta(
         ["d_week_seq1"], None, None,
         ["r_sun", "r_mon", "r_tue", "r_wed", "r_thu", "r_fri", "r_sat"])
@@ -1158,13 +1174,17 @@ def q59(T):
                   .apply(_sum))
     wss = pd.DataFrame(piv).reset_index()
     dd = T.date_dim
-    w1 = set(dd[dd.d_month_seq.between(1200, 1211)].d_week_seq)
-    w2 = set(dd[dd.d_month_seq.between(1212, 1223)].d_week_seq)
+    # join multiplicity: wss × date_dim ON week_seq duplicates per
+    # calendar day of the week inside each month_seq window (cf. q2)
+    m1 = dd[dd.d_month_seq.between(1200, 1211)].groupby("d_week_seq").size()
+    m2 = dd[dd.d_month_seq.between(1212, 1223)].groupby("d_week_seq").size()
     st = T.store
-    y = wss[wss.d_week_seq.isin(w1)].merge(
+    y = wss[wss.d_week_seq.isin(set(m1.index))].merge(
         st, left_on="ss_store_sk", right_on="s_store_sk")
-    x = wss[wss.d_week_seq.isin(w2)].merge(
+    y = y.loc[y.index.repeat(m1.reindex(y.d_week_seq).values)]
+    x = wss[wss.d_week_seq.isin(set(m2.index))].merge(
         st, left_on="ss_store_sk", right_on="s_store_sk")
+    x = x.loc[x.index.repeat(m2.reindex(x.d_week_seq).values)]
     x = x.assign(join_seq=x.d_week_seq - 52)
     m = y.merge(x, left_on=["s_store_id", "d_week_seq"],
                 right_on=["s_store_id", "join_seq"],
@@ -1312,7 +1332,7 @@ def q39(T):
     j = j[j.d_year == 2000]
     g = (j.groupby(["w_warehouse_name", "w_warehouse_sk", "i_item_sk",
                     "d_moy"], as_index=False)
-         .agg(stdev=("inv_quantity_on_hand", "std"),
+         .agg(stdev=("inv_quantity_on_hand", lambda s: s.std(ddof=0)),
               mean=("inv_quantity_on_hand", "mean")))
     cov_f = np.where(g["mean"] == 0, 0, g.stdev / g["mean"])
     g = g[cov_f > 1].copy()
@@ -1427,9 +1447,11 @@ def q17(T):
     g = j.groupby(["i_item_id", "i_item_desc", "s_state"], as_index=False)
 
     def block(col, prefix):
+        # ddof=0: the engine's STDDEV is population (sum/sumsq formula),
+        # matching the reference's kernel
         return {f"{prefix}count": (col, "count"),
                 f"{prefix}ave": (col, "mean"),
-                f"{prefix}stdev": (col, "std")}
+                f"{prefix}stdev": (col, lambda s: s.std(ddof=0))}
 
     out = g.agg(**block("ss_quantity", "store_sales_quantity"),
                 **block("sr_return_quantity", "store_returns_quantity"),
@@ -2301,8 +2323,13 @@ def q51(T):
     m = m.rename(columns={"cume_sales_w": "web_sales",
                           "cume_sales_s": "store_sales"})
     m = m.sort_values(["item_sk", "d_date"], kind="stable")
+    # SQL MAX() OVER ignores NULLs: a date with no web row still carries
+    # the running max — pandas cummax leaves NaN, so forward-fill per item
     m["web_cumulative"] = m.groupby("item_sk")["web_sales"].cummax()
+    m["web_cumulative"] = m.groupby("item_sk")["web_cumulative"].ffill()
     m["store_cumulative"] = m.groupby("item_sk")["store_sales"].cummax()
+    m["store_cumulative"] = m.groupby("item_sk")[
+        "store_cumulative"].ffill()
     out = m[m.web_cumulative > m.store_cumulative]
     out = out[["item_sk", "d_date", "web_sales", "store_sales",
                "web_cumulative", "store_cumulative"]]
