@@ -355,6 +355,9 @@ class PushExecutor(LocalExecutor):
         k = _default_workers()
         if self.stats is not None:
             self.stats.register(node).workers = k
+        if self.cfg.enable_aqe:
+            self._aqe().record_replan(
+                f"fused partitioned agg: hash shuffle elided → {k} reducers")
         child = self._exec(exchange_child)
         in_q = [Channel(self.pipe, 2) for _ in range(k)]
         out = Channel(self.pipe, self.CHANNEL_CAPACITY, producers=k)

@@ -90,9 +90,12 @@ def test_aqe_coalesces_small_shuffles(monkeypatch):
     df = daft_tpu.from_pydict({"k": [i % 5 for i in range(100)],
                                "v": [float(i) for i in range(100)]})
     df = df.into_partitions(8)
+    # count_distinct is non-decomposable → single-stage agg over a real
+    # engine-inserted hash exchange (the fused partitioned-agg dispatcher
+    # handles mergeable finals without materializing a shuffle at all)
     with execution_config_ctx(enable_aqe=True,
                               target_partition_size_bytes=1 << 30):
-        out = df.groupby("k").agg(col("v").sum().alias("s")) \
+        out = df.groupby("k").agg(col("v").count_distinct().alias("s")) \
             .sort("k").to_pydict()
     assert out["k"] == [0, 1, 2, 3, 4]
     planner = adaptive.last_planner()
@@ -102,6 +105,32 @@ def test_aqe_coalesces_small_shuffles(monkeypatch):
     assert "→1 parts" in planner.history[-1].decision
     # user-visible explain
     assert "Adaptive execution" in planner.explain_analyze()
+
+
+def test_aqe_records_fused_partitioned_agg(monkeypatch):
+    """Mergeable grouped aggs skip the shuffle entirely via the fused
+    partitioned-agg dispatcher; with AQE on, that elision is recorded in
+    the adaptive history so explain_analyze shows why no exchange ran."""
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.context import execution_config_ctx
+    from daft_tpu.physical import adaptive
+
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+    df = daft_tpu.from_pydict({"k": [i % 5 for i in range(100)],
+                               "v": [float(i) for i in range(100)]})
+    df = df.into_partitions(8)
+    with execution_config_ctx(enable_aqe=True,
+                              target_partition_size_bytes=1 << 30):
+        out = df.groupby("k").agg(col("v").sum().alias("s")) \
+            .sort("k").to_pydict()
+    assert out["k"] == [0, 1, 2, 3, 4]
+    assert out["s"] == [sum(float(i) for i in range(100) if i % 5 == k)
+                        for k in range(5)]
+    planner = adaptive.last_planner()
+    assert planner is not None and planner.history
+    assert any("fused partitioned agg" in s.decision
+               for s in planner.history)
 
 
 def test_aqe_demotes_hash_join_to_broadcast(monkeypatch):
