@@ -173,16 +173,26 @@ def column_ndv(node: lp.LogicalPlan, name: str,
 
     ``est_rows``: the caller's row estimate for ``node``, if already
     computed (avoids a redundant estimate() walk)."""
-    src = _find_source_with(node, name)
     est = estimate(node).rows if est_rows is None else est_rows
+    footer = column_ndv_footer(node, name, est_rows=est)
+    return est if footer is None else footer
+
+
+def column_ndv_footer(node: lp.LogicalPlan, name: str,
+                      est_rows: Optional[float] = None) -> Optional[float]:
+    """Like :func:`column_ndv` but returns None instead of the near-unique
+    row-estimate fallback: only parquet-footer min/max evidence counts.
+    For decline-if-huge decisions (the fused-agg cardinality gate) the
+    fallback would misfire — a large in-memory groupby on a 5-value key
+    has no footer stats and must keep the default path."""
+    src = _find_source_with(node, name)
     if src is None:
-        return est
+        return None
     rng = _source_column_range(src, name)
     if rng is None:
-        return est
-    if est is None:
-        return rng
-    return min(rng, est)
+        return None
+    est = estimate(node).rows if est_rows is None else est_rows
+    return rng if est is None else min(rng, est)
 
 
 def _find_source_with(node: lp.LogicalPlan, name: str):

@@ -15,6 +15,7 @@ from ..context import get_context
 from ..datatype import DataType
 from ..expressions import Expression, col, lit
 from ..logical import plan as lp
+from ..logical import stats as lstats
 from ..schema import Schema
 from . import plan as pp
 
@@ -278,6 +279,14 @@ def _translate_agg(node: lp.Aggregate, cfg) -> pp.PhysicalPlan:
         else:
             ex = pp.Exchange(p1, "gather", 1)
         p2 = pp.Aggregate(ex, final_aggs, gb2, f_schema, "final")
+        # footer-backed output-cardinality estimate for the executor's
+        # fused-dispatcher gate (max over keys is a lower bound on the
+        # grouped output; enough for a decline-if-huge decision)
+        est_rows = lstats.estimate(child).rows
+        ndvs = [v for v in (lstats.column_ndv_footer(child, e.name(),
+                                                     est_rows=est_rows)
+                            for e in node.group_by) if v is not None]
+        p2.group_ndv = max(ndvs) if ndvs else None
     proj = [col(e.name()) for e in node.group_by] + final_proj
     return pp.Project(p2, proj, node.schema())
 

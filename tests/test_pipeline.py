@@ -191,6 +191,55 @@ def test_partitioned_agg_incremental_merge(many_files, monkeypatch):
     assert out["sv"] == pytest.approx([expected_sv[g] for g in out["g"]])
 
 
+def test_partitioned_agg_declines_on_huge_footer_ndv(many_files, monkeypatch):
+    """Footer stats predicting more groups than _FUSE_MAX_GROUPS route the
+    final agg through the spill-bounded exchange path (one agg per bucket)
+    instead of the fused LSM dispatcher — the SF100 Q18 crossover. Keys
+    without footer evidence (or small ranges) keep the fused default."""
+    from daft_tpu.execution import pipeline
+    from daft_tpu.physical.translate import translate
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+    glob, n = many_files
+
+    def final_agg_node(df):
+        phys = translate(df._builder.optimize().plan)
+        found = []
+
+        def walk(node):
+            if type(node).__name__ == "Aggregate" and node.mode == "final":
+                found.append(node)
+            for c in node.children:
+                walk(c)
+        walk(phys)
+        assert found, "no final Aggregate in plan"
+        return found[0]
+
+    df_wide = dt.read_parquet(glob).groupby("id").agg(
+        col("v").sum().alias("s"))
+    node = final_agg_node(df_wide)
+    assert node.group_ndv == pytest.approx(n)  # dense ids: range == rows
+    # n (8000) distinct ids > a forced-low threshold → fusion declined
+    monkeypatch.setattr(pipeline, "_FUSE_MAX_GROUPS", n // 2)
+    assert pipeline._partitioned_agg_info(node) is None
+    # the small-range key keeps the fused path under the same threshold
+    df_small = dt.read_parquet(glob).groupby("g").agg(
+        col("v").sum().alias("s"))
+    small = final_agg_node(df_small)
+    assert small.group_ndv == pytest.approx(7)
+    assert pipeline._partitioned_agg_info(small) is not None
+    # and both paths still answer correctly end-to-end: the declined
+    # (exchange) path must produce every group with the right sums
+    out = df_wide.sort("id").to_pydict()
+    assert out["id"] == list(range(n))
+    assert out["s"] == pytest.approx([float(i % 500) for i in range(n)])
+    out_small = df_small.sort("g").to_pydict()
+    assert out_small["g"] == list(range(7))
+    expected = {}
+    for i in range(n):
+        expected[i % 7] = expected.get(i % 7, 0.0) + float(i % 500)
+    assert out_small["s"] == pytest.approx([expected[g] for g in range(7)])
+
+
 # --------------------------------------------------- interp executor tier
 
 @pytest.fixture(scope="module")
