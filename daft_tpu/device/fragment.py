@@ -232,12 +232,25 @@ def _max_out_cap(prog: FusedAggProgram, dt: dcol.DeviceTable) -> int:
     return min(1 << (raw.bit_length() - 1), full)
 
 
+def _ledger_grouped(prog: FusedAggProgram, rows: int, cap: int,
+                    out_cap: int, seconds: float, dispatches: int) -> None:
+    """Per-dispatch MFU accounting for the fused grouped-agg family."""
+    from . import costmodel, mfu
+    flops, nbytes = mfu.grouped_agg_models(cap, out_cap, max(prog.nk, 1),
+                                           len(prog.ops))
+    costmodel.ledger_record("grouped_agg", rows=rows,
+                            nbytes=dispatches * nbytes,
+                            flops=dispatches * flops, seconds=seconds,
+                            dispatches=dispatches)
+
+
 def run_fused_agg_table(prog: FusedAggProgram, dt: dcol.DeviceTable,
                         in_schema: Schema, group_exprs, agg_exprs,
                         out_schema: Schema, start_out_cap: int = _OUT_CAP0):
     """Execute on one encoded DeviceTable (possibly HBM-cache-resident).
     Returns None (→ host fallback) when the group count exceeds the
     link-budgeted packed-output ceiling."""
+    import time as _time
     key_fields = [e.to_field(in_schema) for e in group_exprs]
     agg_fields = [out_schema[e.name()] for e in agg_exprs]
     if prog.nk == 0:
@@ -246,12 +259,17 @@ def run_fused_agg_table(prog: FusedAggProgram, dt: dcol.DeviceTable,
         return _decode_packed_global(prog, packed, agg_fields)
     cap_limit = _max_out_cap(prog, dt)
     out_cap = min(start_out_cap, cap_limit)
+    t0 = _time.perf_counter()
+    dispatches = 0
     while True:
         packed = np.asarray(jax.device_get(
             _dispatch_packed(prog, dt, out_cap)))
+        dispatches += 1
         out = _decode_packed_grouped(prog, packed, dt, group_exprs,
                                      key_fields, agg_fields)
         if out is not None:
+            _ledger_grouped(prog, dt.row_count, dt.capacity, out_cap,
+                            _time.perf_counter() - t0, dispatches)
             return out
         # the packed header carries the TRUE group count: jump straight
         # to a fitting bucket, or bail to host when the link can't afford
@@ -281,16 +299,22 @@ def run_fused_agg_tables(prog: FusedAggProgram, tables, in_schema: Schema,
     device→host transfer (one RTT for the whole scan instead of one per
     task). Returns a list parallel to ``tables`` (None → caller falls back
     per-table)."""
+    import time as _time
     if not tables:
         return []
     key_fields = [e.to_field(in_schema) for e in group_exprs]
     agg_fields = [out_schema[e.name()] for e in agg_exprs]
+    t0 = _time.perf_counter()
     try:
         packs = [_dispatch_packed(prog, dt, _OUT_CAP0) for dt in tables]
         stacked = np.asarray(jax.device_get(_stack(packs))) \
             if len(packs) > 1 else [np.asarray(jax.device_get(packs[0]))]
     except Exception:
         return [None] * len(tables)
+    if prog.nk:
+        _ledger_grouped(prog, sum(dt.row_count for dt in tables),
+                        max(dt.capacity for dt in tables), _OUT_CAP0,
+                        _time.perf_counter() - t0, len(packs))
     results: list = [None] * len(tables)
     retry: list = []  # (index, out_cap) — re-dispatched as ONE batch, not
     # per-table (each serial round trip costs ~0.1 s on the tunnel)

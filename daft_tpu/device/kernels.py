@@ -5,20 +5,30 @@ These replace the reference's hash-table kernels (probe tables
 ``src/daft-local-execution/src/sinks/grouped_aggregate.rs``) with the
 XLA-friendly sort + segment-reduce formulation (SURVEY.md §7 hard-part #3):
 
-- ``grouped_agg``: lexicographic ``lax.sort`` on key planes → segment ids via
-  boundary cumsum → ``jax.ops.segment_*`` reductions. Static shapes
-  throughout; outputs padded to capacity with a live-group count.
+- ``grouped_agg``: packed-key ``lax.sort`` → segment ids via boundary
+  cumsum → ``jax.ops.segment_*`` reductions. Static shapes throughout;
+  outputs padded to capacity with a live-group count.
 - ``argsort``: multi-key, per-key descending + nulls-first, returns a
   permutation (host applies it with Arrow take — device computes *indices*,
   variable-width payloads never leave the host).
-- ``merge_join_indices``: two-phase sort/searchsorted inner-equi-join index
-  generation with the prefix-sum expansion trick.
+- ``join_fused_kernel``: sort/searchsorted/expand inner-equi-join index
+  generation as ONE jit program returning ONE packed result matrix.
+
+Roofline discipline (round 6): TPU sort cost grows steeply with operand
+count — every log2(C) bitonic pass re-streams every operand plane through
+HBM, and the 2k+1-plane lexicographic formulation hit a compile-time cliff
+past ~10 operands. All sorts here therefore bit-pack their key planes into
+at most two u64 *radix words* whose unsigned order equals the requested
+lexicographic order (IEEE-total-order float codes, sign-flipped ints, XOR
+for descending, null-rank bits above each value), so any key count sorts
+as ≤ 3 operands (word(s) + row index). Key sets wider than 128 bits run as
+a stable LSD radix: one ≤3-operand pass per 128-bit chunk.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Sequence, Tuple
+from typing import List, Tuple
 
 import numpy as np
 
@@ -26,26 +36,165 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# the u64 radix words require real 64-bit lanes — idempotent here so the
+# kernels are safe to import without the column transport layer
+jax.config.update("jax_enable_x64", True)
 
-def _sort_key_plane(v: jnp.ndarray, valid: jnp.ndarray, descending: bool,
-                    nulls_first: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(null_rank, transformed_value) planes for one sort key."""
-    null_rank = jnp.where(valid,
-                          jnp.int8(1) if nulls_first else jnp.int8(0),
-                          jnp.int8(0) if nulls_first else jnp.int8(1))
-    x = v
-    if x.dtype == jnp.bool_:
-        x = x.astype(jnp.int8)
-    if descending:
-        if jnp.issubdtype(x.dtype, jnp.unsignedinteger):
-            x = jnp.asarray(jnp.iinfo(x.dtype).max, x.dtype) - x
-        elif jnp.issubdtype(x.dtype, jnp.floating):
-            x = -x
+_U64_TOP = np.uint64(1 << 63)
+
+
+def _key_bits(dtype) -> int:
+    """Static value-code width (bits) of one sort key of this dtype."""
+    if dtype == jnp.bool_:
+        return 1
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.finfo(dtype).bits
+    return jnp.iinfo(dtype).bits
+
+
+def _value_code(x: jnp.ndarray, valid: jnp.ndarray,
+                descending: bool) -> jnp.ndarray:
+    """u64 radix code: unsigned-ascending code order == key order.
+
+    Floats use the IEEE total-order transform (flip all bits when
+    negative, else set the sign bit) — this matches ``lax.sort``'s
+    -NaN < -inf < … < inf < NaN ordering bit-for-bit, so the packed and
+    plane formulations agree on every input including NaNs and -0.0.
+    Signed ints flip the sign bit; descending XOR-inverts the code
+    (negation would wrap INT64_MIN). Invalid rows collapse to 0 — null
+    placement is the separate rank bit the caller packs above."""
+    w = _key_bits(x.dtype)
+    if x.dtype == jnp.bool_ or jnp.issubdtype(x.dtype, jnp.unsignedinteger):
+        c = x.astype(jnp.uint64)
+    elif jnp.issubdtype(x.dtype, jnp.floating):
+        if w == 32:
+            b = lax.bitcast_convert_type(x, jnp.uint32)
+            c = jnp.where(b >> 31 != 0, ~b,
+                          b | jnp.uint32(1 << 31)).astype(jnp.uint64)
         else:
-            x = -x.astype(jnp.int64) if x.dtype == jnp.int64 else -x.astype(jnp.int32) \
-                if x.dtype in (jnp.int8, jnp.int16, jnp.int32) else -x
-    x = jnp.where(valid, x, jnp.zeros((), x.dtype))
-    return null_rank, x
+            b = lax.bitcast_convert_type(x, jnp.uint64)
+            c = jnp.where(b >> 63 != 0, ~b, b | _U64_TOP)
+    elif w == 64:
+        c = lax.bitcast_convert_type(x, jnp.uint64) ^ _U64_TOP
+    else:
+        c = (x.astype(jnp.int64) + (1 << (w - 1))).astype(jnp.uint64)
+    if descending:
+        c = c ^ np.uint64((1 << w) - 1 if w < 64 else 0xFFFFFFFFFFFFFFFF)
+    return jnp.where(valid, c, jnp.uint64(0))
+
+
+def _null_rank_code(valid: jnp.ndarray, nulls_first: bool) -> jnp.ndarray:
+    """1-bit code placed ABOVE the value code: the null-placement plane."""
+    rank_of_valid = 1 if nulls_first else 0
+    return jnp.where(valid, jnp.uint64(rank_of_valid),
+                     jnp.uint64(1 - rank_of_valid))
+
+
+def _sort_codes(keys, valids, row_mask, descending, nulls_first,
+                with_dead: bool = True):
+    """The (code, width) list for one multi-key sort: optional dead-row
+    bit, then per-key (null_rank, value) codes, most-significant first."""
+    codes: list = []
+    if with_dead:
+        codes.append(((~row_mask).astype(jnp.uint64), 1))
+    for v, valid, d, nf in zip(keys, valids, descending, nulls_first):
+        live = valid & row_mask
+        codes.append((_null_rank_code(live, nf), 1))
+        codes.append((_value_code(v, live, d), _key_bits(v.dtype)))
+    return codes
+
+
+def _packed_chunks(codes) -> List[Tuple[jnp.ndarray, ...]]:
+    """Pack (code, width) planes — big-endian concatenated — into 128-bit
+    chunks of one or two u64 words each.
+
+    Layout rules (all shifts static):
+
+    - The global bit string is cut every 128 bits REGARDLESS of code
+      boundaries: a code may straddle two chunks (stable LSD radix
+      composes on arbitrary digit boundaries, so per-chunk comparisons
+      still realize the full lexicographic order). Pass count is thus
+      exactly ``ceil(total_bits / 128)``.
+    - Each chunk is LEFT-aligned: the first code's top bit lands on bit
+      63 of the chunk's first word, so a leading dead-row bit is always
+      ``word0 >> 63``.
+    - Within a two-word chunk, lexicographic unsigned (hi, lo) order —
+      what ``lax.sort`` with num_keys=2 compares — equals 128-bit
+      unsigned order of the concatenation."""
+    offs: List[int] = []
+    off = 0
+    for _, w in codes:
+        offs.append(off)  # MSB-first global bit offset of this code
+        off += w
+    W = off
+    C = codes[0][0].shape[0]
+    zero = jnp.zeros(C, dtype=jnp.uint64)
+    chunks: List[Tuple[jnp.ndarray, ...]] = []
+    for cs in range(0, W, 128):
+        ce = min(cs + 128, W)
+        span = 64 if ce - cs <= 64 else 128  # chunk word span in bits
+        words = [zero, zero]
+        for (c, w), s in zip(codes, offs):
+            a, b = max(s, cs), min(s + w, ce)
+            if a >= b:
+                continue  # no overlap with this chunk
+            ln = b - a
+            piece = c >> (s + w - b) if s + w - b else c
+            if ln < 64:
+                piece = piece & np.uint64((1 << ln) - 1)
+            p = span - (a - cs) - ln  # LSB bit position within the chunk
+            if span == 64:
+                words[0] = words[0] | (piece << p)
+            elif p >= 64:
+                words[0] = words[0] | (piece << (p - 64))
+            elif p + ln <= 64:
+                words[1] = words[1] | (piece << p)
+            else:  # straddles the word boundary: split (shift truncates)
+                words[1] = words[1] | (piece << p)
+                words[0] = words[0] | (piece >> (64 - p))
+        chunks.append(tuple(words[:1] if span == 64 else words))
+    return chunks
+
+
+def _packed_argsort(codes, C: int,
+                    want_words: bool = False):
+    """Stable permutation ordering rows ascending by the big-endian
+    concatenation of ``codes``. Chunks wider than 128 bits run as an LSD
+    radix — least-significant chunk first, each pass ONE stable
+    ``lax.sort`` with ≤3 operands (this is the operand-count cliff the
+    plane formulation hit). ``want_words`` additionally returns every
+    chunk's word planes in final sorted order (for boundary detection)."""
+    chunks = _packed_chunks(codes)
+    perm = jnp.arange(C, dtype=jnp.int32)
+    sorted_last: Tuple[jnp.ndarray, ...] = ()
+    for i, words in enumerate(reversed(chunks)):
+        if i > 0:
+            words = tuple(jnp.take(w, perm) for w in words)
+        out = lax.sort(tuple(words) + (perm,), num_keys=len(words),
+                       is_stable=True)
+        perm = out[-1]
+        sorted_last = out[:-1]
+    if not want_words:
+        return perm
+    sorted_words: List[jnp.ndarray] = []
+    for ci, words in enumerate(chunks):
+        if ci == 0 and len(chunks) >= 1:
+            # the most-significant chunk ran last: its sort outputs are
+            # already in final order — no gathers in the common 1-chunk case
+            sorted_words.extend(sorted_last)
+        else:
+            sorted_words.extend(jnp.take(w, perm) for w in words)
+    return perm, tuple(sorted_words)
+
+
+def argsort_pack_plan(dtypes) -> List[int]:
+    """Words per sort pass for keys of these dtypes (dead bit + per-key
+    null-rank bit + value bits) — the traffic model behind the mfu
+    ledger. Length of the list = number of radix passes
+    (``ceil(total_bits / 128)``)."""
+    total = 1 + sum(1 + _key_bits(jnp.dtype(dt)) for dt in dtypes)
+    return [2 if min(total - cs, 128) > 64 else 1
+            for cs in range(0, total, 128)]
 
 
 @partial(jax.jit, static_argnames=("descending", "nulls_first"))
@@ -53,14 +202,8 @@ def argsort_kernel(keys, valids, row_mask, descending: Tuple[bool, ...],
                    nulls_first: Tuple[bool, ...]):
     """Returns the permutation placing live rows first in key order."""
     C = row_mask.shape[0]
-    operands = [(~row_mask).astype(jnp.int8)]
-    for v, valid, d, nf in zip(keys, valids, descending, nulls_first):
-        nr, x = _sort_key_plane(v, valid & row_mask, d, nf)
-        operands.append(nr)
-        operands.append(x)
-    operands.append(jnp.arange(C, dtype=jnp.int32))
-    out = lax.sort(tuple(operands), num_keys=len(operands) - 1, is_stable=True)
-    return out[-1]
+    codes = _sort_codes(keys, valids, row_mask, descending, nulls_first)
+    return _packed_argsort(codes, C)
 
 
 @partial(jax.jit)
@@ -99,20 +242,14 @@ def grouped_agg_impl(keys, key_valids, vals, val_valids, row_mask,
     ascending key order (so string-code groups decode in sorted order).
     """
     C = row_mask.shape[0]
-    dead = (~row_mask).astype(jnp.int8)
-    operands = [dead]
-    for k, kv in zip(keys, key_valids):
-        nr, x = _sort_key_plane(k, kv & row_mask, False, False)
-        operands.append(nr)
-        operands.append(x)
-    # Sort ONLY key planes + a row index, then gather payloads through the
-    # permutation: TPU sort compile time and runtime grow steeply with
-    # operand count (a 21-operand sort took >5 min to compile where this
-    # shape compiles in seconds), while gathers are cheap single-fusion ops.
-    operands.append(jnp.arange(C, dtype=jnp.int32))
-    out = lax.sort(tuple(operands), num_keys=len(operands) - 1,
-                   is_stable=True)
-    perm = out[-1]
+    # Sort ONLY packed key words + a row index, then gather payloads
+    # through the permutation: TPU sort compile time and runtime grow
+    # steeply with operand count (a 21-operand sort took >5 min to compile
+    # where this shape compiles in seconds), while gathers are cheap
+    # single-fusion ops. The u64 packing caps the sort at 3 operands.
+    codes = _sort_codes(keys, key_valids, row_mask,
+                        (False,) * len(keys), (False,) * len(keys))
+    perm = _packed_argsort(codes, C)
     s_keys = [jnp.take(k, perm) for k in keys]
     s_kvalids = [jnp.take(kv & row_mask, perm) for kv in key_valids]
     s_vals = [jnp.take(v, perm) for v in vals]
@@ -223,27 +360,18 @@ def grouped_agg_block_impl(keys, key_valids, vals, val_valids, row_mask,
     re-runs at a grown bucket when group_count > out_cap).
     """
     C = row_mask.shape[0]
-    dead = (~row_mask).astype(jnp.int8)
-    operands = [dead]
-    for k, kv in zip(keys, key_valids):
-        nr, x = _sort_key_plane(k, kv & row_mask, False, False)
-        operands.append(nr)
-        operands.append(x)
-    operands.append(jnp.arange(C, dtype=jnp.int32))
-    out = lax.sort(tuple(operands), num_keys=len(operands) - 1,
-                   is_stable=True)
-    perm = out[-1]
-    s_live = out[0] == 0  # dead flag sorts live rows first
-    s_nr = [out[1 + 2 * i] for i in range(len(keys))]
-    s_x = [out[2 + 2 * i] for i in range(len(keys))]
+    codes = _sort_codes(keys, key_valids, row_mask,
+                        (False,) * len(keys), (False,) * len(keys))
+    perm, s_words = _packed_argsort(codes, C, want_words=True)
+    # dead bit is the MSB of the first sorted word: live rows sort first
+    s_live = (s_words[0] >> np.uint64(63)) == 0
 
-    # group boundaries on the sorted (null_rank, transformed_value) planes —
-    # equivalent to (key, validity) boundaries, and they come free from the
-    # sort outputs (no payload gathers)
+    # group boundaries on the sorted packed words — word equality ⟺
+    # (null_rank, value) equality for every key, and the words come free
+    # from the sort outputs (no payload gathers)
     diff = jnp.zeros(C, dtype=jnp.bool_).at[0].set(True)
-    for nr, x in zip(s_nr, s_x):
-        diff = diff | (x != jnp.concatenate([x[:1], x[:-1]])) \
-            | (nr != jnp.concatenate([nr[:1], nr[:-1]]))
+    for w in s_words:
+        diff = diff | (w != jnp.concatenate([w[:1], w[:-1]]))
     flags = diff & s_live
     segf = jnp.cumsum(flags.astype(jnp.int32)) - 1
     group_count = jnp.sum(flags.astype(jnp.int32))
@@ -258,18 +386,13 @@ def grouped_agg_block_impl(keys, key_valids, vals, val_valids, row_mask,
     starts_c = jnp.clip(starts, 0, C - 1)
     live_group = j < group_count
 
-    # group keys: [out_cap]-sized gathers from the sorted key planes (the
-    # ascending transform is the identity on valid values)
-    out_keys = []
-    out_kvalids = []
-    for (nr, x), k in zip(zip(s_nr, s_x), keys):
-        kx = jnp.take(x, starts_c)
-        if k.dtype == jnp.bool_:
-            kx = kx.astype(jnp.bool_)
-        out_keys.append(kx.astype(k.dtype))
-        out_kvalids.append((jnp.take(nr, starts_c) == 0) & live_group)
-    out_keys = tuple(out_keys)
-    out_kvalids = tuple(out_kvalids)
+    # group keys: [out_cap]-sized gathers from the ORIGINAL key planes
+    # through perm∘starts (the packed words no longer carry the raw
+    # values, but two tiny composed gathers are as cheap as one)
+    first_row = jnp.take(perm, starts_c)
+    out_keys = tuple(jnp.take(k, first_row) for k in keys)
+    out_kvalids = tuple(jnp.take(kv & row_mask, first_row) & live_group
+                        for kv in key_valids)
 
     # One-hot matmul rides the MXU but materializes [C, out_cap]; past a
     # width threshold that escalates to HBM-exhausting sizes (overflow
@@ -410,13 +533,18 @@ global_agg_kernel = partial(jax.jit, static_argnames=("ops",))(global_agg_impl)
 
 # ---------------------------------------------------------------------------
 # sort-merge equi-join (index generation)
+#
+# Pure phase impls (composable inside larger programs — the mesh broadcast
+# join runs them inside its own shard_map program) plus ONE fused jitted
+# kernel: the three-dispatch formulation paid two host round-trips between
+# phases (sort → count → fetch total → expand), which on a tunneled chip
+# cost more than the kernels themselves.
 
-@partial(jax.jit)
-def join_phase_sort(r_key, r_valid, r_mask):
+def join_sort_impl(r_key, r_valid, r_mask):
     """Sort the right side's key column; invalid/dead rows to the end."""
     C = r_key.shape[0]
     live = r_valid & r_mask
-    nr, x = _sort_key_plane(r_key, live, False, False)
+    x = jnp.where(live, r_key, jnp.zeros((), r_key.dtype))
     dead = (~live).astype(jnp.int8)
     s = lax.sort((dead, x, jnp.arange(C, dtype=jnp.int32)), num_keys=2,
                  is_stable=True)
@@ -430,8 +558,7 @@ def join_phase_sort(r_key, r_valid, r_mask):
     return sorted_keys, s[2], live_count
 
 
-@partial(jax.jit)
-def join_phase_count(l_key, l_valid, l_mask, r_sorted, r_live_count):
+def join_count_impl(l_key, l_valid, l_mask, r_sorted, r_live_count):
     """Per-left-row match counts against the sorted right keys."""
     live = l_valid & l_mask
     starts = jnp.searchsorted(r_sorted, l_key, side="left")
@@ -442,8 +569,7 @@ def join_phase_count(l_key, l_valid, l_mask, r_sorted, r_live_count):
     return counts, starts, jnp.sum(counts)
 
 
-@partial(jax.jit, static_argnames=("out_capacity",))
-def join_phase_expand(counts, starts, r_perm, out_capacity: int):
+def join_expand_impl(counts, starts, r_perm, out_capacity: int):
     """Prefix-sum expansion: slot j → (left row, right row) index pair."""
     C = counts.shape[0]
     cum = jnp.cumsum(counts)
@@ -460,3 +586,52 @@ def join_phase_expand(counts, starts, r_perm, out_capacity: int):
     r_idx = jnp.take(r_perm, jnp.clip(r_slot, 0, r_perm.shape[0] - 1))
     valid = j < total
     return owner.astype(jnp.int32), r_idx.astype(jnp.int32), valid
+
+
+def join_fused_impl(l_key, l_valid, l_mask, r_key, r_valid, r_mask,
+                    out_capacity: int):
+    """Build-sort + probe-count + expand as one program, result as ONE
+    packed int32 matrix ``[3, max(out_capacity, C_l)]``:
+
+    - row 0: left row index per output slot (``[:out_capacity]``)
+    - row 1: right row index per output slot (``[:out_capacity]``)
+    - row 2: per-left-row match counts (``[:C_l]``)
+
+    The true match total is ``counts.sum()`` host-side; output slots at or
+    past it are garbage, and a total above ``out_capacity`` means the
+    caller re-dispatches at a grown static bucket (the grouped-agg
+    overflow discipline). One dispatch + one transfer replaces the
+    three-dispatch, two-round-trip phase pipeline."""
+    C_l = l_key.shape[0]
+    r_sorted, r_perm, r_live_count = join_sort_impl(r_key, r_valid, r_mask)
+    counts, starts, _total = join_count_impl(l_key, l_valid, l_mask,
+                                             r_sorted, r_live_count)
+    owner, r_idx, _valid = join_expand_impl(counts, starts, r_perm,
+                                            out_capacity)
+    W = max(out_capacity, C_l)
+    packed = jnp.zeros((3, W), dtype=jnp.int32)
+    packed = packed.at[0, :out_capacity].set(owner)
+    packed = packed.at[1, :out_capacity].set(r_idx)
+    packed = packed.at[2, :C_l].set(counts.astype(jnp.int32))
+    return packed
+
+
+_join_fused_cache: dict = {}
+
+
+def join_fused_kernel(l_key, l_valid, l_mask, r_key, r_valid, r_mask,
+                      out_capacity: int):
+    """The jitted single-dispatch join. The build side's buffers are
+    DONATED on real chips (they are dead after the in-program sort, so
+    XLA reuses their HBM for the sorted planes); CPU backends ignore
+    donation and would warn per call, so the donating executable is only
+    built off-cpu."""
+    from . import backend
+    donate = (backend.backend_name() or "cpu") != "cpu"
+    fn = _join_fused_cache.get(donate)
+    if fn is None:
+        fn = jax.jit(join_fused_impl, static_argnames=("out_capacity",),
+                     donate_argnums=(3, 4, 5) if donate else ())
+        _join_fused_cache[donate] = fn
+    return fn(l_key, l_valid, l_mask, r_key, r_valid, r_mask,
+              out_capacity=out_capacity)

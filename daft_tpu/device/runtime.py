@@ -190,6 +190,10 @@ def try_eval_projection(batch, exprs: List[Expression]):
     for name in c.needs_cols:
         if batch.get_column(name).is_pyobject():
             return None
+    import time as _time
+
+    from . import costmodel
+    t0 = _time.perf_counter()
     dt, outs = _run_compiled(c, batch, exprs)
     n = len(batch)
     cols = []
@@ -199,6 +203,11 @@ def try_eval_projection(batch, exprs: List[Expression]):
             dictionary = dt.columns[_string_out_source(e)].dictionary
         dc = dcol.DeviceColumn(val, valid, f.dtype, dictionary)
         cols.append(dcol.decode_column(f.name, dc, n))
+    costmodel.ledger_record(
+        "projection", rows=n,
+        nbytes=dcol.encoded_nbytes(batch, c.needs_cols)
+        + n * 8 * max(len(exprs), 1),
+        seconds=_time.perf_counter() - t0)
     return RecordBatch.from_series(cols)
 
 
@@ -248,11 +257,20 @@ def try_argsort(key_series: List[Series], descending: List[bool],
         return None
     mask = np.zeros(cap, dtype=np.bool_)
     mask[:n] = True
+    import time as _time
+
+    from . import mfu
+    t0 = _time.perf_counter()
     perm = kernels.argsort_kernel(
         tuple(c.data for c in cols), tuple(c.validity for c in cols),
         jnp.asarray(mask), tuple(bool(d) for d in descending),
         tuple(bool(x) for x in nulls_first))
-    return np.asarray(jax.device_get(perm))[:n].astype(np.int64)
+    out = np.asarray(jax.device_get(perm))[:n].astype(np.int64)
+    costmodel.ledger_record(
+        "argsort", rows=n,
+        nbytes=mfu.argsort_bytes_model(cap, [c.data.dtype for c in cols]),
+        seconds=_time.perf_counter() - t0)
+    return out
 
 
 def try_agg(batch, to_agg: List[Expression], group_by: List[Expression]):
@@ -338,12 +356,21 @@ def try_agg(batch, to_agg: List[Expression], group_by: List[Expression]):
 
     keys_b = [bcast(v, m) for v, m in key_outs]
     vals_b = [bcast(v, m) for v, m in val_outs]
+    import time as _time
+
+    from . import mfu
+    t0 = _time.perf_counter()
     out_keys, out_kvalids, out_vals, out_valids, gcount = \
         kernels.grouped_agg_kernel(
             tuple(v for v, _ in keys_b), tuple(m for _, m in keys_b),
             tuple(v for v, _ in vals_b), tuple(m for _, m in vals_b),
             dt.row_mask, ops)
     g = int(jax.device_get(gcount))
+    # segment-scatter formulation: bytes-bound, no MXU flops to claim
+    _, nbytes = mfu.grouped_agg_models(dt.capacity, dt.capacity, nk,
+                                       len(ops))
+    costmodel.ledger_record("grouped_agg", rows=len(batch), nbytes=nbytes,
+                            seconds=_time.perf_counter() - t0)
     cols = []
     for e, f, kv, km in zip(group_by, key_fields, out_keys, out_kvalids):
         cols.append(decode_group_key(e, f, kv, km, dt, g))

@@ -565,6 +565,11 @@ class LocalExecutor:
         mesh = pmesh.get_mesh()
         rb = RecordBatch.concat([p.combined() for p in parts]) \
             if len(parts) > 1 else parts[0].combined()
+        # tiny repartitions don't repay the collective program's per-shape
+        # compile + dispatch against the host fanout (same admission rule
+        # as the mesh exchange agg; DAFT_TPU_MESH_MIN_ROWS=0 forces)
+        if len(rb) < pmesh.mesh_min_rows():
+            return None
         schema = rb.schema
         # pure data movement must be bit-exact: every column must round-trip
         # the device encoding losslessly (no decimals-as-floats, no f64→f32).
@@ -733,6 +738,10 @@ class LocalExecutor:
             child, lambda p: MicroPartition.from_recordbatch(
                 p.combined().top_n(node.sort_by, node.limit, node.descending,
                                    node.nulls_first))))
+        if not tops:  # an empty child STREAM (not just empty morsels)
+            yield MicroPartition.from_recordbatch(
+                RecordBatch.empty(node.schema()))
+            return
         merged = tops[0].concat(tops[1:]) if len(tops) > 1 else tops[0]
         yield MicroPartition.from_recordbatch(
             merged.combined().top_n(node.sort_by, node.limit, node.descending,

@@ -207,14 +207,42 @@ def _map_workers(node) -> int:
 _MERGE_FINAL_OPS = ("agg.sum", "agg.min", "agg.max", "agg.any_value",
                     "agg.bool_and", "agg.bool_or", "agg.concat")
 
-#: decline the fused dispatcher when footer stats predict more groups than
-#: this: the spill-bounded exchange path aggregates each bucket exactly
-#: once, while the fused reducer's LSM merges cost O(log n) passes over a
-#: state it must also hold in RAM. Measured crossover on TPC-H: 15M groups
-#: (SF10 Q18) fused wins 34.5s vs 46.5s; 150M groups (SF100 Q18) fused
-#: loses 528s vs 207s. In-memory sources have no footer evidence and keep
-#: the fused default (stats.column_ndv_footer returns None there).
+#: decline the fused dispatcher when the evidence predicts more groups
+#: than this: the spill-bounded exchange path aggregates each bucket
+#: exactly once, while the fused reducer's LSM merges cost O(log n) passes
+#: over a state it must also hold in RAM. Measured crossover on TPC-H:
+#: 15M groups (SF10 Q18) fused wins 34.5s vs 46.5s; 150M groups (SF100
+#: Q18) fused loses 528s vs 207s. Evidence, best-first: parquet-footer
+#: NDV; else the planner's row estimate (an upper bound on groups — a
+#: near-unique-key groupby on a huge in-memory source must not default
+#: into the fused reducer's unbounded group state, the r5 OOM hole);
+#: either way a configured DAFT_TPU_MEMORY_LIMIT additionally declines
+#: predicted group state that cannot fit the budget.
 _FUSE_MAX_GROUPS = 32_000_000
+
+#: resident bytes one group row costs the fused reducer (key + agg state
+#: columns at ~8B each plus Arrow overhead), times the ~2× LSM headroom —
+#: deliberately coarse; only the order of magnitude gates anything
+_FUSE_BYTES_PER_GROUP = 16
+
+
+def _fused_groups_admissible(node) -> bool:
+    """Decline-if-huge gate for the fused partitioned-agg dispatcher."""
+    ndv = getattr(node, "group_ndv", None)
+    if ndv is None:
+        ndv = getattr(node, "group_rows_est", None)
+    if ndv is None:
+        return True
+    if ndv > _FUSE_MAX_GROUPS:
+        return False
+    from .memory import memory_limit_bytes
+    budget = memory_limit_bytes()
+    if budget is not None:
+        width = max(1 + len(getattr(node, "group_by", ())
+                            ) + len(getattr(node, "aggs", ())), 2)
+        if ndv * width * _FUSE_BYTES_PER_GROUP > budget:
+            return False
+    return True
 
 
 def _partitioned_agg_info(node):
@@ -232,8 +260,7 @@ def _partitioned_agg_info(node):
     if not (isinstance(ch, pp.Exchange) and ch.kind == "hash"
             and ch.engine_inserted):
         return None
-    ndv = getattr(node, "group_ndv", None)
-    if ndv is not None and ndv > _FUSE_MAX_GROUPS:
+    if not _fused_groups_admissible(node):
         return None
     # shared subplans stream through the executor's shared buffer — the
     # fusion would bypass it

@@ -39,22 +39,25 @@ _resize_jit = None
 
 
 def _get_resize_jit():
-    """One module-level jitted program, (h, w, lo, hi) static — reused
-    across batches so only genuinely new shapes compile."""
+    """One module-level jitted program, (h, w, lo, hi, out_dtype) static —
+    reused across batches so only genuinely new shapes compile. The cast
+    back to the output dtype happens ON-DEVICE: an f32 result fetched and
+    cast host-side made the real download 4× what the cost model priced
+    for uint8 images (the r5 advisory), and 4× what it needed to be."""
     global _resize_jit
     if _resize_jit is None:
         import jax
         import jax.numpy as jnp
 
-        def fn(x, h, w, lo, hi):
+        def fn(x, h, w, lo, hi, out_dtype):
             y = jax.image.resize(x.astype(jnp.float32),
                                  (x.shape[0], h, w, x.shape[3]),
                                  method="bilinear")
             if lo is not None:
                 y = jnp.clip(y, lo, hi)
-            return y
+            return y.astype(out_dtype)
 
-        _resize_jit = jax.jit(fn, static_argnums=(1, 2, 3, 4))
+        _resize_jit = jax.jit(fn, static_argnums=(1, 2, 3, 4, 5))
     return _resize_jit
 
 
@@ -91,8 +94,9 @@ def _device_batch_resize(imgs, w: int, h: int):
         lo, hi = float(info.min), float(info.max)
     else:
         lo = hi = None  # float images: no clamp, match PIL/NumPy behavior
-    out = _get_resize_jit()(jnp.asarray(stack), h, w, lo, hi)
-    res = np.asarray(jax.device_get(out)).astype(dtype)
+    out = _get_resize_jit()(jnp.asarray(stack), h, w, lo, hi,
+                            jnp.dtype(dtype))
+    res = np.asarray(jax.device_get(out))
     if len(shape) == 2:
         res = res[..., 0]
     it = iter(res)

@@ -5,7 +5,7 @@ Reference capability: ``src/daft-recordbatch/src/ops/joins/mod.rs:78-195``
 (``probeable/probe_table.rs:19``). Here the host path factorizes join keys to
 dense group ids (Arrow C++ dictionary encode + np.unique over code rows), then
 runs a fully vectorized sort+searchsorted merge — the same sort-merge
-formulation the TPU tier uses in ``device.kernels.merge_join_indices``, so the
+formulation the TPU tier uses in ``device.kernels.join_fused_kernel``, so the
 two tiers share one algorithm family.
 
 Join semantics follow the reference: inner/left/right/outer/semi/anti; NULL
@@ -107,13 +107,14 @@ def match_indices(l_gids: np.ndarray, r_gids: np.ndarray,
     Returns (li, ri, l_match_counts): parallel index arrays of the matching
     pairs plus per-left-row match counts.
 
-    The device tier's three-phase sort/searchsorted/expand kernels
-    (``device.kernels.join_phase_*``) are chosen by the measured link cost
-    model (``device.costmodel.join_wins``): the output is row-shaped (one
+    The device tier's FUSED sort/searchsorted/expand kernel
+    (``device.kernels.join_fused_kernel`` — one dispatch, one packed
+    result transfer) is chosen by the measured link cost model
+    (``device.costmodel.join_wins``): the output is row-shaped (one
     index pair per match), so on a transfer-bound single-chip link the
     device loses to the host by >10× measured and the model picks numpy;
-    on a local chip (or the CPU mesh in tests) the kernels win and the
-    model picks them. ``DAFT_TPU_DEVICE_JOIN=1/0`` force-overrides.
+    on a local chip (or the CPU mesh in tests) the kernel wins and the
+    model picks it. ``DAFT_TPU_DEVICE_JOIN=1/0`` force-overrides.
     """
     import os
     env = os.environ.get("DAFT_TPU_DEVICE_JOIN")
@@ -163,15 +164,23 @@ def _take_nullable(s: Series, idx: np.ndarray, valid: np.ndarray) -> Series:
 
 
 def _device_match_indices(l_gids, r_gids, l_valid, r_valid):
-    """Three-phase device join index generation (sort right keys →
-    per-left-row counts → prefix-sum expansion). None on device-off."""
+    """Fused single-dispatch device join index generation: build-side
+    sort + probe counts + prefix-sum expansion run as ONE jit program
+    returning ONE packed index matrix (r5's three-phase pipeline paid two
+    host round-trips between phases — fetching the match total before the
+    expansion — which dominated tunneled-link joins). The output bucket
+    is sized FK-shaped (≈ one match per probe row); a larger true total
+    re-dispatches once at the fitting bucket, the grouped-agg overflow
+    discipline. None on device-off."""
     from .device import runtime as drt
     if not drt.device_enabled():
         return None
+    import time as _time
+
     import jax
     import jax.numpy as jnp
 
-    from .device import kernels as K
+    from .device import costmodel, kernels as K, mfu
     from .device.column import bucket_capacity
 
     def pad(a, cap, fill=0):
@@ -185,20 +194,34 @@ def _device_match_indices(l_gids, r_gids, l_valid, r_valid):
     lmask[:n_l] = True
     rmask = np.zeros(c_r, bool)
     rmask[:n_r] = True
-    rs, rperm, rc = K.join_phase_sort(
-        jnp.asarray(pad(r_gids.astype(np.int64), c_r)),
-        jnp.asarray(pad(r_valid, c_r)), jnp.asarray(rmask))
-    cnt, starts, total = K.join_phase_count(
-        jnp.asarray(pad(l_gids.astype(np.int64), c_l)),
-        jnp.asarray(pad(l_valid, c_l)), jnp.asarray(lmask), rs, rc)
-    total = int(jax.device_get(total))
-    cap = max(1 << (max(total, 1) - 1).bit_length(), 1024)
-    own, ridx, valid = K.join_phase_expand(cnt, starts, rperm, cap)
-    own = np.asarray(jax.device_get(own))
-    ridx = np.asarray(jax.device_get(ridx))
-    valid = np.asarray(jax.device_get(valid))
-    counts = np.asarray(jax.device_get(cnt))[:n_l]
-    return own[valid], ridx[valid], counts
+
+    def dispatch(cap):
+        # device arrays are rebuilt per dispatch: the kernel DONATES the
+        # build side's buffers on real chips, so an overflow re-dispatch
+        # cannot reuse them
+        return np.asarray(jax.device_get(K.join_fused_kernel(
+            jnp.asarray(pad(l_gids.astype(np.int64), c_l)),
+            jnp.asarray(pad(l_valid, c_l)), jnp.asarray(lmask),
+            jnp.asarray(pad(r_gids.astype(np.int64), c_r)),
+            jnp.asarray(pad(r_valid, c_r)), jnp.asarray(rmask),
+            out_capacity=cap)))
+
+    t0 = _time.perf_counter()
+    cap = max(bucket_capacity(max(n_l, n_r, 1)), 1024)
+    packed = dispatch(cap)
+    counts = packed[2, :n_l].astype(np.int64)
+    total = int(counts.sum())
+    dispatches = 1
+    if total > cap:  # rare: many-to-many blowup past the FK estimate
+        cap = bucket_capacity(total)
+        packed = dispatch(cap)
+        dispatches = 2
+    costmodel.ledger_record(
+        "join", rows=n_l + n_r,
+        nbytes=dispatches * mfu.join_bytes_model(c_l, c_r, cap),
+        seconds=_time.perf_counter() - t0, dispatches=dispatches)
+    return (packed[0, :total].astype(np.int64),
+            packed[1, :total].astype(np.int64), counts)
 
 
 def join_recordbatch(left, right, left_on: List[Expression],

@@ -342,7 +342,9 @@ def _guard_single_row(rdf, name: str):
 
     @udf(return_dtype=dtype)
     def _check_single(vals, counts):
-        n = counts.to_pylist()[0] if len(counts) else 0
+        # an empty subquery relation can surface its count as NULL through
+        # the exchange path (same guard _check_one already carries)
+        n = (counts.to_pylist()[0] if len(counts) else 0) or 0
         if n > 1:
             raise ValueError(
                 f"scalar subquery produced {n} rows, expected at most 1")

@@ -29,6 +29,27 @@ def _now_us() -> int:
     return int((time.perf_counter() - _START_TS) * 1_000_000)
 
 
+def _ledger_raw() -> Dict[str, dict]:
+    """Raw snapshot of the device-kernel dispatch ledger (never raises —
+    observability must not take a query down over a device import)."""
+    try:
+        from .device import costmodel
+        return costmodel.ledger_snapshot(raw=True)
+    except Exception:
+        return {}
+
+
+def device_kernel_ledger() -> Dict[str, dict]:
+    """Process-wide per-dispatch achieved-bytes/flops ledger with derived
+    roofline/MFU percentages (``costmodel.ledger_record`` feeds it at
+    every real argsort / join / grouped-agg / projection dispatch)."""
+    try:
+        from .device import costmodel
+        return costmodel.ledger_snapshot()
+    except Exception:
+        return {}
+
+
 class OperatorStats:
     """Counters for one physical operator (reference:
     ``RuntimeStatsContext`` counters)."""
@@ -104,6 +125,10 @@ class RuntimeStatsContext:
         self.wall_us: Optional[int] = None
         self.plan = None  # physical plan root, set by the executor
         self._t0 = time.perf_counter()
+        # per-dispatch device-kernel MFU/roofline accounting: snapshot the
+        # process-wide ledger now, diff at finish() → this query's share
+        self._ledger0 = _ledger_raw()
+        self.device_kernels: Dict[str, dict] = {}
 
     def register(self, node) -> OperatorStats:
         key = id(node)
@@ -136,6 +161,12 @@ class RuntimeStatsContext:
 
     def finish(self):
         self.wall_us = int((time.perf_counter() - self._t0) * 1_000_000)
+        try:
+            from .device import costmodel
+            self.device_kernels = costmodel.ledger_delta(
+                self._ledger0, _ledger_raw())
+        except Exception:
+            self.device_kernels = {}
 
     # ---- reporting ---------------------------------------------------
     def exclusive_us(self, key: int) -> int:
@@ -177,6 +208,19 @@ class RuntimeStatsContext:
                 lines.append(f"{st.name}: rows_out={st.rows_out} "
                              f"batches={st.batches_out} "
                              f"total={st.inclusive_us / 1e6:.3f}s")
+        if self.device_kernels:
+            lines.append("device kernels (per-dispatch ledger, "
+                         "end-to-end incl. link):")
+            for kind, d in sorted(self.device_kernels.items()):
+                extra = ""
+                if "achieved_gbps" in d:
+                    extra = (f" {d['achieved_gbps']} GB/s"
+                             f" ({d.get('roofline_pct', 0)}% roofline)")
+                if "mfu_pct" in d:
+                    extra += f" {d['mfu_pct']}% MFU"
+                lines.append(
+                    f"  {kind}: dispatches={d['dispatches']} "
+                    f"rows={d['rows']} time={d['seconds']:.3f}s{extra}")
         return "\n".join(lines)
 
     def as_dict(self) -> Dict[str, dict]:

@@ -26,6 +26,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..device import kernels
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across jax versions: new jax exports it top-level
+    with ``check_vma``; older releases ship ``jax.experimental.shard_map``
+    whose equivalent knob is ``check_rep``.
+
+    The program is returned JITTED: un-jitted shard_map executes eagerly
+    (per-op dispatch over every mesh shard — measured ~70 s for one tiny
+    mesh-exchanged Q1 on the 8-device CPU mesh, vs milliseconds compiled),
+    and every caller here wants the compiled collective anyway."""
+    try:
+        from jax import shard_map as sm
+        mapped = sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    check_vma=check_vma)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+        mapped = sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    check_rep=check_vma)
+    return jax.jit(mapped)
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
     devs = jax.devices()
     n = n_devices or len(devs)
@@ -100,9 +120,7 @@ def sharded_grouped_sum(mesh: Mesh, keys_sharded, vals_sharded,
     """
     n = mesh.shape[axis]
 
-    from jax import shard_map
-
-    @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
+    @partial(shard_map_compat, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
              out_specs=(P(axis), P(axis), P(axis), P(axis)),
              check_vma=False)
     def run(k, v, m):
@@ -171,12 +189,11 @@ def sharded_grouped_agg(mesh: Mesh, keys, kvalids, vals, vvalids, mask,
     nk, nv = len(keys), len(vals)
     assert all(op in MERGEABLE_OPS for op in ops), ops
 
-    from jax import shard_map
-
     spec_in = (P(axis),) * (2 * nk + 2 * nv + 1)
     spec_out = (P(axis),) * (2 * nk + 2 * nv + 1)
 
-    @partial(shard_map, mesh=mesh, in_specs=spec_in, out_specs=spec_out,
+    @partial(shard_map_compat, mesh=mesh, in_specs=spec_in,
+             out_specs=spec_out,
              check_vma=False)
     def run(*args):
         ks = tuple(a.reshape(-1) for a in args[:nk])
@@ -220,25 +237,23 @@ def sharded_broadcast_join(mesh: Mesh, l_key, l_valid, l_mask,
     strategy the planner picks when one side is under the broadcast
     threshold — no all_to_all at all, the build side rides one broadcast).
     Each shard sort-merges its local block against the replicated build
-    side in one XLA program (``kernels.join_phase_*``).
+    side in one XLA program (``kernels.join_*_impl``).
 
     Returns per-shard (left_idx, right_idx, valid) gather-index blocks
     stacked to [n_shards * out_capacity_per_shard]; left indices are
     SHARD-LOCAL (caller adds ``shard * C`` to globalize).
     """
-    from jax import shard_map
-
-    @partial(shard_map, mesh=mesh,
+    @partial(shard_map_compat, mesh=mesh,
              in_specs=(P(axis), P(axis), P(axis), P(), P(), P()),
              out_specs=(P(axis), P(axis), P(axis)), check_vma=False)
     def run(lk, lv, lm, rk, rv, rm):
         lk = lk.reshape(-1)
         lv = lv.reshape(-1)
         lm = lm.reshape(-1)
-        rs, rperm, rcnt = kernels.join_phase_sort(rk, rv, rm)
-        counts, starts, _ = kernels.join_phase_count(lk, lv, lm, rs, rcnt)
-        return kernels.join_phase_expand(counts, starts, rperm,
-                                         out_capacity_per_shard)
+        rs, rperm, rcnt = kernels.join_sort_impl(rk, rv, rm)
+        counts, starts, _ = kernels.join_count_impl(lk, lv, lm, rs, rcnt)
+        return kernels.join_expand_impl(counts, starts, rperm,
+                                        out_capacity_per_shard)
 
     return run(l_key, l_valid, l_mask, r_key, r_valid, r_mask)
 
@@ -255,12 +270,11 @@ def sharded_hash_repartition(mesh: Mesh, planes, valids, mask, pid,
     n = mesh.shape[axis]
     np_ = len(planes)
 
-    from jax import shard_map
-
     spec_in = (P(axis),) * (2 * np_ + 2)
     spec_out = (P(axis),) * (2 * np_ + 1)
 
-    @partial(shard_map, mesh=mesh, in_specs=spec_in, out_specs=spec_out,
+    @partial(shard_map_compat, mesh=mesh, in_specs=spec_in,
+             out_specs=spec_out,
              check_vma=False)
     def run(*args):
         ps = tuple(a.reshape(-1) for a in args[:np_])

@@ -38,6 +38,18 @@ def mesh_size() -> int:
     return n
 
 
+#: below this many rows a mesh collective (exchange agg, hash
+#: repartition) is not worth its per-shape compile + collective dispatch
+#: against the host path; ``DAFT_TPU_MESH_MIN_ROWS=0`` forces the mesh
+#: (the knob the mesh-correctness tests and the multichip dryrun set)
+_MESH_MIN_ROWS = 65536
+
+
+def mesh_min_rows() -> int:
+    v = os.environ.get("DAFT_TPU_MESH_MIN_ROWS")
+    return int(v) if v is not None else _MESH_MIN_ROWS
+
+
 def get_mesh():
     global _mesh
     with _lock:

@@ -265,7 +265,9 @@ def _translate_agg(node: lp.Aggregate, cfg) -> pp.PhysicalPlan:
                           "partial")
     gb2 = [col(e.name()) for e in node.group_by]
     f_schema = _agg_schema(gb2, final_aggs, p1_schema)
-    mesh_ex = _try_mesh_exchange_agg(p1, final_aggs, gb2, f_schema, p1_schema)
+    est_rows = lstats.estimate(child).rows
+    mesh_ex = _try_mesh_exchange_agg(p1, final_aggs, gb2, f_schema,
+                                     p1_schema, est_rows)
     if mesh_ex is not None:
         p2 = mesh_ex
     else:
@@ -281,20 +283,25 @@ def _translate_agg(node: lp.Aggregate, cfg) -> pp.PhysicalPlan:
         p2 = pp.Aggregate(ex, final_aggs, gb2, f_schema, "final")
         # footer-backed output-cardinality estimate for the executor's
         # fused-dispatcher gate (max over keys is a lower bound on the
-        # grouped output; enough for a decline-if-huge decision)
-        est_rows = lstats.estimate(child).rows
+        # grouped output; enough for a decline-if-huge decision). The raw
+        # row estimate rides along as the gate's fallback evidence: with
+        # no footer stats (in-memory/CSV sources) it is an upper bound on
+        # the group count, which is exactly what decline-if-huge needs.
         ndvs = [v for v in (lstats.column_ndv_footer(child, e.name(),
                                                      est_rows=est_rows)
                             for e in node.group_by) if v is not None]
         p2.group_ndv = max(ndvs) if ndvs else None
+        p2.group_rows_est = est_rows
     proj = [col(e.name()) for e in node.group_by] + final_proj
     return pp.Project(p2, proj, node.schema())
 
 
 def _try_mesh_exchange_agg(p1, final_aggs, gb2, f_schema: Schema,
-                           p1_schema: Schema) -> Optional[pp.PhysicalPlan]:
+                           p1_schema: Schema,
+                           est_rows=None) -> Optional[pp.PhysicalPlan]:
     """Choose the ICI-collective shuffle+merge when statically sound: a
-    multi-device mesh is up, every group key / partial value either
+    multi-device mesh is up, the input is big enough to repay the
+    collective program, every group key / partial value either
     round-trips the device encoding bit-exactly or is string/binary (those
     ride shared-dictionary codes — see ``_exchangeable``), and every final
     op merges with itself."""
@@ -305,6 +312,8 @@ def _try_mesh_exchange_agg(p1, final_aggs, gb2, f_schema: Schema,
     if not gb2:
         return None  # global aggs gather a handful of scalars — host wins
     if not drt.device_enabled() or pmesh.mesh_size() < 2:
+        return None
+    if est_rows is not None and est_rows < pmesh.mesh_min_rows():
         return None
     def _exchangeable(dtype) -> bool:
         # bit-exact round trip, or string/binary riding shared dictionary

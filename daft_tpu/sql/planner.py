@@ -536,6 +536,7 @@ class SQLPlanner:
 
         # re-parse projection expressions with full scope ------------------
         exprs: List[Expression] = []
+        bare_alias: dict = {}  # exprs index → deferred bare-name alias
         save = self.i
         for item, alias in proj:
             if item is None:
@@ -562,12 +563,22 @@ class SQLPlanner:
                 # SQL names an unaliased qualified reference by its BARE
                 # column name (``SELECT t.customer_id`` → customer_id) —
                 # self-join collision renames must not leak internal
-                # ``right.x`` names into the output schema
-                alias = self.toks[end - 1].text
+                # ``right.x`` names into the output schema. DEFERRED:
+                # applied below only when the bare name doesn't collide
+                # with another SELECT item's output name (``SELECT a.x,
+                # b.x FROM t a JOIN t b`` must keep planning as
+                # x / right.x, not raise on two ``x`` outputs)
+                bare_alias[len(exprs)] = self.toks[end - 1].text
             if alias is not None:
                 e = e.alias(alias)
             exprs.append(e)
         self.i = save
+        if bare_alias:
+            names = [bare_alias.get(i, e.name())
+                     for i, e in enumerate(exprs)]
+            for i, nm in bare_alias.items():
+                if names.count(nm) == 1:
+                    exprs[i] = exprs[i].alias(nm)
         # ORDER BY <integer> is a SELECT-list ordinal (SQL standard), not
         # a constant sort key (which would be a silent no-op sort)
         for j, o in enumerate(order_by):

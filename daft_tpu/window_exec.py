@@ -58,9 +58,17 @@ def run_window(rb, node):
         perm = rb.argsort(sort_keys, desc, nf)
     else:
         perm = np.arange(n)
-    inv = np.empty(n, dtype=np.int64)
-    inv[perm] = np.arange(n)
-    sorted_rb = rb.take(perm)
+    # pre-clustered input (window after an engine sort on the same keys —
+    # the TPC-DS q47/q63/q89 shape): the permutation is the identity, so
+    # skip the full-batch Arrow take AND the inverse-scatter on every
+    # output column
+    if np.array_equal(perm, np.arange(n)):
+        inv = None
+        sorted_rb = rb
+    else:
+        inv = np.empty(n, dtype=np.int64)
+        inv[perm] = np.arange(n)
+        sorted_rb = rb.take(perm)
 
     # segment ids over partition keys in sorted order
     if part_keys:
@@ -97,7 +105,7 @@ def run_window(rb, node):
         has_order = bool(order_keys)
         out = _eval_window_fn(inner, sorted_rb, seg, starts_per_row, n,
                               has_order, frame, name, order_change, order_vals)
-        out_cols.append(out.take(inv).rename(name))
+        out_cols.append((out if inv is None else out.take(inv)).rename(name))
     from .recordbatch import RecordBatch
     return RecordBatch.from_series(rb.columns() + out_cols)
 

@@ -1,0 +1,338 @@
+"""Round-6 kernel contracts: packed-key argsort (≤3 sort operands, exact
+host agreement), the fused single-dispatch join, and the per-dispatch MFU
+ledger.
+
+The argsort parity sweep is property-based in the seeded-random style
+(hypothesis is not guaranteed in every environment): ~60 random
+configurations over mixed dtypes × descending × nulls_first × null
+density, each asserting EXACT permutation agreement with the pyarrow
+host path (both sides are stable sorts, so ties must agree too).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import daft_tpu
+from daft_tpu.device import costmodel, kernels as K
+from daft_tpu.recordbatch import RecordBatch
+
+
+# ---------------------------------------------------------------- argsort
+
+def _random_frame(rng, n, dtypes):
+    """pydict of random columns (with nulls) for the requested dtypes."""
+    data = {}
+    for i, dt in enumerate(dtypes):
+        nulls = rng.random(n) < rng.choice([0.0, 0.15, 0.5])
+        if dt == "int":
+            v = rng.integers(-2**40, 2**40, n).tolist()
+        elif dt == "small_int":
+            v = rng.integers(-3, 3, n).tolist()  # heavy ties
+        elif dt == "float":
+            v = np.round(rng.uniform(-1e6, 1e6, n), 3).tolist()
+        elif dt == "bool":
+            v = (rng.random(n) > 0.5).tolist()
+        else:  # string
+            v = ["s" + str(rng.integers(0, 8)) for _ in range(n)]
+        data[f"c{i}"] = [None if m else x for x, m in zip(v, nulls)]
+    return data
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_argsort_device_matches_host_property(seed, monkeypatch):
+    """Exact permutation agreement between the packed-key device argsort
+    and the pyarrow host path over random frames/orderings."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(2, 80))
+    n_keys = int(rng.integers(1, 4))
+    dtypes = [rng.choice(["int", "small_int", "float", "bool", "string"])
+              for _ in range(n_keys)]
+    data = _random_frame(rng, n, dtypes)
+    rb = RecordBatch.from_pydict(data)
+    keys = [daft_tpu.col(f"c{i}") for i in range(n_keys)]
+    for trial in range(5):
+        desc = [bool(rng.integers(0, 2)) for _ in range(n_keys)]
+        nf = [bool(rng.integers(0, 2)) for _ in range(n_keys)]
+        monkeypatch.delenv("DAFT_TPU_DEVICE_FORCE", raising=False)
+        monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+        host = rb.argsort(keys, desc, nf)
+        monkeypatch.setenv("DAFT_TPU_DEVICE", "1")
+        monkeypatch.setenv("DAFT_TPU_DEVICE_FORCE", "1")
+        dev = rb.argsort(keys, desc, nf)
+        assert list(dev) == list(host), (dtypes, desc, nf)
+
+
+def test_argsort_f32_codes_match_reference():
+    """f32 value codes (the TPU backend's float plane — f64 rides f32
+    there) order exactly like the float values, including -0.0."""
+    vals = np.array([0.0, -0.0, 1.5, -1.5, np.inf, -np.inf, 3e-9],
+                    np.float32)
+    C = 16
+    k = np.zeros(C, np.float32)
+    k[:len(vals)] = vals
+    mask = np.zeros(C, bool)
+    mask[:len(vals)] = True
+    ones = np.ones(C, bool)
+    for desc in (False, True):
+        perm = np.asarray(K.argsort_kernel(
+            (jnp.asarray(k),), (jnp.asarray(ones),), jnp.asarray(mask),
+            (desc,), (False,)))[:len(vals)]
+        got = [vals[i] for i in perm]
+        # IEEE total order (what lax.sort uses too): -0.0 before 0.0
+        ref = sorted(list(vals),
+                     key=lambda v: (v, not np.signbit(v)), reverse=desc)
+        assert [str(x) for x in got] == [str(x) for x in ref], (desc, got)
+
+
+def _max_sort_operands(jaxpr):
+    mx = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "sort":
+            mx = max(mx, len(eqn.invars))
+        for sub in eqn.params.values():
+            if hasattr(sub, "jaxpr"):
+                mx = max(mx, _max_sort_operands(sub.jaxpr))
+    return mx
+
+
+@pytest.mark.parametrize("n_keys,dtype", [(1, np.int64), (2, np.float32),
+                                          (3, np.int64), (6, np.int32),
+                                          (8, np.float32)])
+def test_argsort_compiles_with_at_most_3_sort_operands(n_keys, dtype):
+    """The operand-count cliff contract: ≤3 operands per lax.sort for ANY
+    key count (the 2k+1-plane formulation hit >5-minute TPU compiles)."""
+    C = 32
+    keys = tuple(jnp.asarray(np.arange(C, dtype=dtype))
+                 for _ in range(n_keys))
+    valids = tuple(jnp.asarray(np.ones(C, bool)) for _ in range(n_keys))
+    mask = jnp.asarray(np.ones(C, bool))
+    flags = tuple(False for _ in range(n_keys))
+    jaxpr = jax.make_jaxpr(lambda ks, vs, m: K.argsort_kernel(
+        ks, vs, m, flags, flags))(keys, valids, mask)
+    assert _max_sort_operands(jaxpr.jaxpr) <= 3
+
+
+def test_grouped_agg_sorts_stay_under_operand_cliff():
+    """The grouped-agg kernels ride the same packed sort: ≤3 operands
+    regardless of key count."""
+    C = 32
+    nk = 5
+    keys = tuple(jnp.asarray(np.arange(C, dtype=np.int64))
+                 for _ in range(nk))
+    ones = tuple(jnp.asarray(np.ones(C, bool)) for _ in range(nk))
+    mask = jnp.asarray(np.ones(C, bool))
+    vals = (jnp.asarray(np.ones(C, np.float32)),)
+    jaxpr = jax.make_jaxpr(
+        lambda ks, kv, v, vv, m: K.grouped_agg_block_impl(
+            ks, kv, v, vv, m, ("sum",), 16))(
+        keys, ones, vals, (mask,), mask)
+    assert _max_sort_operands(jaxpr.jaxpr) <= 3
+
+
+def test_argsort_radix_passes_scale_with_key_bits():
+    assert K.argsort_pack_plan([np.float32]) == [1]       # 34 bits
+    assert K.argsort_pack_plan([np.float32] * 2) == [2]   # 67 bits
+    assert K.argsort_pack_plan([np.int64]) == [2]         # 66 bits
+    # 3 x 65-bit keys = 196 bits → two passes
+    assert len(K.argsort_pack_plan([np.int64] * 3)) == 2
+
+
+# ------------------------------------------------------------- fused join
+
+def _join_keys(seed=3):
+    rng = np.random.default_rng(seed)
+    lk = rng.integers(0, 50, 400)
+    rk = rng.integers(0, 50, 150)
+    lv = rng.random(400) > 0.1
+    rv = rng.random(150) > 0.1
+    return lk, rk, lv, rv
+
+
+def test_fused_join_is_one_dispatch_with_host_identical_indices(
+        monkeypatch):
+    """The fused kernel must be dispatched EXACTLY once per build/probe
+    pair (no per-phase dispatches, no host round-trips between phases),
+    and its indices must match the host merge exactly."""
+    from daft_tpu import joins
+    lk, rk, lv, rv = _join_keys()
+    calls = {"n": 0}
+    real = K.join_fused_kernel
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(K, "join_fused_kernel", counting)
+    out = joins._device_match_indices(lk, rk, lv, rv)
+    assert out is not None
+    assert calls["n"] == 1, f"expected ONE dispatch, saw {calls['n']}"
+    dli, dri, dcnt = out
+    monkeypatch.setenv("DAFT_TPU_DEVICE_JOIN", "0")
+    hli, hri, hcnt = joins.match_indices(lk, rk, lv, rv)
+    assert sorted(zip(dli.tolist(), dri.tolist())) == \
+        sorted(zip(hli.tolist(), hri.tolist()))
+    assert np.array_equal(dcnt, hcnt)
+
+
+def test_fused_join_overflow_redispatches_once(monkeypatch):
+    """A many-to-many blowup past the FK-shaped output estimate re-runs
+    at the fitting bucket — two dispatches, still correct."""
+    from daft_tpu import joins
+    n = 1200  # 1200*1200 pairs ≫ bucket_capacity(1200)=2048 slots
+    lk = np.zeros(n, np.int64)
+    rk = np.zeros(n, np.int64)
+    ones = np.ones(n, bool)
+    calls = {"n": 0}
+    real = K.join_fused_kernel
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(K, "join_fused_kernel", counting)
+    dli, dri, dcnt = joins._device_match_indices(lk, rk, ones, ones)
+    assert calls["n"] == 2
+    assert len(dli) == n * n
+    assert dcnt.tolist() == [n] * n
+
+
+# ------------------------------------------------------------- MFU ledger
+
+def test_ledger_records_and_derives():
+    costmodel.ledger_reset()
+    costmodel.ledger_record("argsort", rows=100, nbytes=1e9, seconds=0.5)
+    costmodel.ledger_record("argsort", rows=50, nbytes=1e9, seconds=0.5)
+    snap = costmodel.ledger_snapshot()
+    d = snap["argsort"]
+    assert d["dispatches"] == 2 and d["rows"] == 150
+    assert d["achieved_gbps"] == 2.0
+    assert d["roofline_pct"] == pytest.approx(
+        100.0 * 2e9 / costmodel.hbm_bps(), rel=1e-6)
+    costmodel.ledger_reset()
+    assert costmodel.ledger_snapshot() == {}
+
+
+def test_ledger_delta_isolates_a_query():
+    costmodel.ledger_reset()
+    costmodel.ledger_record("join", rows=10, nbytes=100.0, seconds=0.1)
+    before = costmodel.ledger_snapshot(raw=True)
+    costmodel.ledger_record("join", rows=7, nbytes=50.0, seconds=0.1)
+    costmodel.ledger_record("grouped_agg", rows=3, nbytes=10.0,
+                            flops=1e12, seconds=0.2)
+    delta = costmodel.ledger_delta(before,
+                                   costmodel.ledger_snapshot(raw=True))
+    assert delta["join"]["rows"] == 7
+    assert delta["grouped_agg"]["mfu_pct"] > 0
+    costmodel.ledger_reset()
+
+
+def test_real_dispatches_feed_the_ledger(monkeypatch):
+    """try_argsort and the device join both account their dispatches."""
+    costmodel.ledger_reset()
+    monkeypatch.setenv("DAFT_TPU_DEVICE_FORCE", "1")
+    rb = RecordBatch.from_pydict({"a": [3, 1, 2, None, 5]})
+    rb.argsort([daft_tpu.col("a")], [False], [False])
+    from daft_tpu import joins
+    lk, rk, lv, rv = _join_keys()
+    joins._device_match_indices(lk, rk, lv, rv)
+    snap = costmodel.ledger_snapshot()
+    assert snap["argsort"]["dispatches"] == 1
+    assert snap["argsort"]["rows"] == 5
+    assert snap["join"]["dispatches"] == 1
+    assert snap["join"]["bytes"] > 0 and snap["join"]["seconds"] > 0
+    costmodel.ledger_reset()
+
+
+def test_query_stats_carry_ledger_delta(monkeypatch):
+    """observability: a query's RuntimeStatsContext reports the device
+    dispatches IT caused, and render() prints them."""
+    from daft_tpu import observability as obs
+    costmodel.ledger_reset()
+    ctx = obs.new_query_stats()
+    costmodel.ledger_record("argsort", rows=9, nbytes=1e6, seconds=0.01)
+    ctx.finish()
+    assert ctx.device_kernels["argsort"]["rows"] == 9
+    assert "argsort" in ctx.render()
+    # a later query must not re-report the same work
+    ctx2 = obs.new_query_stats()
+    ctx2.finish()
+    assert ctx2.device_kernels == {}
+    costmodel.ledger_reset()
+
+
+def test_mfu_report_embeds_ledger():
+    from daft_tpu.device import mfu
+    costmodel.ledger_reset()
+    costmodel.ledger_record("join", rows=4, nbytes=1.0, seconds=0.1)
+    r = mfu.report(n=1 << 10)
+    assert "error" not in r, r
+    assert r["ledger"]["join"]["dispatches"] == 1
+    assert r["argsort"]["sort_passes"] == 1
+    costmodel.ledger_reset()
+
+
+def test_dispatch_log_appends_are_serialized(tmp_path, monkeypatch):
+    """Concurrent decision logging must never interleave JSONL lines."""
+    import json
+    import threading
+    log = tmp_path / "d.jsonl"
+    monkeypatch.setenv("DAFT_TPU_DISPATCH_LOG", str(log))
+    monkeypatch.setenv("DAFT_TPU_LINK_RTT_MS", "10")
+    monkeypatch.setenv("DAFT_TPU_LINK_UP_MBPS", "50")
+    monkeypatch.setenv("DAFT_TPU_LINK_DOWN_MBPS", "50")
+    costmodel.reset_for_tests()
+
+    def spam():
+        for _ in range(200):
+            costmodel.row_output_op_wins(1e6, 1e6, host_bytes=2e6)
+
+    threads = [threading.Thread(target=spam) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = log.read_text().splitlines()
+    assert len(lines) == 1600
+    for ln in lines:
+        json.loads(ln)  # every line parses — no interleaving
+    costmodel.reset_for_tests()
+
+
+# ------------------------------------------------- fused-agg group gate
+
+def test_fused_gate_falls_back_to_row_estimate():
+    from daft_tpu.execution import pipeline as pl
+
+    class Node:
+        group_by = ("k",)
+        aggs = ("s",)
+
+    n = Node()
+    n.group_ndv = None
+    n.group_rows_est = None
+    assert pl._fused_groups_admissible(n)          # no evidence: default
+    n.group_rows_est = pl._FUSE_MAX_GROUPS + 1
+    assert not pl._fused_groups_admissible(n)      # row estimate declines
+    n.group_ndv = 1000.0                           # footer evidence wins
+    assert pl._fused_groups_admissible(n)
+
+
+def test_fused_gate_respects_memory_budget(monkeypatch):
+    from daft_tpu.execution import pipeline as pl
+
+    class Node:
+        group_by = ("k",)
+        aggs = ("a", "b")
+
+    n = Node()
+    n.group_ndv = 10_000_000.0  # under the group cap …
+    n.group_rows_est = None
+    monkeypatch.setenv("DAFT_TPU_MEMORY_LIMIT", "64MB")
+    assert not pl._fused_groups_admissible(n)  # … but not under 64MB
+    monkeypatch.setenv("DAFT_TPU_MEMORY_LIMIT", "64GB")
+    assert pl._fused_groups_admissible(n)

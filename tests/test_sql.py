@@ -253,3 +253,45 @@ def test_rollup_grouping_in_window_partition():
     assert list(grand.total) == [7.0]
     lvl0_a = out[(out.lochierarchy == 0) & (out.cat == "a")]
     assert sorted(lvl0_a.rank_within_parent) == [1, 2]
+
+
+def test_self_join_bare_qualified_refs_keep_planning():
+    """Regression (r5 advisory): the bare-name alias of an unaliased
+    qualified ref must NOT apply when it collides with another SELECT
+    item's output name — ``SELECT a.x, b.x FROM t a JOIN t b`` plans as
+    x / right.x instead of raising a duplicate-column error."""
+    t = dt.from_pydict({"x": [1, 2, 3], "k": [1, 1, 2]})
+    out = sql("SELECT a.x, b.x FROM t a JOIN t b ON a.k = b.k",
+              t=t).to_pydict()
+    assert sorted(out.keys()) == ["right.x", "x"]
+    rows = sorted(zip(out["x"], out["right.x"]))
+    # k=1 rows {1,2} self-join → 4 pairs; k=2 row {3} → 1 pair
+    assert rows == [(1, 1), (1, 2), (2, 1), (2, 2), (3, 3)]
+
+
+def test_unaliased_qualified_ref_still_gets_bare_name():
+    """The non-colliding case keeps its SQL-standard bare output name."""
+    t = dt.from_pydict({"customer_id": [7], "k": [1]})
+    out = sql("SELECT c.customer_id FROM t c", t=t).to_pydict()
+    assert list(out.keys()) == ["customer_id"]
+
+
+def test_scalar_subquery_over_empty_relation_yields_null():
+    """Latent host-path bug exposed by the mesh admission gate: the
+    single-row guard's count surfaces as NULL (not 0) for an empty
+    subquery relation through the exchange path — must read as 0."""
+    t = dt.from_pydict({"x": [1, 2]})
+    e = dt.from_pydict({"y": [5]})
+    out = sql("SELECT x, (SELECT y FROM e WHERE y > 100) m FROM t "
+              "ORDER BY x", t=t, e=e).to_pydict()
+    assert out["x"] == [1, 2]
+    assert out["m"] == [None, None]
+
+
+def test_order_by_limit_over_empty_stream():
+    """TopN over a child that yields NO morsels (not just empty ones)
+    must produce an empty result, not IndexError (TPC-DS q8 shape)."""
+    t = dt.from_pydict({"x": [1, 2, 3]})
+    out = sql("SELECT x FROM t WHERE x > 100 ORDER BY x LIMIT 5",
+              t=t).to_pydict()
+    assert out["x"] == []
