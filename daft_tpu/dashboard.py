@@ -33,6 +33,9 @@ def broadcast_query(stats) -> None:
             "ts": time.strftime("%H:%M:%S"),
             "operators": stats.as_dict(),
             "explain": stats.render(getattr(stats, "plan", None)),
+            # resilience plane: recovery events (retries, quarantines,
+            # recomputed map tasks, speculative wins…) for this query
+            "recovery": dict(getattr(stats, "recovery", {}) or {}),
         }
     except Exception:
         return
@@ -58,8 +61,13 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         rows = []
         with _history_lock:
             for i, q in enumerate(reversed(_history)):
+                rec = q.get("recovery") or {}
+                rec_html = ("<p><b>recovery events:</b> "
+                            + html.escape(json.dumps(rec)) + "</p>"
+                            if rec else "")
                 rows.append(
                     f"<h3>query {len(_history) - i} — {q['ts']}</h3>"
+                    f"{rec_html}"
                     f"<pre>{html.escape(q['explain'])}</pre>")
         body = ("<html><head><title>daft-tpu dashboard</title></head><body>"
                 "<h1>daft-tpu queries</h1>"

@@ -10,9 +10,14 @@ between stages ride the mesh collectives or the driver's host exchange.
 
 from .stages import Stage, StagePlan
 from .worker import Worker, InProcessWorker, WorkerManager, StageTask
+from .resilience import (FaultPlan, RetryPolicy, ResilienceContext,
+                         TaskSupervisor, InjectedFault, ShuffleFetchError,
+                         FailFastError, TaskTimeout)
 from .scheduler import (Scheduler, RoundRobinScheduler, LeastLoadedScheduler,
                         StageRunner)
 
 __all__ = ["Stage", "StagePlan", "Worker", "InProcessWorker",
            "WorkerManager", "StageTask", "Scheduler", "RoundRobinScheduler",
-           "LeastLoadedScheduler", "StageRunner"]
+           "LeastLoadedScheduler", "StageRunner", "FaultPlan", "RetryPolicy",
+           "ResilienceContext", "TaskSupervisor", "InjectedFault",
+           "ShuffleFetchError", "FailFastError", "TaskTimeout"]

@@ -35,6 +35,40 @@ def _dumps(obj) -> bytes:
         return pickle.dumps(obj)
 
 
+class RemoteTaskError(RuntimeError):
+    """A remote task failed with an exception that could not be
+    reconstructed locally; carries the remote type name + traceback."""
+
+
+def _exc_payload(exc: BaseException) -> dict:
+    """Serialize a worker-side exception so the scheduler's retry
+    classification (and the user) sees the TRUE cause — the exception
+    object itself when picklable (ShuffleFetchError must survive the
+    wire for lineage recovery), plus type/message/traceback always."""
+    import traceback
+    try:
+        pickled = _dumps(exc)
+        pickle.loads(pickled)  # prove it round-trips
+    except Exception:
+        pickled = None
+    return {"pickled": pickled, "type": type(exc).__name__,
+            "message": str(exc), "traceback": traceback.format_exc()}
+
+
+def _raise_remote(payload: dict) -> None:
+    if payload.get("pickled"):
+        try:
+            exc = pickle.loads(payload["pickled"])
+        except Exception:
+            exc = None
+        if isinstance(exc, BaseException):
+            exc.remote_traceback = payload.get("traceback", "")
+            raise exc
+    raise RemoteTaskError(
+        f"remote worker failed: {payload.get('type')}: "
+        f"{payload.get('message')}\n{payload.get('traceback', '')}")
+
+
 def _parts_to_ipc(parts: List[MicroPartition]) -> bytes:
     sink = io.BytesIO()
     offsets = []
@@ -83,7 +117,10 @@ class WorkerServer:
                 n = int(self.headers.get("Content-Length", 0))
                 blob = self.rfile.read(n)
                 try:
-                    task_plan, inputs_wire, shuffle_out = pickle.loads(blob)
+                    task_plan, inputs_wire, shuffle_out, *rest = \
+                        pickle.loads(blob)
+                    fault_key = rest[0] if rest else ""
+                    attempt = rest[1] if len(rest) > 1 else 0
                     # cloudpickle-serialized closures need cloudpickle's
                     # reducers importable on this host; plan fragments
                     # without closure UDFs decode with plain pickle
@@ -97,7 +134,8 @@ class WorkerServer:
                     def run():
                         return run_task(StageTask(
                             -1, plan, stage_inputs,
-                            shuffle_out=shuffle_out))
+                            shuffle_out=shuffle_out,
+                            fault_key=fault_key, attempt=attempt))
 
                     res = pool.submit(run).result()
                     from .worker import ShuffleResult
@@ -106,9 +144,12 @@ class WorkerServer:
                     else:
                         body = pickle.dumps(("parts", _parts_to_ipc(res)))
                     status = 200
-                except Exception:
-                    import traceback
-                    body = traceback.format_exc().encode()
+                except Exception as exc:
+                    # serialize the REAL exception (type + traceback, and
+                    # the object itself when picklable) so the scheduler's
+                    # retry classification sees the true cause instead of
+                    # an opaque text blob
+                    body = pickle.dumps(("error", _exc_payload(exc)))
                     status = 500
                 self.send_response(status)
                 self.send_header("Content-Length", str(len(body)))
@@ -146,7 +187,12 @@ class RemoteWorker(Worker):
         import os
         import urllib.error
 
+        from .resilience import active_fault_plan
         from .worker import FetchSpec
+        plan = active_fault_plan()
+        if plan is not None:  # injection site 3: remote-worker RPC
+            plan.maybe_fail("rpc", task.fault_key or f"rpc.{self.id}",
+                            attempt=task.attempt)
         inputs_wire = {}
         for k, v in task.stage_inputs.items():
             if isinstance(v, FetchSpec):
@@ -154,16 +200,26 @@ class RemoteWorker(Worker):
             else:
                 inputs_wire[k] = ("parts", _parts_to_ipc(v))
         blob = pickle.dumps((_dumps(task.plan), inputs_wire,
-                             task.shuffle_out))
+                             task.shuffle_out, task.fault_key, task.attempt))
         req = urllib.request.Request(self.address, data=blob, method="POST")
         timeout = float(os.environ.get("DAFT_TPU_WORKER_TIMEOUT", "3600"))
         try:
             with urllib.request.urlopen(req, timeout=timeout) as r:
                 body = r.read()
         except urllib.error.HTTPError as exc:
-            # surface the remote traceback the server sent in the body
-            detail = exc.read().decode(errors="replace")
-            raise RuntimeError(f"remote worker failed:\n{detail}") from exc
+            # the body carries the serialized worker-side exception:
+            # re-raise the original object (retry classification and
+            # lineage recovery see the true cause) or a RemoteTaskError
+            # with the remote type + traceback
+            raw = exc.read()
+            try:
+                kind, payload = pickle.loads(raw)
+            except Exception:
+                raise RuntimeError("remote worker failed:\n"
+                                   + raw.decode(errors="replace")) from exc
+            if kind == "error":
+                _raise_remote(payload)
+            raise RuntimeError(f"remote worker failed: {payload!r}") from exc
         kind, payload = pickle.loads(body)
         if kind == "shuffle":
             return payload

@@ -15,9 +15,11 @@ from typing import Dict, Iterator, List, Optional
 
 from ..micropartition import MicroPartition
 from ..physical import plan as pp
+from .resilience import (FetchRetryState, ResilienceContext, RetryPolicy,
+                         ShuffleFetchError, TaskSupervisor, count)
 from .stages import Boundary, Stage, StagePlan
-from .worker import (FetchSpec, ShuffleOutSpec, ShuffleResult, StageTask,
-                     WorkerManager, WorkerState)
+from .worker import (FetchSpec, ShuffleOutSpec, StageTask, WorkerManager,
+                     WorkerState)
 
 
 def _sort_fragment_root(remainder, pid: int):
@@ -78,15 +80,30 @@ class StageRunner:
     tasks spill hash-partitioned output into their worker's cache, reduce
     tasks fan out one-per-partition and fetch their slice from every map
     worker (the reference's flight-shuffle map/serve/fetch pipeline);
-    every other boundary materializes through the driver. Failed tasks
-    retry once on a different worker. ``DAFT_TPU_DISTRIBUTED_SHUFFLE=
-    driver`` forces the materializing path."""
+    every other boundary materializes through the driver. Failures route
+    through the resilience plane (``resilience.py``): bounded retries
+    with backoff on other workers, per-worker quarantine, lineage
+    recomputation of lost shuffle partitions, and speculative backups
+    for stragglers. ``DAFT_TPU_DISTRIBUTED_SHUFFLE=driver`` forces the
+    materializing path."""
 
     def __init__(self, manager: WorkerManager,
-                 scheduler: Optional[Scheduler] = None, max_retries: int = 1):
+                 scheduler: Optional[Scheduler] = None,
+                 max_retries: Optional[int] = None):
         self.manager = manager
         self.scheduler = scheduler or LeastLoadedScheduler()
-        self.max_retries = max_retries
+        self.max_retries = max_retries  # None → DAFT_TPU_MAX_RETRIES
+        self._rctx: Optional[ResilienceContext] = None
+
+    def _resilience(self) -> ResilienceContext:
+        if self._rctx is None:
+            self._rctx = ResilienceContext(
+                policy=RetryPolicy(max_retries=self.max_retries))
+        return self._rctx
+
+    def _supervisor(self) -> TaskSupervisor:
+        return TaskSupervisor(self._resilience(), self.manager,
+                              self.scheduler)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -96,6 +113,10 @@ class StageRunner:
                               "flight") != "driver"
 
     def run(self, stage_plan: StagePlan) -> Iterator[MicroPartition]:
+        # fresh resilience state per query: quarantines/lineage span
+        # stages but not queries
+        self._rctx = ResilienceContext(
+            policy=RetryPolicy(max_retries=self.max_retries))
         consumer: Dict[int, tuple] = {}
         for s in stage_plan.stages:
             for b in s.boundaries:
@@ -136,8 +157,8 @@ class StageRunner:
                     # boundaries disagree on partition count — no shared
                     # fan-out exists; materialize driver-side instead
                     for up, srcs in fetch_srcs.items():
-                        mat_inputs[up] = self._driver_fetch(srcs,
-                                                            fetch_n[up])
+                        mat_inputs[up] = self._driver_fetch_resilient(
+                            srcs, fetch_n[up], up)
                     outputs[stage.id] = self._run_stage(stage, mat_inputs,
                                                         shuffle_out)
                 else:
@@ -153,16 +174,21 @@ class StageRunner:
         yield from outputs[stage_plan.root.id]
 
     def _cleanup_shuffles(self, fetch_srcs: Dict[int, list]) -> None:
-        """Best-effort release of consumed map outputs, addressed straight
-        to each serving host through the shuffle transport (the address is
-        part of the map receipt — one call per shuffle id)."""
+        """Best-effort release of consumed map outputs when the consuming
+        stage completes, addressed straight to each serving host through
+        the shuffle transport (the address is part of the map receipt —
+        one call per shuffle id). Recovered outputs are released through
+        their whole lineage translation chain (the recomputed replacement
+        lives at a different address than the receipt)."""
         from .shuffle_service import unregister_remote
+        lineage = self._resilience().lineage
         for srcs in fetch_srcs.values():
-            for address, shuffle_id in srcs:
-                try:
-                    unregister_remote(address, shuffle_id)
-                except Exception:
-                    pass
+            for src in srcs:
+                for address, shuffle_id in lineage.chain(tuple(src)):
+                    try:
+                        unregister_remote(address, shuffle_id)
+                    except Exception:
+                        pass
 
     # ------------------------------------------------------------------
     def _make_tasks(self, stage: Stage,
@@ -184,10 +210,12 @@ class StageRunner:
                     continue
                 tasks.append(StageTask(stage.id, stage.with_scan_tasks(chunk),
                                        stage_inputs, task_idx=i,
-                                       shuffle_out=shuffle_out))
+                                       shuffle_out=shuffle_out,
+                                       fault_key=stage.task_key(i)))
             return tasks
         return [StageTask(stage.id, stage.plan, stage_inputs,
-                          shuffle_out=shuffle_out)]
+                          shuffle_out=shuffle_out,
+                          fault_key=stage.task_key(0))]
 
     def _run_stage(self, stage: Stage,
                    stage_inputs: Dict[int, List[MicroPartition]],
@@ -238,7 +266,7 @@ class StageRunner:
                 return self._run_stage(rest, bindings, shuffle_out)
         # defensive fallback: materialize the shuffled inputs driver-side
         for up, srcs in fetch_srcs.items():
-            mat_inputs[up] = self._driver_fetch(srcs, n)
+            mat_inputs[up] = self._driver_fetch_resilient(srcs, n, up)
         return self._run_stage(stage, mat_inputs, shuffle_out)
 
     def _range_sort_remainder(self, sub_stage: Stage, remainder, pid: int,
@@ -289,7 +317,9 @@ class StageRunner:
                 # reading every stored output through the shuffle service
                 rest = Stage(sub_stage.id, remainder, [])
                 bindings: Dict[int, object] = {pid: FetchSpec(
-                    [(r.address, r.shuffle_id) for r in receipts], 0)}
+                    [(r.address, r.shuffle_id) for r in receipts], 0,
+                    keys=[sub_stage.task_key(j, "p1")
+                          for j in range(len(receipts))])}
                 bindings.update(mat_inputs)
                 return self._run_stage(rest, bindings, None)
             bipc = _ipc_bytes(boundaries.to_arrow_table())
@@ -298,31 +328,72 @@ class StageRunner:
                                         boundaries_ipc=bipc)
             phase2 = [StageTask(
                 sub_stage.id, pp.StageInput(pid, sort_node.schema()),
-                {pid: FetchSpec([(r.address, r.shuffle_id)], 0)},
-                task_idx=j, shuffle_out=range_spec)
+                {pid: FetchSpec([(r.address, r.shuffle_id)], 0,
+                                keys=[sub_stage.task_key(j, "p1")])},
+                task_idx=j, shuffle_out=range_spec,
+                fault_key=sub_stage.task_key(j, "p2"))
                 for j, r in enumerate(receipts)]
             receipts2 = self._collect(phase2)
         finally:
             self._cleanup_shuffles(
                 {0: [(r.address, r.shuffle_id) for r in receipts]})
         srcs2 = [(r.address, r.shuffle_id) for r in receipts2]
+        keys2 = [sub_stage.task_key(j, "p2") for j in range(len(receipts2))]
         try:
             tasks = []
             for i in range(k):
-                bindings = {pid: FetchSpec(srcs2, i)}
+                bindings = {pid: FetchSpec(srcs2, i, keys=keys2)}
                 bindings.update(mat_inputs)
                 tasks.append(StageTask(sub_stage.id, remainder, bindings,
-                                       task_idx=i))
+                                       task_idx=i,
+                                       fault_key=sub_stage.task_key(i,
+                                                                    "p3")))
             return self._collect(tasks)
         finally:
             self._cleanup_shuffles({0: srcs2})
 
     @staticmethod
-    def _driver_fetch(srcs: list, n: int) -> List[MicroPartition]:
+    def _driver_fetch(srcs: list, n: int, keys: Optional[list] = None,
+                      partition: Optional[int] = None
+                      ) -> List[MicroPartition]:
+        """Fetch partitions [0, n) — or just ``partition`` — from every
+        source onto the driver."""
         from .worker import resolve_stage_inputs
+        parts = range(n) if partition is None else [partition]
+        out: List[MicroPartition] = []
+        for i in parts:
+            out.extend(resolve_stage_inputs(
+                {0: FetchSpec(srcs, i, keys=keys)})[0])
+        return out
+
+    def _driver_fetch_resilient(self, srcs: list, n: int, up: int
+                                ) -> List[MicroPartition]:
+        """Driver-side materialization with the same fetch-failure
+        handling the worker-side reduce tasks get (one shared
+        ``FetchRetryState`` policy): a backed-off refetch first, lineage
+        recomputation of the producing map task when the same source
+        fails twice (its data is gone). Retries are per-partition, so
+        one flaky fetch never refetches the whole boundary."""
+        import time
+        ctx = self._resilience()
+        keys = [f"s{up}.m{j}" for j in range(len(srcs))]
         out: List[MicroPartition] = []
         for i in range(n):
-            out.extend(resolve_stage_inputs({0: FetchSpec(srcs, i)})[0])
+            state = FetchRetryState(ctx.policy)
+            while True:
+                cur = [ctx.lineage.resolve(tuple(s)) for s in srcs]
+                try:
+                    out.extend(self._driver_fetch(cur, n, keys,
+                                                  partition=i))
+                    break
+                except ShuffleFetchError as exc:
+                    if state.should_recover(exc) \
+                            and not self._supervisor().recover_source(
+                                (exc.address, exc.shuffle_id), exc):
+                        raise
+                    count("retries")
+                    time.sleep(ctx.policy.backoff_s(f"s{up}.p{i}",
+                                                    state.attempts))
         return out
 
     def _run_reduce_fanout(self, stage: Stage, fetch_srcs: Dict[int, list],
@@ -331,37 +402,31 @@ class StageRunner:
                            ) -> list:
         """One reduce task per hash partition: task i binds each shuffled
         input to FetchSpec(partition=i); driver-materialized bindings
-        (broadcast/gather sides) replicate to every task."""
+        (broadcast/gather sides) replicate to every task. Fetch sources
+        carry stable ``s<upstream>.m<map_idx>`` keys so injected faults
+        replay identically across runs (the shuffle uuid does not)."""
         tasks = []
         for i in range(n):
-            si: Dict[int, object] = {up: FetchSpec(srcs, i)
-                                     for up, srcs in fetch_srcs.items()}
+            si: Dict[int, object] = {
+                up: FetchSpec(srcs, i,
+                              keys=[f"s{up}.m{j}"
+                                    for j in range(len(srcs))])
+                for up, srcs in fetch_srcs.items()}
             si.update(mat_inputs)
             tasks.append(StageTask(stage.id, stage.plan, si, task_idx=i,
-                                   shuffle_out=shuffle_out))
+                                   shuffle_out=shuffle_out,
+                                   fault_key=stage.task_key(i, "r")))
         return self._collect(tasks)
 
     def _collect(self, tasks: List[StageTask]) -> list:
-        futures = []
-        for t in tasks:
-            wid = self.scheduler.pick(t, self.manager.snapshot())
-            futures.append((t, wid, self.manager.dispatch(t, wid)))
+        """Dispatch one batch of tasks through the resilient task
+        supervisor (retry/quarantine/lineage/speculation live there) and
+        flatten the per-task results in task order."""
+        per_task = self._supervisor().run(tasks)
         out: list = []
-        for t, wid, fut in futures:
-            try:
-                res = fut.result()
-            except Exception:
-                if self.max_retries < 1:
-                    raise
-                res = self._retry(t, exclude=wid)
+        for res in per_task:
             out.extend(res if isinstance(res, list) else [res])
         return out
-
-    def _retry(self, task: StageTask, exclude: str):
-        states = [s for s in self.manager.snapshot()
-                  if s.worker.id != exclude] or self.manager.snapshot()
-        wid = self.scheduler.pick(task, states)
-        return self.manager.dispatch(task, wid).result()
 
     # ------------------------------------------------------------------
     def _apply_exchange(self, b: Boundary, parts: List[MicroPartition]

@@ -97,6 +97,15 @@ class ShuffleCache:
         with open(p, "rb") as f:
             return f.read()
 
+    def touch(self) -> None:
+        """Refresh the spill dir's mtime: an actively-served output must
+        never look orphaned to the TTL sweep (the TTL is an IDLE bound,
+        not a lifetime bound)."""
+        try:
+            os.utime(self._root, None)
+        except OSError:
+            pass
+
     def partitions(self) -> List[int]:
         return sorted(self._rows)
 
@@ -160,6 +169,7 @@ class ShuffleServer:
                     self.send_response(404)
                     self.end_headers()
                     return
+                cache.touch()
                 body = cache.partition_bytes(pidx)
                 self.send_response(200)
                 self.send_header("Content-Type",
@@ -229,6 +239,7 @@ class FlightShuffleServer:
                 if cache is None:
                     raise paflight.FlightServerError(
                         f"unknown shuffle {sid!r}")
+                cache.touch()
                 path = cache._path(int(pidx))
                 gen = _spill_file_batches(path)
                 first = next(gen, None)
@@ -272,10 +283,68 @@ class FlightShuffleServer:
         self._server.shutdown()
 
 
+def sweep_orphaned_shuffles(root: Optional[str] = None,
+                            ttl_s: Optional[float] = None) -> List[str]:
+    """Startup sweep: delete ``shuffle_<id>/`` spill dirs IDLE longer
+    than a TTL (``DAFT_TPU_SHUFFLE_TTL``, seconds, default 86400) — the
+    remains of crashed workers that never reached
+    ``ShuffleCache.cleanup()``. Serving a partition refreshes the dir's
+    mtime, so an actively-fetched output never ages out.
+    With no explicit ``root``, sweeps this process's spill dir AND every
+    sibling ``daft_tpu_spill_*`` root under the tmpdir (a crashed
+    process's per-process mkdtemp root is exactly where its orphans
+    live). The TTL guards live dirs of concurrent processes. Returns the
+    removed paths."""
+    import glob
+    import shutil
+    import tempfile
+    import time as _time
+    if root is None:
+        from ..execution.memory import spill_dir
+        roots = [spill_dir()]
+        roots += [p for p in glob.glob(os.path.join(
+            tempfile.gettempdir(), "daft_tpu_spill_*"))
+            if p not in roots and os.path.isdir(p)]
+    else:
+        roots = [root]
+    if ttl_s is None:
+        ttl_s = float(os.environ.get("DAFT_TPU_SHUFFLE_TTL", "86400"))
+    removed: List[str] = []
+    cutoff = _time.time() - ttl_s
+    for r in roots:
+        try:
+            entries = os.listdir(r)
+        except OSError:
+            continue
+        for name in entries:
+            if not name.startswith("shuffle_"):
+                continue
+            path = os.path.join(r, name)
+            try:
+                if os.path.isdir(path) and os.path.getmtime(path) < cutoff:
+                    shutil.rmtree(path, ignore_errors=True)
+                    removed.append(path)
+            except OSError:
+                continue
+    return removed
+
+
+_swept_once = False
+
+
 def make_shuffle_server(port: int = 0, host: Optional[str] = None):
     """Transport factory: Arrow Flight when available (the reference's
     design), stdlib HTTP otherwise; ``DAFT_TPU_SHUFFLE_TRANSPORT=http``
-    forces the fallback."""
+    forces the fallback. The first server created in a process also
+    sweeps orphaned shuffle dirs crashed processes left behind (once —
+    the glob+stat walk is not worth repeating per server)."""
+    global _swept_once
+    if not _swept_once:
+        _swept_once = True
+        try:
+            sweep_orphaned_shuffles()
+        except Exception:
+            pass  # janitorial; must never block serving
     pref = os.environ.get("DAFT_TPU_SHUFFLE_TRANSPORT", "flight")
     if pref != "http" and paflight is not None:
         return FlightShuffleServer(port, host=host)
@@ -393,10 +462,43 @@ def unregister_remote(address: str, shuffle_id: str) -> None:
         pass
 
 
-def fetch_partition(address: str, shuffle_id: str, partition: int
-                    ) -> Optional[pa.Table]:
+def fetch_partition(address: str, shuffle_id: str, partition: int,
+                    fault_key: Optional[str] = None) -> Optional[pa.Table]:
     """Reduce-side fetch: partition bytes → Arrow table (reference:
-    flight_client do_get). Dispatches on the address scheme."""
+    flight_client do_get). Dispatches on the address scheme. Any failure
+    raises ``ShuffleFetchError`` carrying the (address, shuffle_id)
+    identity the scheduler's lineage recovery keys on. ``fault_key`` is
+    the stable (run-independent) source identity used for deterministic
+    fault injection; it defaults to the shuffle id."""
+    from .resilience import ShuffleFetchError, active_fault_plan
+    key = fault_key or shuffle_id
+    plan = active_fault_plan()
+    if plan is not None:  # injection site 2: partition fetch
+        if plan.decide("crash", f"{key}/p{partition}"):
+            # a dead map worker: the served data is really gone — every
+            # later fetch of this shuffle fails too, until the scheduler
+            # recomputes the producing map task
+            try:
+                unregister_remote(address, shuffle_id)
+            except Exception:
+                pass
+            raise ShuffleFetchError(address, shuffle_id, partition,
+                                    detail="injected worker crash",
+                                    injected=True)
+        if plan.decide("fetch", f"{key}/p{partition}"):
+            raise ShuffleFetchError(address, shuffle_id, partition,
+                                    detail="injected fetch fault",
+                                    injected=True)
+    try:
+        return _fetch_partition_raw(address, shuffle_id, partition)
+    except Exception as exc:
+        raise ShuffleFetchError(address, shuffle_id, partition,
+                                detail=f"{type(exc).__name__}: "
+                                       f"{str(exc)[:200]}") from exc
+
+
+def _fetch_partition_raw(address: str, shuffle_id: str, partition: int
+                         ) -> Optional[pa.Table]:
     if address.startswith("grpc://"):
         if paflight is None:
             raise RuntimeError(
@@ -415,6 +517,8 @@ def fetch_partition(address: str, shuffle_id: str, partition: int
     url = f"{address}/shuffle/{shuffle_id}/{partition}"
     timeout = float(os.environ.get("DAFT_TPU_SHUFFLE_TIMEOUT", "600"))
     with urllib.request.urlopen(url, timeout=timeout) as r:
+        if r.status != 200:
+            raise RuntimeError(f"shuffle server returned {r.status}")
         body = r.read()
     if not body:
         return None

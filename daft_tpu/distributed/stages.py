@@ -13,6 +13,11 @@ A stage is therefore an exchange-free fragment whose leaves are scan tasks,
 in-memory partitions, or upstream stage outputs — exactly the shape of a
 dispatchable worker task (flotilla's SwordfishTask carries a LocalPhysicalPlan
 fragment the same way).
+
+Stage/task identities (``Stage.task_key``) double as the lineage keys of
+the resilience plane: every shuffle receipt a boundary consumes is
+registered against the producing map task, so a lost partition re-executes
+only its producer (``distributed/resilience.py``).
 """
 
 from __future__ import annotations
@@ -41,6 +46,17 @@ class Stage:
     id: int
     plan: pp.PhysicalPlan
     boundaries: List[Boundary] = field(default_factory=list)
+
+    def task_key(self, task_idx: int, phase: str = "") -> str:
+        """Stable identity of one of this stage's tasks, minted at the
+        planning layer: stage ids come from the deterministic plan-split
+        counter and task indices from the deterministic sharding, so the
+        same query produces the same keys run after run. The resilience
+        plane keys fault-injection decisions and shuffle lineage on these
+        (never on run-specific uuids), which is what makes chaos runs
+        replay bit-identically."""
+        p = f".{phase}" if phase else ""
+        return f"s{self.id}{p}.t{task_idx}"
 
     def is_map_like(self) -> bool:
         """True when the fragment is partition-parallel end-to-end, so its

@@ -56,10 +56,14 @@ class ShuffleResult:
 @dataclass
 class FetchSpec:
     """Reduce-side stage input: pull partition ``partition`` from every
-    listed (address, shuffle_id) map output and concat."""
+    listed (address, shuffle_id) map output and concat. ``keys`` are
+    stable per-source identities (stage/map-task derived, NOT the
+    run-specific shuffle uuid) so fault-injection decisions replay
+    bit-identically across runs."""
 
     sources: List  # [(address, shuffle_id)]
     partition: int
+    keys: Optional[List[str]] = None
 
 
 @dataclass
@@ -76,6 +80,11 @@ class StageTask:
     task_idx: int = 0
     preferred_worker: Optional[str] = None
     shuffle_out: Optional[ShuffleOutSpec] = None
+    # resilience plane: stable task identity for fault injection/lineage
+    # (minted by the stage planner) and the dispatch attempt number (set
+    # by the task supervisor; travels over the remote-worker wire)
+    fault_key: str = ""
+    attempt: int = 0
 
 
 def resolve_stage_inputs(stage_inputs: Dict[int, object]
@@ -87,8 +96,11 @@ def resolve_stage_inputs(stage_inputs: Dict[int, object]
     for sid, binding in stage_inputs.items():
         if isinstance(binding, FetchSpec):
             tables = []
-            for address, shuffle_id in binding.sources:
-                t = fetch_partition(address, shuffle_id, binding.partition)
+            for j, (address, shuffle_id) in enumerate(binding.sources):
+                fkey = binding.keys[j] \
+                    if binding.keys and j < len(binding.keys) else None
+                t = fetch_partition(address, shuffle_id, binding.partition,
+                                    fault_key=fkey)
                 if t is not None and t.num_rows:
                     tables.append(t)
             if tables:
@@ -107,6 +119,12 @@ def run_task(task: StageTask) -> object:
     """Execute one stage task on the local streaming executor. Returns a
     partition list, or a ShuffleResult when the task shuffles out."""
     from ..execution.executor import LocalExecutor
+    from .resilience import active_fault_plan
+    plan = active_fault_plan()
+    if plan is not None:  # injection site 1: task execution
+        plan.maybe_fail("task",
+                        task.fault_key or f"s{task.stage_id}.t{task.task_idx}",
+                        attempt=task.attempt)
     ex = LocalExecutor()
     inputs = resolve_stage_inputs(task.stage_inputs)
     stream = ex.run(task.plan, stage_inputs=inputs)

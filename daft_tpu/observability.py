@@ -39,6 +39,17 @@ def _ledger_raw() -> Dict[str, dict]:
         return {}
 
 
+def _recovery_raw() -> Dict[str, int]:
+    """Raw snapshot of the distributed resilience counters (retries,
+    quarantines, recomputed map tasks, speculative wins/losses …) —
+    never raises, like the device ledger."""
+    try:
+        from .distributed import resilience
+        return resilience.counters_snapshot()
+    except Exception:
+        return {}
+
+
 def device_kernel_ledger() -> Dict[str, dict]:
     """Process-wide per-dispatch achieved-bytes/flops ledger with derived
     roofline/MFU percentages (``costmodel.ledger_record`` feeds it at
@@ -129,6 +140,9 @@ class RuntimeStatsContext:
         # process-wide ledger now, diff at finish() → this query's share
         self._ledger0 = _ledger_raw()
         self.device_kernels: Dict[str, dict] = {}
+        # same pattern for the resilience plane's recovery events
+        self._recovery0 = _recovery_raw()
+        self.recovery: Dict[str, int] = {}
 
     def register(self, node) -> OperatorStats:
         key = id(node)
@@ -167,6 +181,12 @@ class RuntimeStatsContext:
                 self._ledger0, _ledger_raw())
         except Exception:
             self.device_kernels = {}
+        try:
+            from .distributed import resilience
+            self.recovery = resilience.counters_delta(
+                self._recovery0, _recovery_raw())
+        except Exception:
+            self.recovery = {}
 
     # ---- reporting ---------------------------------------------------
     def exclusive_us(self, key: int) -> int:
@@ -221,6 +241,10 @@ class RuntimeStatsContext:
                 lines.append(
                     f"  {kind}: dispatches={d['dispatches']} "
                     f"rows={d['rows']} time={d['seconds']:.3f}s{extra}")
+        if self.recovery:
+            lines.append("resilience (recovery events):")
+            for k, v in sorted(self.recovery.items()):
+                lines.append(f"  {k}: {v}")
         return "\n".join(lines)
 
     def as_dict(self) -> Dict[str, dict]:

@@ -39,7 +39,10 @@ def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
         from jax import shard_map as sm
         mapped = sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                     check_vma=check_vma)
-    except ImportError:
+    except (ImportError, TypeError):
+        # TypeError: intermediate jax versions export top-level shard_map
+        # but still spell the knob check_rep — fall through to the
+        # experimental path, which takes it under that name
         from jax.experimental.shard_map import shard_map as sm
         mapped = sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                     check_rep=check_vma)

@@ -44,9 +44,21 @@ class DistributedRunner(Runner):
 
     def run_iter(self, builder, results_buffer_size: Optional[int] = None
                  ) -> Iterator[MicroPartition]:
+        from .. import observability as obs
         optimized = builder.optimize()
         pplan = translate(optimized.plan)
         stage_plan = StagePlan.from_physical(pplan)
         runner = StageRunner(self._get_manager(),
                              self._scheduler or LeastLoadedScheduler())
-        yield from runner.run(stage_plan)
+        # driver-level query stats: each stage task runs its own local
+        # executor (whose stats only cover that fragment); this context
+        # spans the whole query, so its resilience-counter delta carries
+        # every recovery event of the run into explain_analyze and the
+        # dashboard
+        stats = obs.new_query_stats()
+        stats.plan = pplan
+        try:
+            yield from runner.run(stage_plan)
+        finally:
+            stats.finish()
+            obs.set_last_stats(stats)

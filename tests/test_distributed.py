@@ -326,6 +326,56 @@ def test_remote_worker_shuffles_over_flight(monkeypatch):
         srv.shutdown()
 
 
+def test_transient_fetch_failure_recovers(monkeypatch):
+    """Regression: a reduce-side fetch that fails transiently (network
+    blip, serving worker briefly unreachable) must be retried by the
+    resilience plane — not abort the query — and the recovery must be
+    counted."""
+    from daft_tpu.distributed import resilience as rz
+    from daft_tpu.distributed import shuffle_service as ss
+
+    monkeypatch.setenv("DAFT_TPU_DISTRIBUTED_SHUFFLE", "flight")
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+    monkeypatch.setenv("DAFT_TPU_RETRY_BACKOFF", "0.01")
+    rz.reset_for_tests()
+
+    df = (daft_tpu.from_pydict({"k": [i % 6 for i in range(900)],
+                                "v": [float(i) for i in range(900)]})
+          .into_partitions(3)
+          .groupby("k").agg(col("v").sum().alias("s")).sort("k"))
+    local = df.to_pydict()
+
+    orig = ss.fetch_partition
+    state = {"failed": False}
+
+    def flaky(address, shuffle_id, partition, fault_key=None):
+        if not state["failed"]:
+            state["failed"] = True
+            raise rz.ShuffleFetchError(address, shuffle_id, partition,
+                                       detail="transient blip")
+        return orig(address, shuffle_id, partition, fault_key=fault_key)
+
+    monkeypatch.setattr(ss, "fetch_partition", flaky)
+    runner = DistributedRunner(num_workers=3)
+    import daft_tpu.context as ctx
+    old = ctx.get_context()._runner
+    ctx.get_context().set_runner(runner)
+    try:
+        fresh = (daft_tpu.from_pydict({"k": [i % 6 for i in range(900)],
+                                       "v": [float(i) for i in range(900)]})
+                 .into_partitions(3)
+                 .groupby("k").agg(col("v").sum().alias("s")).sort("k"))
+        dist = fresh.to_pydict()
+    finally:
+        ctx.get_context().set_runner(old)
+    assert state["failed"], "the flaky fetch was never exercised"
+    assert dist == local
+    c = rz.counters_snapshot()
+    assert c.get("fetch_failures", 0) >= 1, c
+    assert c.get("retries", 0) >= 1, c
+    rz.reset_for_tests()
+
+
 def test_sort_merge_join_not_fanned_out(monkeypatch):
     """Regression: a sort_merge-strategy join has NO co-partitioning
     exchanges, so fanning its stage out per hash partition would re-run
