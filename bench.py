@@ -305,6 +305,182 @@ def run_chaos(root):
             "recovery_events": {k: v for k, v in sorted(counters.items())}}
 
 
+def _canon_rows(d: dict):
+    """Column dict → sorted row tuples (floats rounded) for an
+    order-insensitive answer comparison."""
+    cols = sorted(d)
+    return sorted(tuple(round(v, 6) if isinstance(v, float) else v
+                        for v in row)
+                  for row in zip(*(d[c] for c in cols)))
+
+
+def run_shuffle_bench():
+    """``--shuffle``: microbench of the distributed shuffle data plane.
+    Two probes, both landing in the artifact so the trajectory finally
+    captures shuffle throughput:
+
+    1. a TPC-H Q1-shaped distributed group-by (low-cardinality keys,
+       sum/mean/count aggs) through the flight shuffle with the fast path
+       OFF (no combine, no compression) and ON (defaults) — rows/s through
+       the hash exchange, bytes over the wire, compression ratio, combine
+       reduction factor;
+    2. a multi-source reduce fetch, serial vs the bounded parallel pool —
+       the overlap evidence (parallel wall < serial sum).
+    """
+    import numpy as np
+
+    import daft_tpu as dt
+    import daft_tpu.context as dctx
+    from daft_tpu import col
+    from daft_tpu.distributed import shuffle_service as ss
+    from daft_tpu.runners.distributed_runner import DistributedRunner
+
+    rng = np.random.default_rng(8)
+    n = 300_000
+    data = {
+        "rf": rng.integers(0, 3, n).tolist(),
+        "ls": rng.integers(0, 2, n).tolist(),
+        "qty": rng.integers(1, 50, n).astype("float64").tolist(),
+        "price": rng.uniform(1, 100, n).round(2).tolist(),
+    }
+
+    def q1_shape(df):
+        return (df.groupby("rf", "ls")
+                .agg(col("qty").sum().alias("sum_qty"),
+                     col("price").sum().alias("sum_price"),
+                     col("qty").mean().alias("avg_qty"),
+                     col("price").mean().alias("avg_price"),
+                     col("qty").count().alias("cnt"))
+                .sort("rf").to_pydict())
+
+    def one_run(env):
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        runner = DistributedRunner(num_workers=3)
+        old = dctx.get_context()._runner
+        dctx.get_context().set_runner(runner)
+        before = ss.shuffle_counters_snapshot()
+        t0 = time.time()
+        try:
+            out = q1_shape(dt.from_pydict(data).into_partitions(4))
+        finally:
+            dctx.get_context().set_runner(old)
+            if runner._manager is not None:
+                runner._manager.shutdown()
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        elapsed = time.time() - t0
+        d = ss.shuffle_counters_delta(before)
+        return out, elapsed, d
+
+    common = {"DAFT_TPU_DISTRIBUTED_SHUFFLE": "flight",
+              "DAFT_TPU_DEVICE": "0"}
+    base_out, base_s, base_c = one_run({
+        **common, "DAFT_TPU_SHUFFLE_COMBINE": "0",
+        "DAFT_TPU_SHUFFLE_COMPRESSION": "none"})
+    fast_out, fast_s, fast_c = one_run({
+        **common, "DAFT_TPU_SHUFFLE_COMBINE": "auto",
+        "DAFT_TPU_SHUFFLE_COMPRESSION": "lz4"})
+
+    def wire(c):
+        return int(c.get("bytes_written", 0))
+
+    res = {
+        "rows": n,
+        "baseline": {  # pre-PR data plane: raw rows, uncompressed, serial
+            "elapsed_s": round(base_s, 3),
+            "rows_per_s": round(n / base_s, 1),
+            "wire_bytes": wire(base_c),
+            "rows_on_wire": int(base_c.get("rows_pushed", 0)),
+        },
+        "fast_path": {
+            "elapsed_s": round(fast_s, 3),
+            "rows_per_s": round(n / fast_s, 1),
+            "wire_bytes": wire(fast_c),
+            "rows_on_wire": int(fast_c.get("rows_pushed", 0)),
+            "compression_ratio": round(
+                fast_c.get("bytes_pushed_raw", 0)
+                / max(wire(fast_c), 1), 3),
+            "combine_reduction": round(
+                fast_c.get("combine_rows_in", 0)
+                / max(fast_c.get("combine_rows_out", 1), 1), 2),
+            "fetch_wall_s": round(fast_c.get("fetch_span_us", 0) / 1e6, 4),
+            "fetch_serial_equiv_s": round(
+                fast_c.get("fetch_wall_us", 0) / 1e6, 4),
+        },
+        "wire_bytes_saved_ratio": round(
+            wire(base_c) / max(wire(fast_c), 1), 2),
+        # canonicalized: the query sorts by rf only, so tie order among
+        # equal-rf groups is unspecified across the two runs
+        "answers_match": _canon_rows(base_out) == _canon_rows(fast_out),
+    }
+
+    # probe 2: multi-source fetch overlap, serial loop vs the bounded pool
+    import pyarrow as pa
+
+    from daft_tpu.distributed.worker import FetchSpec, _ParallelFetch
+    srv = ss.make_shuffle_server()
+    caches = []
+    big = pa.table({"x": np.arange(400_000, dtype=np.int64),
+                    "y": rng.uniform(size=400_000)})
+    for _ in range(6):
+        c = ss.ShuffleCache()
+        c.push(0, big)
+        srv.register(c)
+        caches.append(c)
+    srcs = [(srv.address, c.shuffle_id) for c in caches]
+    # discarded warm-up pass: both timed measurements below run against
+    # warm page cache + warm server threads, so the speedup isolates
+    # fetch OVERLAP rather than cache warmth
+    for addr, sid in srcs:
+        ss.fetch_partition(addr, sid, 0)
+    t0 = time.time()
+    for addr, sid in srcs:
+        ss.fetch_partition(addr, sid, 0)
+    serial_s = time.time() - t0
+    t0 = time.time()
+    parts = list(_ParallelFetch(FetchSpec(srcs, 0)))
+    parallel_s = time.time() - t0
+    for c in caches:
+        srv.unregister(c.shuffle_id)
+    srv.shutdown()
+    res["fetch_overlap"] = {
+        "sources": len(srcs),
+        "bytes_per_source": int(big.nbytes),
+        "serial_s": round(serial_s, 4),
+        "parallel_s": round(parallel_s, 4),
+        "speedup": round(serial_s / max(parallel_s, 1e-9), 2),
+        "rows_fetched": sum(len(p) for p in parts),
+    }
+
+    # probe 3: codec spill/wire sizes on a real-size payload (the Q1
+    # probe's wire tables are tiny combined group states where IPC
+    # framing dominates and a ratio would mislead)
+    comp = {}
+    for codec in ("none", "lz4", "zstd"):
+        saved = os.environ.get("DAFT_TPU_SHUFFLE_COMPRESSION")
+        os.environ["DAFT_TPU_SHUFFLE_COMPRESSION"] = codec
+        try:
+            c = ss.ShuffleCache()
+            c.push(0, big)
+            c.close()
+            comp[codec] = c.partition_size(0)
+            c.cleanup()
+        finally:
+            if saved is None:
+                os.environ.pop("DAFT_TPU_SHUFFLE_COMPRESSION", None)
+            else:
+                os.environ["DAFT_TPU_SHUFFLE_COMPRESSION"] = saved
+    res["compression_bytes"] = comp
+    if comp.get("none"):
+        res["compression_ratio_lz4"] = round(
+            comp["none"] / max(comp.get("lz4", 1), 1), 3)
+    return res
+
+
 def run_arrow_baseline():
     import pyarrow.compute as pc
     import pyarrow.dataset as pads
@@ -564,6 +740,13 @@ def main():
         if r is not None:
             detail["chaos"] = r
 
+    if "--shuffle" in sys.argv:
+        # shuffle data-plane microbench: hash-exchange rows/s, wire bytes,
+        # compression ratio, combine reduction, fetch overlap
+        r = section("shuffle", run_shuffle_bench, min_needed=40.0)
+        if r is not None:
+            detail["shuffle_bench"] = r
+
     r = section("tpch_sf1_suite_host",
                 lambda: run_tpch_suite(DATA, budget_s=_remaining() - 10),
                 min_needed=20.0)
@@ -613,7 +796,7 @@ def main():
 
     results_dir = os.path.join(REPO, "benchmarking", "results")
     os.makedirs(results_dir, exist_ok=True)
-    artifact = os.path.join(results_dir, "r6_bench_driver.json")
+    artifact = os.path.join(results_dir, "r8_bench_driver.json")
     with open(artifact, "w") as f:
         json.dump(full, f, indent=1)
     # progress/bulk lines first (NOT last): full detail for humans reading
@@ -671,12 +854,19 @@ def main():
         compact["chaos"] = {
             "match": ch.get("match"),
             "events": sum(ch.get("recovery_events", {}).values())}
+    sb = detail.get("shuffle_bench")
+    if isinstance(sb, dict) and "error" not in sb:
+        compact["shuffle"] = {
+            "rows_per_s": sb["fast_path"]["rows_per_s"],
+            "wire_saved": sb.get("wire_bytes_saved_ratio"),
+            "combine_x": sb["fast_path"].get("combine_reduction"),
+            "fetch_speedup": sb.get("fetch_overlap", {}).get("speedup")}
     if skipped:
         compact["n_skipped"] = len(skipped)
     if errors:
         compact["n_errors"] = len(errors)
     # hard cap: drop optional keys until the line fits the driver's window
-    for drop in ("chaos", "ledger_dispatches", "mfu", "families",
+    for drop in ("shuffle", "chaos", "ledger_dispatches", "mfu", "families",
                  "q1_winner", "backend"):
         if len(json.dumps(compact)) <= 1500:
             break

@@ -40,6 +40,85 @@ _PA_AGGS = {
 }
 
 
+# ----------------------------------------------- partial/merge decomposition
+
+#: How each aggregation decomposes across a shuffle/pipeline boundary:
+#: ``op -> (partial-state ops over the input, merge op over each state
+#: column)``. Single-sourced on purpose — three layers read it:
+#: the planner's partial/final split (``physical/translate._split_aggs``),
+#: the local fused partitioned-agg reducer (``execution/pipeline``), and
+#: the distributed map-side shuffle combine
+#: (``distributed/stages.combine_for_boundary`` → ``worker.run_task``).
+#: An op absent here (see :data:`NON_DECOMPOSABLE_AGGS`) aggregates in a
+#: single stage over gathered/co-partitioned rows.
+AGG_DECOMPOSITION: Dict[str, Tuple[Tuple[str, ...], str]] = {
+    "sum": (("sum",), "sum"),
+    "count": (("count",), "sum"),
+    "min": (("min",), "min"),
+    "max": (("max",), "max"),
+    "any_value": (("any_value",), "any_value"),
+    "bool_and": (("bool_and",), "bool_and"),
+    "bool_or": (("bool_or",), "bool_or"),
+    "list": (("list",), "concat"),
+    "concat": (("concat",), "concat"),
+    "mean": (("sum", "count"), "sum"),
+    "stddev": (("sum", "count", "sumsq"), "sum"),
+    "var": (("sum", "count", "sumsq"), "sum"),
+}
+
+#: aggregations with no partial/merge split — their whole input must meet
+#: in one place (the planner gathers or co-partitions the raw rows)
+NON_DECOMPOSABLE_AGGS = frozenset({
+    "count_distinct", "approx_count_distinct", "approx_percentiles",
+    "skew", "set"})
+
+#: merge-stage ops that are associative SELF-merges: re-applying the op
+#: over its own output column correctly merges two batches of state
+#: (derived from the table above — every merge op is one)
+SELF_MERGE_OPS = frozenset(m for _, m in AGG_DECOMPOSITION.values())
+
+
+def merge_exprs_for(aggs: List[Expression], alias_to: str = "out"
+                    ) -> Optional[List[Expression]]:
+    """For merge/final-stage aggs shaped ``op(col(p)).alias(out)`` whose
+    ops are all self-merges, the expressions that merge two batches of
+    aggregated state:
+
+    - ``alias_to="out"`` — merge batches of FINAL-schema state:
+      ``op(col(out)).alias(out)`` (the fused partitioned-agg reducer's
+      shape in ``execution/pipeline.py``).
+    - ``alias_to="source"`` — merge batches of WIRE-schema partial
+      columns: ``op(col(p)).alias(p)`` (the map-side shuffle combine's
+      shape: the combined output keeps the exact map-output schema, so
+      the reduce side is unchanged).
+
+    Returns None when any agg is not a single-column self-merge — the
+    caller falls back to its unmerged path."""
+    out: List[Expression] = []
+    seen: Dict[str, str] = {}
+    for a in aggs:
+        u = a._unalias()
+        if not u.op.startswith("agg.") or u.op[4:] not in SELF_MERGE_OPS \
+                or len(u.args) != 1:
+            return None
+        arg = u.args[0]._unalias()
+        if arg.op != "col":
+            return None
+        if alias_to == "out":
+            out.append(Expression(u.op, (col(a.name()),), u.params)
+                       .alias(a.name()))
+        else:
+            src = arg.name()
+            prev = seen.get(src)
+            if prev is not None:
+                if prev != u.op:
+                    return None  # conflicting merges of one wire column
+                continue
+            seen[src] = u.op
+            out.append(Expression(u.op, (col(src),), u.params).alias(src))
+    return out
+
+
 def agg_recordbatch(batch, to_agg: List[Expression], group_by: List[Expression]):
     from .recordbatch import RecordBatch
 

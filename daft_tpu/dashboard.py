@@ -36,6 +36,9 @@ def broadcast_query(stats) -> None:
             # resilience plane: recovery events (retries, quarantines,
             # recomputed map tasks, speculative wins…) for this query
             "recovery": dict(getattr(stats, "recovery", {}) or {}),
+            # shuffle data plane: bytes written/fetched, compression
+            # ratio inputs, combine reduction, fetch overlap
+            "shuffle": dict(getattr(stats, "shuffle", {}) or {}),
         }
     except Exception:
         return
@@ -65,9 +68,14 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 rec_html = ("<p><b>recovery events:</b> "
                             + html.escape(json.dumps(rec)) + "</p>"
                             if rec else "")
+                shf = q.get("shuffle") or {}
+                shf_html = ("<p><b>shuffle:</b> "
+                            + html.escape(json.dumps(
+                                {k: round(v, 1) for k, v in shf.items()}))
+                            + "</p>" if shf else "")
                 rows.append(
                     f"<h3>query {len(_history) - i} — {q['ts']}</h3>"
-                    f"{rec_html}"
+                    f"{rec_html}{shf_html}"
                     f"<pre>{html.escape(q['explain'])}</pre>")
         body = ("<html><head><title>daft-tpu dashboard</title></head><body>"
                 "<h1>daft-tpu queries</h1>"

@@ -511,6 +511,59 @@ def agg_upload_wins(bytes_up: float, bytes_down: float,
     return dev_s < host_s
 
 
+# distributed-shuffle wire model: the DCN-tier host↔host transport
+# (flight/HTTP shuffle service), NOT the host↔device link profiled above.
+# Coarse constants in the same spirit as the host kernel bandwidths — the
+# decision only needs the ratio between an agg pass and a row's full
+# shuffle trip (serialize + wire + deserialize + reduce-side agg) to the
+# right order of magnitude. DAFT_TPU_SHUFFLE_WIRE_MBPS overrides for real
+# pod DCN numbers.
+SHUFFLE_SER_BPS = 2.0e9   # arrow IPC write/read, per side, per byte
+
+
+def shuffle_wire_bps() -> float:
+    return float(os.environ.get("DAFT_TPU_SHUFFLE_WIRE_MBPS", "1000")) * 1e6
+
+
+def shuffle_combine_wins(rows: Optional[int], groups: Optional[int],
+                         num_partitions: int, n_cols: int = 4,
+                         bytes_per_col: float = 8.0) -> bool:
+    """Price the map-side shuffle combine for a hash boundary feeding a
+    decomposable grouped aggregation (Partial Partial Aggregates).
+
+    The combine pays one extra grouped-agg pass over the map output
+    (``rows`` state rows at ``HOST_AGG_BPS``) and saves the full shuffle
+    trip — IPC serialize, wire, deserialize, reduce-side agg — for every
+    row it eliminates: without the combine the wire carries ~``rows``
+    per-morsel group states, with it at most ``groups × num_partitions``
+    (each map task holds ≤ groups states per partition). Near-unique keys
+    (TPC-H Q18's shape) eliminate almost nothing and decline; reductive
+    group-bys (Q1's shape) accept.
+
+    With no cardinality evidence the combine wins by default — for
+    decomposable aggs the pre-shuffle combine is the literature's default,
+    and its worst case (zero reduction) costs one extra linear pass while
+    its best saves the whole wire. The decision lands in
+    ``decision_counts``/the dispatch log under ``shuffle_combine``
+    ("device" = combine applied)."""
+    row_bytes = max(n_cols, 1) * bytes_per_col
+    if not rows or not groups:
+        # no cardinality evidence: default-accept, logged like every
+        # other decision so the combine is always traceable
+        _log("shuffle_combine", True, 0.0, 0.0, rows=rows or 0,
+             groups=groups or 0, num_partitions=num_partitions)
+        return True
+    groups_out = min(rows, groups * max(num_partitions, 1))
+    saved_rows = max(rows - groups_out, 0)
+    per_byte_trip = (2.0 / SHUFFLE_SER_BPS + 1.0 / shuffle_wire_bps()
+                     + 1.0 / HOST_AGG_BPS)
+    saved_s = saved_rows * row_bytes * per_byte_trip
+    extra_s = rows * row_bytes / HOST_AGG_BPS
+    _log("shuffle_combine", saved_s > extra_s, extra_s, saved_s,
+         rows=rows, groups=groups, num_partitions=num_partitions)
+    return saved_s > extra_s
+
+
 def join_wins(n_left: int, n_right: int, bytes_up: float,
               bytes_down: float) -> bool:
     """Equi-join as the fused device sort-merge: output is one packed

@@ -139,6 +139,10 @@ class StageRunner:
                              is not None):
                     shuffle_out = ShuffleOutSpec(b.num_partitions,
                                                  tuple(b.by))
+                    combo = self._plan_combine(stage_plan, cstage, b, stage)
+                    if combo is not None:
+                        shuffle_out.combine_aggs, \
+                            shuffle_out.combine_by = combo
             fetch_srcs: Dict[int, list] = {}
             fetch_n: Dict[int, int] = {}
             mat_inputs: Dict[int, List[MicroPartition]] = {}
@@ -172,6 +176,34 @@ class StageRunner:
                                                     shuffle_out)
             shuffled[stage.id] = shuffle_out is not None
         yield from outputs[stage_plan.root.id]
+
+    def _plan_combine(self, stage_plan: StagePlan, cstage: Stage,
+                      b: Boundary, up_stage: Stage):
+        """Decide the map-side combine for one hash boundary: structural
+        eligibility comes from the stage planner
+        (``StagePlan.combine_for_boundary`` — the boundary must feed a
+        final grouped aggregation whose aggs are all self-merges), then
+        the cost model prices the modeled wire savings against the extra
+        map-side agg pass (``costmodel.shuffle_combine_wins`` over the
+        planner's row/NDV evidence). ``DAFT_TPU_SHUFFLE_COMBINE=1``
+        forces it, ``0`` is the escape hatch, default ``auto``."""
+        import os
+        mode = os.environ.get("DAFT_TPU_SHUFFLE_COMBINE", "auto").lower()
+        if mode in ("0", "off", "false", "none"):
+            return None
+        combo = stage_plan.combine_for_boundary(cstage, b, up_stage)
+        if combo is None:
+            return None
+        combine_aggs, combine_by, agg_node = combo
+        if mode not in ("1", "on", "force", "true"):
+            from ..device import costmodel
+            rows = getattr(agg_node, "group_rows_est", None)
+            groups = getattr(agg_node, "group_ndv", None)
+            if not costmodel.shuffle_combine_wins(
+                    rows, groups, b.num_partitions,
+                    n_cols=len(combine_aggs) + len(combine_by)):
+                return None
+        return combine_aggs, combine_by
 
     def _cleanup_shuffles(self, fetch_srcs: Dict[int, list]) -> None:
         """Best-effort release of consumed map outputs when the consuming

@@ -22,6 +22,7 @@ import http.server
 import io
 import os
 import threading
+import urllib.error
 import urllib.parse
 import urllib.request
 import uuid
@@ -34,6 +35,79 @@ try:
     import pyarrow.flight as paflight
 except ImportError:  # pragma: no cover - flight is baked into this image
     paflight = None
+
+
+# ------------------------------------------------------- shuffle counters
+# Process-wide data-plane accounting, mirroring the device-kernel dispatch
+# ledger and the resilience counters: ``RuntimeStatsContext`` snapshots at
+# query start and diffs at finish() for the per-query ``shuffle`` block
+# (bytes written/fetched, compression ratio, combine reduction, fetch wall
+# vs serial-equivalent time).
+
+_shuffle_counters_lock = threading.Lock()
+_shuffle_counters: Dict[str, float] = {}
+
+
+def shuffle_count(name: str, n: float = 1) -> None:
+    with _shuffle_counters_lock:
+        _shuffle_counters[name] = _shuffle_counters.get(name, 0) + n
+
+
+def shuffle_counters_snapshot() -> Dict[str, float]:
+    with _shuffle_counters_lock:
+        return dict(_shuffle_counters)
+
+
+def shuffle_counters_delta(before: Dict[str, float],
+                           after: Optional[Dict[str, float]] = None
+                           ) -> Dict[str, float]:
+    if after is None:
+        after = shuffle_counters_snapshot()
+    out = {}
+    for k, v in after.items():
+        d = v - before.get(k, 0)
+        if d:
+            out[k] = d
+    return out
+
+
+def shuffle_counters_reset() -> None:
+    with _shuffle_counters_lock:
+        _shuffle_counters.clear()
+
+
+# ------------------------------------------------------ wire compression
+
+#: spill/wire chunk size: the HTTP handler sends (and the orphan of a
+#: partition occupies) at most this much resident memory per partition,
+#: regardless of partition size
+_CHUNK_BYTES = 1 << 20
+
+_ipc_opts_cache: Dict[str, Tuple[Optional[object], Optional[str]]] = {}
+
+
+def _ipc_write_options() -> Tuple[Optional["paipc.IpcWriteOptions"],
+                                  Optional[str]]:
+    """(IPC write options, codec name) for shuffle spill writers.
+    ``DAFT_TPU_SHUFFLE_COMPRESSION=lz4|zstd|none`` (default ``lz4``)
+    selects Arrow IPC *buffer* compression — self-describing on the wire,
+    so readers (``_spill_streams`` / ``_spill_file_batches`` / the fetch
+    path, including the post-seal straggler-append single-write branch)
+    need no configuration. Auto-falls back to uncompressed when the codec
+    is missing from this pyarrow build."""
+    pref = os.environ.get("DAFT_TPU_SHUFFLE_COMPRESSION", "lz4").lower()
+    if pref in ("none", "off", "0", ""):
+        return None, None
+    hit = _ipc_opts_cache.get(pref)
+    if hit is not None:
+        return hit
+    try:
+        opts = paipc.IpcWriteOptions(compression=pref)
+    except Exception:
+        opts = None  # unknown codec / not built in → uncompressed
+    out = (opts, pref if opts is not None else None)
+    _ipc_opts_cache[pref] = out
+    return out
 
 
 class ShuffleCache:
@@ -57,7 +131,8 @@ class ShuffleCache:
         w = self._writers.get(partition)
         if w is None:
             f = open(self._path(partition), "ab")
-            w = (paipc.new_stream(f, schema), f)
+            opts, _ = _ipc_write_options()
+            w = (paipc.new_stream(f, schema, options=opts), f)
             self._writers[partition] = w
         return w[0]
 
@@ -72,30 +147,72 @@ class ShuffleCache:
                 # a torn header mid-stream (fetch also tolerates a
                 # truncated tail — see _spill_streams)
                 buf = io.BytesIO()
-                with paipc.new_stream(buf, table.schema) as w:
+                opts, _ = _ipc_write_options()
+                with paipc.new_stream(buf, table.schema, options=opts) as w:
                     w.write_table(table)
+                payload = buf.getvalue()
                 with open(self._path(partition), "ab") as f:
-                    f.write(buf.getvalue())
+                    f.write(payload)
                     f.flush()
                     os.fsync(f.fileno())
+                shuffle_count("bytes_written", len(payload))
             else:
                 self._writer(partition, table.schema).write_table(table)
             self._rows[partition] = self._rows.get(partition, 0) + len(table)
+        shuffle_count("rows_pushed", table.num_rows)
+        shuffle_count("bytes_pushed_raw", table.nbytes)
 
     def close(self) -> None:
         with self._lock:
+            if self._sealed:
+                return
             for w, f in self._writers.values():
                 w.close()
                 f.close()
             self._writers = {}
             self._sealed = True
+            # on-disk == on-wire bytes (the server streams the spill files
+            # verbatim); straggler appends are counted at push time
+            written = 0
+            for p in self._rows:
+                try:
+                    written += os.path.getsize(self._path(p))
+                except OSError:
+                    pass
+        shuffle_count("bytes_written", written)
 
-    def partition_bytes(self, partition: int) -> bytes:
+    def partition_chunks(self, partition: int, limit: Optional[int] = None,
+                         chunk_bytes: int = _CHUNK_BYTES):
+        """Yield one partition's spill-file bytes in bounded chunks — the
+        serving side's resident memory is ``chunk_bytes``, never the
+        partition size. Reads exactly ``limit`` bytes when given (the size
+        an HTTP Content-Length was announced from), else the size at open,
+        so a concurrent straggler append can't outgrow an announced
+        length."""
         p = self._path(partition)
-        if not os.path.exists(p):
-            return b""
-        with open(p, "rb") as f:
-            return f.read()
+        if limit is None:
+            try:
+                limit = os.path.getsize(p)
+            except OSError:
+                return
+        try:
+            f = open(p, "rb")
+        except OSError:
+            return
+        with f:
+            remaining = limit
+            while remaining > 0:
+                chunk = f.read(min(chunk_bytes, remaining))
+                if not chunk:
+                    return
+                remaining -= len(chunk)
+                yield chunk
+
+    def partition_size(self, partition: int) -> int:
+        try:
+            return os.path.getsize(self._path(partition))
+        except OSError:
+            return 0
 
     def touch(self) -> None:
         """Refresh the spill dir's mtime: an actively-served output must
@@ -170,13 +287,18 @@ class ShuffleServer:
                     self.end_headers()
                     return
                 cache.touch()
-                body = cache.partition_bytes(pidx)
+                # chunked send off the spill file: resident memory is one
+                # chunk, never the partition (Content-Length comes from a
+                # stat, and partition_chunks sends exactly that many bytes
+                # even under a concurrent straggler append)
+                size = cache.partition_size(pidx)
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "application/vnd.apache.arrow.stream")
-                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Content-Length", str(size))
                 self.end_headers()
-                self.wfile.write(body)
+                for chunk in cache.partition_chunks(pidx, limit=size):
+                    self.wfile.write(chunk)
 
         self._server = http.server.ThreadingHTTPServer((self._host, port),
                                                        Handler)
@@ -256,6 +378,13 @@ class FlightShuffleServer:
                     for _, b in gen:
                         yield b
 
+                opts, _ = _ipc_write_options()
+                if opts is not None:
+                    try:  # compress the Flight wire like the spill files
+                        return paflight.GeneratorStream(schema, batches(),
+                                                        options=opts)
+                    except TypeError:  # pyarrow without the options kwarg
+                        pass
                 return paflight.GeneratorStream(schema, batches())
 
         # the port is bound in __init__ (so .port is valid immediately);
@@ -489,12 +618,101 @@ def fetch_partition(address: str, shuffle_id: str, partition: int,
             raise ShuffleFetchError(address, shuffle_id, partition,
                                     detail="injected fetch fault",
                                     injected=True)
+    import time as _time
+    t0 = _time.perf_counter()
     try:
-        return _fetch_partition_raw(address, shuffle_id, partition)
+        out = _fetch_partition_raw(address, shuffle_id, partition)
     except Exception as exc:
         raise ShuffleFetchError(address, shuffle_id, partition,
                                 detail=f"{type(exc).__name__}: "
                                        f"{str(exc)[:200]}") from exc
+    # serial-equivalent fetch time: the per-call sum the parallel fetch's
+    # span is compared against in the stats/bench overlap evidence
+    shuffle_count("fetch_wall_us", (_time.perf_counter() - t0) * 1e6)
+    shuffle_count("fetches")
+    return out
+
+
+class _CountingStream:
+    """Minimal file-like over an HTTP response: counts wire bytes and
+    supports 1-probe pushback so concatenated IPC streams can be read
+    incrementally (never buffering the whole body)."""
+
+    def __init__(self, raw):
+        self._raw = raw
+        self._buf = b""
+        self.nread = 0
+
+    def read(self, n=-1):
+        if n is None or n < 0:
+            out = self._buf + self._raw.read()
+            self._buf = b""
+        elif self._buf:
+            out, self._buf = self._buf[:n], self._buf[n:]
+            if len(out) < n:
+                out += self._raw.read(n - len(out))
+        else:
+            out = self._raw.read(n)
+        self.nread += len(out)
+        return out
+
+    def push(self, data: bytes) -> None:
+        self._buf = data + self._buf
+        self.nread -= len(data)
+
+    def readable(self):
+        return True
+
+    def seekable(self):
+        return False
+
+    def writable(self):
+        return False
+
+    @property
+    def closed(self):
+        return False
+
+    def flush(self):
+        pass
+
+    def close(self):
+        # pyarrow's PythonFile closes the source when the reader closes;
+        # the SAME response must stay readable for the next concatenated
+        # stream, so closing is a no-op (the with-block on the response
+        # owns the socket)
+        pass
+
+
+def _iter_stream_tables(f: "_CountingStream"):
+    """Yield one Table per concatenated IPC stream, read INCREMENTALLY off
+    a file-like (the HTTP fetch path: resident memory is the decoded
+    batches of the current stream, never the raw body). A truncated
+    trailing stream — a torn straggler append — is logged and dropped,
+    same contract as ``_spill_streams``."""
+    while True:
+        head = f.read(1)  # probe: clean EOF between streams?
+        if not head:
+            return
+        f.push(head)
+        start = f.nread
+        try:
+            with paipc.open_stream(f) as rd:
+                batches = list(rd)
+                schema = rd.schema
+        except pa.ArrowInvalid:
+            # drain-and-count in chunks: the dropped-tail size for the log
+            # without materializing the remaining body (this path must stay
+            # as memory-bounded as the happy path)
+            rest = 0
+            while True:
+                chunk = f.read(_CHUNK_BYTES)
+                if not chunk:
+                    break
+                rest += len(chunk)
+            _log_truncated_tail(start, f.nread + rest)
+            return
+        yield pa.Table.from_batches(batches, schema=schema)
 
 
 def _fetch_partition_raw(address: str, shuffle_id: str, partition: int
@@ -512,16 +730,28 @@ def _fetch_partition_raw(address: str, shuffle_id: str, partition: int
             t = reader.read_all()
         finally:
             client.close()
+        # decoded batch bytes — the flight client API exposes no
+        # compressed-frame size; wire compression shows on the WRITE side
+        # (bytes_written vs bytes_pushed_raw), which both transports share
+        shuffle_count("bytes_fetched", t.nbytes)
         meta = t.schema.metadata or {}
         return None if meta.get(b"daft_tpu_empty") == b"1" else t
     url = f"{address}/shuffle/{shuffle_id}/{partition}"
     timeout = float(os.environ.get("DAFT_TPU_SHUFFLE_TIMEOUT", "600"))
-    with urllib.request.urlopen(url, timeout=timeout) as r:
-        if r.status != 200:
-            raise RuntimeError(f"shuffle server returned {r.status}")
-        body = r.read()
-    if not body:
+    try:
+        r = urllib.request.urlopen(url, timeout=timeout)
+    except urllib.error.HTTPError as exc:
+        # urlopen raises on every non-200 — surface the status explicitly
+        # so ShuffleFetchError.detail carries it (a 404 here usually means
+        # the serving worker unregistered/lost the shuffle: lineage
+        # recovery's cue)
+        raise RuntimeError(
+            f"shuffle server returned HTTP {exc.code} for "
+            f"{shuffle_id}/p{partition}") from exc
+    with r:
+        src = _CountingStream(r)
+        tables = list(_iter_stream_tables(src))
+        shuffle_count("bytes_fetched", max(src.nread, 0))
+    if not tables:
         return None
-    tables = [pa.Table.from_batches(batches, schema=schema)
-              for schema, batches in _spill_streams(body)]
-    return pa.concat_tables(tables) if tables else None
+    return pa.concat_tables(tables) if len(tables) > 1 else tables[0]

@@ -234,6 +234,57 @@ class StagePlan:
 
         return cut, clone(stage.plan), placeholder_id
 
+    def combine_for_boundary(self, consumer: Stage, b: Boundary,
+                             upstream: Stage):
+        """Map-side combine plan for one hash boundary: when the boundary
+        feeds a final grouped aggregation whose aggs are all associative
+        self-merges (``aggs.AGG_DECOMPOSITION``), return
+        ``(combine_aggs, combine_by, agg_node)`` — the merge expressions
+        each map task applies per partition before ``ShuffleCache.push``
+        (wire carries group states instead of rows), aliased so the
+        combined output keeps the upstream stage's EXACT wire schema.
+        None when the consumer isn't that shape, any agg falls outside
+        the self-merge table (non-decomposable sets keep today's plan),
+        or the combine would drop a wire column no final agg reads."""
+        from ..aggs import merge_exprs_for
+        if b.kind != "hash" or not b.by:
+            return None
+        agg = self._consumer_agg(consumer.plan, b.upstream)
+        if agg is None or not agg.group_by:
+            return None
+        if {e.name() for e in b.by} != {e.name() for e in agg.group_by}:
+            return None
+        merge = merge_exprs_for(agg.aggs, alias_to="source")
+        if merge is None:
+            return None
+        wire_cols = list(upstream.plan.schema().column_names)
+        by_names = {e.name() for e in b.by}
+        if {e.name() for e in merge} | by_names != set(wire_cols):
+            return None  # a wire column no final agg reads would vanish
+        order = {n: i for i, n in enumerate(wire_cols)}
+        merge.sort(key=lambda e: order[e.name()])
+        return tuple(merge), tuple(b.by), agg
+
+    @staticmethod
+    def _consumer_agg(node, upstream: int):
+        """The UNIQUE final Aggregate directly consuming
+        ``StageInput(upstream)``, else None (an aggregate reached through
+        intermediate operators can't combine: the wire rows feed those
+        operators first)."""
+        found = []
+
+        def walk(n):
+            if isinstance(n, pp.Aggregate) and n.children \
+                    and isinstance(n.children[0], pp.StageInput) \
+                    and n.children[0].stage_id == upstream \
+                    and n.mode in ("final", "single"):
+                found.append(n)
+            for c in n.children:
+                walk(c)
+
+        walk(node)
+        return found[0] if len(found) == 1 else None
+
     def repr_ascii(self) -> str:
         lines = []
         for s in self.stages:

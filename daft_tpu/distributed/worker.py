@@ -11,12 +11,19 @@ multi-host gRPC worker drops in without touching the scheduler.
 from __future__ import annotations
 
 import concurrent.futures as cf
+import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..micropartition import MicroPartition
 from ..physical import plan as pp
+
+#: map-side combine: merge buffered per-partition state only once the
+#: buffer rivals the state (LSM-style amortization, same rule as the
+#: local fused reducer in execution/pipeline.py)
+_COMBINE_REAGG_ROWS = 1 << 16
 
 
 @dataclass
@@ -31,7 +38,18 @@ class ShuffleOutSpec:
       computation: phase 1 of the distributed range/sort protocol.
     - ``range`` — range-partition by ``by`` against ``boundaries_ipc``
       (arrow-IPC boundary rows): phase 2; rows move worker→worker, the
-      driver only ever sees samples, boundaries and receipts."""
+      driver only ever sees samples, boundaries and receipts.
+
+    ``combine_aggs``/``combine_by`` (hash only) switch on the MAP-SIDE
+    COMBINE: each partition's morsels are pre-aggregated to one
+    group-state table before ``ShuffleCache.push``, so the wire carries
+    group states instead of per-morsel rows (Partial Partial Aggregates).
+    The combine exprs are self-merge aggs over the map-output (wire)
+    schema and PRESERVE it, so the reduce side is byte-compatible with the
+    uncombined plan; the stage planner only attaches them when the
+    consumer is a decomposable final aggregation and the cost model prices
+    the wire savings above the extra agg pass
+    (``stages.combine_for_boundary`` + ``costmodel.shuffle_combine_wins``)."""
 
     num_partitions: int
     by: tuple  # key Expressions
@@ -39,6 +57,8 @@ class ShuffleOutSpec:
     descending: tuple = ()
     boundaries_ipc: Optional[bytes] = None
     sample_k: int = 0
+    combine_aggs: Optional[tuple] = None  # merge exprs over the wire schema
+    combine_by: tuple = ()                # combine group keys (boundary keys)
 
 
 @dataclass
@@ -87,29 +107,219 @@ class StageTask:
     attempt: int = 0
 
 
-def resolve_stage_inputs(stage_inputs: Dict[int, object]
-                         ) -> Dict[int, List[MicroPartition]]:
-    """Materialize any FetchSpec bindings via the shuffle service."""
+def _chaos_serialized() -> bool:
+    return os.environ.get("DAFT_TPU_CHAOS_SERIALIZE", "0") \
+        not in ("0", "", "false")
+
+
+def fetch_parallelism() -> int:
+    """Bounded per-source fetch concurrency for a reduce task's stage
+    input (``DAFT_TPU_SHUFFLE_FETCH_PARALLELISM``, default 4).
+    ``DAFT_TPU_CHAOS_SERIALIZE=1`` forces 1 — deterministic sequential
+    source order, bit-identical to the pre-parallel fetch path, which is
+    what keeps the chaos-replay contract. An ACTIVE FAULT PLAN also
+    defaults to 1 (explicit env setting wins): the parallel pool rolls
+    EVERY source's injection decision on every attempt — a failing source
+    no longer short-circuits the later ones — which multiplies injected
+    faults (crash faults really destroy their sources) per retry and
+    exhausts retry budgets the resilience plane's chaos scenarios were
+    tuned for. Chaos runs measure recovery, not fetch throughput."""
+    if _chaos_serialized():
+        return 1
+    env = os.environ.get("DAFT_TPU_SHUFFLE_FETCH_PARALLELISM")
+    if env is not None:
+        try:
+            return max(int(env), 1)
+        except ValueError:
+            pass  # unparsable → the fault-plan-aware default below
+    from .resilience import active_fault_plan
+    return 1 if active_fault_plan() is not None else 4
+
+
+def _stream_safe(plan: pp.PhysicalPlan, sid: int,
+                 has_shuffle_out: bool) -> bool:
+    """True when delivering a FetchSpec binding as MULTIPLE morsels (one
+    per source, as fetches land) preserves the fragment's semantics:
+
+    - the unique direct consumer of ``StageInput(sid)`` is a final
+      grouped/global Aggregate whose aggs are all self-merges — the
+      executor's streaming merge-agg re-merges across morsels
+      (``LocalExecutor._merge_agg_stream``), so fetch overlaps reduce
+      compute; or
+    - every node between the root and the StageInput is row-local
+      (Project/Filter/UDFProject/Explode/Unpivot) AND the task shuffles
+      out — the morsels are re-partitioned into the cache, so output
+      granularity is invisible downstream.
+
+    Everything else (Dedup, joins, limits, bare passthrough returning
+    partitions) gets today's single concatenated morsel."""
+    from ..aggs import merge_exprs_for
+    parents: List = []
+    row_local = (pp.Project, pp.Filter, pp.UDFProject, pp.Explode,
+                 pp.Unpivot)
+
+    def walk(n, ancestors_row_local):
+        for c in n.children:
+            if isinstance(c, pp.StageInput) and c.stage_id == sid:
+                parents.append((n, ancestors_row_local))
+            walk(c, ancestors_row_local and isinstance(n, row_local))
+
+    if isinstance(plan, pp.StageInput) and plan.stage_id == sid:
+        return has_shuffle_out  # bare passthrough → repartitioned anyway
+    walk(plan, True)
+    if len(parents) != 1:
+        return False
+    parent, chain_row_local = parents[0]
+    if isinstance(parent, pp.Aggregate) \
+            and merge_exprs_for(parent.aggs, alias_to="out") is not None:
+        return True
+    return has_shuffle_out and chain_row_local \
+        and isinstance(parent, row_local)
+
+
+class _ParallelFetch:
+    """Lazy reduce-side stage-input binding: fans a FetchSpec's per-source
+    fetches onto a bounded thread pool the moment the task resolves its
+    inputs, and yields the per-source tables IN SOURCE ORDER as morsels —
+    fetch overlaps whatever the executor is doing instead of blocking on a
+    full ``pa.concat_tables`` barrier.
+
+    - ``streaming=True`` yields one MicroPartition per source (consumers
+      vetted by ``_stream_safe``); ``False`` concatenates to a single
+      morsel at the end — the sources still fetched concurrently.
+    - Failures surface on iteration as ``ShuffleFetchError`` for the first
+      failing source in order; ``FetchRetryState`` at the task supervisor
+      (or the driver's backed-off fetch) stays the SINGLE retry policy —
+      this class adds none of its own.
+    - Per-source ``keys`` keep their stable identities, so injected fault
+      decisions replay exactly; under ``DAFT_TPU_CHAOS_SERIALIZE=1`` the
+      supervisor resolves inputs eagerly+sequentially instead (see
+      ``resolve_stage_inputs``) and this class is never constructed."""
+
+    def __init__(self, spec: FetchSpec, streaming: bool = False):
+        self.spec = spec
+        self.streaming = streaming
+        self._pool: Optional[cf.ThreadPoolExecutor] = None
+        self._futs: Optional[List] = None
+        self._cached: Optional[List[MicroPartition]] = None
+        self._t0 = time.perf_counter()
+        k = min(fetch_parallelism(), max(len(spec.sources), 1))
+        if k > 1:
+            from .shuffle_service import fetch_partition
+            self._pool = cf.ThreadPoolExecutor(
+                max_workers=k, thread_name_prefix="daft-tpu-fetch")
+            self._futs = [
+                self._pool.submit(fetch_partition, address, shuffle_id,
+                                  spec.partition, fault_key=self._key(j))
+                for j, (address, shuffle_id) in enumerate(spec.sources)]
+
+    def _key(self, j: int) -> Optional[str]:
+        keys = self.spec.keys
+        return keys[j] if keys and j < len(keys) else None
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def _tables(self):
+        """Per-source tables in source order (None/empty skipped)."""
+        if self._futs is not None:
+            try:
+                for fut in self._futs:
+                    t = fut.result()
+                    if t is not None and t.num_rows:
+                        yield t
+            finally:
+                self._pool.shutdown(wait=False, cancel_futures=True)
+                self._futs = None
+        else:
+            from .shuffle_service import fetch_partition
+            for j, (address, shuffle_id) in enumerate(self.spec.sources):
+                t = fetch_partition(address, shuffle_id,
+                                    self.spec.partition,
+                                    fault_key=self._key(j))
+                if t is not None and t.num_rows:
+                    yield t
+
+    def __iter__(self):
+        from ..recordbatch import RecordBatch
+        from .shuffle_service import shuffle_count
+        if self._cached is not None:
+            # a plan can reference the same StageInput twice (e.g. a
+            # self-join over one shuffled upstream): the second
+            # consumption replays the materialized morsels like the
+            # pre-parallel list binding did — never refetches (which
+            # would double wire traffic AND roll fresh injection
+            # decisions mid-task)
+            yield from self._cached
+            return
+        tables = self._tables()
+        if not self.streaming:
+            import pyarrow as pa
+            buf = list(tables)
+            tables = iter([pa.concat_tables(buf)]
+                          if len(buf) > 1 else buf)
+        acc: List[MicroPartition] = []
+        try:
+            for t in tables:
+                mp = MicroPartition.from_recordbatch(
+                    RecordBatch.from_arrow_table(t))
+                acc.append(mp)
+                yield mp
+        finally:
+            # actual wall the multi-source fetch occupied (overlapped);
+            # compare against the per-call fetch_wall_us sum for the
+            # parallel-vs-serial evidence
+            shuffle_count("fetch_span_us",
+                          (time.perf_counter() - self._t0) * 1e6)
+        self._cached = acc  # only a fully-drained iteration is replayable
+
+
+def _fetch_spec_eager(binding: FetchSpec) -> List[MicroPartition]:
+    """The pre-parallel fetch path: sequential source order, one fully
+    concatenated morsel. Kept verbatim as the DAFT_TPU_CHAOS_SERIALIZE
+    mode so PR 2's replay tests observe bit-identical event sequences."""
     from ..recordbatch import RecordBatch
     from .shuffle_service import fetch_partition
-    out: Dict[int, List[MicroPartition]] = {}
+    tables = []
+    for j, (address, shuffle_id) in enumerate(binding.sources):
+        fkey = binding.keys[j] \
+            if binding.keys and j < len(binding.keys) else None
+        t = fetch_partition(address, shuffle_id, binding.partition,
+                            fault_key=fkey)
+        if t is not None and t.num_rows:
+            tables.append(t)
+    if not tables:
+        return []
+    import pyarrow as pa
+    merged = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+    return [MicroPartition.from_recordbatch(
+        RecordBatch.from_arrow_table(merged))]
+
+
+def resolve_stage_inputs(stage_inputs: Dict[int, object],
+                         plan: Optional[pp.PhysicalPlan] = None,
+                         shuffle_out: Optional[ShuffleOutSpec] = None
+                         ) -> Dict[int, object]:
+    """Resolve FetchSpec bindings through the shuffle service.
+
+    Default: each FetchSpec becomes a lazy :class:`_ParallelFetch` whose
+    per-source fetches start immediately on a bounded pool; when ``plan``
+    shows multi-morsel delivery is safe (``_stream_safe``) the executor
+    consumes sources as they land — pipelined fetch. Under
+    ``DAFT_TPU_CHAOS_SERIALIZE=1`` everything degrades to the eager,
+    sequential, fully-concatenating path for bit-identical chaos replay."""
+    out: Dict[int, object] = {}
+    serialized = _chaos_serialized()
     for sid, binding in stage_inputs.items():
         if isinstance(binding, FetchSpec):
-            tables = []
-            for j, (address, shuffle_id) in enumerate(binding.sources):
-                fkey = binding.keys[j] \
-                    if binding.keys and j < len(binding.keys) else None
-                t = fetch_partition(address, shuffle_id, binding.partition,
-                                    fault_key=fkey)
-                if t is not None and t.num_rows:
-                    tables.append(t)
-            if tables:
-                import pyarrow as pa
-                merged = pa.concat_tables(tables)
-                out[sid] = [MicroPartition.from_recordbatch(
-                    RecordBatch.from_arrow_table(merged))]
+            if serialized:
+                out[sid] = _fetch_spec_eager(binding)
             else:
-                out[sid] = []
+                streaming = plan is not None \
+                    and len(binding.sources) > 1 \
+                    and _stream_safe(plan, sid, shuffle_out is not None)
+                out[sid] = _ParallelFetch(binding, streaming=streaming)
         else:
             out[sid] = binding
     return out
@@ -126,7 +336,8 @@ def run_task(task: StageTask) -> object:
                         task.fault_key or f"s{task.stage_id}.t{task.task_idx}",
                         attempt=task.attempt)
     ex = LocalExecutor()
-    inputs = resolve_stage_inputs(task.stage_inputs)
+    inputs = resolve_stage_inputs(task.stage_inputs, plan=task.plan,
+                                  shuffle_out=task.shuffle_out)
     stream = ex.run(task.plan, stage_inputs=inputs)
     if task.shuffle_out is None:
         return list(stream)
@@ -138,20 +349,23 @@ def run_task(task: StageTask) -> object:
     rows = 0
     samples_ipc = None
     if spec.kind == "hash":
-        for mp in stream:
-            rows += len(mp)
-            for i, piece in enumerate(
-                    mp.partition_by_hash(by, spec.num_partitions)):
-                if len(piece):
-                    cache.push(i, piece.combined().to_arrow_table())
+        if spec.combine_aggs:
+            rows = _hash_shuffle_combined(stream, cache, spec, by)
+        else:
+            for mp in stream:
+                rows += len(mp)
+                for i, piece in enumerate(
+                        mp.partition_by_hash(by, spec.num_partitions)):
+                    if len(piece):
+                        cache.push(i, piece.combined().to_arrow_table())
     elif spec.kind == "store":
         sampled = []
         for mp in stream:
             rows += len(mp)
             if len(mp):
-                cache.push(0, mp.combined().to_arrow_table())
+                rb = mp.combined()
+                cache.push(0, rb.to_arrow_table())
                 if spec.sample_k > 0:
-                    rb = mp.combined()
                     s = rb.sample(size=min(spec.sample_k, len(rb)))
                     sampled.append(s.eval_expression_list(by))
         if sampled:
@@ -175,6 +389,62 @@ def run_task(task: StageTask) -> object:
     server.register(cache)
     return ShuffleResult(server.address, cache.shuffle_id,
                          spec.num_partitions, rows, samples_ipc)
+
+
+def _hash_shuffle_combined(stream, cache, spec: ShuffleOutSpec,
+                           by: list) -> int:
+    """Map-side combine (Partial Partial Aggregates): hash-partition every
+    morsel, but pre-aggregate each partition's buffered pieces to ONE
+    group-state table before pushing — the wire carries group states, not
+    per-morsel rows. The combine exprs are self-merge aggs over the wire
+    schema (``stages.combine_for_boundary``), so the pushed schema is
+    byte-identical to the uncombined path and the reduce side needs no
+    changes. Buffers merge LSM-style (only once the buffer rivals the
+    state) so re-aggregation stays O(log n) passes; peak residency is ~2×
+    this task's per-partition group cardinality — the state the reduce
+    side would otherwise hold anyway."""
+    from .shuffle_service import shuffle_count
+    n = spec.num_partitions
+    caggs = list(spec.combine_aggs)
+    cby = list(spec.combine_by)
+    state: List[Optional[MicroPartition]] = [None] * n
+    buf: List[List[MicroPartition]] = [[] for _ in range(n)]
+    bufrows = [0] * n
+    rows = 0
+    wire_schema = None
+
+    def merge(i: int) -> None:
+        if not buf[i]:
+            return
+        fresh = buf[i][0].concat(buf[i][1:]) if len(buf[i]) > 1 \
+            else buf[i][0]
+        merged = fresh if state[i] is None else state[i].concat([fresh])
+        out = merged.agg(caggs, cby)
+        state[i] = out.cast_to_schema(wire_schema) \
+            if wire_schema is not None else out
+        buf[i], bufrows[i] = [], 0
+
+    for mp in stream:
+        rows += len(mp)
+        if wire_schema is None and len(mp):
+            wire_schema = mp.schema
+        for i, piece in enumerate(mp.partition_by_hash(by, n)):
+            if len(piece):
+                buf[i].append(piece)
+                bufrows[i] += len(piece)
+                if bufrows[i] >= max(
+                        _COMBINE_REAGG_ROWS,
+                        0 if state[i] is None else len(state[i])):
+                    merge(i)
+    pushed = 0
+    for i in range(n):
+        merge(i)
+        if state[i] is not None and len(state[i]):
+            pushed += len(state[i])
+            cache.push(i, state[i].combined().to_arrow_table())
+    shuffle_count("combine_rows_in", rows)
+    shuffle_count("combine_rows_out", pushed)
+    return rows
 
 
 def _ipc_bytes(table) -> bytes:

@@ -210,11 +210,20 @@ class LocalExecutor:
         yield from iter(node.partitions)
 
     def _exec_StageInput(self, node: pp.StageInput):
+        # binding: a materialized partition list OR a lazy _ParallelFetch
+        # (distributed reduce input — per-source tables stream in as the
+        # bounded fetch pool completes them; emptiness is only known after
+        # draining it)
         parts = self.stage_inputs.get(node.stage_id)
-        if not parts:
+        if parts is None:
             yield MicroPartition.empty(node.schema())
             return
-        yield from iter(parts)
+        got = False
+        for p in parts:
+            got = True
+            yield p
+        if not got:
+            yield MicroPartition.empty(node.schema())
 
     # pipelined maps ---------------------------------------------------
     def _exec_Project(self, node: pp.Project):
@@ -281,11 +290,67 @@ class LocalExecutor:
         yield from self._exec(node.children[1])
 
     # aggregation ------------------------------------------------------
+    def _streamed_agg_input(self, node) -> bool:
+        """True when this Aggregate's child is a StageInput bound to a
+        STREAMING parallel fetch: the binding yields one morsel per map
+        source (not hash-disjoint!), so per-morsel aggregation would
+        duplicate groups — the streaming merge-agg below re-merges
+        instead. ``worker._stream_safe`` only enables streaming when the
+        aggs are self-merges, so the merge table always exists here."""
+        ch = node.children[0] if node.children else None
+        if not isinstance(ch, pp.StageInput):
+            return False
+        return getattr(self.stage_inputs.get(ch.stage_id),
+                       "streaming", False)
+
     def _exec_Aggregate(self, node: pp.Aggregate):
+        if self._streamed_agg_input(node):
+            yield from self._merge_agg_stream(node,
+                                              self._exec(node.children[0]))
+            return
         child = self._exec(node.children[0])
         yield from _ordered_parallel(
             child, lambda p: p.agg(node.aggs, node.group_by)
             .cast_to_schema(node.schema()))
+
+    _MERGE_AGG_REAGG_ROWS = 1 << 17
+
+    def _merge_agg_stream(self, node: pp.Aggregate, stream):
+        """Streaming merge over a multi-morsel pipelined-fetch input:
+        aggregate each arriving source morsel and LSM-merge the states
+        with the self-merge table (``aggs.merge_exprs_for``) — reduce
+        compute overlaps the remaining fetches instead of waiting on the
+        full concat barrier, and emits ONE state morsel like the barrier
+        path did."""
+        from ..aggs import merge_exprs_for
+        merge_aggs = merge_exprs_for(node.aggs, alias_to="out")
+        state: Optional[MicroPartition] = None
+        buf: List[MicroPartition] = []
+        rows = 0
+
+        def merge():
+            nonlocal state, buf, rows
+            if not buf:
+                return
+            fresh = buf[0].concat(buf[1:]) if len(buf) > 1 else buf[0]
+            fresh = fresh.agg(node.aggs, node.group_by) \
+                .cast_to_schema(node.schema())
+            state = fresh if state is None else \
+                state.concat([fresh]).agg(merge_aggs, node.group_by) \
+                .cast_to_schema(node.schema())
+            buf, rows = [], 0
+
+        for mp in stream:
+            buf.append(mp)
+            rows += len(mp)
+            if rows >= max(self._MERGE_AGG_REAGG_ROWS,
+                           0 if state is None else len(state)):
+                merge()
+        merge()
+        if state is not None:
+            yield state
+        else:
+            yield MicroPartition.empty(node.schema())
 
     def _exec_DeviceFragmentAgg(self, node: pp.DeviceFragmentAgg):
         from ..aggs import split_agg_expr

@@ -200,12 +200,14 @@ def _map_workers(node) -> int:
     return _default_workers()
 
 
-#: final-stage agg ops that are associative self-merges: re-applying the op
-#: over its own output column merges two partial states correctly. This is
-#: what makes the reference's Partitioned dispatcher + grouped_aggregate
-#: sink sound (``dispatcher.rs:24-60``, ``sinks/grouped_aggregate.rs:54-151``)
-_MERGE_FINAL_OPS = ("agg.sum", "agg.min", "agg.max", "agg.any_value",
-                    "agg.bool_and", "agg.bool_or", "agg.concat")
+# The final-stage agg ops the fused reducer can merge are the associative
+# self-merges single-sourced in ``aggs.AGG_DECOMPOSITION``: re-applying the
+# op over its own output column merges two partial states correctly, which
+# is what makes the reference's Partitioned dispatcher + grouped_aggregate
+# sink sound (``dispatcher.rs:24-60``, ``sinks/grouped_aggregate.rs:54-151``).
+# The merge expressions come from ``aggs.merge_exprs_for`` (shared with the
+# distributed map-side shuffle combine and the streaming reduce-side merge
+# agg).
 
 #: decline the fused dispatcher when the evidence predicts more groups
 #: than this: the spill-bounded exchange path aggregates each bucket
@@ -252,7 +254,7 @@ def _partitioned_agg_info(node):
     stage; else None. ``merge_aggs`` re-merge two batches of FINAL-schema
     state: for a final agg ``op(col(p)).alias(out)``, the merge is
     ``op(col(out)).alias(out)``."""
-    from ..expressions.expressions import Expression, col
+    from ..aggs import merge_exprs_for
     if not (isinstance(node, pp.Aggregate) and node.mode == "final"
             and node.group_by):
         return None
@@ -267,15 +269,9 @@ def _partitioned_agg_info(node):
     if getattr(ch, "shared_consumers", 1) > 1 \
             or getattr(node, "shared_consumers", 1) > 1:
         return None
-    merge = []
-    for a in node.aggs:
-        u = a._unalias()
-        if u.op not in _MERGE_FINAL_OPS or len(u.args) != 1:
-            return None
-        if u.args[0]._unalias().op != "col":
-            return None
-        merge.append(Expression(u.op, (col(a.name()),), u.params)
-                     .alias(a.name()))
+    merge = merge_exprs_for(node.aggs, alias_to="out")
+    if merge is None:
+        return None
     return ch.children[0], list(ch.by), merge
 
 
@@ -338,6 +334,13 @@ class PushExecutor(LocalExecutor):
         pagg = _partitioned_agg_info(node)
         if pagg is not None:
             out = self._partitioned_agg_stage(node, *pagg)
+        elif isinstance(node, pp.Aggregate) \
+                and self._streamed_agg_input(node):
+            # a streaming parallel-fetch stage input yields one morsel per
+            # map SOURCE (not hash-disjoint) — the per-morsel map kernel
+            # would duplicate groups; run the inherited streaming
+            # merge-agg handler on a driver stage instead
+            out = self._driver_stage(node)
         else:
             kernel = _map_kernel(node)
             if kernel is not None:

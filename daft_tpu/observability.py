@@ -50,6 +50,17 @@ def _recovery_raw() -> Dict[str, int]:
         return {}
 
 
+def _shuffle_raw() -> Dict[str, float]:
+    """Raw snapshot of the shuffle data-plane counters (bytes written/
+    fetched, compression ratio inputs, combine row reduction, fetch wall
+    vs serial-equivalent time) — never raises, like the device ledger."""
+    try:
+        from .distributed import shuffle_service
+        return shuffle_service.shuffle_counters_snapshot()
+    except Exception:
+        return {}
+
+
 def device_kernel_ledger() -> Dict[str, dict]:
     """Process-wide per-dispatch achieved-bytes/flops ledger with derived
     roofline/MFU percentages (``costmodel.ledger_record`` feeds it at
@@ -143,6 +154,10 @@ class RuntimeStatsContext:
         # same pattern for the resilience plane's recovery events
         self._recovery0 = _recovery_raw()
         self.recovery: Dict[str, int] = {}
+        # …and for the shuffle data plane (bytes written/fetched,
+        # compression, combine reduction, fetch overlap)
+        self._shuffle0 = _shuffle_raw()
+        self.shuffle: Dict[str, float] = {}
 
     def register(self, node) -> OperatorStats:
         key = id(node)
@@ -187,6 +202,12 @@ class RuntimeStatsContext:
                 self._recovery0, _recovery_raw())
         except Exception:
             self.recovery = {}
+        try:
+            from .distributed import shuffle_service
+            self.shuffle = shuffle_service.shuffle_counters_delta(
+                self._shuffle0, _shuffle_raw())
+        except Exception:
+            self.shuffle = {}
 
     # ---- reporting ---------------------------------------------------
     def exclusive_us(self, key: int) -> int:
@@ -245,6 +266,7 @@ class RuntimeStatsContext:
             lines.append("resilience (recovery events):")
             for k, v in sorted(self.recovery.items()):
                 lines.append(f"  {k}: {v}")
+        lines.extend(render_shuffle_block(self.shuffle))
         return "\n".join(lines)
 
     def as_dict(self) -> Dict[str, dict]:
@@ -263,6 +285,45 @@ class RuntimeStatsContext:
                          "inclusive_us": st.inclusive_us,
                          "exclusive_us": self.exclusive_us(key)}
         return out
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024 or unit == "GB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} GB"
+
+
+def render_shuffle_block(sh: Dict[str, float]) -> List[str]:
+    """Human lines for one query's shuffle data-plane delta (shared by
+    ``explain(analyze=True)`` and the dashboard). Shows each fast-path
+    layer's evidence: wire-vs-raw bytes (compression ratio), combine row
+    reduction, and parallel-fetch wall vs the serial-equivalent sum."""
+    if not sh:
+        return []
+    lines = ["shuffle (data plane):"]
+    written = sh.get("bytes_written", 0)
+    raw = sh.get("bytes_pushed_raw", 0)
+    if written or raw:
+        ratio = f", {raw / written:.2f}x compression" if written else ""
+        lines.append(f"  written: {_fmt_bytes(written)} wire "
+                     f"({_fmt_bytes(raw)} raw{ratio}), "
+                     f"rows={int(sh.get('rows_pushed', 0))}")
+    cin, cout = sh.get("combine_rows_in", 0), sh.get("combine_rows_out", 0)
+    if cin:
+        red = f" ({cin / cout:.1f}x reduction)" if cout else ""
+        lines.append(f"  combine: {int(cin)} -> {int(cout)} rows{red}")
+    fetched = sh.get("bytes_fetched", 0)
+    if fetched or sh.get("fetches"):
+        wall = sh.get("fetch_span_us", 0) / 1e6
+        serial = sh.get("fetch_wall_us", 0) / 1e6
+        overlap = f", wall {wall:.3f}s vs serial-equivalent " \
+                  f"{serial:.3f}s" if wall else \
+                  f", serial {serial:.3f}s"
+        lines.append(f"  fetched: {_fmt_bytes(fetched)} in "
+                     f"{int(sh.get('fetches', 0))} fetches{overlap}")
+    return lines
 
 
 # ---------------------------------------------------------------------------

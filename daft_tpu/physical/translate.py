@@ -19,9 +19,11 @@ from ..logical import stats as lstats
 from ..schema import Schema
 from . import plan as pp
 
-# aggs that cannot be split into partial/final stages → single-stage agg
-_NON_DECOMPOSABLE = {"count_distinct", "approx_count_distinct",
-                     "approx_percentiles", "skew", "set"}
+# aggs outside the decomposition table cannot be split into partial/final
+# stages → single-stage agg (single-sourced with the pipeline reducer and
+# the distributed map-side combine: ``aggs.AGG_DECOMPOSITION`` is the
+# decomposition table of record)
+from ..aggs import AGG_DECOMPOSITION as _DECOMPOSABLE
 
 
 import threading as _threading
@@ -244,7 +246,7 @@ def _translate_agg(node: lp.Aggregate, cfg) -> pp.PhysicalPlan:
     pchild = _t(child, cfg)
     nparts = _nparts(child)
     specs = [split_agg_expr(e) for e in node.aggs]
-    decomposable = all(op not in _NON_DECOMPOSABLE for op, _, _, _ in specs)
+    decomposable = all(op in _DECOMPOSABLE for op, _, _, _ in specs)
 
     if not decomposable:
         # gather everything and aggregate once

@@ -169,6 +169,43 @@ def test_backoff_is_deterministic_and_bounded():
     assert a[1] > a[0] * 0.5  # grows (modulo jitter)
 
 
+def test_fast_path_layers_do_not_perturb_chaos_replay(monkeypatch,
+                                                      tmp_path):
+    """PR 3 contract: the shuffle fast path (map-side combine, IPC
+    compression, parallel fetch) degrades to the deterministic sequential
+    behavior under DAFT_TPU_CHAOS_SERIALIZE=1 — the same seeded fault
+    spec replays the SAME event sequence and answer across every knob
+    combination, including a raised fetch-parallelism that the serialize
+    mode must override."""
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+    monkeypatch.setenv("DAFT_TPU_DISTRIBUTED_SHUFFLE", "flight")
+    monkeypatch.setenv("DAFT_TPU_FAULT_SPEC",
+                       "task:0.06,fetch:0.06,crash:0.06")
+    monkeypatch.setenv("DAFT_TPU_FAULT_SEED", "11")
+    monkeypatch.setenv("DAFT_TPU_RETRY_BACKOFF", "0.01")
+    monkeypatch.setenv("DAFT_TPU_CHAOS_SERIALIZE", "1")
+    monkeypatch.setenv("DAFT_TPU_SPECULATIVE_MULTIPLIER", "0")
+    from daft_tpu.context import execution_config_ctx
+
+    def one_run(knobs):
+        for k, v in knobs.items():
+            monkeypatch.setenv(k, v)
+        rz.reset_for_tests()
+        with execution_config_ctx(scan_tasks_min_size_bytes=1):
+            out = _run_distributed(_scan_groupby_df(tmp_path))
+        return out, sorted(rz.fault_events())
+
+    out1, ev1 = one_run({"DAFT_TPU_SHUFFLE_COMBINE": "0",
+                         "DAFT_TPU_SHUFFLE_COMPRESSION": "none",
+                         "DAFT_TPU_SHUFFLE_FETCH_PARALLELISM": "1"})
+    out2, ev2 = one_run({"DAFT_TPU_SHUFFLE_COMBINE": "1",
+                         "DAFT_TPU_SHUFFLE_COMPRESSION": "lz4",
+                         "DAFT_TPU_SHUFFLE_FETCH_PARALLELISM": "8"})
+    assert ev1, "the fixed spec/seed injected nothing — tune the seed"
+    assert ev1 == ev2
+    assert out1 == out2
+
+
 # ------------------------------------------------- chaos: end-to-end
 def test_chaos_smoke_fixed_spec(monkeypatch):
     """The CI chaos smoke: one distributed query under a fixed seeded

@@ -1,0 +1,415 @@
+"""Shuffle fast path: map-side combine (Partial Partial Aggregates),
+compressed transport (Arrow IPC buffer compression + chunked/incremental
+HTTP transfer), and the parallel pipelined reduce-side fetch
+(``distributed/shuffle_service.py``, ``distributed/worker.py``,
+``distributed/stages.py``)."""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.distributed import shuffle_service as ss
+from daft_tpu.distributed.worker import (FetchSpec, _ParallelFetch,
+                                         _stream_safe)
+from daft_tpu.physical import plan as pp
+from daft_tpu.runners.distributed_runner import DistributedRunner
+
+
+def _run_distributed(df, num_workers=3):
+    import daft_tpu.context as ctx
+    runner = DistributedRunner(num_workers=num_workers)
+    old = ctx.get_context()._runner
+    ctx.get_context().set_runner(runner)
+    try:
+        return df.to_pydict()
+    finally:
+        ctx.get_context().set_runner(old)
+
+
+def _frame(n=6000, nkeys=7, parts=4, seed=3):
+    rng = np.random.default_rng(seed)
+    return daft_tpu.from_pydict({
+        "k": rng.integers(0, nkeys, n).tolist(),
+        "v": [float(i) for i in range(n)],
+        "w": rng.uniform(0, 10, n).round(3).tolist(),
+    }).into_partitions(parts)
+
+
+def _approx_eq(a, b):
+    for x, y in zip(a, b):
+        assert x == pytest.approx(y, rel=1e-9), (a, b)
+
+
+# ------------------------------------------------------- map-side combine
+def test_combine_parity_on_decomposable_aggs(monkeypatch):
+    """Combine forced ON: the distributed answer over every decomposable
+    agg family matches the single-node engine exactly, and the wire
+    carries fewer rows than entered the combine."""
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+    monkeypatch.setenv("DAFT_TPU_DISTRIBUTED_SHUFFLE", "flight")
+    monkeypatch.setenv("DAFT_TPU_SHUFFLE_COMBINE", "1")
+
+    def q(df):
+        return (df.groupby("k")
+                .agg(col("v").sum().alias("s"),
+                     col("w").mean().alias("m"),
+                     col("v").count().alias("c"),
+                     col("w").min().alias("lo"),
+                     col("w").max().alias("hi"),
+                     col("v").stddev().alias("sd"))
+                .sort("k").to_pydict())
+
+    local = q(_frame())
+    before = ss.shuffle_counters_snapshot()
+    dist = _run_distributed(
+        _frame().groupby("k").agg(
+            col("v").sum().alias("s"), col("w").mean().alias("m"),
+            col("v").count().alias("c"), col("w").min().alias("lo"),
+            col("w").max().alias("hi"),
+            col("v").stddev().alias("sd")).sort("k"))
+    d = ss.shuffle_counters_delta(before)
+    assert dist["k"] == local["k"]
+    assert dist["c"] == local["c"]
+    for name in ("s", "m", "lo", "hi", "sd"):
+        _approx_eq(dist[name], local[name])
+    assert d.get("combine_rows_in", 0) > 0, d
+    assert d.get("combine_rows_out", 0) <= d["combine_rows_in"], d
+
+
+def test_mixed_decomposable_and_fallback_aggs(monkeypatch):
+    """An aggregate set mixing decomposable (sum) with non-decomposable
+    (count_distinct) falls back to today's single-stage plan — no combine
+    runs, and the answer still matches the single-node engine."""
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+    monkeypatch.setenv("DAFT_TPU_DISTRIBUTED_SHUFFLE", "flight")
+    monkeypatch.setenv("DAFT_TPU_SHUFFLE_COMBINE", "1")
+
+    def q(df):
+        return (df.groupby("k")
+                .agg(col("v").sum().alias("s"),
+                     col("v").count_distinct().alias("nd"))
+                .sort("k").to_pydict())
+
+    local = q(_frame(n=2500))
+    before = ss.shuffle_counters_snapshot()
+    dist = _run_distributed(
+        _frame(n=2500).groupby("k").agg(
+            col("v").sum().alias("s"),
+            col("v").count_distinct().alias("nd")).sort("k"))
+    d = ss.shuffle_counters_delta(before)
+    assert dist["k"] == local["k"]
+    assert dist["nd"] == local["nd"]
+    _approx_eq(dist["s"], local["s"])
+    assert d.get("combine_rows_in", 0) == 0, d  # fallback: no combine
+
+
+def test_combine_escape_hatch_and_wire_reduction(monkeypatch):
+    """DAFT_TPU_SHUFFLE_COMBINE=0 disables the combine; the fast path
+    (combine on) pushes measurably fewer rows over the wire for the same
+    query and both answers agree."""
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+    monkeypatch.setenv("DAFT_TPU_DISTRIBUTED_SHUFFLE", "flight")
+
+    def run(combine):
+        monkeypatch.setenv("DAFT_TPU_SHUFFLE_COMBINE", combine)
+        before = ss.shuffle_counters_snapshot()
+        out = _run_distributed(
+            _frame(n=8000, nkeys=5).groupby("k")
+            .agg(col("v").sum().alias("s")).sort("k"))
+        return out, ss.shuffle_counters_delta(before)
+
+    off_out, off_c = run("0")
+    on_out, on_c = run("1")
+    assert off_out["k"] == on_out["k"]
+    _approx_eq(off_out["s"], on_out["s"])
+    assert off_c.get("combine_rows_in", 0) == 0
+    assert on_c.get("combine_rows_in", 0) > 0
+    assert on_c.get("rows_pushed", 0) < off_c.get("rows_pushed", 0), \
+        (on_c, off_c)
+
+
+def test_combine_cost_model_declines_near_unique_keys():
+    """The pricing: reductive group-bys combine, near-unique keys (zero
+    wire savings, a wasted agg pass) decline, and no evidence defaults to
+    combining."""
+    from daft_tpu.device import costmodel
+    assert costmodel.shuffle_combine_wins(1_000_000, 4, 8)
+    assert not costmodel.shuffle_combine_wins(1_000_000, 900_000, 8)
+    assert costmodel.shuffle_combine_wins(None, None, 8)
+    assert costmodel.shuffle_combine_wins(0, None, 8)
+
+
+def test_decomposition_table_is_single_sourced():
+    """The planner split, the fused pipeline reducer, and the map-side
+    combine must agree on what decomposes: every op the pipeline reducer
+    merges is a merge op of the table, and the non-decomposable set is
+    disjoint from the table."""
+    from daft_tpu import aggs
+    assert aggs.SELF_MERGE_OPS == frozenset(
+        m for _, m in aggs.AGG_DECOMPOSITION.values())
+    assert not set(aggs.AGG_DECOMPOSITION) & aggs.NON_DECOMPOSABLE_AGGS
+    # merge helper round-trip: final aggs merge to themselves by name
+    from daft_tpu.expressions import col as c
+    finals = [c("p0").sum().alias("out0"), c("p1").max().alias("out1")]
+    m_out = aggs.merge_exprs_for(finals, alias_to="out")
+    assert [e.name() for e in m_out] == ["out0", "out1"]
+    m_src = aggs.merge_exprs_for(finals, alias_to="source")
+    assert [e.name() for e in m_src] == ["p0", "p1"]
+    assert aggs.merge_exprs_for(
+        [c("p0").mean().alias("x")], alias_to="out") is None
+
+
+# ---------------------------------------------------- compressed transport
+TRANSPORTS = ["http"] + (["flight"] if ss.paflight is not None else [])
+CODECS = ["none", "lz4", "zstd"]
+
+
+def _codec_available(codec):
+    if codec == "none":
+        return True
+    try:
+        import pyarrow.ipc as paipc
+        paipc.IpcWriteOptions(compression=codec)
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.parametrize("transport", TRANSPORTS)
+@pytest.mark.parametrize("codec", CODECS)
+def test_compression_roundtrip_with_straggler(monkeypatch, transport,
+                                              codec):
+    """Every codec round-trips through spill→serve→fetch, including a
+    post-seal straggler append (written as its own compressed stream in a
+    single write)."""
+    if not _codec_available(codec):
+        pytest.skip(f"{codec} not built into this pyarrow")
+    monkeypatch.setenv("DAFT_TPU_SHUFFLE_COMPRESSION", codec)
+    srv = ss.ShuffleServer() if transport == "http" \
+        else ss.FlightShuffleServer()
+    try:
+        cache = ss.ShuffleCache()
+        t = pa.table({"x": list(range(20000)),
+                      "s": [f"row-{i % 50}" for i in range(20000)]})
+        cache.push(0, t.slice(0, 15000))
+        cache.push(0, t.slice(15000))
+        srv.register(cache)  # seals
+        cache.push(0, pa.table({"x": [-1, -2],
+                                "s": ["strag", "strag"]}))
+        got = ss.fetch_partition(srv.address, cache.shuffle_id, 0)
+        assert got.num_rows == 20002
+        assert sorted(got.column("x").to_pylist())[:2] == [-2, -1]
+    finally:
+        srv.shutdown()
+
+
+def test_compression_reduces_spill_bytes(monkeypatch):
+    """lz4 (the default) writes measurably fewer spill/wire bytes than
+    'none' on compressible data, and the fallback for an unknown codec is
+    uncompressed, never an error."""
+    t = pa.table({"x": list(range(200_000)),
+                  "s": ["abcdefgh"] * 200_000})
+    sizes = {}
+    for codec in ("none", "lz4", "bogus"):
+        monkeypatch.setenv("DAFT_TPU_SHUFFLE_COMPRESSION", codec)
+        c = ss.ShuffleCache()
+        c.push(0, t)
+        c.close()
+        sizes[codec] = c.partition_size(0)
+        c.cleanup()
+    if _codec_available("lz4"):
+        assert sizes["lz4"] < sizes["none"] * 0.7, sizes
+    assert sizes["bogus"] == sizes["none"], sizes
+
+
+def test_chunked_http_send_and_incremental_read(monkeypatch):
+    """A multi-megabyte partition round-trips the HTTP transport (chunked
+    send, incremental concatenated-IPC reads) byte-exactly, across
+    several writer streams."""
+    monkeypatch.setenv("DAFT_TPU_SHUFFLE_COMPRESSION", "none")
+    srv = ss.ShuffleServer()
+    try:
+        cache = ss.ShuffleCache()
+        rng = np.random.default_rng(0)
+        t = pa.table({"x": rng.integers(0, 1 << 40, 400_000),
+                      "y": rng.uniform(size=400_000)})
+        cache.push(0, t)
+        srv.register(cache)
+        cache.push(0, t.slice(0, 1000))  # second stream after seal
+        assert cache.partition_size(0) > ss._CHUNK_BYTES  # really chunked
+        got = ss.fetch_partition(srv.address, cache.shuffle_id, 0)
+        assert got.num_rows == 401_000
+        assert got.column("x").to_pylist()[:5] == \
+            t.column("x").to_pylist()[:5]
+    finally:
+        srv.shutdown()
+
+
+def test_http_error_detail_is_explicit():
+    """Satellite: urlopen raises HTTPError on any non-200 — the dead
+    status-check branch is gone and the error path surfaces the status
+    code in ShuffleFetchError.detail."""
+    from daft_tpu.distributed.resilience import ShuffleFetchError
+    srv = ss.ShuffleServer()
+    try:
+        with pytest.raises(ShuffleFetchError) as ei:
+            ss.fetch_partition(srv.address, "missing", 0)
+        assert "HTTP 404" in ei.value.detail, ei.value.detail
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------- parallel pipelined fetch
+def _serve_sources(k, rows_each=200, parts=1):
+    srv = ss.make_shuffle_server()
+    caches = []
+    for j in range(k):
+        c = ss.ShuffleCache()
+        c.push(0, pa.table({"x": list(range(j * rows_each,
+                                            (j + 1) * rows_each))}))
+        srv.register(c)
+        caches.append(c)
+    return srv, [(srv.address, c.shuffle_id) for c in caches]
+
+
+def test_parallel_fetch_overlaps_and_preserves_source_order(monkeypatch):
+    """The bounded pool overlaps per-source fetches (≥2 genuinely
+    in-flight at once — structural, not wall-clock, so suite load can't
+    flake it) and still yields tables in SOURCE order even when
+    completions land out of order."""
+    srv, srcs = _serve_sources(4)
+    orig = ss.fetch_partition
+    lock = threading.Lock()
+    state = {"inflight": 0, "peak": 0}
+    gate = threading.Event()
+
+    def slow(address, shuffle_id, partition, fault_key=None):
+        with lock:
+            state["inflight"] += 1
+            state["peak"] = max(state["peak"], state["inflight"])
+            if state["inflight"] >= 2:
+                gate.set()  # two fetches provably concurrent
+        # stall until overlap is observed (or a generous timeout) so a
+        # slow-to-spawn second thread still gets counted
+        gate.wait(timeout=10.0)
+        try:
+            return orig(address, shuffle_id, partition,
+                        fault_key=fault_key)
+        finally:
+            with lock:
+                state["inflight"] -= 1
+
+    monkeypatch.setattr(ss, "fetch_partition", slow)
+    monkeypatch.setenv("DAFT_TPU_SHUFFLE_FETCH_PARALLELISM", "4")
+    try:
+        pf = _ParallelFetch(FetchSpec(srcs, 0), streaming=True)
+        parts = list(pf)
+        assert state["peak"] >= 2, state  # overlapped
+        # source order: source j holds rows [j*200, (j+1)*200)
+        firsts = [p.to_pydict()["x"][0] for p in parts]
+        assert firsts == [0, 200, 400, 600]
+    finally:
+        srv.shutdown()
+
+
+def test_chaos_serialize_forces_sequential_single_morsel(monkeypatch):
+    """Under DAFT_TPU_CHAOS_SERIALIZE=1 the fast path degrades to the
+    deterministic pre-PR behavior: eager sequential fetches, one
+    concatenated morsel per stage input."""
+    from daft_tpu.distributed.worker import resolve_stage_inputs
+    monkeypatch.setenv("DAFT_TPU_CHAOS_SERIALIZE", "1")
+    srv, srcs = _serve_sources(3)
+    calls = []
+    orig = ss.fetch_partition
+
+    def spy(address, shuffle_id, partition, fault_key=None):
+        calls.append(shuffle_id)
+        return orig(address, shuffle_id, partition, fault_key=fault_key)
+
+    monkeypatch.setattr(ss, "fetch_partition", spy)
+    try:
+        out = resolve_stage_inputs({0: FetchSpec(srcs, 0)})
+        assert isinstance(out[0], list) and len(out[0]) == 1
+        assert len(out[0][0]) == 600
+        assert calls == [sid for _, sid in srcs]  # sequential, in order
+    finally:
+        srv.shutdown()
+
+
+def test_stream_safety_rules():
+    """Multi-morsel delivery is only enabled where it preserves
+    semantics: merge-safe final aggregate, or row-local chain feeding a
+    shuffle-out; Dedup/limit/bare-return shapes stay single-morsel."""
+    from daft_tpu.expressions import col as c
+    schema = daft_tpu.from_pydict({"k": [1], "s": [1.0]}).schema()
+    si = pp.StageInput(7, schema)
+    agg = pp.Aggregate(si, [c("s").sum().alias("s")], [c("k")], schema,
+                       "final")
+    assert _stream_safe(agg, 7, has_shuffle_out=False)
+    assert _stream_safe(pp.Project(agg, [c("k"), c("s")], schema), 7,
+                        False)
+    # non-self-merge agg (mean over raw rows) → unsafe
+    agg2 = pp.Aggregate(pp.StageInput(7, schema),
+                        [c("s").mean().alias("m")], [c("k")], schema,
+                        "single")
+    assert not _stream_safe(agg2, 7, False)
+    # dedup over the input → unsafe either way
+    dd = pp.Dedup(pp.StageInput(7, schema), [c("k")])
+    assert not _stream_safe(dd, 7, False)
+    assert not _stream_safe(dd, 7, True)
+    # bare passthrough: only safe when re-partitioned into a shuffle-out
+    bare = pp.StageInput(7, schema)
+    assert not _stream_safe(bare, 7, False)
+    assert _stream_safe(bare, 7, True)
+    # row-local chain: safe only with a shuffle-out
+    proj = pp.Project(pp.StageInput(7, schema), [c("k")], schema)
+    assert not _stream_safe(proj, 7, False)
+    assert _stream_safe(proj, 7, True)
+
+
+def test_streaming_merge_agg_multi_source_parity(monkeypatch):
+    """End-to-end: a reduce aggregate over MANY map sources (streamed as
+    one morsel per source) equals the local answer — the streaming
+    merge-agg must re-merge across morsels, never aggregate them
+    independently."""
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+    monkeypatch.setenv("DAFT_TPU_DISTRIBUTED_SHUFFLE", "flight")
+    monkeypatch.setenv("DAFT_TPU_SHUFFLE_FETCH_PARALLELISM", "8")
+
+    def q(df):
+        return (df.groupby("k").agg(col("v").sum().alias("s"),
+                                    col("v").count().alias("c"))
+                .sort("k").to_pydict())
+
+    local = q(_frame(n=9000, parts=6))
+    dist = _run_distributed(
+        _frame(n=9000, parts=6).groupby("k")
+        .agg(col("v").sum().alias("s"),
+             col("v").count().alias("c")).sort("k"),
+        num_workers=4)
+    assert dist["k"] == local["k"]
+    assert dist["c"] == local["c"]
+    _approx_eq(dist["s"], local["s"])
+
+
+# ------------------------------------------------------------ stats plumbing
+def test_runtime_stats_shuffle_block(monkeypatch):
+    """RuntimeStatsContext.shuffle carries the per-query data-plane delta
+    and explain(analyze) renders it."""
+    from daft_tpu import observability as obs
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+    monkeypatch.setenv("DAFT_TPU_DISTRIBUTED_SHUFFLE", "flight")
+    _run_distributed(_frame(n=4000).groupby("k")
+                     .agg(col("v").sum().alias("s")).sort("k"))
+    stats = obs.last_query_stats()
+    assert stats is not None and stats.shuffle, stats and stats.shuffle
+    assert stats.shuffle.get("bytes_written", 0) > 0
+    assert stats.shuffle.get("fetches", 0) > 0
+    r = stats.render()
+    assert "shuffle (data plane):" in r
+    assert "written:" in r and "fetched:" in r
