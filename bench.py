@@ -481,6 +481,162 @@ def run_shuffle_bench():
     return res
 
 
+def run_scan_bench():
+    """``--scan``: microbench of the scan-side IO plane against a
+    latency-injected local HTTP object store (every request pays a fixed
+    service delay, modeling object-store RTT). One projected, filtered
+    multi-file parquet read runs twice: the pre-PR path
+    (``DAFT_TPU_IO_PLANNED_READS=0`` + ``DAFT_TPU_SCAN_PREFETCH=0`` —
+    per-column-chunk ranged GETs, whole-task loads) and the fast path
+    (defaults: planned coalesced ranges, parallel fetch,
+    prefetch-pipelined tasks). Records GET-request reduction, scan
+    wall-clock speedup, answer parity, and the per-query ``io`` stats
+    block."""
+    import http.server
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import daft_tpu as dt
+    import daft_tpu.observability as obs
+    from daft_tpu import col
+    from daft_tpu.io import read_planner as rp
+
+    delay_s = float(os.environ.get("BENCH_SCAN_DELAY_MS", "15")) / 1e3
+    nfiles, rows = 8, 160_000
+    root = tempfile.mkdtemp(prefix="daft_tpu_scanbench_")
+    rng = np.random.default_rng(9)
+    for i in range(nfiles):
+        t = pa.table({
+            "seq": pa.array(np.arange(i * rows, (i + 1) * rows)),
+            "k": pa.array(rng.integers(0, 1000, rows)),
+            "v": pa.array(rng.uniform(size=rows)),
+            "w": pa.array(rng.uniform(size=rows)),
+            "pad_f": pa.array(rng.uniform(size=rows)),
+            "pad_s": pa.array([f"pad-{j % 97:04d}" for j in range(rows)]),
+        })
+        pq.write_table(t, os.path.join(root, f"part-{i}.parquet"),
+                       row_group_size=rows // 8)
+
+    class _Store(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _file(self):
+            p = os.path.join(root, self.path.lstrip("/"))
+            return p if os.path.isfile(p) else None
+
+        def do_HEAD(self):
+            time.sleep(delay_s)
+            p = self._file()
+            if p is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(os.path.getsize(p)))
+            self.end_headers()
+
+        def do_GET(self):
+            time.sleep(delay_s)
+            p = self._file()
+            if p is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            with open(p, "rb") as f:
+                data = f.read()
+            rng_hdr = self.headers.get("Range")
+            if rng_hdr:
+                spec = rng_hdr.split("=")[1]
+                a, b = spec.split("-")
+                start, end = int(a), min(int(b), len(data) - 1)
+                chunk = data[start:end + 1]
+                self.send_response(206)
+            else:
+                chunk = data
+                self.send_response(200)
+            self.send_header("Content-Length", str(len(chunk)))
+            self.end_headers()
+            self.wfile.write(chunk)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Store)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    urls = [f"http://127.0.0.1:{srv.server_port}/part-{i}.parquet"
+            for i in range(nfiles)]
+    half = nfiles * rows // 2  # ordered seq → half the row groups prune
+
+    def query():
+        return (dt.read_parquet(urls)
+                .where(col("seq") < half)
+                .select("k", "v")
+                .sum("v").to_pydict())
+
+    def one_run(env):
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        before = rp.scan_counters_snapshot()
+        t0 = time.time()
+        try:
+            out = query()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        elapsed = time.time() - t0
+        return out, elapsed, rp.scan_counters_delta(before)
+
+    try:
+        # both runs pin their knobs via env (the context may have frozen
+        # either set into its config at first touch; env always wins)
+        naive_out, naive_s, naive_c = one_run(
+            {"DAFT_TPU_IO_PLANNED_READS": "0", "DAFT_TPU_SCAN_PREFETCH": "0"})
+        fast_out, fast_s, fast_c = one_run(
+            {"DAFT_TPU_IO_PLANNED_READS": "1", "DAFT_TPU_SCAN_PREFETCH": "2"})
+    finally:
+        srv.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+
+    st = obs.last_query_stats()
+    res = {
+        "files": nfiles, "rows": nfiles * rows,
+        "rows_scanned": half,
+        "request_delay_ms": delay_s * 1e3,
+        "naive": {
+            "elapsed_s": round(naive_s, 3),
+            "rows_per_s": round(half / naive_s, 1),
+            "gets": int(naive_c.get("gets", 0)),
+            "bytes_fetched": int(naive_c.get("bytes_fetched", 0)),
+        },
+        "fast_path": {
+            "elapsed_s": round(fast_s, 3),
+            "rows_per_s": round(half / fast_s, 1),
+            "gets": int(fast_c.get("gets", 0)),
+            "bytes_fetched": int(fast_c.get("bytes_fetched", 0)),
+            "ranges_planned": int(fast_c.get("ranges_planned", 0)),
+            "range_requests": int(fast_c.get("range_requests", 0)),
+            "bytes_used": int(fast_c.get("bytes_used", 0)),
+            "prefetch_wall_s": round(fast_c.get("scan_span_us", 0) / 1e6, 4),
+            "prefetch_serial_equiv_s": round(
+                fast_c.get("scan_task_us", 0) / 1e6, 4),
+        },
+        "request_reduction": round(
+            naive_c.get("gets", 0) / max(fast_c.get("gets", 1), 1), 2),
+        "scan_speedup": round(naive_s / max(fast_s, 1e-9), 2),
+        "answers_match": _canon_rows(naive_out) == _canon_rows(fast_out),
+        # the io stats block explain(analyze=True) renders for this query
+        "io_stats_block": obs.render_io_block(st.io) if st is not None
+        else None,
+    }
+    return res
+
+
 def run_arrow_baseline():
     import pyarrow.compute as pc
     import pyarrow.dataset as pads
@@ -747,6 +903,13 @@ def main():
         if r is not None:
             detail["shuffle_bench"] = r
 
+    if "--scan" in sys.argv:
+        # scan-side IO plane microbench: GET coalescing + parallel fetch +
+        # prefetch pipelining against a latency-injected local object store
+        r = section("scan", run_scan_bench, min_needed=40.0)
+        if r is not None:
+            detail["scan_bench"] = r
+
     r = section("tpch_sf1_suite_host",
                 lambda: run_tpch_suite(DATA, budget_s=_remaining() - 10),
                 min_needed=20.0)
@@ -796,7 +959,7 @@ def main():
 
     results_dir = os.path.join(REPO, "benchmarking", "results")
     os.makedirs(results_dir, exist_ok=True)
-    artifact = os.path.join(results_dir, "r8_bench_driver.json")
+    artifact = os.path.join(results_dir, "r9_bench_driver.json")
     with open(artifact, "w") as f:
         json.dump(full, f, indent=1)
     # progress/bulk lines first (NOT last): full detail for humans reading
@@ -861,13 +1024,19 @@ def main():
             "wire_saved": sb.get("wire_bytes_saved_ratio"),
             "combine_x": sb["fast_path"].get("combine_reduction"),
             "fetch_speedup": sb.get("fetch_overlap", {}).get("speedup")}
+    sc = detail.get("scan_bench")
+    if isinstance(sc, dict) and "error" not in sc:
+        compact["scan"] = {
+            "req_reduction": sc.get("request_reduction"),
+            "speedup": sc.get("scan_speedup"),
+            "match": sc.get("answers_match")}
     if skipped:
         compact["n_skipped"] = len(skipped)
     if errors:
         compact["n_errors"] = len(errors)
     # hard cap: drop optional keys until the line fits the driver's window
-    for drop in ("shuffle", "chaos", "ledger_dispatches", "mfu", "families",
-                 "q1_winner", "backend"):
+    for drop in ("scan", "shuffle", "chaos", "ledger_dispatches", "mfu",
+                 "families", "q1_winner", "backend"):
         if len(json.dumps(compact)) <= 1500:
             break
         compact.pop(drop, None)

@@ -62,6 +62,15 @@ class ExecutionConfig:
     device_min_rows: int = 0
     device_enabled: bool = True
     target_partition_size_bytes: int = 512 * 1024 * 1024
+    # scan fast path (io/read_planner.py). Field names are chosen so the
+    # DAFT_<NAME> env override spells the documented knob names
+    # (DAFT_TPU_IO_COALESCE_GAP, DAFT_TPU_SCAN_PREFETCH, …); byte values
+    # accept suffixes ("1MiB") via the env parser below.
+    tpu_io_coalesce_gap: int = 1 << 20       # range-coalescing hole tolerance
+    tpu_io_min_request: int = 8 << 20        # coalesced-request size floor
+    tpu_io_range_parallelism: int = 8        # concurrent range GETs / source
+    tpu_io_planned_reads: bool = True        # 0 → naive per-chunk ranged GETs
+    tpu_scan_prefetch: int = 2               # ScanTasks resolved ahead
 
 
 def _exec_config_from_env() -> ExecutionConfig:
@@ -72,7 +81,12 @@ def _exec_config_from_env() -> ExecutionConfig:
             if f.type == "bool" or isinstance(f.default, bool):
                 kwargs[f.name] = env not in ("0", "false", "False")
             elif isinstance(f.default, int):
-                kwargs[f.name] = int(env)
+                try:
+                    kwargs[f.name] = int(env)
+                except ValueError:
+                    # byte knobs accept suffixed values ("1MiB", "8MB")
+                    from .execution.memory import parse_bytes
+                    kwargs[f.name] = parse_bytes(env)
             elif isinstance(f.default, float):
                 kwargs[f.name] = float(env)
             elif isinstance(f.default, str):

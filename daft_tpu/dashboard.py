@@ -39,6 +39,9 @@ def broadcast_query(stats) -> None:
             # shuffle data plane: bytes written/fetched, compression
             # ratio inputs, combine reduction, fetch overlap
             "shuffle": dict(getattr(stats, "shuffle", {}) or {}),
+            # scan-side IO plane: GETs vs planned ranges (coalescing),
+            # bytes fetched vs used, prefetch overlap
+            "io": dict(getattr(stats, "io", {}) or {}),
         }
     except Exception:
         return
@@ -73,9 +76,14 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                             + html.escape(json.dumps(
                                 {k: round(v, 1) for k, v in shf.items()}))
                             + "</p>" if shf else "")
+                sio = q.get("io") or {}
+                io_html = ("<p><b>io:</b> "
+                           + html.escape(json.dumps(
+                               {k: round(v, 1) for k, v in sio.items()}))
+                           + "</p>" if sio else "")
                 rows.append(
                     f"<h3>query {len(_history) - i} — {q['ts']}</h3>"
-                    f"{rec_html}{shf_html}"
+                    f"{rec_html}{shf_html}{io_html}"
                     f"<pre>{html.escape(q['explain'])}</pre>")
         body = ("<html><head><title>daft-tpu dashboard</title></head><body>"
                 "<h1>daft-tpu queries</h1>"

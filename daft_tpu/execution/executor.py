@@ -38,6 +38,22 @@ def _pool() -> cf.ThreadPoolExecutor:
     return _POOL
 
 
+_SCAN_POOL: Optional[cf.ThreadPoolExecutor] = None
+
+
+def _scan_pool() -> cf.ThreadPoolExecutor:
+    """Dedicated pool for prefetch-pipelined scan producers. NOT the
+    shared exec pool: a producer blocked on its bounded output queue
+    would otherwise hold an exec slot that a downstream operator's future
+    needs to drain that very queue (deadlock when window+1 ≥ pool size)."""
+    global _SCAN_POOL
+    if _SCAN_POOL is None:
+        _SCAN_POOL = cf.ThreadPoolExecutor(
+            max_workers=max((os.cpu_count() or 4) * 2, 8),
+            thread_name_prefix="daft-tpu-scan")
+    return _SCAN_POOL
+
+
 def _ordered_parallel(inputs: Iterator, fn: Callable,
                       width: Optional[int] = None) -> Iterator:
     """Map fn over inputs on the pool, yielding results in order with a
@@ -191,17 +207,149 @@ class LocalExecutor:
                     rb.slice(start, min(start + morsel, n)))
 
     def _exec_ScanSource(self, node: pp.ScanSource):
-        def run(t):
-            est = t.size_bytes() or 0
-            self.mem.acquire(est)
-            try:
-                return _load_with_retry(t)
-            finally:
-                self.mem.release(est)
+        from ..io import read_planner as rp
         if not node.tasks:
             yield MicroPartition.empty(node.schema())
             return
-        yield from self._morselize(_ordered_parallel(iter(node.tasks), run))
+        prefetch = rp.scan_prefetch_tasks()
+        if prefetch <= 0 or rp.scan_sequential_fallback():
+            # pre-fast-path behavior: whole-task loads on the pool (kept
+            # verbatim as the DAFT_TPU_CHAOS_SERIALIZE / active-fault-plan
+            # degradation so PR 2's replay contract stays bit-identical)
+            def run(t):
+                est = t.size_bytes() or 0
+                self.mem.acquire(est)
+                try:
+                    return _load_with_retry(t)
+                finally:
+                    self.mem.release(est)
+            yield from self._morselize(_ordered_parallel(iter(node.tasks),
+                                                         run))
+            return
+        yield from self._morselize(self._prefetch_scan(node.tasks, prefetch))
+
+    def _prefetch_scan(self, tasks, window: int):
+        """Prefetch-pipelined scan source: up to ``window`` upcoming
+        ScanTasks resolve on the IO pool AHEAD of the one the consumer is
+        draining, each admission-gated by the memory manager (prefetched
+        bytes can't blow DAFT_TPU_MEMORY_LIMIT), and each task's batches
+        stream out as its files decode — the first morsel lands at
+        first-file completion, not task completion. Output stays in task
+        order. Wall vs serial-equivalent time feeds the ``io`` stats
+        block."""
+        import collections
+        import queue as _queue
+        import threading
+        import time as _time
+
+        from ..io import read_planner as rp
+
+        pool = _scan_pool()
+        t_span0 = _time.perf_counter()
+
+        class _Stream:
+            """Per-task batch queue; ``dead`` makes an abandoned consumer
+            (early limit, error upstream) stop the producer. UNBOUNDED on
+            purpose: memory admission is the loading gate (as in the
+            pre-PR path, which also released admission on load
+            completion). A bounded queue would let a producer block on
+            put() while HOLDING admission that the FIFO-head task's
+            producer is waiting for — a deadlock the consumer, stuck on
+            the head task's queue, could never break."""
+
+            def __init__(self):
+                self.q = _queue.Queue()
+                self.dead = threading.Event()
+
+            def put(self, item):
+                if self.dead.is_set():
+                    raise _ScanAbandoned()
+                self.q.put(item)
+
+        class _ScanAbandoned(Exception):
+            pass
+
+        def produce(task, st: _Stream):
+            if st.dead.is_set():  # consumer gone before we even started
+                return
+            t0 = _time.perf_counter()
+            est = task.size_bytes() or 0
+            self.mem.acquire(est)
+            try:
+                if st.dead.is_set():
+                    return
+                schema = task.materialized_schema()
+                produced = False
+                try:
+                    for rb in task.stream_batches():
+                        st.put(("batch",
+                                MicroPartition.from_recordbatch(
+                                    rb.cast_to_schema(schema))))
+                        produced = True
+                except OSError:
+                    if produced:
+                        raise  # can't re-stream mid-task without dup rows
+                    _time.sleep(0.2)  # transient remote IO: one clean retry
+                    for rb in task.stream_batches():
+                        st.put(("batch",
+                                MicroPartition.from_recordbatch(
+                                    rb.cast_to_schema(schema))))
+                        produced = True
+                if not produced:
+                    st.put(("batch", MicroPartition.empty(schema)))
+                st.put(("done", None))
+            except _ScanAbandoned:
+                pass
+            except BaseException as exc:  # noqa: BLE001
+                try:
+                    st.put(("err", exc))
+                except _ScanAbandoned:
+                    pass
+            finally:
+                self.mem.release(est)
+                rp.scan_count("scan_task_us",
+                              (_time.perf_counter() - t0) * 1e6)
+
+        inflight = collections.deque()
+        it = iter(tasks)
+
+        def submit() -> bool:
+            try:
+                t = next(it)
+            except StopIteration:
+                return False
+            st = _Stream()
+            pool.submit(produce, t, st)
+            inflight.append(st)
+            rp.scan_count("prefetch_tasks")
+            return True
+
+        for _ in range(window + 1):
+            if not submit():
+                break
+        current = None
+        try:
+            while inflight:
+                current = inflight.popleft()
+                while True:
+                    kind, val = current.q.get()
+                    if kind == "batch":
+                        yield val
+                    elif kind == "err":
+                        raise val
+                    else:
+                        break
+                current = None
+                submit()
+        finally:
+            # an abandoned consumer (early limit, downstream error) must
+            # unblock every producer — including the one being drained
+            if current is not None:
+                current.dead.set()
+            for st in inflight:
+                st.dead.set()
+            rp.scan_count("scan_span_us",
+                          (_time.perf_counter() - t_span0) * 1e6)
 
     def _exec_InMemorySource(self, node: pp.InMemorySource):
         if not node.partitions:

@@ -23,10 +23,10 @@ import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import Dict, Iterator, List, Optional, Tuple
 
-from .object_io import AzureConfig, IOStatsContext, ObjectSource
+from .object_io import (RETRYABLE_STATUS as _RETRYABLE_STATUS,
+                        AzureConfig, IOStatsContext, ObjectSource,
+                        parallel_get_ranges, retry_backoff_s)
 from .s3 import _ConnectionPool, _glob_regex, _header_val
-
-_RETRYABLE_STATUS = {429, 500, 502, 503, 504}
 _API_VERSION = "2021-08-06"
 
 
@@ -137,12 +137,12 @@ class AzureBlobSource(ObjectSource):
             except (OSError, http.client.HTTPException) as exc:
                 conn.close()
                 last_exc = exc
-                time.sleep(min(0.1 * (2 ** attempt), 2.0))
+                time.sleep(retry_backoff_s(path, attempt))
                 continue
             if status in _RETRYABLE_STATUS:
                 last_exc = RuntimeError(
                     f"azure {method} {path}: HTTP {status}: {data[:200]!r}")
-                time.sleep(min(0.1 * (2 ** attempt), 2.0))
+                time.sleep(retry_backoff_s(path, attempt))
                 continue
             return status, rheaders, data
         raise last_exc
@@ -164,6 +164,11 @@ class AzureBlobSource(ObjectSource):
         if stats:
             stats.record_get(len(data))
         return data
+
+    def get_ranges(self, path, ranges, stats=None, parallelism=None):
+        return parallel_get_ranges(
+            self, path, ranges, stats,
+            min(parallelism or 8, self.config.max_connections))
 
     def put(self, path, data, stats=None) -> None:
         account, container, key = self._resolve(path)

@@ -19,10 +19,10 @@ import time
 import urllib.parse
 from typing import Dict, Iterator, List, Optional, Tuple
 
-from .object_io import GCSConfig, IOStatsContext, ObjectSource
+from .object_io import (RETRYABLE_STATUS as _RETRYABLE_STATUS,
+                        GCSConfig, IOStatsContext, ObjectSource,
+                        parallel_get_ranges, retry_backoff_s)
 from .s3 import _ConnectionPool, _glob_regex
-
-_RETRYABLE_STATUS = {429, 500, 502, 503, 504}
 
 
 def _parse_gs_url(path: str) -> Tuple[str, str]:
@@ -67,12 +67,12 @@ class GCSSource(ObjectSource):
             except (OSError, http.client.HTTPException) as exc:
                 conn.close()
                 last_exc = exc
-                time.sleep(min(0.1 * (2 ** attempt), 2.0))
+                time.sleep(retry_backoff_s(path, attempt))
                 continue
             if status in _RETRYABLE_STATUS:
                 last_exc = RuntimeError(
                     f"gcs {method} {path}: HTTP {status}: {data[:200]!r}")
-                time.sleep(min(0.1 * (2 ** attempt), 2.0))
+                time.sleep(retry_backoff_s(path, attempt))
                 continue
             return status, rheaders, data
         raise last_exc
@@ -97,6 +97,11 @@ class GCSSource(ObjectSource):
         if stats:
             stats.record_get(len(data))
         return data
+
+    def get_ranges(self, path, ranges, stats=None, parallelism=None):
+        return parallel_get_ranges(
+            self, path, ranges, stats,
+            min(parallelism or 8, self.config.max_connections))
 
     def put(self, path, data, stats=None) -> None:
         bucket, key = _parse_gs_url(path)

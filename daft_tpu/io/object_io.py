@@ -11,13 +11,91 @@ S3 (``s3.py``, SigV4), GCS (``gcs.py``, JSON API), Azure Blob
 
 from __future__ import annotations
 
+import concurrent.futures as _cf
 import dataclasses
 import glob as _glob
+import hashlib
 import os
 import threading
+import time
+import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Dict, Iterator, List, Optional, Tuple
+
+#: HTTP statuses worth retrying across every object source (throttle +
+#: transient server errors); any other 4xx/3xx is deterministic — retrying
+#: a 404 just burns the whole retry budget against a missing key
+RETRYABLE_STATUS = frozenset({408, 429, 500, 502, 503, 504})
+
+
+def retry_backoff_s(key: str, attempt: int, base: float = 0.05,
+                    cap: float = 2.0) -> float:
+    """Bounded exponential backoff with deterministic jitter for object
+    source retry loops (same policy shape as the resilience plane's
+    ``RetryPolicy.backoff_s`` / ``FetchRetryState``: the jitter hashes
+    from (key, attempt), so chaos replays pace identically)."""
+    exp = base * (2 ** max(attempt, 0))
+    h = int(hashlib.sha256(f"{key}:{attempt}".encode()).hexdigest()[:8], 16)
+    return min(exp * (0.5 + h / 0xFFFFFFFF), cap)
+
+
+_io_pool_lock = threading.Lock()
+_io_pool: Optional[_cf.ThreadPoolExecutor] = None
+
+
+def io_pool() -> _cf.ThreadPoolExecutor:
+    """Shared bounded pool for parallel range fetches (the process-wide
+    analogue of the reference's tokio IO runtime)."""
+    global _io_pool
+    with _io_pool_lock:
+        if _io_pool is None:
+            _io_pool = _cf.ThreadPoolExecutor(
+                max_workers=max(min((os.cpu_count() or 4) * 2, 16), 4),
+                thread_name_prefix="daft-tpu-io")
+        return _io_pool
+
+
+def parallel_get_ranges(source: "ObjectSource", path: str,
+                        ranges: List[Tuple[int, int]],
+                        stats: Optional["IOStatsContext"] = None,
+                        parallelism: Optional[int] = None) -> List[bytes]:
+    """Fetch ``ranges`` concurrently on the shared IO pool, bounded by
+    ``parallelism`` in-flight requests; results come back in input order.
+    The per-scheme sources route ``get_ranges`` here (their connection
+    pools make the concurrent GETs reuse sockets)."""
+    par = max(parallelism or 1, 1)
+    if len(ranges) <= 1 or par <= 1:
+        return [source.get(path, r, stats) for r in ranges]
+    pool = io_pool()
+    out: List[Optional[bytes]] = [None] * len(ranges)
+    it = iter(enumerate(ranges))
+    pending = {}
+    err: List[BaseException] = []
+
+    def submit():
+        try:
+            i, r = next(it)
+        except StopIteration:
+            return
+        pending[pool.submit(source.get, path, r, stats)] = i
+
+    for _ in range(min(par, len(ranges))):
+        submit()
+    while pending:
+        done, _ = _cf.wait(list(pending),
+                           return_when=_cf.FIRST_COMPLETED)
+        for f in done:
+            i = pending.pop(f)
+            try:
+                out[i] = f.result()
+            except BaseException as exc:  # noqa: BLE001
+                err.append(exc)
+            if not err:
+                submit()
+    if err:
+        raise err[0]
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -124,6 +202,14 @@ class ObjectSource:
             stats: Optional[IOStatsContext] = None) -> bytes:
         raise NotImplementedError
 
+    def get_ranges(self, path: str, ranges: List[Tuple[int, int]],
+                   stats: Optional[IOStatsContext] = None,
+                   parallelism: Optional[int] = None) -> List[bytes]:
+        """Fetch several byte ranges of one object; results in input
+        order. Default loops over :meth:`get`; network sources override
+        with pooled concurrent requests."""
+        return [self.get(path, r, stats) for r in ranges]
+
     def put(self, path: str, data: bytes,
             stats: Optional[IOStatsContext] = None) -> None:
         raise NotImplementedError
@@ -160,6 +246,18 @@ class LocalSource(ObjectSource):
         if stats:
             stats.record_get(len(data))
         return data
+
+    def get_ranges(self, path, ranges, stats=None, parallelism=None):
+        # one open + seeks: local disk gains nothing from pooled threads
+        out = []
+        with open(self._strip(path), "rb") as f:
+            for start, end in ranges:
+                f.seek(start)
+                out.append(f.read(end - start))
+        if stats:
+            for b in out:
+                stats.record_get(len(b))
+        return out
 
     def put(self, path, data, stats=None):
         p = self._strip(path)
@@ -205,16 +303,29 @@ class HTTPSource(ObjectSource):
 
     def get(self, path, byte_range=None, stats=None):
         last_err = None
-        for _ in range(max(1, self.config.num_tries)):
+        tries = max(1, self.config.num_tries)
+        for attempt in range(tries):
             try:
                 with urllib.request.urlopen(self._request(path, byte_range)) as r:
                     data = r.read()
                 if stats:
                     stats.record_get(len(data))
                 return data
-            except Exception as exc:  # retry on transient network errors
+            except urllib.error.HTTPError as exc:
+                # non-transient statuses (404, 403, 400 …) are
+                # deterministic: retrying just burns the budget
+                if exc.code not in RETRYABLE_STATUS:
+                    raise
                 last_err = exc
+            except Exception as exc:  # transient network errors
+                last_err = exc
+            if attempt + 1 < tries:
+                time.sleep(retry_backoff_s(path, attempt))
         raise last_err
+
+    def get_ranges(self, path, ranges, stats=None, parallelism=None):
+        return parallel_get_ranges(self, path, ranges, stats,
+                                   parallelism or 8)
 
     def get_size(self, path):
         req = self._request(path)
@@ -271,6 +382,11 @@ class IOClient:
     # convenience passthroughs
     def get(self, path, byte_range=None, stats=None) -> bytes:
         return self.source_for(path).get(path, byte_range, stats)
+
+    def get_ranges(self, path, ranges, stats=None,
+                   parallelism=None) -> List[bytes]:
+        return self.source_for(path).get_ranges(path, ranges, stats,
+                                                parallelism)
 
     def put(self, path, data, stats=None) -> None:
         return self.source_for(path).put(path, data, stats)

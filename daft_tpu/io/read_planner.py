@@ -1,0 +1,394 @@
+"""Scan-side byte-range read planner (reference: ``daft-parquet/read_planner``).
+
+The scan fast path's planning layer: given a parquet footer plus the
+projected columns and pruned row groups, emit the EXACT byte ranges the
+decode will touch, coalesce them (hole tolerance + request floor) into few
+large GETs, fetch them concurrently over the source's connection pool
+(``ObjectSource.get_ranges``), and hand pyarrow an in-memory
+:class:`RangeCache` file shim so it never issues its own small GETs.
+
+Also owns the process-wide scan-plane counters (mirroring the shuffle
+counters in ``distributed/shuffle_service.py``): ``RuntimeStatsContext``
+snapshots at query start and diffs at ``finish()`` into the per-query
+``io`` block — requests issued vs ranges planned (coalescing evidence),
+bytes fetched vs bytes used (over-fetch), and prefetch overlap wall vs
+serial-equivalent.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .object_io import IOStatsContext
+
+
+# ------------------------------------------------------- scan-plane counters
+
+_counters_lock = threading.Lock()
+_counters: Dict[str, float] = {}
+
+
+def scan_count(name: str, n: float = 1) -> None:
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def scan_counters_snapshot() -> Dict[str, float]:
+    with _counters_lock:
+        return dict(_counters)
+
+
+def scan_counters_delta(before: Dict[str, float],
+                        after: Optional[Dict[str, float]] = None
+                        ) -> Dict[str, float]:
+    if after is None:
+        after = scan_counters_snapshot()
+    out = {}
+    for k, v in after.items():
+        d = v - before.get(k, 0)
+        if d:
+            out[k] = d
+    return out
+
+
+def scan_counters_reset() -> None:
+    with _counters_lock:
+        _counters.clear()
+
+
+class _ScanIOStats(IOStatsContext):
+    """The previously-dangling ``IOStatsContext``, wired for real: every
+    scan-path object GET/PUT records here AND mirrors into the process-wide
+    scan counters so the per-query ``io`` stats block sees it."""
+
+    def record_get(self, nbytes: int):
+        super().record_get(nbytes)
+        with _counters_lock:
+            _counters["gets"] = _counters.get("gets", 0) + 1
+            _counters["bytes_fetched"] = \
+                _counters.get("bytes_fetched", 0) + nbytes
+
+    def record_list(self):
+        super().record_list()
+        with _counters_lock:
+            _counters["lists"] = _counters.get("lists", 0) + 1
+
+
+#: process-wide stats context threaded through planner / fetch / scan reads
+SCAN_STATS = _ScanIOStats("scan")
+
+
+# ----------------------------------------------------------------- knobs
+
+def _env_bytes(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return None
+    from ..execution.memory import parse_bytes
+    return parse_bytes(v)
+
+
+def _cfg(attr: str, default):
+    try:
+        from ..context import get_context
+        return getattr(get_context().execution_config, attr)
+    except Exception:
+        return default
+
+
+def coalesce_gap_bytes() -> int:
+    """Hole tolerance for range coalescing (``DAFT_TPU_IO_COALESCE_GAP``,
+    default 1MiB): two needed ranges separated by at most this many waste
+    bytes merge into one request."""
+    v = _env_bytes("DAFT_TPU_IO_COALESCE_GAP")
+    return v if v is not None else _cfg("tpu_io_coalesce_gap", 1 << 20)
+
+
+def min_request_bytes() -> int:
+    """Request floor (``DAFT_TPU_IO_MIN_REQUEST``, default 8MiB): after
+    gap-coalescing, a sub-floor request absorbs its neighbor when the hole
+    between them is itself smaller than the floor — request count drops
+    toward per-RTT-amortizing sizes with bounded waste."""
+    v = _env_bytes("DAFT_TPU_IO_MIN_REQUEST")
+    return v if v is not None else _cfg("tpu_io_min_request", 8 << 20)
+
+
+def range_parallelism() -> int:
+    """Concurrent range GETs per source (``DAFT_TPU_IO_RANGE_PARALLELISM``,
+    default 8; each source additionally caps at its configured
+    ``max_connections``)."""
+    v = os.environ.get("DAFT_TPU_IO_RANGE_PARALLELISM")
+    if v is not None and v != "":
+        return max(int(v), 1)
+    return max(int(_cfg("tpu_io_range_parallelism", 8)), 1)
+
+
+def planned_reads_enabled() -> bool:
+    """``DAFT_TPU_IO_PLANNED_READS=0`` restores the naive per-column-chunk
+    ranged-read path (the pre-fast-path behavior; also the bench baseline)."""
+    v = os.environ.get("DAFT_TPU_IO_PLANNED_READS")
+    if v is not None and v != "":
+        return v not in ("0", "false", "False")
+    return bool(_cfg("tpu_io_planned_reads", True))
+
+
+def scan_prefetch_tasks() -> int:
+    """How many upcoming ScanTasks the scan source resolves ahead of the
+    consumer (``DAFT_TPU_SCAN_PREFETCH``, default 2; 0 disables)."""
+    v = os.environ.get("DAFT_TPU_SCAN_PREFETCH")
+    if v is not None and v != "":
+        return max(int(v), 0)
+    return max(int(_cfg("tpu_scan_prefetch", 2)), 0)
+
+
+def stream_chunk_bytes() -> int:
+    """Chunk size for streaming whole-object reads (CSV/JSON),
+    ``DAFT_TPU_IO_STREAM_CHUNK`` default 8MiB."""
+    v = _env_bytes("DAFT_TPU_IO_STREAM_CHUNK")
+    return v if v is not None else 8 << 20
+
+
+def infer_head_bytes() -> int:
+    """Byte budget for head-range schema inference on remote CSV/JSON
+    (``DAFT_TPU_IO_INFER_BYTES``, default 1MiB; 0 → whole object)."""
+    v = _env_bytes("DAFT_TPU_IO_INFER_BYTES")
+    return v if v is not None else 1 << 20
+
+
+def scan_sequential_fallback() -> bool:
+    """True when the scan fast path must degrade to the sequential path:
+    ``DAFT_TPU_CHAOS_SERIALIZE=1`` or an active fault plan — PR 2's chaos
+    replay contract requires the injected-fault exposure (and event order)
+    of the pre-fast-path scan loop."""
+    if os.environ.get("DAFT_TPU_CHAOS_SERIALIZE", "0") \
+            not in ("0", "", "false"):
+        return True
+    try:
+        from ..distributed.resilience import active_fault_plan
+        return active_fault_plan() is not None
+    except Exception:
+        return False
+
+
+# -------------------------------------------------------------- planning
+
+def plan_parquet_ranges(md, row_groups: Optional[Sequence[int]] = None,
+                        columns: Optional[Sequence[str]] = None
+                        ) -> List[Tuple[int, int]]:
+    """Exact [start, end) byte ranges of the column chunks a read of
+    ``row_groups`` × ``columns`` will touch (dictionary page through last
+    data page — parquet stores them contiguously per chunk). ``None``
+    means all groups / all columns. Nested columns match on their root
+    name. Sorted and overlap-merged."""
+    groups = range(md.num_row_groups) if row_groups is None else row_groups
+    roots = None if columns is None else {c for c in columns}
+    out: List[Tuple[int, int]] = []
+    for g in groups:
+        rg = md.row_group(g)
+        for ci in range(rg.num_columns):
+            cc = rg.column(ci)
+            if roots is not None \
+                    and cc.path_in_schema.split(".")[0] not in roots:
+                continue
+            start = cc.data_page_offset
+            if cc.dictionary_page_offset is not None:
+                start = min(start, cc.dictionary_page_offset)
+            out.append((start, start + cc.total_compressed_size))
+    return _normalize(out)
+
+
+def _normalize(ranges: Sequence[Tuple[int, int]]) -> List[Tuple[int, int]]:
+    """Sort and merge overlapping/adjacent ranges."""
+    out: List[Tuple[int, int]] = []
+    for s, e in sorted(r for r in ranges if r[1] > r[0]):
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def coalesce_ranges(ranges: Sequence[Tuple[int, int]],
+                    gap: Optional[int] = None,
+                    floor: Optional[int] = None) -> List[Tuple[int, int]]:
+    """Needed ranges → request ranges. Two passes:
+
+    1. **hole tolerance**: merge neighbors separated by at most ``gap``
+       waste bytes (column chunks of adjacent projected columns are
+       usually separated only by the chunks of pruned columns' headers
+       or nothing at all);
+    2. **request floor**: a request smaller than ``floor`` absorbs its
+       neighbor when the hole between them is itself under ``floor`` —
+       tiny scattered chunks (many row groups × narrow projection) batch
+       into RTT-amortizing GETs with bounded waste (every absorbed hole
+       < floor).
+    """
+    gap = coalesce_gap_bytes() if gap is None else gap
+    floor = min_request_bytes() if floor is None else floor
+    merged = _normalize(ranges)
+    if not merged:
+        return []
+
+    def merge_pass(rs: List[Tuple[int, int]], want) -> List[Tuple[int, int]]:
+        out = [rs[0]]
+        for s, e in rs[1:]:
+            ps, pe = out[-1]
+            if want(ps, pe, s, e):
+                out[-1] = (ps, max(pe, e))
+            else:
+                out.append((s, e))
+        return out
+
+    merged = merge_pass(merged, lambda ps, pe, s, e: s - pe <= gap)
+    merged = merge_pass(
+        merged, lambda ps, pe, s, e: s - pe < floor
+        and (pe - ps < floor or e - s < floor))
+    return merged
+
+
+# ------------------------------------------------------------ range cache
+
+class RangeCache:
+    """Fetched [start, end) → bytes segments; serves sub-range reads by
+    slicing across segments (requests may each cover several needed
+    ranges). ``read`` raises ``KeyError`` on any uncovered byte so the
+    caller can fall back to a direct GET."""
+
+    def __init__(self, segments: Sequence[Tuple[Tuple[int, int], bytes]]):
+        self._segs = sorted(((s, s + len(data), data)
+                             for (s, _e), data in segments),
+                            key=lambda x: x[0])
+
+    def covers(self, start: int, end: int) -> bool:
+        try:
+            self.read(start, end)
+            return True
+        except KeyError:
+            return False
+
+    def read(self, start: int, end: int) -> bytes:
+        if end <= start:
+            return b""
+        parts = []
+        pos = start
+        for s, e, data in self._segs:
+            if e <= pos:
+                continue
+            if s > pos:
+                break
+            take_end = min(end, e)
+            parts.append(data[pos - s:take_end - s])
+            pos = take_end
+            if pos >= end:
+                return b"".join(parts)
+        raise KeyError(f"range [{start}, {end}) not covered")
+
+
+class RangeCacheFile(io.RawIOBase):
+    """Seekable file shim over a :class:`RangeCache`, with per-read
+    fallback to direct ranged GETs for bytes the planner did not fetch
+    (pyarrow header probes, planner misses). Feeds ``pa.PythonFile``."""
+
+    def __init__(self, cache: RangeCache, source, path: str,
+                 size: Optional[int] = None,
+                 stats: Optional[IOStatsContext] = None):
+        self._cache = cache
+        self._src = source
+        self._path = path
+        self._lazy_size = size
+        self._stats = stats
+        self._pos = 0
+
+    @property
+    def _size(self) -> int:
+        if self._lazy_size is None:
+            self._lazy_size = self._src.get_size(self._path)
+        return self._lazy_size
+
+    def readable(self):
+        return True
+
+    def seekable(self):
+        return True
+
+    def seek(self, offset, whence=io.SEEK_SET):
+        if whence == io.SEEK_SET:
+            self._pos = offset
+        elif whence == io.SEEK_CUR:
+            self._pos += offset
+        else:
+            self._pos = self._size + offset
+        return self._pos
+
+    def tell(self):
+        return self._pos
+
+    def read(self, n=-1):
+        if n is None or n < 0:
+            n = self._size - self._pos
+        if n <= 0:
+            return b""
+        start, end = self._pos, self._pos + n
+        try:
+            data = self._cache.read(start, end)
+        except KeyError:
+            # planner miss — bounded by the file size, counted so the
+            # stats expose any systematic planning hole
+            end = min(end, self._size)
+            if end <= start:
+                return b""
+            scan_count("planner_miss_gets")
+            data = self._src.get(self._path, (start, end), self._stats)
+        self._pos += len(data)
+        return data
+
+    def size(self):
+        return self._size
+
+
+class ChunkedObjectReader(io.RawIOBase):
+    """Sequential streaming reader over chunked ranged GETs — the
+    single-pass formats' (CSV/JSON) replacement for buffering the whole
+    object: resident memory is chunk-sized, and the parser starts before
+    the tail arrives."""
+
+    def __init__(self, source, path: str, chunk: Optional[int] = None,
+                 stats: Optional[IOStatsContext] = None):
+        self._src = source
+        self._path = path
+        self._chunk = chunk or stream_chunk_bytes()
+        self._stats = stats
+        self._size = source.get_size(path)
+        self._pos = 0  # next byte to hand out
+        self._buf = b""
+        self._buf_at = 0  # file offset of _buf[0]
+
+    def readable(self):
+        return True
+
+    def read(self, n=-1):
+        if n is None or n < 0:
+            n = self._size - self._pos
+        out = []
+        need = n
+        while need > 0 and self._pos < self._size:
+            off = self._pos - self._buf_at
+            avail = len(self._buf) - off
+            if avail <= 0:
+                fetch_end = min(self._pos + max(self._chunk, need),
+                                self._size)
+                self._buf = self._src.get(self._path,
+                                          (self._pos, fetch_end),
+                                          self._stats)
+                self._buf_at = self._pos
+                off, avail = 0, len(self._buf)
+                if avail == 0:
+                    break
+            take = min(avail, need)
+            out.append(self._buf[off:off + take])
+            self._pos += take
+            need -= take
+        return b"".join(out)

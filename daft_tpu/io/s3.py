@@ -24,10 +24,11 @@ import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import Dict, Iterator, List, Optional, Tuple
 
-from .object_io import IOStatsContext, ObjectSource, S3Config
+from .object_io import (RETRYABLE_STATUS as _RETRYABLE_STATUS,
+                        IOStatsContext, ObjectSource, S3Config,
+                        parallel_get_ranges, retry_backoff_s)
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
-_RETRYABLE_STATUS = {429, 500, 502, 503, 504}
 
 
 def _parse_s3_url(path: str) -> Tuple[str, str]:
@@ -159,12 +160,12 @@ class S3Source(ObjectSource):
             except (OSError, http.client.HTTPException) as exc:
                 conn.close()
                 last_exc = exc
-                time.sleep(min(0.1 * (2 ** attempt), 2.0))
+                time.sleep(retry_backoff_s(path, attempt))
                 continue
             if status in _RETRYABLE_STATUS:
                 last_exc = RuntimeError(
                     f"s3 {method} {path}: HTTP {status}: {data[:200]!r}")
-                time.sleep(min(0.1 * (2 ** attempt), 2.0))
+                time.sleep(retry_backoff_s(path, attempt))
                 continue
             return status, rheaders, data
         raise last_exc
@@ -181,6 +182,11 @@ class S3Source(ObjectSource):
         if stats:
             stats.record_get(len(data))
         return data
+
+    def get_ranges(self, path, ranges, stats=None, parallelism=None):
+        return parallel_get_ranges(
+            self, path, ranges, stats,
+            min(parallelism or 8, self.config.max_connections))
 
     def put(self, path, data, stats=None) -> None:
         bucket, key = _parse_s3_url(path)

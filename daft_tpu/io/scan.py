@@ -87,25 +87,29 @@ class ScanTask:
     def size_bytes(self) -> Optional[int]:
         return self._size_bytes
 
-    def execute(self) -> List[RecordBatch]:
+    def stream_batches(self) -> Iterator[RecordBatch]:
+        """Stream result batches (one per source file) with residual
+        pushdowns applied incrementally — the prefetch-pipelined scan
+        yields morsels off this as each file decodes, and a satisfied
+        limit stops reading the remaining files. May yield nothing for an
+        all-filtered task (``execute`` adds the empty-batch fallback)."""
         from . import readers
-        if self.generator is not None:
-            batches = list(self.generator())
-        else:
-            batches = readers.read_scan_task(self)
-        # apply residual pushdowns (reader may have applied some already)
-        out = []
+        src = self.generator() if self.generator is not None \
+            else readers.iter_scan_task_batches(self)
         remaining = self.pushdowns.limit
-        for b in batches:
+        for b in src:
             if self.pushdowns.filters is not None:
                 b = b.filter(self.pushdowns.filters)
             if remaining is not None:
                 if remaining <= 0:
-                    break
+                    return
                 b = b.head(remaining)
                 remaining -= len(b)
             if len(b):
-                out.append(b)
+                yield b
+
+    def execute(self) -> List[RecordBatch]:
+        out = list(self.stream_batches())
         if not out:
             return [RecordBatch.empty(self.materialized_schema())]
         return out
@@ -252,9 +256,16 @@ class GlobScanOperator(ScanOperator):
             schema = readers.infer_schema(self._paths[0], file_format,
                                           self._options, io_config)
         if hive_partitioning:
-            parts = _hive_values(self._paths[0])
-            for k, v in parts.items():
-                self._hive_fields[k] = DataType.infer_from_pylist([v])
+            # union keys/types across ALL globbed paths — inferring from
+            # the first path alone silently drops the partition columns of
+            # mixed-key layouts (and types from a single value misjudge
+            # e.g. a first partition that happens to look numeric)
+            values: Dict[str, List[Any]] = {}
+            for p in self._paths:
+                for k, v in _hive_values(p).items():
+                    values.setdefault(k, []).append(v)
+            for k, vs in values.items():
+                self._hive_fields[k] = DataType.infer_from_pylist(vs)
             schema = schema.non_distinct_union(
                 Schema([Field(k, t) for k, t in self._hive_fields.items()]))
         self._schema = schema
@@ -270,15 +281,32 @@ class GlobScanOperator(ScanOperator):
                 f"paths = {self._paths[:3]}{'…' if len(self._paths) > 3 else ''}"]
 
     def to_scan_tasks(self, pushdowns: Pushdowns) -> List[ScanTask]:
-        from . import readers
+        from . import read_planner as rp, readers
         from ..context import get_context
         cfg = get_context().execution_config
-        tasks: List[ScanTask] = []
-        for p in self._paths:
-            pv = _hive_values(p) if self._hive else {}
-            tasks.extend(readers.make_scan_tasks(
+
+        def plan_one(p: str) -> List[ScanTask]:
+            pv = {}
+            if self._hive:
+                # missing-key → null fill: every task carries the UNION's
+                # keys so a path lacking one still materializes the column
+                vals = _hive_values(p)
+                pv = {k: vals.get(k) for k in self._hive_fields}
+            return readers.make_scan_tasks(
                 p, self._format, self._schema, pushdowns, self._options, pv,
-                self._io_config))
+                self._io_config)
+
+        remote = [p for p in self._paths if "://" in p
+                  and not p.startswith("file://")]
+        if len(remote) > 1 and not rp.scan_sequential_fallback():
+            # footer fetches dominate multi-file remote planning (one RTT
+            # chain per file) — fan them over the IO pool, order preserved
+            from .object_io import io_pool
+            futs = [io_pool().submit(plan_one, p) for p in self._paths]
+            groups = [f.result() for f in futs]
+        else:
+            groups = [plan_one(p) for p in self._paths]
+        tasks: List[ScanTask] = [t for g in groups for t in g]
         tasks = split_scan_tasks(tasks, cfg.scan_tasks_max_size_bytes,
                                  cfg.parquet_split_row_groups_max_files)
         return merge_scan_tasks(tasks, cfg.scan_tasks_min_size_bytes,

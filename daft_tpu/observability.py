@@ -61,6 +61,17 @@ def _shuffle_raw() -> Dict[str, float]:
         return {}
 
 
+def _scan_io_raw() -> Dict[str, float]:
+    """Raw snapshot of the scan-plane IO counters (object GETs, planned
+    ranges vs coalesced requests, bytes fetched vs used, prefetch wall vs
+    serial-equivalent) — never raises, like the device ledger."""
+    try:
+        from .io import read_planner
+        return read_planner.scan_counters_snapshot()
+    except Exception:
+        return {}
+
+
 def device_kernel_ledger() -> Dict[str, dict]:
     """Process-wide per-dispatch achieved-bytes/flops ledger with derived
     roofline/MFU percentages (``costmodel.ledger_record`` feeds it at
@@ -158,6 +169,10 @@ class RuntimeStatsContext:
         # compression, combine reduction, fetch overlap)
         self._shuffle0 = _shuffle_raw()
         self.shuffle: Dict[str, float] = {}
+        # …and for the scan-side IO plane (requests vs planned ranges,
+        # bytes fetched vs used, prefetch overlap)
+        self._io0 = _scan_io_raw()
+        self.io: Dict[str, float] = {}
 
     def register(self, node) -> OperatorStats:
         key = id(node)
@@ -208,6 +223,12 @@ class RuntimeStatsContext:
                 self._shuffle0, _shuffle_raw())
         except Exception:
             self.shuffle = {}
+        try:
+            from .io import read_planner
+            self.io = read_planner.scan_counters_delta(
+                self._io0, _scan_io_raw())
+        except Exception:
+            self.io = {}
 
     # ---- reporting ---------------------------------------------------
     def exclusive_us(self, key: int) -> int:
@@ -267,6 +288,7 @@ class RuntimeStatsContext:
             for k, v in sorted(self.recovery.items()):
                 lines.append(f"  {k}: {v}")
         lines.extend(render_shuffle_block(self.shuffle))
+        lines.extend(render_io_block(self.io))
         return "\n".join(lines)
 
     def as_dict(self) -> Dict[str, dict]:
@@ -323,6 +345,44 @@ def render_shuffle_block(sh: Dict[str, float]) -> List[str]:
                   f", serial {serial:.3f}s"
         lines.append(f"  fetched: {_fmt_bytes(fetched)} in "
                      f"{int(sh.get('fetches', 0))} fetches{overlap}")
+    return lines
+
+
+def render_io_block(d: Dict[str, float]) -> List[str]:
+    """Human lines for one query's scan-plane IO delta (shared by
+    ``explain(analyze=True)`` and the dashboard). Each fast-path layer's
+    evidence: requests issued vs byte ranges needed pre-coalesce, bytes
+    fetched vs bytes actually decoded, and prefetch-pipelined wall vs the
+    serial-equivalent sum of per-task load times."""
+    if not d:
+        return []
+    lines = ["io (scan plane):"]
+    gets = int(d.get("gets", 0))
+    planned = int(d.get("ranges_planned", 0))
+    reqs = int(d.get("range_requests", 0))
+    if gets or planned:
+        coal = f", {planned / reqs:.1f}x coalesced" if reqs else ""
+        lines.append(f"  requests: {gets} GETs "
+                     f"({planned} ranges needed -> {reqs} range "
+                     f"requests{coal})")
+    fetched = d.get("bytes_fetched", 0)
+    used = d.get("bytes_used", 0)
+    if fetched:
+        eff = f" ({100.0 * used / fetched:.1f}% used)" if used else ""
+        lines.append(f"  bytes: {_fmt_bytes(fetched)} fetched / "
+                     f"{_fmt_bytes(used)} decoded{eff}")
+    span = d.get("scan_span_us", 0) / 1e6
+    serial = d.get("scan_task_us", 0) / 1e6
+    if span or serial:
+        tasks = int(d.get("prefetch_tasks", 0))
+        overlap = f" ({serial / span:.1f}x overlap)" if span else ""
+        lines.append(f"  prefetch: {tasks} tasks, wall {span:.3f}s vs "
+                     f"serial-equivalent {serial:.3f}s{overlap}")
+    misses = int(d.get("planner_miss_gets", 0))
+    falls = int(d.get("planned_read_fallbacks", 0))
+    if misses or falls:
+        lines.append(f"  planner: {misses} miss GETs, "
+                     f"{falls} whole-file fallbacks")
     return lines
 
 

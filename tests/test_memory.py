@@ -177,7 +177,7 @@ def test_scan_load_retries_transient_io(monkeypatch, tmp_path):
 
     from daft_tpu.io import readers
     calls = {"n": 0}
-    orig = readers.read_scan_task
+    orig = readers.iter_scan_task_batches
 
     def flaky(task):
         calls["n"] += 1
@@ -185,6 +185,6 @@ def test_scan_load_retries_transient_io(monkeypatch, tmp_path):
             raise OSError("transient read failure")
         return orig(task)
 
-    monkeypatch.setattr(readers, "read_scan_task", flaky)
+    monkeypatch.setattr(readers, "iter_scan_task_batches", flaky)
     assert df.to_pydict() == {"x": [1, 2, 3]}
     assert calls["n"] >= 2

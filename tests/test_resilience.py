@@ -171,11 +171,13 @@ def test_backoff_is_deterministic_and_bounded():
 
 def test_fast_path_layers_do_not_perturb_chaos_replay(monkeypatch,
                                                       tmp_path):
-    """PR 3 contract: the shuffle fast path (map-side combine, IPC
-    compression, parallel fetch) degrades to the deterministic sequential
-    behavior under DAFT_TPU_CHAOS_SERIALIZE=1 — the same seeded fault
-    spec replays the SAME event sequence and answer across every knob
-    combination, including a raised fetch-parallelism that the serialize
+    """PR 3 contract (extended by the PR 4 scan fast path): the shuffle
+    fast path (map-side combine, IPC compression, parallel fetch) AND the
+    scan fast path (planned coalesced reads, prefetch-pipelined tasks)
+    degrade to the deterministic sequential behavior under
+    DAFT_TPU_CHAOS_SERIALIZE=1 — the same seeded fault spec replays the
+    SAME event sequence and answer across every knob combination,
+    including raised fetch-parallelism / scan-prefetch that the serialize
     mode must override."""
     monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
     monkeypatch.setenv("DAFT_TPU_DISTRIBUTED_SHUFFLE", "flight")
@@ -197,10 +199,14 @@ def test_fast_path_layers_do_not_perturb_chaos_replay(monkeypatch,
 
     out1, ev1 = one_run({"DAFT_TPU_SHUFFLE_COMBINE": "0",
                          "DAFT_TPU_SHUFFLE_COMPRESSION": "none",
-                         "DAFT_TPU_SHUFFLE_FETCH_PARALLELISM": "1"})
+                         "DAFT_TPU_SHUFFLE_FETCH_PARALLELISM": "1",
+                         "DAFT_TPU_SCAN_PREFETCH": "0",
+                         "DAFT_TPU_IO_PLANNED_READS": "0"})
     out2, ev2 = one_run({"DAFT_TPU_SHUFFLE_COMBINE": "1",
                          "DAFT_TPU_SHUFFLE_COMPRESSION": "lz4",
-                         "DAFT_TPU_SHUFFLE_FETCH_PARALLELISM": "8"})
+                         "DAFT_TPU_SHUFFLE_FETCH_PARALLELISM": "8",
+                         "DAFT_TPU_SCAN_PREFETCH": "8",
+                         "DAFT_TPU_IO_PLANNED_READS": "1"})
     assert ev1, "the fixed spec/seed injected nothing — tune the seed"
     assert ev1 == ev2
     assert out1 == out2
