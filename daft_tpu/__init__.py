@@ -5,6 +5,14 @@ TPU-first: Arrow C++ host columns, jit-compiled XLA relational operators,
 ICI-collective shuffles over a jax device Mesh.
 """
 
+# the runtime lock-order sanitizer must patch the lock factories BEFORE
+# the engine modules below create their module-level locks — this block
+# stays first (analysis.knobs / lock_sanitizer are import-light)
+from .analysis import knobs as _knobs
+if _knobs.env_bool("DAFT_TPU_SANITIZE"):
+    from .analysis import lock_sanitizer as _lock_sanitizer
+    _lock_sanitizer.enable()
+
 from .datatype import DataType, ImageFormat, ImageMode, TimeUnit
 from .expressions import (
     Expression, col, lit, element, coalesce, interval, list_, struct,

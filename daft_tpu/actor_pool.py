@@ -175,7 +175,8 @@ class ActorPool:
 
 
 def pool_enabled() -> bool:
-    return os.environ.get("DAFT_TPU_ACTOR_POOL", "1") != "0"
+    from .analysis import knobs
+    return knobs.env_bool("DAFT_TPU_ACTOR_POOL")
 
 
 def try_make_pool(udf) -> Optional[ActorPool]:

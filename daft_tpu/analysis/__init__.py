@@ -1,0 +1,32 @@
+"""Engine-aware static analysis + runtime sanitizers (daft-lint).
+
+Import-light on purpose: runtime modules import :mod:`.knobs` (the
+declarative ``DAFT_TPU_*`` registry + typed env accessors) on their hot
+import path; the AST rule families and the CLI load lazily via
+``python -m daft_tpu.analysis`` / :func:`run_analysis`.
+
+Layout:
+
+- ``knobs.py`` — the single knob registry + ``env_*`` accessors +
+  README knob-table generation
+- ``framework.py`` — findings, ``# daft-lint: allow(<rule>) -- reason``
+  pragmas, source walking, baseline
+- ``rule_knobs.py`` — knob registry discipline (one parse site, no
+  unregistered reads, no code↔README drift)
+- ``rule_determinism.py`` — chaos-replay determinism (no unseeded
+  random / wall-clock decisions / unordered pool iteration in
+  replay-critical modules)
+- ``rule_locks.py`` — blocking calls under locks, unguarded
+  module-state mutation
+- ``rule_jit.py`` — device-kernel jit hygiene + jaxpr dispatch-contract
+  re-verification (shared with tests/test_device_kernels.py)
+- ``lock_sanitizer.py`` — runtime lock-order graph + cycle detection
+  (``DAFT_TPU_SANITIZE=1``)
+"""
+
+from . import knobs  # noqa: F401  (the engine-facing surface)
+
+
+def run_analysis(*args, **kwargs):
+    from .framework import run_analysis as _run
+    return _run(*args, **kwargs)

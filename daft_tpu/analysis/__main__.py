@@ -1,0 +1,67 @@
+"""daft-lint CLI: ``python -m daft_tpu.analysis``.
+
+Exit status 0 = no non-baselined findings; 1 = findings. Also the
+knob-docs generator: ``--knob-docs`` prints the generated README tables,
+``--knob-docs --write`` rewrites the README's generated blocks in place.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m daft_tpu.analysis",
+        description="engine-aware static analysis for daft_tpu")
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative files/dirs to scan "
+                         "(default: daft_tpu tests bench.py)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings")
+    ap.add_argument("--no-contracts", action="store_true",
+                    help="skip the jaxpr dispatch-contract re-verification "
+                         "(no jax import)")
+    ap.add_argument("--no-readme", action="store_true",
+                    help="skip the README knob-table drift check")
+    ap.add_argument("--knob-docs", action="store_true",
+                    help="print the generated knob tables and exit")
+    ap.add_argument("--write", action="store_true",
+                    help="with --knob-docs: rewrite README generated blocks")
+    args = ap.parse_args(argv)
+
+    # the dispatch-contract checks trace jaxprs; never touch a real TPU
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from . import knobs
+    from .framework import DEFAULT_SUBDIRS, repo_root, run_analysis
+
+    root = repo_root()
+
+    if args.knob_docs:
+        if args.write:
+            changed = knobs.update_readme(os.path.join(root, "README.md"))
+            print("README.md updated" if changed else "README.md up to date")
+            return 0
+        for group in knobs.GROUPS:
+            print(f"### {group}\n{knobs.knob_table_markdown(group)}\n")
+        return 0
+
+    subdirs = tuple(args.paths) if args.paths else DEFAULT_SUBDIRS
+    findings = run_analysis(root, subdirs=subdirs,
+                            contracts=not args.no_contracts,
+                            readme=not args.no_readme)
+    if args.json:
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"daft-lint: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,230 @@
+"""daft-lint core: findings, pragmas, source walking, baseline.
+
+The linter is engine-aware: each rule family encodes a real invariant of
+THIS codebase (knob registry discipline, chaos-replay determinism, lock
+discipline, jit hygiene) rather than generic style. Rules operate on
+parsed ASTs of the repo tree and return :class:`Finding`\\ s.
+
+Suppression is explicit and justified::
+
+    something_flagged()  # daft-lint: allow(rule-name) -- why it is safe
+
+The pragma may sit on the finding's line or the line directly above it.
+An ``allow(...)`` without a ``-- reason`` string is itself a finding
+(``pragma-missing-reason``) — grandfathering without a written
+justification is exactly the drift this tool exists to stop.
+
+A committed baseline (``analysis/baseline.json``) can grandfather known
+findings; this repo's baseline is **empty** and must stay empty — fix or
+pragma-justify, don't baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: the canonical scan set: the engine tree, the test tree (knob-usage
+#: round-trip), and the bench driver
+DEFAULT_SUBDIRS = ("daft_tpu", "tests", "bench.py")
+
+#: chaos-replay-critical modules: any nondeterminism here can break the
+#: bit-identical replay contract of the resilience plane (PR 2)
+REPLAY_CRITICAL = (
+    "daft_tpu/distributed/resilience.py",
+    "daft_tpu/distributed/shuffle_service.py",
+    "daft_tpu/distributed/worker.py",
+    "daft_tpu/distributed/remote_worker.py",
+    "daft_tpu/distributed/scheduler.py",
+    "daft_tpu/io/read_planner.py",
+    "daft_tpu/execution/executor.py",
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str      # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def key(self) -> str:
+        return f"{self.path}:{self.rule}:{self.line}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+_PRAGMA_RE = re.compile(
+    r"#\s*daft-lint:\s*allow\(([\w\-,\s]+)\)(?:\s*--\s*(.*\S))?")
+
+
+@dataclasses.dataclass
+class SourceFile:
+    """One parsed source file plus its pragma index."""
+    path: str                # repo-relative
+    abspath: str
+    text: str
+    tree: ast.Module
+    lines: List[str]
+
+    @property
+    def pragmas(self) -> Dict[int, Tuple[List[str], Optional[str]]]:
+        cached = getattr(self, "_pragmas", None)
+        if cached is None:
+            cached = {}
+            for i, line in enumerate(self.lines, start=1):
+                m = _PRAGMA_RE.search(line)
+                if m:
+                    rules = [r.strip() for r in m.group(1).split(",")
+                             if r.strip()]
+                    cached[i] = (rules, m.group(2))
+            self._pragmas = cached
+        return cached
+
+    def allowed(self, rule: str, line: int) -> bool:
+        """True when the line itself — or the contiguous comment block
+        directly above it — carries a pragma for ``rule`` WITH a
+        justification (multi-line reasons are encouraged)."""
+        entry = self.pragmas.get(line)
+        if entry and rule in entry[0] and entry[1]:
+            return True
+        ln = line - 1
+        while 1 <= ln <= len(self.lines) \
+                and self.lines[ln - 1].lstrip().startswith("#"):
+            entry = self.pragmas.get(ln)
+            if entry and rule in entry[0] and entry[1]:
+                return True
+            ln -= 1
+        return False
+
+    def pragma_findings(self) -> List[Finding]:
+        """Reason-less pragmas are findings themselves."""
+        out = []
+        for ln, (rules, reason) in self.pragmas.items():
+            if not reason:
+                out.append(Finding(
+                    "pragma-missing-reason", self.path, ln,
+                    f"daft-lint pragma for {', '.join(rules)} has no "
+                    f"`-- <reason>` justification"))
+        return out
+
+
+def load_source(abspath: str, root: str) -> Optional[SourceFile]:
+    try:
+        with open(abspath, encoding="utf-8") as f:
+            text = f.read()
+        tree = ast.parse(text, filename=abspath)
+    except (OSError, SyntaxError):
+        return None
+    rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+    return SourceFile(rel, abspath, text, tree, text.splitlines())
+
+
+def walk_sources(root: str,
+                 subdirs: Iterable[str] = ("daft_tpu",)) -> List[SourceFile]:
+    """Parse every ``*.py`` under ``root/<subdir>`` (skipping caches)."""
+    out = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        if os.path.isfile(base):
+            sf = load_source(base, root)
+            if sf:
+                out.append(sf)
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    sf = load_source(os.path.join(dirpath, fn), root)
+                    if sf:
+                        out.append(sf)
+    return out
+
+
+def repo_root() -> str:
+    """The repo root containing this daft_tpu checkout."""
+    here = os.path.dirname(os.path.abspath(__file__))   # …/daft_tpu/analysis
+    return os.path.dirname(os.path.dirname(here))
+
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> List[str]:
+    path = path or BASELINE_PATH
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return list(data.get("findings", []))
+    except (OSError, ValueError):
+        return []
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Iterable[str]) -> List[Finding]:
+    grandfathered = set(baseline)
+    return [f for f in findings if f.key() not in grandfathered]
+
+
+def run_analysis(root: Optional[str] = None,
+                 subdirs: Iterable[str] = DEFAULT_SUBDIRS,
+                 contracts: bool = True,
+                 readme: bool = True,
+                 baseline: Optional[List[str]] = None) -> List[Finding]:
+    """Run every rule family over the tree; returns non-baselined,
+    non-pragma'd findings sorted by location."""
+    from . import rule_determinism, rule_jit, rule_knobs, rule_locks
+
+    root = root or repo_root()
+    sources = walk_sources(root, subdirs)
+    findings: List[Finding] = []
+    for sf in sources:
+        findings.extend(sf.pragma_findings())
+
+    findings.extend(rule_knobs.check(sources))
+    if readme:
+        findings.extend(rule_knobs.check_readme(root))
+    findings.extend(rule_determinism.check(sources))
+    findings.extend(rule_locks.check(sources))
+    findings.extend(rule_jit.check(sources))
+    if contracts:
+        findings.extend(rule_jit.check_dispatch_contracts())
+
+    # pragma suppression (a pragma never suppresses pragma-missing-reason)
+    by_path = {sf.path: sf for sf in sources}
+    kept = []
+    for f in findings:
+        sf = by_path.get(f.path)
+        if (f.rule != "pragma-missing-reason" and sf is not None
+                and sf.allowed(f.rule, f.line)):
+            continue
+        kept.append(f)
+
+    kept = apply_baseline(kept, load_baseline() if baseline is None
+                          else baseline)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept
+
+
+# ------------------------------------------------------------- ast utils
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains, else ''."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(node: ast.Call) -> str:
+    return dotted_name(node.func)

@@ -1,0 +1,432 @@
+"""The engine's single declarative knob registry.
+
+Every ``DAFT_TPU_*`` environment knob is declared here exactly once:
+name, parse type, default, owning module, README table group, and a
+one-line doc. Runtime code reads knobs through the typed accessors
+(``env_int`` / ``env_float`` / ``env_bool`` / ``env_bytes`` /
+``env_str`` / ``env_raw``) so each knob has exactly ONE parse site —
+``rule_knobs`` flags direct ``os.environ`` reads of ``DAFT_TPU_*``
+names anywhere else, and the README knob tables are *generated* from
+this registry (``python -m daft_tpu.analysis --knob-docs``), so code,
+config and docs cannot drift silently.
+
+Knobs mirrored by an ``ExecutionConfig`` field record it in
+``config_field``; for those the env var is the per-process override and
+the config field is the per-query value (``context._exec_config_from_env``
+parses the same spelling — the registry documents both).
+
+This module must stay import-light (os + dataclasses only): the whole
+engine imports it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+_FALSY = ("0", "false", "False", "no", "off", "")
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str           # full env var name (DAFT_TPU_…)
+    type: str           # "int" | "float" | "bool" | "str" | "bytes"
+    default: object     # parsed-type default; None = unset/contextual
+    module: str         # owning module (repo-relative path)
+    group: str          # README table group (one generated table each)
+    doc: str            # one-line effect description for the table
+    config_field: str = ""   # mirrored ExecutionConfig field, if any
+    default_str: str = ""    # display override for the docs table
+
+
+def _k(name, type_, default, module, group, doc, config_field="",
+       default_str=""):
+    return Knob(name, type_, default, module, group, doc, config_field,
+                default_str)
+
+
+_KNOBS: List[Knob] = [
+    # ---------------------------------------------------------- core
+    _k("DAFT_TPU_DEVICE", "bool", True, "daft_tpu/device/runtime.py",
+       "core", "`0` disables the device tier entirely (pure host execution)"),
+    _k("DAFT_TPU_DEVICE_FORCE", "str", None, "daft_tpu/device/costmodel.py",
+       "core", "force device-vs-host routing: `1`/`device` forces device, "
+       "`0`/`host` forces host; unset lets the measured-link cost model "
+       "decide"),
+    _k("DAFT_TPU_DEVICE_MIN_ROWS", "int", None, "daft_tpu/device/runtime.py",
+       "core", "row floor below which ops stay on host (default: 4096 on a "
+       "transfer-bound link, 0 when the backend shares host memory)",
+       default_str="auto"),
+    _k("DAFT_TPU_DEVICE_JOIN", "str", None, "daft_tpu/joins.py",
+       "core", "`1`/`0` force-overrides the cost model's device-join "
+       "routing; unset = modeled", default_str="auto"),
+    _k("DAFT_TPU_NATIVE", "bool", True, "daft_tpu/native/__init__.py",
+       "core", "`0` disables the native (C-accelerated) expression paths"),
+    _k("DAFT_TPU_ACTOR_POOL", "bool", True, "daft_tpu/actor_pool.py",
+       "core", "`0` disables the stateful-UDF actor pool (inline execution)"),
+    _k("DAFT_TPU_MEMORY_LIMIT", "bytes", None, "daft_tpu/execution/memory.py",
+       "core", "process memory budget for scan admission + spill decisions "
+       "(accepts byte suffixes, e.g. `64GiB`); unset = no budget"),
+    _k("DAFT_TPU_SPILL_DIR", "str", None, "daft_tpu/execution/memory.py",
+       "core", "spill directory root (default: a fresh "
+       "`daft_tpu_spill_<pid>` under the system tmpdir)",
+       default_str="tmpdir"),
+    _k("DAFT_TPU_MESH_DEVICES", "int", None, "daft_tpu/parallel/mesh.py",
+       "core", "caps the device-mesh axis length (default: all visible "
+       "devices)", default_str="all"),
+    _k("DAFT_TPU_MESH_MIN_ROWS", "int", 64 * 1024, "daft_tpu/parallel/mesh.py",
+       "core", "row floor for mesh (multi-chip collective) execution "
+       "(64Ki); `0` forces the mesh path"),
+    _k("DAFT_TPU_REAL_DEVICE", "bool", False, "tests/conftest.py",
+       "core", "`1` runs the test suite against the real accelerator "
+       "backend (no CPU forcing, no virtual mesh)"),
+    # -------------------------------------------------------- device
+    _k("DAFT_TPU_BACKEND_TIMEOUT", "float", 60.0,
+       "daft_tpu/device/backend.py", "device",
+       "seconds to wait for device-backend initialization before falling "
+       "back to host"),
+    _k("DAFT_TPU_COMPILATION_CACHE", "str", None,
+       "daft_tpu/device/backend.py", "device",
+       "persistent XLA compilation-cache directory (amortizes remote "
+       "compiles across processes)"),
+    _k("DAFT_TPU_COMPILE_CACHE", "str", None, "daft_tpu/device/backend.py",
+       "device", "legacy alias of `DAFT_TPU_COMPILATION_CACHE`"),
+    _k("DAFT_TPU_HBM_CACHE_BYTES", "bytes", 8 * 1024 ** 3,
+       "daft_tpu/device/cache.py", "device",
+       "HBM budget for the resident-column cache (byte suffixes accepted)",
+       default_str="8GiB"),
+    _k("DAFT_TPU_LINK_RTT_MS", "float", None, "daft_tpu/device/costmodel.py",
+       "device", "override the measured host↔device link RTT (ms)",
+       default_str="measured"),
+    _k("DAFT_TPU_LINK_UP_MBPS", "float", None,
+       "daft_tpu/device/costmodel.py", "device",
+       "override the measured host→device bandwidth (MB/s)",
+       default_str="measured"),
+    _k("DAFT_TPU_LINK_DOWN_MBPS", "float", None,
+       "daft_tpu/device/costmodel.py", "device",
+       "override the measured device→host bandwidth (MB/s)",
+       default_str="measured"),
+    _k("DAFT_TPU_LINK_CACHE", "bool", True, "daft_tpu/device/costmodel.py",
+       "device", "`0` disables the persisted link-calibration profile "
+       "(re-measures per process)"),
+    _k("DAFT_TPU_LINK_CACHE_PATH", "str", None,
+       "daft_tpu/device/costmodel.py", "device",
+       "path of the persisted link profile (default: under the user cache "
+       "dir)", default_str="auto"),
+    _k("DAFT_TPU_PEAK_FLOPS", "float", 197e12,
+       "daft_tpu/device/costmodel.py", "device",
+       "chip peak FLOP/s the MFU ledger normalizes against (default: "
+       "v5e bf16)", default_str="197e12"),
+    _k("DAFT_TPU_HBM_BPS", "float", 819e9, "daft_tpu/device/costmodel.py",
+       "device", "chip HBM bandwidth the roofline normalizes against",
+       default_str="819e9"),
+    _k("DAFT_TPU_DISPATCH_LOG", "str", None, "daft_tpu/device/costmodel.py",
+       "device", "JSONL path appending one record per real device dispatch"),
+    _k("DAFT_TPU_CACHE_INVEST", "bool", True,
+       "daft_tpu/device/costmodel.py", "device",
+       "`0` stops the cost model from pricing upload as an investment for "
+       "cacheable (reused) columns"),
+    # ------------------------------------------------------- shuffle
+    _k("DAFT_TPU_DISTRIBUTED_SHUFFLE", "str", "flight",
+       "daft_tpu/distributed/scheduler.py", "shuffle",
+       "`driver` routes stage boundaries through the driver instead of "
+       "the worker-to-worker shuffle plane"),
+    _k("DAFT_TPU_SHUFFLE_TRANSPORT", "str", "flight",
+       "daft_tpu/distributed/shuffle_service.py", "shuffle",
+       "`flight` (Arrow Flight) or `http` partition transport"),
+    _k("DAFT_TPU_SHUFFLE_HOST", "str", "127.0.0.1",
+       "daft_tpu/distributed/shuffle_service.py", "shuffle",
+       "bind address of the per-host partition server (`0.0.0.0` serves "
+       "other hosts)"),
+    _k("DAFT_TPU_SHUFFLE_ADVERTISE", "str", None,
+       "daft_tpu/distributed/shuffle_service.py", "shuffle",
+       "address peers are told to fetch from (default: the bind host, or "
+       "`127.0.0.1` when bound to `0.0.0.0`)", default_str="bind host"),
+    _k("DAFT_TPU_SHUFFLE_COMPRESSION", "str", "lz4",
+       "daft_tpu/distributed/shuffle_service.py", "shuffle",
+       "`lz4`/`zstd`/`none` IPC buffer compression for shuffle spill+wire; "
+       "auto-falls back to `none` when the codec is missing from the "
+       "pyarrow build"),
+    _k("DAFT_TPU_SHUFFLE_FETCH_PARALLELISM", "int", 4,
+       "daft_tpu/distributed/worker.py", "shuffle",
+       "bounded per-source fetch concurrency for a reduce task's stage "
+       "input; `DAFT_TPU_CHAOS_SERIALIZE=1` forces 1, and an active "
+       "`DAFT_TPU_FAULT_SPEC` defaults it to 1 (set explicitly to combine)"),
+    _k("DAFT_TPU_SHUFFLE_COMBINE", "str", "auto",
+       "daft_tpu/distributed/scheduler.py", "shuffle",
+       "map-side combine: `auto` (cost-model gated), `1` force, `0` "
+       "escape hatch"),
+    _k("DAFT_TPU_SHUFFLE_WIRE_MBPS", "float", 1000.0,
+       "daft_tpu/device/costmodel.py", "shuffle",
+       "wire bandwidth the combine cost model assumes (set to the pod's "
+       "real DCN number)"),
+    _k("DAFT_TPU_SHUFFLE_TIMEOUT", "float", 600.0,
+       "daft_tpu/distributed/shuffle_service.py", "shuffle",
+       "seconds a partition fetch may take before it fails as retryable"),
+    _k("DAFT_TPU_SHUFFLE_TTL", "float", 86400.0,
+       "daft_tpu/distributed/shuffle_service.py", "shuffle",
+       "idle seconds before an orphaned shuffle directory is swept at "
+       "service startup"),
+    # ---------------------------------------------------- resilience
+    _k("DAFT_TPU_FAULT_SPEC", "str", None,
+       "daft_tpu/distributed/resilience.py", "resilience",
+       "comma-separated `site:rate[:N][:sticky]` seeded fault-injection "
+       "spec (`task`/`fetch`/`crash`/`rpc` sites)"),
+    _k("DAFT_TPU_FAULT_SEED", "str", "0",
+       "daft_tpu/distributed/resilience.py", "resilience",
+       "seed hashed into every fault-injection decision (same seed → "
+       "bit-identical chaos replay)"),
+    _k("DAFT_TPU_CHAOS_SERIALIZE", "bool", False,
+       "daft_tpu/distributed/worker.py", "resilience",
+       "`1` serializes task execution (one task with all its retries at a "
+       "time) and degrades the fetch/scan fast paths so chaos runs replay "
+       "bit-identically"),
+    _k("DAFT_TPU_MAX_RETRIES", "int", 3,
+       "daft_tpu/distributed/resilience.py", "resilience",
+       "bounded per-task retry budget"),
+    _k("DAFT_TPU_RETRY_BACKOFF", "float", 0.05,
+       "daft_tpu/distributed/resilience.py", "resilience",
+       "retry backoff base seconds (deterministic jitter on top)"),
+    _k("DAFT_TPU_RETRY_BACKOFF_CAP", "float", 2.0,
+       "daft_tpu/distributed/resilience.py", "resilience",
+       "retry backoff cap seconds"),
+    _k("DAFT_TPU_QUARANTINE_AFTER", "int", 3,
+       "daft_tpu/distributed/resilience.py", "resilience",
+       "consecutive failures that quarantine a worker"),
+    _k("DAFT_TPU_QUARANTINE_S", "float", 30.0,
+       "daft_tpu/distributed/resilience.py", "resilience",
+       "quarantine duration seconds (timed re-admission, never empty "
+       "placement)"),
+    _k("DAFT_TPU_TASK_TIMEOUT", "float", 0.0,
+       "daft_tpu/distributed/resilience.py", "resilience",
+       "seconds before a hung task attempt is abandoned as retryable "
+       "(`0` = off)"),
+    _k("DAFT_TPU_SPECULATIVE_MULTIPLIER", "float", 4.0,
+       "daft_tpu/distributed/resilience.py", "resilience",
+       "speculative-execution trigger: multiplier × median sibling "
+       "duration (`0` = off)"),
+    _k("DAFT_TPU_SPECULATIVE_MIN_S", "float", 0.5,
+       "daft_tpu/distributed/resilience.py", "resilience",
+       "minimum task age before speculation is considered"),
+    _k("DAFT_TPU_WORKER_TIMEOUT", "float", 3600.0,
+       "daft_tpu/distributed/remote_worker.py", "resilience",
+       "remote-worker RPC timeout seconds"),
+    _k("DAFT_TPU_NUM_WORKERS", "int", 0,
+       "daft_tpu/runners/distributed_runner.py", "resilience",
+       "distributed-runner worker count (`0` = auto from cpu count)",
+       default_str="auto"),
+    # ------------------------------------------------------- io-scan
+    _k("DAFT_TPU_IO_COALESCE_GAP", "bytes", 1 << 20,
+       "daft_tpu/io/read_planner.py", "io-scan",
+       "hole tolerance when coalescing needed byte ranges into requests",
+       config_field="tpu_io_coalesce_gap", default_str="1MiB"),
+    _k("DAFT_TPU_IO_MIN_REQUEST", "bytes", 8 << 20,
+       "daft_tpu/io/read_planner.py", "io-scan",
+       "request-size floor: sub-floor requests absorb neighbors across "
+       "holes smaller than the floor",
+       config_field="tpu_io_min_request", default_str="8MiB"),
+    _k("DAFT_TPU_IO_RANGE_PARALLELISM", "int", 8,
+       "daft_tpu/io/read_planner.py", "io-scan",
+       "concurrent range GETs per source (capped by the source's "
+       "`max_connections`)", config_field="tpu_io_range_parallelism"),
+    _k("DAFT_TPU_IO_PLANNED_READS", "bool", True,
+       "daft_tpu/io/read_planner.py", "io-scan",
+       "`0` restores the naive per-column-chunk ranged-read path",
+       config_field="tpu_io_planned_reads", default_str="1"),
+    _k("DAFT_TPU_SCAN_PREFETCH", "int", 2,
+       "daft_tpu/io/read_planner.py", "io-scan",
+       "ScanTasks resolved ahead of the consumer; `0` disables; "
+       "chaos/fault plans force the sequential path",
+       config_field="tpu_scan_prefetch"),
+    _k("DAFT_TPU_IO_STREAM_CHUNK", "bytes", 8 << 20,
+       "daft_tpu/io/read_planner.py", "io-scan",
+       "chunk size for streaming remote CSV/JSON reads",
+       default_str="8MiB"),
+    _k("DAFT_TPU_IO_INFER_BYTES", "bytes", 1 << 20,
+       "daft_tpu/io/read_planner.py", "io-scan",
+       "head-range budget for remote CSV/JSON schema inference (`0` → "
+       "whole object)", default_str="1MiB"),
+    # ------------------------------------------------- observability
+    _k("DAFT_TPU_XPLANE_DIR", "str", None, "daft_tpu/observability.py",
+       "observability", "directory capturing a jax profiler "
+       "(xplane/TensorBoard) trace per query"),
+    _k("DAFT_TPU_CHROME_TRACE", "str", None, "daft_tpu/observability.py",
+       "observability", "`1` or a path; writes a chrome://tracing JSON for "
+       "the last execution"),
+    _k("DAFT_TPU_PROGRESS", "bool", False, "daft_tpu/observability.py",
+       "observability", "`1` enables a tqdm partition-progress bar"),
+    _k("DAFT_TPU_OTLP_ENDPOINT", "str", None, "daft_tpu/observability.py",
+       "observability", "OTLP/HTTP collector endpoint receiving per-query "
+       "operator counters"),
+    _k("DAFT_TPU_SANITIZE", "bool", False,
+       "daft_tpu/analysis/lock_sanitizer.py", "observability",
+       "`1` wraps engine lock acquisition in the runtime lock-order "
+       "sanitizer (cycle detection, contention + blocking-while-held "
+       "accounting; reported at pytest session end and in "
+       "`explain(analyze=True)`)"),
+]
+
+REGISTRY: Dict[str, Knob] = {k.name: k for k in _KNOBS}
+
+GROUPS: List[str] = []
+for _kn in _KNOBS:
+    if _kn.group not in GROUPS:
+        GROUPS.append(_kn.group)
+
+
+class UnknownKnobError(KeyError):
+    pass
+
+
+def _checked(name: str, expect_type: Optional[str] = None) -> Knob:
+    k = REGISTRY.get(name)
+    if k is None:
+        raise UnknownKnobError(
+            f"{name} is not in the knob registry "
+            f"(daft_tpu/analysis/knobs.py) — register it before reading it")
+    if expect_type is not None and k.type != expect_type:
+        raise TypeError(
+            f"{name} is registered as type {k.type!r} but was read as "
+            f"{expect_type!r} — one knob, one parse")
+    return k
+
+
+def parse(name: str, raw: str):
+    """Parse a raw env string per the knob's registered type."""
+    k = _checked(name)
+    if k.type == "int":
+        return int(raw)
+    if k.type == "float":
+        return float(raw)
+    if k.type == "bool":
+        return raw not in _FALSY
+    if k.type == "bytes":
+        from ..execution.memory import parse_bytes
+        return parse_bytes(raw)
+    return raw
+
+
+_MISSING = object()
+
+
+def _get(name: str, type_: str, default):
+    k = _checked(name, type_)
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return k.default if default is _MISSING else default
+    return parse(name, v)
+
+
+def env_raw(name: str) -> Optional[str]:
+    """The raw env string, or None when unset/empty. For sites whose
+    semantics hinge on *presence* (tri-state force flags)."""
+    _checked(name)
+    v = os.environ.get(name)
+    return None if v is None or v == "" else v
+
+
+def env_is_set(name: str) -> bool:
+    _checked(name)
+    return os.environ.get(name) is not None
+
+
+def env_int(name: str, default=_MISSING) -> Optional[int]:
+    return _get(name, "int", default)
+
+
+def env_float(name: str, default=_MISSING) -> Optional[float]:
+    return _get(name, "float", default)
+
+
+def env_bool(name: str, default=_MISSING) -> Optional[bool]:
+    return _get(name, "bool", default)
+
+
+def env_bytes(name: str, default=_MISSING) -> Optional[int]:
+    return _get(name, "bytes", default)
+
+
+def env_str(name: str, default=_MISSING) -> Optional[str]:
+    return _get(name, "str", default)
+
+
+# ------------------------------------------------------------------ docs
+
+_TABLE_HEADER = "| env var | type | default | effect |\n| --- | --- | --- | --- |"
+
+
+def _default_cell(k: Knob) -> str:
+    if k.default_str:
+        return f"`{k.default_str}`"
+    if k.default is None:
+        return "unset"
+    if k.type == "bool":
+        return "`1`" if k.default else "`0`"
+    return f"`{k.default}`"
+
+
+def knob_table_markdown(group: str) -> str:
+    """One generated markdown table for a registry group."""
+    rows = [_TABLE_HEADER]
+    for k in _KNOBS:
+        if k.group != group:
+            continue
+        doc = k.doc
+        if k.config_field:
+            doc += f" (mirrors `ExecutionConfig.{k.config_field}`)"
+        rows.append(f"| `{k.name}` | {k.type} | {_default_cell(k)} | {doc} |")
+    return "\n".join(rows)
+
+
+def _marker(group: str, end: bool) -> str:
+    word = "END" if end else "BEGIN"
+    return f"<!-- knob-table:{group} {word} -->"
+
+
+def knob_block(group: str) -> str:
+    """A full generated README block, markers included."""
+    return (f"{_marker(group, False)}\n"
+            f"<!-- generated by `python -m daft_tpu.analysis --knob-docs "
+            f"--write`; edit daft_tpu/analysis/knobs.py, not this table -->\n"
+            f"{knob_table_markdown(group)}\n{_marker(group, True)}")
+
+
+def readme_drift(readme_text: str) -> List[str]:
+    """Human-readable drift problems between the registry and the README's
+    generated knob-table blocks (empty list = in sync)."""
+    problems = []
+    for group in GROUPS:
+        begin, end = _marker(group, False), _marker(group, True)
+        i, j = readme_text.find(begin), readme_text.find(end)
+        if i < 0 or j < 0:
+            problems.append(
+                f"README is missing the generated knob table for group "
+                f"{group!r} (markers {begin} … {end})")
+            continue
+        current = readme_text[i:j + len(end)]
+        if current != knob_block(group):
+            problems.append(
+                f"README knob table for group {group!r} is stale — "
+                f"regenerate with `python -m daft_tpu.analysis --knob-docs "
+                f"--write`")
+    return problems
+
+
+def update_readme(readme_path: str, write: bool = True) -> bool:
+    """Rewrite every generated knob-table block in the README from the
+    registry. Returns True when the file changed (or would change)."""
+    with open(readme_path) as f:
+        text = f.read()
+    new = text
+    for group in GROUPS:
+        begin, end = _marker(group, False), _marker(group, True)
+        i, j = new.find(begin), new.find(end)
+        if i < 0 or j < 0:
+            continue
+        new = new[:i] + knob_block(group) + new[j + len(end):]
+    changed = new != text
+    if changed and write:
+        with open(readme_path, "w") as f:
+            f.write(new)
+    return changed

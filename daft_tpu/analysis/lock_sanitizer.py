@@ -1,0 +1,356 @@
+"""Runtime lock-order sanitizer (opt-in: ``DAFT_TPU_SANITIZE=1``).
+
+Static analysis can prove a blocking call sits under a lock, but not
+that lock A is ever taken while lock B is held in one thread and the
+inverse in another — the classic latent deadlock that only fires under
+production interleavings. This sanitizer proves it at test time:
+
+- ``enable()`` patches the ``threading.Lock``/``threading.RLock``
+  factories so every lock *created by engine code* (creation frame
+  inside ``daft_tpu/``) is wrapped in a tracking proxy. Foreign locks
+  (jax, pyarrow, stdlib machinery) pass through untouched — zero noise,
+  bounded overhead.
+- Each tracked lock is keyed by its **allocation site** (file:line) —
+  stable across lock instances, so per-object locks (one per operator,
+  one per cache) aggregate into one graph node and cross-query cycles
+  are visible.
+- Every acquisition while other tracked locks are held adds
+  ``held-site → acquired-site`` edges to a global lock-order graph;
+  cycle detection runs on edge insert. A cycle means two code paths
+  disagree about acquisition order: a potential deadlock, reported with
+  both sites.
+- Contended acquisitions (the non-blocking fast-path probe fails) and
+  ``time.sleep`` while holding a tracked lock (the runtime twin of the
+  static ``blocking-under-lock`` rule) are counted.
+
+``tests/conftest.py`` enables this for the whole suite under
+``DAFT_TPU_SANITIZE=1`` and fails the session on any cycle; per-query
+deltas land in ``explain(analyze=True)`` / the dashboard via
+``observability.RuntimeStatsContext``.
+
+The :class:`LockOrderSanitizer` state is instanceable so tests can
+exercise cycle detection in isolation without polluting the global
+session graph.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SELF = os.path.abspath(__file__)
+
+
+class LockOrderSanitizer:
+    """A lock-order graph + counters. One global instance backs the
+    ``DAFT_TPU_SANITIZE=1`` session; tests may build their own."""
+
+    def __init__(self):
+        self._meta = threading.Lock()   # created pre-patch: never tracked
+        self._edges: Dict[str, Set[str]] = {}
+        self._edge_witness: Dict[Tuple[str, str], str] = {}
+        self._sites: Set[str] = set()
+        self._cycles: List[str] = []
+        self._cycle_keys: Set[Tuple[str, str]] = set()
+        self._held = threading.local()
+        self.acquisitions = 0
+        self.contended = 0
+        self.blocking_while_held = 0
+        self._blocking_sites: Set[str] = set()
+
+    # ---- per-thread held stack --------------------------------------
+    def _stack(self) -> List[str]:
+        st = getattr(self._held, "stack", None)
+        if st is None:
+            st = []
+            self._held.stack = st
+        return st
+
+    def held_sites(self) -> List[str]:
+        return list(self._stack())
+
+    # ---- graph ------------------------------------------------------
+    def note_acquire(self, site: str, contended: bool) -> None:
+        stack = self._stack()
+        with self._meta:
+            self.acquisitions += 1
+            if contended:
+                self.contended += 1
+            self._sites.add(site)
+            for held in stack:
+                if held != site:
+                    self._add_edge(held, site)
+        stack.append(site)
+
+    def note_release(self, site: str) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == site:
+                del stack[i]
+                break
+
+    def note_blocking(self, what: str) -> None:
+        stack = self._stack()
+        if not stack:
+            return
+        with self._meta:
+            self.blocking_while_held += 1
+            self._blocking_sites.add(f"{what} while holding {stack[-1]}")
+
+    def _add_edge(self, a: str, b: str) -> None:
+        # caller holds self._meta
+        succ = self._edges.setdefault(a, set())
+        if b in succ:
+            return
+        succ.add(b)
+        self._edge_witness[(a, b)] = \
+            f"thread {threading.current_thread().name}"
+        path = self._find_path(b, a)
+        if path is not None:
+            key = (min(a, b), max(a, b))
+            if key not in self._cycle_keys:
+                self._cycle_keys.add(key)
+                self._cycles.append(" -> ".join([a, b] + path[1:]))
+
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS path src→dst through the edge set (caller holds _meta)."""
+        seen = {src}
+        stack = [(src, [src])]
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._edges.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # ---- reporting --------------------------------------------------
+    def summary(self) -> dict:
+        with self._meta:
+            return {
+                "locks": len(self._sites),
+                "edges": sum(len(s) for s in self._edges.values()),
+                "cycles": list(self._cycles),
+                "acquisitions": self.acquisitions,
+                "contended": self.contended,
+                "blocking_while_held": self.blocking_while_held,
+                "blocking_sites": sorted(self._blocking_sites),
+            }
+
+    def report(self) -> str:
+        s = self.summary()
+        lines = [
+            f"lock-order sanitizer: {s['locks']} lock sites, "
+            f"{s['edges']} order edges, {s['acquisitions']} acquisitions "
+            f"({s['contended']} contended)",
+        ]
+        if s["cycles"]:
+            lines.append(f"POTENTIAL DEADLOCKS ({len(s['cycles'])} "
+                         f"acquisition-order cycles):")
+            lines.extend(f"  {c}" for c in s["cycles"])
+        else:
+            lines.append("no acquisition-order cycles")
+        if s["blocking_while_held"]:
+            lines.append(f"blocking-while-held events: "
+                         f"{s['blocking_while_held']}")
+            lines.extend(f"  {b}" for b in s["blocking_sites"])
+        return "\n".join(lines)
+
+    # ---- wrapping ---------------------------------------------------
+    def track(self, real_lock, site: str):
+        """Wrap an existing lock object for this sanitizer instance."""
+        return _TrackedLock(real_lock, site, self)
+
+
+class _TrackedLock:
+    """Proxy recording acquisition order. Forwards everything else to
+    the real lock — EXCEPT the Condition fast-path internals
+    (``_release_save`` etc.), which must fall back to plain
+    acquire/release through the proxy so bookkeeping stays truthful."""
+
+    __slots__ = ("_lock", "_site", "_san", "_depth")
+    _CONDITION_INTERNALS = ("_release_save", "_acquire_restore", "_is_owned")
+
+    def __init__(self, real_lock, site: str, san: LockOrderSanitizer):
+        self._lock = real_lock
+        self._site = site
+        self._san = san
+        self._depth = 0     # reentrant depth (RLock); benign race per-lock
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if not blocking:
+            got = self._lock.acquire(False)
+            if got:
+                self._san.note_acquire(self._site, contended=False)
+                self._depth += 1
+            return got
+        # probe first so contention is observable without timing
+        if self._lock.acquire(False):
+            self._san.note_acquire(self._site, contended=False)
+            self._depth += 1
+            return True
+        self._san.note_acquire(self._site, contended=True)
+        try:
+            got = self._lock.acquire(True, timeout) if timeout != -1 \
+                else self._lock.acquire(True)
+        except BaseException:
+            # e.g. KeyboardInterrupt delivered mid-acquire: the site was
+            # optimistically pushed — pop it or every later acquisition
+            # on this thread records false held→acquired edges
+            self._san.note_release(self._site)
+            raise
+        if not got:
+            self._san.note_release(self._site)
+        else:
+            self._depth += 1
+        return got
+
+    def release(self):
+        self._depth = max(self._depth - 1, 0)
+        self._lock.release()
+        self._san.note_release(self._site)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __getattr__(self, name):
+        if name in _TrackedLock._CONDITION_INTERNALS:
+            # force Condition onto plain acquire()/release() via the proxy
+            raise AttributeError(name)
+        return getattr(self._lock, name)
+
+    def __repr__(self):
+        return f"<tracked {self._lock!r} from {self._site}>"
+
+
+# ----------------------------------------------------------- global state
+
+_global = LockOrderSanitizer()
+_enabled = False
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+_real_sleep = time.sleep
+
+
+def _creation_site() -> Optional[str]:
+    """file:line of the engine frame creating the lock, or None when the
+    creator is foreign code (jax/pyarrow/stdlib) — those stay untracked."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != _SELF and not fn.startswith("<"):
+            if os.path.abspath(fn).startswith(_PKG_ROOT + os.sep):
+                rel = os.path.relpath(os.path.abspath(fn),
+                                      os.path.dirname(_PKG_ROOT))
+                return f"{rel.replace(os.sep, '/')}:{f.f_lineno}"
+            return None
+        f = f.f_back
+    return None
+
+
+def _make_lock():
+    real = _real_lock()
+    site = _creation_site()
+    if site is None:
+        return real
+    return _global.track(real, site)
+
+
+def _make_rlock():
+    real = _real_rlock()
+    site = _creation_site()
+    if site is None:
+        return real
+    return _global.track(real, site)
+
+
+def _sleep_watched(secs):
+    _global.note_blocking(f"time.sleep({secs})")
+    return _real_sleep(secs)
+
+
+def enabled_by_env() -> bool:
+    from . import knobs
+    return bool(knobs.env_bool("DAFT_TPU_SANITIZE"))
+
+
+def enable() -> None:
+    """Patch the lock factories + time.sleep. Idempotent. Engine locks
+    created BEFORE enable() stay untracked — call as early as possible
+    (tests/conftest.py enables before importing daft_tpu)."""
+    global _enabled
+    if _enabled:
+        return
+    # daft-lint: allow(unguarded-global-mutation) -- single-threaded
+    # bootstrap: enable() runs in conftest/CLI before any engine thread
+    _enabled = True
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+    time.sleep = _sleep_watched
+
+
+def disable() -> None:
+    global _enabled
+    if not _enabled:
+        return
+    # daft-lint: allow(unguarded-global-mutation) -- mirror of enable():
+    # teardown runs on the single main thread at session end
+    _enabled = False
+    threading.Lock = _real_lock
+    threading.RLock = _real_rlock
+    time.sleep = _real_sleep
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def sanitizer() -> LockOrderSanitizer:
+    return _global
+
+
+def summary() -> dict:
+    return _global.summary()
+
+
+def report() -> str:
+    return _global.report()
+
+
+# -------------------------------------------- observability integration
+
+def counters_snapshot() -> Dict[str, float]:
+    """Monotonic counters for per-query deltas (observability pattern:
+    snapshot at query start, diff at finish)."""
+    if not _enabled:
+        return {}
+    s = _global.summary()
+    return {"acquisitions": s["acquisitions"],
+            "contended": s["contended"],
+            "blocking_while_held": s["blocking_while_held"]}
+
+
+def counters_delta(before: Dict[str, float],
+                   after: Dict[str, float]) -> Dict[str, float]:
+    out = {k: after.get(k, 0) - before.get(k, 0) for k in after}
+    # graph size is a level, not a delta — report current absolutes
+    if _enabled:
+        s = _global.summary()
+        out["graph_locks"] = s["locks"]
+        out["graph_edges"] = s["edges"]
+        out["graph_cycles"] = len(s["cycles"])
+    return out

@@ -1,0 +1,179 @@
+"""Rule family 1 — knob registry discipline.
+
+Invariant: every ``DAFT_TPU_*`` environment knob is declared once in
+``analysis/knobs.py`` and parsed once (the typed accessors there). The
+rule flags:
+
+- ``knob-unregistered`` — an env read (or typed-accessor call) naming a
+  ``DAFT_TPU_*`` knob the registry doesn't know;
+- ``knob-direct-read`` — a registered knob read through raw
+  ``os.environ`` / ``os.getenv`` instead of the registry accessor
+  (a second parse site: int-vs-bytes-vs-bool drift starts here);
+- ``knob-type-mismatch`` — an accessor call whose type disagrees with
+  the registry declaration (the same knob parsed two different ways);
+- ``knob-unused`` — a registered knob that appears nowhere in the code;
+- ``knob-config-drift`` — registry ``config_field`` entries that don't
+  match ``ExecutionConfig``, or tpu-spelled ``ExecutionConfig`` fields
+  missing from the registry;
+- ``knob-doc-drift`` — README generated knob tables stale vs the
+  registry (see ``knobs.readme_drift``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List
+
+from . import knobs
+from .framework import Finding, SourceFile, call_name, dotted_name
+
+REGISTRY_MODULE = "daft_tpu/analysis/knobs.py"
+
+_ACCESSOR_TYPES = {
+    "env_int": "int", "env_float": "float", "env_bool": "bool",
+    "env_bytes": "bytes", "env_str": "str",
+}
+_PRESENCE_ACCESSORS = ("env_raw", "env_is_set")
+
+
+def _literal_knob(node: ast.AST):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith("DAFT_TPU_"):
+        return node.value
+    return None
+
+
+_KNOB_NAME_RE = re.compile(r"DAFT_TPU_[A-Z0-9_]+")
+
+
+def check(sources: List[SourceFile]) -> List[Finding]:
+    out: List[Finding] = []
+    seen_anywhere = set()
+
+    for sf in sources:
+        if sf.path != REGISTRY_MODULE:
+            # the registry's own literals must not count as "usage";
+            # full-token extraction, not substring: DAFT_TPU_DEVICE must
+            # not be "seen" inside DAFT_TPU_DEVICE_FORCE
+            seen_anywhere.update(
+                m for m in _KNOB_NAME_RE.findall(sf.text)
+                if m in knobs.REGISTRY)
+        if not sf.path.startswith("daft_tpu/") or sf.path == REGISTRY_MODULE:
+            continue
+        for node in ast.walk(sf.tree):
+            # raw env reads: os.environ.get / os.getenv / os.environ[...]
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name.endswith("environ.get") or name.endswith("getenv"):
+                    knob = _literal_knob(node.args[0]) if node.args else None
+                    if knob is None:
+                        continue
+                    if knob not in knobs.REGISTRY:
+                        out.append(Finding(
+                            "knob-unregistered", sf.path, node.lineno,
+                            f"env read of unregistered knob {knob} — declare "
+                            f"it in {REGISTRY_MODULE}"))
+                    else:
+                        out.append(Finding(
+                            "knob-direct-read", sf.path, node.lineno,
+                            f"{knob} read through os.environ — use the "
+                            f"registry accessor (analysis.knobs.env_*) so "
+                            f"the knob has one parse site"))
+                else:
+                    short = name.rsplit(".", 1)[-1]
+                    if short in _ACCESSOR_TYPES or short in \
+                            _PRESENCE_ACCESSORS:
+                        knob = _literal_knob(node.args[0]) \
+                            if node.args else None
+                        if knob is None:
+                            continue
+                        reg = knobs.REGISTRY.get(knob)
+                        if reg is None:
+                            out.append(Finding(
+                                "knob-unregistered", sf.path, node.lineno,
+                                f"accessor read of unregistered knob {knob}"))
+                        elif short in _ACCESSOR_TYPES \
+                                and reg.type != _ACCESSOR_TYPES[short]:
+                            out.append(Finding(
+                                "knob-type-mismatch", sf.path, node.lineno,
+                                f"{knob} is registered as {reg.type!r} but "
+                                f"read via {short}()"))
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and dotted_name(node.value).endswith("environ"):
+                knob = _literal_knob(node.slice)
+                if knob is None:
+                    continue
+                if knob not in knobs.REGISTRY:
+                    out.append(Finding(
+                        "knob-unregistered", sf.path, node.lineno,
+                        f"env read of unregistered knob {knob}"))
+                else:
+                    out.append(Finding(
+                        "knob-direct-read", sf.path, node.lineno,
+                        f"{knob} read through os.environ[...] — use the "
+                        f"registry accessor"))
+
+    for name, k in knobs.REGISTRY.items():
+        if name not in seen_anywhere:
+            out.append(Finding(
+                "knob-unused", REGISTRY_MODULE, 1,
+                f"{name} is registered (owner {k.module}) but appears "
+                f"nowhere in the scanned tree — stale registry entry?"))
+
+    out.extend(_config_drift(sources))
+    return out
+
+
+def _config_drift(sources: List[SourceFile]) -> List[Finding]:
+    """Registry.config_field ↔ ExecutionConfig field cross-check."""
+    ctx = next((sf for sf in sources
+                if sf.path == "daft_tpu/context.py"), None)
+    if ctx is None:
+        return []
+    fields = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "ExecutionConfig":
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) \
+                        and isinstance(stmt.target, ast.Name):
+                    fields.add(stmt.target.id)
+    out = []
+    mirrored = set()
+    for name, k in knobs.REGISTRY.items():
+        if not k.config_field:
+            continue
+        mirrored.add(k.config_field)
+        if k.config_field not in fields:
+            out.append(Finding(
+                "knob-config-drift", REGISTRY_MODULE, 1,
+                f"{name} claims ExecutionConfig.{k.config_field} but that "
+                f"field does not exist"))
+        if f"DAFT_{k.config_field.upper()}" != name:
+            out.append(Finding(
+                "knob-config-drift", REGISTRY_MODULE, 1,
+                f"{name}: config_field {k.config_field!r} does not spell "
+                f"the env name (context auto-parses DAFT_<FIELD>)"))
+    for f in fields:
+        env_name = f"DAFT_{f.upper()}"
+        if env_name.startswith("DAFT_TPU_") \
+                and env_name not in knobs.REGISTRY:
+            out.append(Finding(
+                "knob-config-drift", "daft_tpu/context.py", 1,
+                f"ExecutionConfig.{f} is env-parsable as {env_name} but "
+                f"that knob is not registered"))
+    return out
+
+
+def check_readme(root: str) -> List[Finding]:
+    path = os.path.join(root, "README.md")
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return [Finding("knob-doc-drift", "README.md", 1,
+                        "README.md is missing")]
+    return [Finding("knob-doc-drift", "README.md", 1, p)
+            for p in knobs.readme_drift(text)]

@@ -42,6 +42,9 @@ def broadcast_query(stats) -> None:
             # scan-side IO plane: GETs vs planned ranges (coalescing),
             # bytes fetched vs used, prefetch overlap
             "io": dict(getattr(stats, "io", {}) or {}),
+            # lock-order sanitizer (DAFT_TPU_SANITIZE=1): graph size,
+            # cycles, per-query contention/blocking events
+            "sanitizer": dict(getattr(stats, "sanitizer", {}) or {}),
         }
     except Exception:
         return
@@ -81,9 +84,14 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                            + html.escape(json.dumps(
                                {k: round(v, 1) for k, v in sio.items()}))
                            + "</p>" if sio else "")
+                san = q.get("sanitizer") or {}
+                san_html = ("<p><b>lock sanitizer:</b> "
+                            + html.escape(json.dumps(
+                                {k: round(v, 1) for k, v in san.items()}))
+                            + "</p>" if san else "")
                 rows.append(
                     f"<h3>query {len(_history) - i} — {q['ts']}</h3>"
-                    f"{rec_html}{shf_html}{io_html}"
+                    f"{rec_html}{shf_html}{io_html}{san_html}"
                     f"<pre>{html.escape(q['explain'])}</pre>")
         body = ("<html><head><title>daft-tpu dashboard</title></head><body>"
                 "<h1>daft-tpu queries</h1>"

@@ -32,7 +32,8 @@ _failed = False
 
 
 def _timeout() -> float:
-    return float(os.environ.get("DAFT_TPU_BACKEND_TIMEOUT", "60"))
+    from ..analysis import knobs
+    return knobs.env_float("DAFT_TPU_BACKEND_TIMEOUT")
 
 
 def _probe_body() -> None:
@@ -40,6 +41,8 @@ def _probe_body() -> None:
     try:
         import jax
 
+        # daft-lint: allow(unguarded-global-mutation) -- the _done Event is
+        # the sync point: readers wait on it, this write happens-before set()
         _backend = jax.default_backend()
 
         # persistent XLA compilation cache: suite runs stop paying the
@@ -49,8 +52,9 @@ def _probe_body() -> None:
         # artifacts are machine-feature-pinned and reload with SIGILL-risk
         # warnings across hosts. Opt out with DAFT_TPU_COMPILATION_CACHE=0
         # or point it elsewhere via =path.
-        cache = os.environ.get("DAFT_TPU_COMPILATION_CACHE") \
-            or os.environ.get("DAFT_TPU_COMPILE_CACHE") or ""
+        from ..analysis import knobs
+        cache = knobs.env_str("DAFT_TPU_COMPILATION_CACHE") \
+            or knobs.env_str("DAFT_TPU_COMPILE_CACHE") or ""
         if cache != "0" and _backend == "tpu":
             path = cache or os.path.join(
                 os.path.expanduser("~"), ".cache", "daft_tpu_xla")
@@ -62,6 +66,8 @@ def _probe_body() -> None:
             except Exception:
                 pass  # older jax without the knob: in-memory cache only
     except Exception:
+        # daft-lint: allow(unguarded-global-mutation) -- Event-synchronized
+        # with readers (see _backend above)
         _failed = True
     finally:
         _done.set()
@@ -88,6 +94,8 @@ def backend_name(wait: bool = True) -> Optional[str]:
         if not _done.is_set():
             # timed out: permanently mark the device tier unusable so later
             # callers don't re-block for another full timeout.
+            # daft-lint: allow(unguarded-global-mutation) -- worst case two
+            # timed-out threads both store True; probe never clears it
             _failed = True
             return None
     if not _done.is_set():

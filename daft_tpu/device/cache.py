@@ -36,8 +36,8 @@ def _budget() -> int:
     # strings ride i32 codes), and the grouped-agg workspace peaks well
     # under the remaining half. 4 GiB (r4) turned away SF10's ~3.4 GiB
     # hot-column set that residency would have repaid.
-    return int(os.environ.get("DAFT_TPU_HBM_CACHE_BYTES",
-                              str(8 * 1024 ** 3)))
+    from ..analysis import knobs
+    return knobs.env_bytes("DAFT_TPU_HBM_CACHE_BYTES")
 
 
 def task_fingerprint(task) -> Optional[Tuple]:

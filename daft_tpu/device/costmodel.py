@@ -83,15 +83,16 @@ _profile: Optional[LinkProfile] = None
 
 
 def _env_profile() -> Optional[LinkProfile]:
-    rtt = os.environ.get("DAFT_TPU_LINK_RTT_MS")
-    up = os.environ.get("DAFT_TPU_LINK_UP_MBPS")
-    down = os.environ.get("DAFT_TPU_LINK_DOWN_MBPS")
+    from ..analysis import knobs
+    rtt = knobs.env_float("DAFT_TPU_LINK_RTT_MS", default=None)
+    up = knobs.env_float("DAFT_TPU_LINK_UP_MBPS", default=None)
+    down = knobs.env_float("DAFT_TPU_LINK_DOWN_MBPS", default=None)
     if rtt is None and up is None and down is None:
         return None
     return LinkProfile(
-        rtt_s=float(rtt or 1.0) / 1e3,
-        up_bps=float(up or 100.0) * 1e6,
-        down_bps=float(down or 100.0) * 1e6)
+        rtt_s=(rtt if rtt is not None else 1.0) / 1e3,
+        up_bps=(up if up is not None else 100.0) * 1e6,
+        down_bps=(down if down is not None else 100.0) * 1e6)
 
 
 def _measure() -> LinkProfile:
@@ -160,7 +161,8 @@ _LINK_BLEND_MAX_S = 6 * 3600.0  # blend with a stale profile up to this age
 
 
 def _link_cache_path() -> str:
-    p = os.environ.get("DAFT_TPU_LINK_CACHE_PATH")
+    from ..analysis import knobs
+    p = knobs.env_str("DAFT_TPU_LINK_CACHE_PATH")
     if p:
         return p
     return os.path.join(os.path.expanduser("~"), ".cache", "daft_tpu",
@@ -225,7 +227,11 @@ def link_profile() -> LinkProfile:
         if bname == "cpu":
             _profile = _SHARED_MEMORY
             return _profile
-        use_cache = os.environ.get("DAFT_TPU_LINK_CACHE", "1") != "0"
+        from ..analysis import knobs
+        use_cache = bool(knobs.env_bool("DAFT_TPU_LINK_CACHE"))
+        # daft-lint: allow(blocking-under-lock) -- intentional: _lock held
+        # across load/measure/store so threads wait for the ONE calibration
+        # instead of racing duplicate multi-second link measurements
         stored, age = _load_stored(bname) if use_cache else (None, None)
         if stored is not None and age is not None and age < _LINK_CACHE_TTL_S:
             _profile = stored
@@ -251,6 +257,8 @@ def link_profile() -> LinkProfile:
                 up_bps=math.sqrt(meas.up_bps * stored.up_bps),
                 down_bps=math.sqrt(meas.down_bps * stored.down_bps))
         if use_cache:
+            # daft-lint: allow(blocking-under-lock) -- tiny atomic JSON
+            # write, same single-calibration critical section as above
             _store(bname, meas)
         _profile = meas
         return _profile
@@ -269,12 +277,14 @@ def reset_for_tests() -> None:
 def peak_flops() -> float:
     """Accelerator peak FLOP/s (bf16-class). Defaults to TPU v5e public
     specs; override per chip with ``DAFT_TPU_PEAK_FLOPS``."""
-    return float(os.environ.get("DAFT_TPU_PEAK_FLOPS", 197e12))
+    from ..analysis import knobs
+    return knobs.env_float("DAFT_TPU_PEAK_FLOPS")
 
 
 def hbm_bps() -> float:
     """Accelerator HBM bandwidth (bytes/s); ``DAFT_TPU_HBM_BPS`` overrides."""
-    return float(os.environ.get("DAFT_TPU_HBM_BPS", 819e9))
+    from ..analysis import knobs
+    return knobs.env_float("DAFT_TPU_HBM_BPS")
 
 
 # ------------------------------------------------- per-dispatch MFU ledger
@@ -351,10 +361,15 @@ def ledger_reset() -> None:
 
 
 def _forced() -> Optional[bool]:
-    v = os.environ.get("DAFT_TPU_DEVICE_FORCE")
-    if v == "1":
+    from ..analysis import knobs
+    v = knobs.env_raw("DAFT_TPU_DEVICE_FORCE")
+    if v is None:
+        return None
+    # spellings documented in the knob registry: 1/device force device,
+    # 0/host force host
+    if v.lower() in ("1", "device", "on", "true"):
         return True
-    if v == "0":
+    if v.lower() in ("0", "host", "off", "false"):
         return False
     return None
 
@@ -374,7 +389,8 @@ def _log(kind: str, device: bool, host_s: float, dev_s: float,
     the raw material for regressing predicted-vs-actual residuals (r4:
     per-query mispredicts like Q22-at-SF10 could only be diagnosed by
     re-deriving which decisions each query made)."""
-    path = os.environ.get("DAFT_TPU_DISPATCH_LOG")
+    from ..analysis import knobs
+    path = knobs.env_str("DAFT_TPU_DISPATCH_LOG")
     rec = None
     if path:
         import json
@@ -393,6 +409,8 @@ def _log(kind: str, device: bool, host_s: float, dev_s: float,
         # writes are usually atomic on Linux, but that is not guaranteed,
         # and the handle is reopened per record)
         try:
+            # daft-lint: allow(blocking-under-lock) -- the serialization IS
+            # the point (see comment above); sub-ms local append
             with open(path, "a") as f:
                 f.write(rec)
         except OSError:
@@ -491,7 +509,8 @@ def agg_upload_wins(bytes_up: float, bytes_down: float,
         / HOST_AGG_BPS
     kernel_s = DEV_DISPATCH_S + bytes_up / DEV_AGG_BPS
     dev_s = lp.device_seconds(bytes_up, bytes_down, round_trips, kernel_s)
-    if cacheable and os.environ.get("DAFT_TPU_CACHE_INVEST", "1") != "0":
+    from ..analysis import knobs
+    if cacheable and knobs.env_bool("DAFT_TPU_CACHE_INVEST"):
         # invest only when residency PAYS: a resident rerun (no upload,
         # but every dispatch still pays its — window-amortized, see
         # _fragment_scan_tasks' single packed fetch — round trips) must
@@ -522,7 +541,8 @@ SHUFFLE_SER_BPS = 2.0e9   # arrow IPC write/read, per side, per byte
 
 
 def shuffle_wire_bps() -> float:
-    return float(os.environ.get("DAFT_TPU_SHUFFLE_WIRE_MBPS", "1000")) * 1e6
+    from ..analysis import knobs
+    return knobs.env_float("DAFT_TPU_SHUFFLE_WIRE_MBPS") * 1e6
 
 
 def shuffle_combine_wins(rows: Optional[int], groups: Optional[int],

@@ -33,7 +33,8 @@ _DEVICE_AGGS = {"sum", "mean", "min", "max", "count", "stddev", "var",
 
 
 def device_enabled() -> bool:
-    if os.environ.get("DAFT_TPU_DEVICE", "1") == "0":
+    from ..analysis import knobs
+    if not knobs.env_bool("DAFT_TPU_DEVICE"):
         return False
     from . import backend
     return backend.device_ready()
@@ -47,9 +48,10 @@ def _is_transfer_bound() -> bool:
 
 
 def _min_rows() -> int:
-    env = os.environ.get("DAFT_TPU_DEVICE_MIN_ROWS")
+    from ..analysis import knobs
+    env = knobs.env_int("DAFT_TPU_DEVICE_MIN_ROWS", default=None)
     if env is not None:
-        return int(env)
+        return env
     # on a transfer-bound link, tiny batches are pure round-trip overhead
     return 4096 if _is_transfer_bound() else 0
 
@@ -69,10 +71,11 @@ def _min_rows_override(n_rows: int) -> Optional[bool]:
     """An explicit DAFT_TPU_DEVICE_MIN_ROWS keeps its documented meaning on
     every backend (device runs at or above that many rows); FORCE trumps it.
     None → no override, consult the cost model."""
-    env = os.environ.get("DAFT_TPU_DEVICE_MIN_ROWS")
-    if env is None or os.environ.get("DAFT_TPU_DEVICE_FORCE") is not None:
+    from ..analysis import knobs
+    env = knobs.env_int("DAFT_TPU_DEVICE_MIN_ROWS", default=None)
+    if env is None or knobs.env_is_set("DAFT_TPU_DEVICE_FORCE"):
         return None
-    return n_rows >= max(int(env), 1)
+    return n_rows >= max(env, 1)
 
 
 def _row_output_profitable(batch, needs_cols, n_outputs: int,

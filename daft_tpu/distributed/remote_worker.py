@@ -202,7 +202,8 @@ class RemoteWorker(Worker):
         blob = pickle.dumps((_dumps(task.plan), inputs_wire,
                              task.shuffle_out, task.fault_key, task.attempt))
         req = urllib.request.Request(self.address, data=blob, method="POST")
-        timeout = float(os.environ.get("DAFT_TPU_WORKER_TIMEOUT", "3600"))
+        from ..analysis import knobs
+        timeout = knobs.env_float("DAFT_TPU_WORKER_TIMEOUT")
         try:
             with urllib.request.urlopen(req, timeout=timeout) as r:
                 body = r.read()

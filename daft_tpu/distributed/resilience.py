@@ -230,10 +230,11 @@ _plan: Optional[FaultPlan] = None
 def active_fault_plan() -> Optional[FaultPlan]:
     """The process fault plan, re-parsed whenever the env spec/seed
     change (so tests flip scenarios with monkeypatch.setenv alone)."""
-    spec = os.environ.get("DAFT_TPU_FAULT_SPEC", "")
+    from ..analysis import knobs
+    spec = knobs.env_str("DAFT_TPU_FAULT_SPEC", default="")
     if not spec:
         return None
-    seed = os.environ.get("DAFT_TPU_FAULT_SEED", "0")
+    seed = knobs.env_str("DAFT_TPU_FAULT_SEED")
     global _plan
     with _plan_lock:
         if _plan is None or _plan.spec != spec or _plan.seed != seed:
@@ -281,25 +282,26 @@ class RetryPolicy:
                  speculative_min_s: Optional[float] = None,
                  clock: Callable[[], float] = time.monotonic,
                  seed: Optional[str] = None):
-        env = os.environ.get
+        from ..analysis import knobs
 
-        def _f(val, name, default):
-            return float(env(name, default)) if val is None else val
+        def _f(val, name):
+            return knobs.env_float(name) if val is None else val
 
-        self.max_retries = int(env("DAFT_TPU_MAX_RETRIES", "3")) \
+        self.max_retries = knobs.env_int("DAFT_TPU_MAX_RETRIES") \
             if max_retries is None else max_retries
-        self.backoff_base = _f(backoff_base, "DAFT_TPU_RETRY_BACKOFF", "0.05")
-        self.backoff_cap = _f(backoff_cap, "DAFT_TPU_RETRY_BACKOFF_CAP", "2.0")
-        self.quarantine_after = int(env("DAFT_TPU_QUARANTINE_AFTER", "3")) \
+        self.backoff_base = _f(backoff_base, "DAFT_TPU_RETRY_BACKOFF")
+        self.backoff_cap = _f(backoff_cap, "DAFT_TPU_RETRY_BACKOFF_CAP")
+        self.quarantine_after = knobs.env_int("DAFT_TPU_QUARANTINE_AFTER") \
             if quarantine_after is None else quarantine_after
-        self.quarantine_s = _f(quarantine_s, "DAFT_TPU_QUARANTINE_S", "30")
-        self.task_timeout = _f(task_timeout, "DAFT_TPU_TASK_TIMEOUT", "0")
+        self.quarantine_s = _f(quarantine_s, "DAFT_TPU_QUARANTINE_S")
+        self.task_timeout = _f(task_timeout, "DAFT_TPU_TASK_TIMEOUT")
         self.speculative_multiplier = _f(
-            speculative_multiplier, "DAFT_TPU_SPECULATIVE_MULTIPLIER", "4")
+            speculative_multiplier, "DAFT_TPU_SPECULATIVE_MULTIPLIER")
         self.speculative_min_s = _f(
-            speculative_min_s, "DAFT_TPU_SPECULATIVE_MIN_S", "0.5")
+            speculative_min_s, "DAFT_TPU_SPECULATIVE_MIN_S")
         self.clock = clock
-        self.seed = env("DAFT_TPU_FAULT_SEED", "0") if seed is None else seed
+        self.seed = knobs.env_str("DAFT_TPU_FAULT_SEED") \
+            if seed is None else seed
         self._lock = threading.Lock()
         self._fails: Dict[str, int] = defaultdict(int)
         self._quarantined_until: Dict[str, float] = {}
@@ -369,7 +371,13 @@ class ShuffleLineage:
     def __init__(self):
         # RLock: a recompute's own fetch failures recover recursively on
         # the same thread; the lock also dedups concurrent recoveries of
-        # the same source.
+        # the same source. NOTE the lock-order sanitizer
+        # (DAFT_TPU_SANITIZE=1) reports recover()'s retry-backoff sleeps
+        # as blocking-while-held — intentional: holding the lock across
+        # the recompute is what makes N consumers of a lost source wait
+        # for ONE recompute instead of racing N. Per-source locks would
+        # unserialize recoveries of unrelated sources; revisit if that
+        # ever shows up as real contention.
         self._lock = threading.RLock()
         self._producer: Dict[Tuple[str, str], object] = {}
         self._translation: Dict[Tuple[str, str], Tuple[str, str]] = {}
@@ -510,8 +518,8 @@ class TaskSupervisor:
     # ---- main loop -------------------------------------------------
     def run(self, tasks: List, speculate: bool = True) -> List:
         import concurrent.futures as cf
-        if len(tasks) > 1 and os.environ.get(
-                "DAFT_TPU_CHAOS_SERIALIZE", "0") not in ("0", "", "false"):
+        from ..analysis import knobs
+        if len(tasks) > 1 and knobs.env_bool("DAFT_TPU_CHAOS_SERIALIZE"):
             # exact-replay mode: one task (with all its retries and
             # recoveries) at a time, so every injection decision happens
             # in a deterministic total order — concurrent recovery of a

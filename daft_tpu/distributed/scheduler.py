@@ -108,9 +108,8 @@ class StageRunner:
     # ------------------------------------------------------------------
     @staticmethod
     def _shuffle_enabled() -> bool:
-        import os
-        return os.environ.get("DAFT_TPU_DISTRIBUTED_SHUFFLE",
-                              "flight") != "driver"
+        from ..analysis import knobs
+        return knobs.env_str("DAFT_TPU_DISTRIBUTED_SHUFFLE") != "driver"
 
     def run(self, stage_plan: StagePlan) -> Iterator[MicroPartition]:
         # fresh resilience state per query: quarantines/lineage span
@@ -187,8 +186,8 @@ class StageRunner:
         map-side agg pass (``costmodel.shuffle_combine_wins`` over the
         planner's row/NDV evidence). ``DAFT_TPU_SHUFFLE_COMBINE=1``
         forces it, ``0`` is the escape hatch, default ``auto``."""
-        import os
-        mode = os.environ.get("DAFT_TPU_SHUFFLE_COMBINE", "auto").lower()
+        from ..analysis import knobs
+        mode = knobs.env_str("DAFT_TPU_SHUFFLE_COMBINE").lower()
         if mode in ("0", "off", "false", "none"):
             return None
         combo = stage_plan.combine_for_boundary(cstage, b, up_stage)

@@ -95,7 +95,8 @@ def _ipc_write_options() -> Tuple[Optional["paipc.IpcWriteOptions"],
     path, including the post-seal straggler-append single-write branch)
     need no configuration. Auto-falls back to uncompressed when the codec
     is missing from this pyarrow build."""
-    pref = os.environ.get("DAFT_TPU_SHUFFLE_COMPRESSION", "lz4").lower()
+    from ..analysis import knobs
+    pref = knobs.env_str("DAFT_TPU_SHUFFLE_COMPRESSION").lower()
     if pref in ("none", "off", "0", ""):
         return None, None
     hit = _ipc_opts_cache.get(pref)
@@ -151,12 +152,18 @@ class ShuffleCache:
                 with paipc.new_stream(buf, table.schema, options=opts) as w:
                     w.write_table(table)
                 payload = buf.getvalue()
+                # daft-lint: allow(blocking-under-lock) -- post-seal
+                # straggler append must be atomic vs concurrent fetches
+                # reading this file; local spill-dir write, rare path
                 with open(self._path(partition), "ab") as f:
                     f.write(payload)
                     f.flush()
                     os.fsync(f.fileno())
                 shuffle_count("bytes_written", len(payload))
             else:
+                # daft-lint: allow(blocking-under-lock) -- per-partition
+                # writer state and the sealed check are one atomic unit;
+                # the open is a once-per-partition local file create
                 self._writer(partition, table.schema).write_table(table)
             self._rows[partition] = self._rows.get(partition, 0) + len(table)
         shuffle_count("rows_pushed", table.num_rows)
@@ -247,10 +254,10 @@ class ShuffleServer:
 
     def __init__(self, port: int = 0, host: Optional[str] = None,
                  advertise_host: Optional[str] = None):
-        self._host = host or os.environ.get("DAFT_TPU_SHUFFLE_HOST",
-                                            "127.0.0.1")
+        from ..analysis import knobs
+        self._host = host or knobs.env_str("DAFT_TPU_SHUFFLE_HOST")
         self._advertise = advertise_host \
-            or os.environ.get("DAFT_TPU_SHUFFLE_ADVERTISE") \
+            or knobs.env_str("DAFT_TPU_SHUFFLE_ADVERTISE") \
             or ("127.0.0.1" if self._host == "0.0.0.0" else self._host)
         self._caches: Dict[str, ShuffleCache] = {}
         self._lock = threading.Lock()
@@ -337,10 +344,10 @@ class FlightShuffleServer:
         if paflight is None:
             raise RuntimeError("pyarrow.flight not available; "
                                "use ShuffleServer (HTTP)")
-        self._host = host or os.environ.get("DAFT_TPU_SHUFFLE_HOST",
-                                            "127.0.0.1")
+        from ..analysis import knobs
+        self._host = host or knobs.env_str("DAFT_TPU_SHUFFLE_HOST")
         self._advertise = advertise_host \
-            or os.environ.get("DAFT_TPU_SHUFFLE_ADVERTISE") \
+            or knobs.env_str("DAFT_TPU_SHUFFLE_ADVERTISE") \
             or ("127.0.0.1" if self._host == "0.0.0.0" else self._host)
         self._caches: Dict[str, ShuffleCache] = {}
         self._lock = threading.Lock()
@@ -437,7 +444,8 @@ def sweep_orphaned_shuffles(root: Optional[str] = None,
     else:
         roots = [root]
     if ttl_s is None:
-        ttl_s = float(os.environ.get("DAFT_TPU_SHUFFLE_TTL", "86400"))
+        from ..analysis import knobs
+        ttl_s = knobs.env_float("DAFT_TPU_SHUFFLE_TTL")
     removed: List[str] = []
     cutoff = _time.time() - ttl_s
     for r in roots:
@@ -459,6 +467,7 @@ def sweep_orphaned_shuffles(root: Optional[str] = None,
 
 
 _swept_once = False
+_swept_lock = threading.Lock()
 
 
 def make_shuffle_server(port: int = 0, host: Optional[str] = None):
@@ -468,13 +477,16 @@ def make_shuffle_server(port: int = 0, host: Optional[str] = None):
     sweeps orphaned shuffle dirs crashed processes left behind (once —
     the glob+stat walk is not worth repeating per server)."""
     global _swept_once
-    if not _swept_once:
+    with _swept_lock:
+        sweep = not _swept_once
         _swept_once = True
+    if sweep:
         try:
             sweep_orphaned_shuffles()
         except Exception:
             pass  # janitorial; must never block serving
-    pref = os.environ.get("DAFT_TPU_SHUFFLE_TRANSPORT", "flight")
+    from ..analysis import knobs
+    pref = knobs.env_str("DAFT_TPU_SHUFFLE_TRANSPORT")
     if pref != "http" and paflight is not None:
         return FlightShuffleServer(port, host=host)
     return ShuffleServer(port, host=host)
@@ -737,7 +749,8 @@ def _fetch_partition_raw(address: str, shuffle_id: str, partition: int
         meta = t.schema.metadata or {}
         return None if meta.get(b"daft_tpu_empty") == b"1" else t
     url = f"{address}/shuffle/{shuffle_id}/{partition}"
-    timeout = float(os.environ.get("DAFT_TPU_SHUFFLE_TIMEOUT", "600"))
+    from ..analysis import knobs
+    timeout = knobs.env_float("DAFT_TPU_SHUFFLE_TIMEOUT")
     try:
         r = urllib.request.urlopen(url, timeout=timeout)
     except urllib.error.HTTPError as exc:

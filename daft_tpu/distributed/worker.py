@@ -11,7 +11,6 @@ multi-host gRPC worker drops in without touching the scheduler.
 from __future__ import annotations
 
 import concurrent.futures as cf
-import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -108,8 +107,8 @@ class StageTask:
 
 
 def _chaos_serialized() -> bool:
-    return os.environ.get("DAFT_TPU_CHAOS_SERIALIZE", "0") \
-        not in ("0", "", "false")
+    from ..analysis import knobs
+    return bool(knobs.env_bool("DAFT_TPU_CHAOS_SERIALIZE"))
 
 
 def fetch_parallelism() -> int:
@@ -126,7 +125,8 @@ def fetch_parallelism() -> int:
     tuned for. Chaos runs measure recovery, not fetch throughput."""
     if _chaos_serialized():
         return 1
-    env = os.environ.get("DAFT_TPU_SHUFFLE_FETCH_PARALLELISM")
+    from ..analysis import knobs
+    env = knobs.env_raw("DAFT_TPU_SHUFFLE_FETCH_PARALLELISM")
     if env is not None:
         try:
             return max(int(env), 1)

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import os
+import threading
 from typing import Callable, Iterator, List, Optional
 
 import numpy as np
@@ -27,18 +28,23 @@ from ..recordbatch import RecordBatch
 from ..series import Series
 
 _POOL: Optional[cf.ThreadPoolExecutor] = None
+_SCAN_POOL: Optional[cf.ThreadPoolExecutor] = None
+# guards pool creation: two racing first callers used to each build a
+# pool, leaking the loser's worker threads for the process lifetime
+# (found by daft-lint's unguarded-global-mutation rule)
+_pools_lock = threading.Lock()
 
 
 def _pool() -> cf.ThreadPoolExecutor:
     global _POOL
-    if _POOL is None:
-        _POOL = cf.ThreadPoolExecutor(
-            max_workers=max(os.cpu_count() or 4, 4),
-            thread_name_prefix="daft-tpu-exec")
-    return _POOL
-
-
-_SCAN_POOL: Optional[cf.ThreadPoolExecutor] = None
+    if _POOL is not None:   # hot path: no lock once built
+        return _POOL
+    with _pools_lock:
+        if _POOL is None:
+            _POOL = cf.ThreadPoolExecutor(
+                max_workers=max(os.cpu_count() or 4, 4),
+                thread_name_prefix="daft-tpu-exec")
+        return _POOL
 
 
 def _scan_pool() -> cf.ThreadPoolExecutor:
@@ -47,11 +53,14 @@ def _scan_pool() -> cf.ThreadPoolExecutor:
     would otherwise hold an exec slot that a downstream operator's future
     needs to drain that very queue (deadlock when window+1 ≥ pool size)."""
     global _SCAN_POOL
-    if _SCAN_POOL is None:
-        _SCAN_POOL = cf.ThreadPoolExecutor(
-            max_workers=max((os.cpu_count() or 4) * 2, 8),
-            thread_name_prefix="daft-tpu-scan")
-    return _SCAN_POOL
+    if _SCAN_POOL is not None:
+        return _SCAN_POOL
+    with _pools_lock:
+        if _SCAN_POOL is None:
+            _SCAN_POOL = cf.ThreadPoolExecutor(
+                max_workers=max((os.cpu_count() or 4) * 2, 8),
+                thread_name_prefix="daft-tpu-scan")
+        return _SCAN_POOL
 
 
 def _ordered_parallel(inputs: Iterator, fn: Callable,

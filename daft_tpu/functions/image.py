@@ -57,6 +57,9 @@ def _get_resize_jit():
                 y = jnp.clip(y, lo, hi)
             return y.astype(out_dtype)
 
+        # daft-lint: allow(unguarded-global-mutation) -- benign last-wins
+        # memo: jax.jit wrapper construction is cheap (compiles lazily),
+        # a racing duplicate is discarded and both are usable
         _resize_jit = jax.jit(fn, static_argnums=(1, 2, 3, 4, 5))
     return _resize_jit
 

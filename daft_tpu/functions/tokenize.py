@@ -132,6 +132,9 @@ def get_tokenizer(tokens_path: Optional[str],
             if tokens_path in (None, "bytes"):
                 ranks = {bytes([i]): i for i in range(256)}
             else:
+                # daft-lint: allow(blocking-under-lock) -- load-once
+                # dedupe is the point: holding the cache lock during the
+                # vocab read stops N threads doing N expensive loads
                 ranks = _load_tiktoken_file(tokens_path)
             tk = BPETokenizer(ranks, pattern)
             _cache[key] = tk

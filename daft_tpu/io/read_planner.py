@@ -84,11 +84,8 @@ SCAN_STATS = _ScanIOStats("scan")
 # ----------------------------------------------------------------- knobs
 
 def _env_bytes(name: str) -> Optional[int]:
-    v = os.environ.get(name)
-    if v is None or v == "":
-        return None
-    from ..execution.memory import parse_bytes
-    return parse_bytes(v)
+    from ..analysis import knobs
+    return knobs.env_bytes(name, default=None)
 
 
 def _cfg(attr: str, default):
@@ -120,27 +117,30 @@ def range_parallelism() -> int:
     """Concurrent range GETs per source (``DAFT_TPU_IO_RANGE_PARALLELISM``,
     default 8; each source additionally caps at its configured
     ``max_connections``)."""
-    v = os.environ.get("DAFT_TPU_IO_RANGE_PARALLELISM")
-    if v is not None and v != "":
-        return max(int(v), 1)
+    from ..analysis import knobs
+    v = knobs.env_int("DAFT_TPU_IO_RANGE_PARALLELISM", default=None)
+    if v is not None:
+        return max(v, 1)
     return max(int(_cfg("tpu_io_range_parallelism", 8)), 1)
 
 
 def planned_reads_enabled() -> bool:
     """``DAFT_TPU_IO_PLANNED_READS=0`` restores the naive per-column-chunk
     ranged-read path (the pre-fast-path behavior; also the bench baseline)."""
-    v = os.environ.get("DAFT_TPU_IO_PLANNED_READS")
-    if v is not None and v != "":
-        return v not in ("0", "false", "False")
+    from ..analysis import knobs
+    v = knobs.env_bool("DAFT_TPU_IO_PLANNED_READS", default=None)
+    if v is not None:
+        return v
     return bool(_cfg("tpu_io_planned_reads", True))
 
 
 def scan_prefetch_tasks() -> int:
     """How many upcoming ScanTasks the scan source resolves ahead of the
     consumer (``DAFT_TPU_SCAN_PREFETCH``, default 2; 0 disables)."""
-    v = os.environ.get("DAFT_TPU_SCAN_PREFETCH")
-    if v is not None and v != "":
-        return max(int(v), 0)
+    from ..analysis import knobs
+    v = knobs.env_int("DAFT_TPU_SCAN_PREFETCH", default=None)
+    if v is not None:
+        return max(v, 0)
     return max(int(_cfg("tpu_scan_prefetch", 2)), 0)
 
 
@@ -163,8 +163,8 @@ def scan_sequential_fallback() -> bool:
     ``DAFT_TPU_CHAOS_SERIALIZE=1`` or an active fault plan — PR 2's chaos
     replay contract requires the injected-fault exposure (and event order)
     of the pre-fast-path scan loop."""
-    if os.environ.get("DAFT_TPU_CHAOS_SERIALIZE", "0") \
-            not in ("0", "", "false"):
+    from ..analysis import knobs
+    if knobs.env_bool("DAFT_TPU_CHAOS_SERIALIZE"):
         return True
     try:
         from ..distributed.resilience import active_fault_plan

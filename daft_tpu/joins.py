@@ -116,8 +116,8 @@ def match_indices(l_gids: np.ndarray, r_gids: np.ndarray,
     on a local chip (or the CPU mesh in tests) the kernel wins and the
     model picks it. ``DAFT_TPU_DEVICE_JOIN=1/0`` force-overrides.
     """
-    import os
-    env = os.environ.get("DAFT_TPU_DEVICE_JOIN")
+    from .analysis import knobs
+    env = knobs.env_raw("DAFT_TPU_DEVICE_JOIN")
     use_device = env == "1"
     if env is None:
         from .device import costmodel, runtime as drt

@@ -79,7 +79,8 @@ def _build(src_hash: str) -> bool:
 
 def _load():
     global _lib, AVAILABLE
-    if os.environ.get("DAFT_TPU_NATIVE", "1") in ("0", "false"):
+    from ..analysis import knobs
+    if not knobs.env_bool("DAFT_TPU_NATIVE"):
         return
     src_hash = _src_hash()
     stamp = None
@@ -133,7 +134,10 @@ def _load():
     lib.dn_bpe_encode_batch.restype = i64
     lib.dn_bpe_free.argtypes = [ctypes.c_void_p]
 
+    # daft-lint: allow(unguarded-global-mutation) -- import-time init:
+    # _load() runs once at module bottom under the interpreter import lock
     _lib = lib
+    # daft-lint: allow(unguarded-global-mutation) -- same import-time init
     AVAILABLE = True
 
 

@@ -72,6 +72,17 @@ def _scan_io_raw() -> Dict[str, float]:
         return {}
 
 
+def _sanitizer_raw() -> Dict[str, float]:
+    """Raw snapshot of the lock-order sanitizer counters (acquisitions,
+    contended acquisitions, blocking-while-held events) — empty unless
+    DAFT_TPU_SANITIZE=1; never raises, like the device ledger."""
+    try:
+        from .analysis import lock_sanitizer
+        return lock_sanitizer.counters_snapshot()
+    except Exception:
+        return {}
+
+
 def device_kernel_ledger() -> Dict[str, dict]:
     """Process-wide per-dispatch achieved-bytes/flops ledger with derived
     roofline/MFU percentages (``costmodel.ledger_record`` feeds it at
@@ -173,6 +184,10 @@ class RuntimeStatsContext:
         # bytes fetched vs used, prefetch overlap)
         self._io0 = _scan_io_raw()
         self.io: Dict[str, float] = {}
+        # …and for the lock-order sanitizer (DAFT_TPU_SANITIZE=1):
+        # per-query acquisition/contention deltas + current graph size
+        self._sanitizer0 = _sanitizer_raw()
+        self.sanitizer: Dict[str, float] = {}
 
     def register(self, node) -> OperatorStats:
         key = id(node)
@@ -229,6 +244,12 @@ class RuntimeStatsContext:
                 self._io0, _scan_io_raw())
         except Exception:
             self.io = {}
+        try:
+            from .analysis import lock_sanitizer
+            self.sanitizer = lock_sanitizer.counters_delta(
+                self._sanitizer0, _sanitizer_raw())
+        except Exception:
+            self.sanitizer = {}
 
     # ---- reporting ---------------------------------------------------
     def exclusive_us(self, key: int) -> int:
@@ -289,6 +310,7 @@ class RuntimeStatsContext:
                 lines.append(f"  {k}: {v}")
         lines.extend(render_shuffle_block(self.shuffle))
         lines.extend(render_io_block(self.io))
+        lines.extend(render_sanitizer_block(self.sanitizer))
         return "\n".join(lines)
 
     def as_dict(self) -> Dict[str, dict]:
@@ -386,6 +408,26 @@ def render_io_block(d: Dict[str, float]) -> List[str]:
     return lines
 
 
+def render_sanitizer_block(s: Dict[str, float]) -> List[str]:
+    """Human lines for one query's lock-sanitizer delta (shared by
+    ``explain(analyze=True)`` and the dashboard; empty unless
+    ``DAFT_TPU_SANITIZE=1``): current lock-order graph size + cycle
+    count, and this query's acquisition/contention/blocking events."""
+    if not s:
+        return []
+    cycles = int(s.get("graph_cycles", 0))
+    lines = ["concurrency (lock sanitizer):"]
+    lines.append(f"  graph: {int(s.get('graph_locks', 0))} lock sites, "
+                 f"{int(s.get('graph_edges', 0))} order edges, "
+                 f"{cycles} cycle{'s' if cycles != 1 else ''}"
+                 + (" (POTENTIAL DEADLOCK)" if cycles else ""))
+    lines.append(f"  this query: {int(s.get('acquisitions', 0))} "
+                 f"acquisitions, {int(s.get('contended', 0))} contended, "
+                 f"{int(s.get('blocking_while_held', 0))} "
+                 f"blocking-while-held")
+    return lines
+
+
 # ---------------------------------------------------------------------------
 # per-process "last query" registry
 
@@ -400,7 +442,8 @@ def xplane_trace_dir() -> Optional[str]:
     reference's chrome-trace layer (``src/common/tracing``): device kernel
     timelines, HBM transfers and XLA compilation spans land in
     ``<dir>/plugins/profile``."""
-    return os.environ.get("DAFT_TPU_XPLANE_DIR") or None
+    from .analysis import knobs
+    return knobs.env_str("DAFT_TPU_XPLANE_DIR") or None
 
 
 _xplane_lock = threading.Lock()
@@ -444,7 +487,8 @@ class _XplaneTrace:
 
 
 def chrome_trace_path() -> Optional[str]:
-    v = os.environ.get("DAFT_TPU_CHROME_TRACE")
+    from .analysis import knobs
+    v = knobs.env_str("DAFT_TPU_CHROME_TRACE")
     if not v:
         return None
     low = v.strip().lower()
@@ -456,7 +500,8 @@ def chrome_trace_path() -> Optional[str]:
 
 
 def progress_enabled() -> bool:
-    return os.environ.get("DAFT_TPU_PROGRESS", "0") not in ("0", "false", "")
+    from .analysis import knobs
+    return bool(knobs.env_bool("DAFT_TPU_PROGRESS"))
 
 
 def new_query_stats() -> RuntimeStatsContext:
@@ -472,7 +517,8 @@ def set_last_stats(ctx: RuntimeStatsContext):
     from . import dashboard
     if dashboard._server is not None:
         dashboard.broadcast_query(ctx)
-    endpoint = os.environ.get("DAFT_TPU_OTLP_ENDPOINT")
+    from .analysis import knobs
+    endpoint = knobs.env_str("DAFT_TPU_OTLP_ENDPOINT")
     if endpoint:
         export_otlp(ctx, endpoint)
 

@@ -10,11 +10,10 @@ can't hang planning.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Optional
 
-_lock = threading.Lock()
+_lock = threading.RLock()   # re-entrant: get_mesh holds it across mesh_size
 _mesh = None
 _size: Optional[int] = None
 
@@ -24,18 +23,22 @@ def mesh_size() -> int:
     global _size
     if _size is not None:
         return _size
-    from ..device import backend
-    if backend.backend_name() is None:
-        _size = 0
-        return 0
-    import jax
+    with _lock:
+        if _size is not None:
+            return _size
+        from ..device import backend
+        if backend.backend_name() is None:
+            _size = 0
+            return 0
+        import jax
 
-    n = len(jax.devices())
-    cap = os.environ.get("DAFT_TPU_MESH_DEVICES")
-    if cap is not None:
-        n = min(n, int(cap))
-    _size = n
-    return n
+        n = len(jax.devices())
+        from ..analysis import knobs
+        cap = knobs.env_int("DAFT_TPU_MESH_DEVICES")
+        if cap is not None:
+            n = min(n, cap)
+        _size = n
+        return n
 
 
 #: below this many rows a mesh collective (exchange agg, hash
@@ -46,8 +49,9 @@ _MESH_MIN_ROWS = 65536
 
 
 def mesh_min_rows() -> int:
-    v = os.environ.get("DAFT_TPU_MESH_MIN_ROWS")
-    return int(v) if v is not None else _MESH_MIN_ROWS
+    from ..analysis import knobs
+    v = knobs.env_int("DAFT_TPU_MESH_MIN_ROWS", default=None)
+    return v if v is not None else _MESH_MIN_ROWS
 
 
 def get_mesh():

@@ -22,8 +22,10 @@ adaptive layers compose here:
 The streaming spill-cache shuffle composes with all three (it simply
 takes precedence over resizing at exchanges it serves).
 
-Enable with ``DAFT_TPU_ENABLE_AQE=1`` / ``set_execution_config(enable_aqe=
-True)``.
+Enable with ``DAFT_ENABLE_AQE=1`` (the ``ExecutionConfig.enable_aqe`` env
+spelling — this docstring used to advertise a ``DAFT_TPU_``-prefixed AQE
+knob that never existed; caught by the daft-lint knob registry) /
+``set_execution_config(enable_aqe=True)``.
 """
 
 from __future__ import annotations

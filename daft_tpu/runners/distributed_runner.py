@@ -28,8 +28,9 @@ class DistributedRunner(Runner):
 
     def __init__(self, num_workers: Optional[int] = None, scheduler=None):
         super().__init__()
+        from ..analysis import knobs
         self.num_workers = num_workers or max(
-            int(os.environ.get("DAFT_TPU_NUM_WORKERS", "0"))
+            knobs.env_int("DAFT_TPU_NUM_WORKERS")
             or min((os.cpu_count() or 4) // 2, 8), 2)
         self._scheduler = scheduler
         self._manager: Optional[WorkerManager] = None

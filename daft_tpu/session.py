@@ -9,6 +9,7 @@ and namespace; attached UDFs become SQL-callable functions.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional, Union
 
 from .catalog import (
@@ -221,13 +222,20 @@ class Session:
 
 
 _SESSION: Optional[Session] = None
+# two racing first callers used to each build a Session, and attachments
+# made through the loser silently vanished (daft-lint
+# unguarded-global-mutation find)
+_session_lock = threading.Lock()
 
 
 def _session() -> Session:
     global _SESSION
-    if _SESSION is None:
-        _SESSION = Session()
-    return _SESSION
+    if _SESSION is not None:    # hot path: no lock once built
+        return _SESSION
+    with _session_lock:
+        if _SESSION is None:
+            _SESSION = Session()
+        return _SESSION
 
 
 def current_session() -> Session:

@@ -44,8 +44,12 @@ import numpy as np
 import pyarrow as pa
 import pytest
 
+# importing daft_tpu ALSO arms the runtime lock-order sanitizer when
+# DAFT_TPU_SANITIZE=1 (daft_tpu/__init__.py patches the lock factories
+# before any engine module creates its module-level locks)
 import daft_tpu
 from daft_tpu import DataType, col
+from daft_tpu.analysis import lock_sanitizer as _lock_sanitizer
 
 
 @pytest.fixture(params=[False, True], ids=["host", "device"])
@@ -74,3 +78,16 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "exchange" in item.nodeid or "multichip" in item.nodeid:
             item.add_marker(skip)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """DAFT_TPU_SANITIZE=1: print the lock-order sanitizer report at
+    session end and FAIL the session on any acquisition-order cycle (a
+    potential deadlock two threads haven't hit yet)."""
+    if not _lock_sanitizer.is_enabled():
+        return
+    print("\n" + _lock_sanitizer.report())
+    if _lock_sanitizer.summary()["cycles"]:
+        print("daft-lint lock sanitizer: acquisition-order cycles "
+              "detected — failing the session")
+        session.exitstatus = 1

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 import daft_tpu
+from daft_tpu.analysis import rule_jit
 from daft_tpu.device import costmodel, kernels as K
 from daft_tpu.recordbatch import RecordBatch
 
@@ -89,49 +90,41 @@ def test_argsort_f32_codes_match_reference():
         assert [str(x) for x in got] == [str(x) for x in ref], (desc, got)
 
 
-def _max_sort_operands(jaxpr):
-    mx = 0
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == "sort":
-            mx = max(mx, len(eqn.invars))
-        for sub in eqn.params.values():
-            if hasattr(sub, "jaxpr"):
-                mx = max(mx, _max_sort_operands(sub.jaxpr))
-    return mx
+# the jaxpr walk + contract numbers are single-sourced in the jit-hygiene
+# lint rule (daft_tpu/analysis/rule_jit.py) — tests and
+# `python -m daft_tpu.analysis` prove the SAME contracts
 
 
-@pytest.mark.parametrize("n_keys,dtype", [(1, np.int64), (2, np.float32),
-                                          (3, np.int64), (6, np.int32),
-                                          (8, np.float32)])
+@pytest.mark.parametrize("n_keys,dtype", rule_jit.ARGSORT_CASES)
 def test_argsort_compiles_with_at_most_3_sort_operands(n_keys, dtype):
     """The operand-count cliff contract: ≤3 operands per lax.sort for ANY
     key count (the 2k+1-plane formulation hit >5-minute TPU compiles)."""
-    C = 32
-    keys = tuple(jnp.asarray(np.arange(C, dtype=dtype))
-                 for _ in range(n_keys))
-    valids = tuple(jnp.asarray(np.ones(C, bool)) for _ in range(n_keys))
-    mask = jnp.asarray(np.ones(C, bool))
-    flags = tuple(False for _ in range(n_keys))
-    jaxpr = jax.make_jaxpr(lambda ks, vs, m: K.argsort_kernel(
-        ks, vs, m, flags, flags))(keys, valids, mask)
-    assert _max_sort_operands(jaxpr.jaxpr) <= 3
+    jaxpr = rule_jit.argsort_jaxpr(n_keys, dtype)
+    assert rule_jit.max_sort_operands(jaxpr.jaxpr) \
+        <= rule_jit.ARGSORT_MAX_SORT_OPERANDS
 
 
 def test_grouped_agg_sorts_stay_under_operand_cliff():
     """The grouped-agg kernels ride the same packed sort: ≤3 operands
     regardless of key count."""
-    C = 32
-    nk = 5
-    keys = tuple(jnp.asarray(np.arange(C, dtype=np.int64))
-                 for _ in range(nk))
-    ones = tuple(jnp.asarray(np.ones(C, bool)) for _ in range(nk))
-    mask = jnp.asarray(np.ones(C, bool))
-    vals = (jnp.asarray(np.ones(C, np.float32)),)
-    jaxpr = jax.make_jaxpr(
-        lambda ks, kv, v, vv, m: K.grouped_agg_block_impl(
-            ks, kv, v, vv, m, ("sum",), 16))(
-        keys, ones, vals, (mask,), mask)
-    assert _max_sort_operands(jaxpr.jaxpr) <= 3
+    jaxpr = rule_jit.grouped_agg_jaxpr(n_keys=5)
+    assert rule_jit.max_sort_operands(jaxpr.jaxpr) \
+        <= rule_jit.ARGSORT_MAX_SORT_OPERANDS
+
+
+def test_fused_join_jaxpr_has_no_host_callbacks():
+    """The single-dispatch contract, statically: the fused join program
+    contains zero host-callback primitives (a host round-trip inside the
+    fused program would silently reintroduce the per-phase transfers)."""
+    jx = rule_jit.join_fused_jaxpr()
+    for prim in rule_jit.FORBIDDEN_IN_FUSED_JOIN:
+        assert rule_jit.count_primitive(jx.jaxpr, prim) == 0
+
+
+def test_lint_dispatch_contract_checker_is_clean():
+    """The lint rule's own contract re-verification (what CI runs via
+    `python -m daft_tpu.analysis`) agrees with the tests above."""
+    assert rule_jit.check_dispatch_contracts() == []
 
 
 def test_argsort_radix_passes_scale_with_key_bits():
