@@ -637,6 +637,257 @@ def run_scan_bench():
     return res
 
 
+def _pct(sorted_vals, p: float):
+    """p-quantile of a pre-sorted list (nearest-rank)."""
+    if not sorted_vals:
+        return None
+    i = min(int(p * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def run_serve_bench(root=None, duration_s=None, concurrency=None):
+    """``--serve``: sustained mixed traffic through the query scheduler.
+
+    Closed-loop clients (one per worker slot, 3 sessions) submit a
+    rotating mix of TPC-H shapes (q1/q6/q3) + point lookups for
+    ``BENCH_SERVE_SECONDS`` (default 20s) at ``BENCH_SERVE_CONCURRENCY``
+    (default 4). Reports QPS, p50/p99 latency, queue wait, admission
+    rejections, plan/result cache hit rates, the repeated-vs-cold mean
+    latency ratio (the plan/result caches' amortization evidence), and
+    the admission-accounting leak check (outstanding admitted bytes must
+    return to zero after drain)."""
+    import threading
+
+    from benchmarking.tpch import queries as Q
+
+    from daft_tpu import col, serving
+
+    if root is None:
+        # serving traffic is interactive-shaped: a dedicated small TPC-H
+        # dataset (SF0.1) keeps per-query latency in the hundreds of ms
+        # so a bounded run actually exercises repeats, queuing, and the
+        # caches (SF1 queries run ~15s+ on this class of box — a 20s
+        # window would barely complete one per worker)
+        root = os.path.join(REPO, ".cache", "tpch_sf0.1_serve_v1")
+        if not os.path.isdir(os.path.join(root, "lineitem")):
+            from benchmarking.tpch.datagen import generate_tpch
+            print("generating TPC-H SF0.1 (serve bench, one-time) …",
+                  file=sys.stderr, flush=True)
+            generate_tpch(root, 0.1, 2)
+    duration_s = duration_s if duration_s is not None \
+        else float(os.environ.get("BENCH_SERVE_SECONDS", "20"))
+    concurrency = concurrency if concurrency is not None \
+        else int(os.environ.get("BENCH_SERVE_CONCURRENCY", "4"))
+    get_df = _get_df_factory(root)
+
+    def lookup(k):
+        return get_df("lineitem").where(col("l_orderkey") == k) \
+            .select("l_orderkey", "l_partkey", "l_quantity",
+                    "l_extendedprice").limit(10)
+
+    shapes = [("q1", lambda: Q.q1(get_df)),
+              ("q6", lambda: Q.q6(get_df)),
+              ("q3", lambda: Q.q3(get_df))] + \
+             [(f"lookup{k}", (lambda k=k: lookup(k)))
+              for k in (1, 7, 32, 69)]
+    sched = serving.QueryScheduler(concurrency=concurrency)
+    recs = []
+    rec_lock = threading.Lock()
+    submit_counts = {}
+    t_end = time.time() + duration_s
+
+    def client(ci):
+        i = ci
+        while time.time() < t_end:
+            name, fac = shapes[i % len(shapes)]
+            i += concurrency
+            with rec_lock:
+                n_prior = submit_counts.get(name, 0)
+                submit_counts[name] = n_prior + 1
+            t0 = time.time()
+            try:
+                h = sched.submit(fac(), session=f"s{ci % 3}")
+                h.result(timeout=120)
+            except serving.AdmissionRejected as exc:
+                with rec_lock:
+                    recs.append((name, None, None, False,
+                                 f"rejected:{exc.kind}"))
+                continue
+            except Exception as exc:  # noqa: BLE001 — recorded, not fatal
+                with rec_lock:
+                    recs.append((name, None, None, False,
+                                 f"error:{str(exc)[:80]}"))
+                continue
+            with rec_lock:
+                recs.append((name, time.time() - t0, h.queue_wait_s,
+                             n_prior == 0, "ok"))
+
+    t_wall0 = time.time()
+    threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+               for ci in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 150)
+    wall = time.time() - t_wall0
+    sched_counters = sched.counters_snapshot()
+    outstanding = sched.admission.outstanding
+    sched.shutdown()
+
+    ok = [r for r in recs if r[4] == "ok"]
+    lats = sorted(r[1] for r in ok)
+    waits = sorted(r[2] for r in ok)
+    cold = [r[1] for r in ok if r[3]]
+    warm = [r[1] for r in ok if not r[3]]
+    errors = [r[4] for r in recs if r[4].startswith("error")]
+    pc_hits = sched_counters.get("plan_cache_hits", 0)
+    pc_miss = sched_counters.get("plan_cache_misses", 0)
+    rc_hits = sched_counters.get("result_cache_hits", 0)
+    rc_miss = sched_counters.get("result_cache_misses", 0)
+    out = {
+        "concurrency": concurrency,
+        "duration_s": round(wall, 2),
+        "completed": len(ok),
+        "qps": round(len(ok) / max(wall, 1e-9), 2),
+        "latency_p50_ms": round(1e3 * (_pct(lats, 0.50) or 0), 2),
+        "latency_p99_ms": round(1e3 * (_pct(lats, 0.99) or 0), 2),
+        "queue_wait_mean_ms": round(
+            1e3 * (sum(waits) / len(waits) if waits else 0), 2),
+        "queue_wait_p99_ms": round(1e3 * (_pct(waits, 0.99) or 0), 2),
+        "rejections": {
+            k.replace("rejected_", ""): int(v)
+            for k, v in sched_counters.items()
+            if k.startswith("rejected_") and v},
+        "plan_cache_hit_rate": round(
+            pc_hits / max(pc_hits + pc_miss, 1), 3),
+        "result_cache_hit_rate": round(
+            rc_hits / max(rc_hits + rc_miss, 1), 3),
+        "plan_cache_structure_hits": int(
+            sched_counters.get("plan_cache_structure_hits", 0)),
+        "cold_mean_ms": round(
+            1e3 * sum(cold) / len(cold), 2) if cold else None,
+        "repeat_mean_ms": round(
+            1e3 * sum(warm) / len(warm), 2) if warm else None,
+        "admitted_bytes_outstanding_after_drain": int(outstanding),
+    }
+    if cold and warm and sum(warm):
+        out["repeat_speedup"] = round(
+            (sum(cold) / len(cold)) / (sum(warm) / len(warm)), 2)
+    try:
+        from daft_tpu.device.runtime import compile_cache_counters
+        out["jit_projection_cache"] = compile_cache_counters()
+    except Exception:
+        pass
+    try:
+        from daft_tpu.analysis import lock_sanitizer
+        if lock_sanitizer.is_enabled():
+            out["sanitizer_cycles"] = int(
+                lock_sanitizer.counters_snapshot().get("graph_cycles", 0))
+    except Exception:
+        pass
+    if errors:
+        out["errors"] = errors[:5]
+        out["n_errors"] = len(errors)
+    return out
+
+
+def run_serve_smoke() -> int:
+    """``--serve-smoke``: the CI gate. A few seconds of mixed traffic over
+    a small temp table; exit 1 on an admission-accounting leak
+    (outstanding admitted bytes after drain), a wrong answer, or any
+    lock-order sanitizer cycle. No TPC-H datagen required."""
+    import shutil
+    import tempfile
+
+    import daft_tpu as dt
+    from daft_tpu import col
+
+    d = tempfile.mkdtemp(prefix="daft_tpu_serve_smoke_")
+    try:
+        n = 4000
+        dt.from_pydict({
+            "k": list(range(n)),
+            "g": [i % 13 for i in range(n)],
+            "v": [float(i % 97) for i in range(n)],
+        }).write_parquet(os.path.join(d, "t"))
+        root_glob = os.path.join(d, "t", "*.parquet")
+
+        def table():
+            return dt.read_parquet(root_glob)
+
+        expected = table().groupby("g") \
+            .agg(col("v").sum().alias("s")).sort("g").to_pydict()
+
+        import threading
+
+        from daft_tpu import serving
+        shapes = [
+            ("agg", lambda: table().groupby("g")
+             .agg(col("v").sum().alias("s")).sort("g")),
+            ("topk", lambda: table().sort("v", desc=True).limit(5)),
+            ("lookup", lambda: table().where(col("k") == 1234).limit(1)),
+        ]
+        sched = serving.QueryScheduler(concurrency=4)
+        t_end = time.time() + float(
+            os.environ.get("BENCH_SERVE_SMOKE_SECONDS", "4"))
+        failures = []
+        done = [0]
+        lock = threading.Lock()
+
+        def client(ci):
+            i = ci
+            while time.time() < t_end:
+                name, fac = shapes[i % len(shapes)]
+                i += 1
+                try:
+                    h = sched.submit(fac(), session=f"s{ci % 3}")
+                    ps = h.result(timeout=60)
+                    if name == "agg":
+                        got = ps.to_recordbatch().to_pydict()
+                        if got != expected:
+                            raise AssertionError(
+                                "agg answer mismatch under concurrency")
+                    with lock:
+                        done[0] += 1
+                except Exception as exc:  # noqa: BLE001
+                    with lock:
+                        failures.append(f"{name}: {exc!r}"[:200])
+
+        threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+                   for ci in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        outstanding = sched.admission.outstanding
+        counters = sched.counters_snapshot()
+        sched.shutdown()
+        cycles = 0
+        try:
+            from daft_tpu.analysis import lock_sanitizer
+            if lock_sanitizer.is_enabled():
+                cycles = int(lock_sanitizer.counters_snapshot()
+                             .get("graph_cycles", 0))
+        except Exception:
+            pass
+        result = {
+            "serve_smoke": {
+                "completed": done[0],
+                "failures": failures[:5],
+                "admitted_bytes_outstanding": int(outstanding),
+                "sanitizer_cycles": cycles,
+                "plan_cache_hits": int(counters.get("plan_cache_hits", 0)),
+                "result_cache_hits": int(
+                    counters.get("result_cache_hits", 0)),
+            }}
+        print(json.dumps(result), flush=True)
+        if failures or outstanding or cycles or done[0] == 0:
+            return 1
+        return 0
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def run_arrow_baseline():
     import pyarrow.compute as pc
     import pyarrow.dataset as pads
@@ -910,6 +1161,15 @@ def main():
         if r is not None:
             detail["scan_bench"] = r
 
+    if "--serve" in sys.argv:
+        # serving plane: sustained mixed traffic through the query
+        # scheduler — QPS, p50/p99 latency, queue wait, rejections,
+        # plan/result cache hit rates, repeated-vs-cold latency ratio
+        # min_needed covers one-time SF0.1 datagen on a fresh checkout
+        r = section("serve", run_serve_bench, min_needed=120.0)
+        if r is not None:
+            detail["serve_bench"] = r
+
     r = section("tpch_sf1_suite_host",
                 lambda: run_tpch_suite(DATA, budget_s=_remaining() - 10),
                 min_needed=20.0)
@@ -959,7 +1219,7 @@ def main():
 
     results_dir = os.path.join(REPO, "benchmarking", "results")
     os.makedirs(results_dir, exist_ok=True)
-    artifact = os.path.join(results_dir, "r9_bench_driver.json")
+    artifact = os.path.join(results_dir, "r11_bench_driver.json")
     with open(artifact, "w") as f:
         json.dump(full, f, indent=1)
     # progress/bulk lines first (NOT last): full detail for humans reading
@@ -1030,13 +1290,21 @@ def main():
             "req_reduction": sc.get("request_reduction"),
             "speedup": sc.get("scan_speedup"),
             "match": sc.get("answers_match")}
+    sv = detail.get("serve_bench")
+    if isinstance(sv, dict) and "error" not in sv:
+        compact["serve"] = {
+            "qps": sv.get("qps"),
+            "p99_ms": sv.get("latency_p99_ms"),
+            "repeat_x": sv.get("repeat_speedup"),
+            "rc_hit": sv.get("result_cache_hit_rate"),
+            "leak": sv.get("admitted_bytes_outstanding_after_drain")}
     if skipped:
         compact["n_skipped"] = len(skipped)
     if errors:
         compact["n_errors"] = len(errors)
     # hard cap: drop optional keys until the line fits the driver's window
-    for drop in ("scan", "shuffle", "chaos", "ledger_dispatches", "mfu",
-                 "families", "q1_winner", "backend"):
+    for drop in ("serve", "scan", "shuffle", "chaos", "ledger_dispatches",
+                 "mfu", "families", "q1_winner", "backend"):
         if len(json.dumps(compact)) <= 1500:
             break
         compact.pop(drop, None)
@@ -1048,5 +1316,9 @@ def main():
 if __name__ == "__main__":
     if "--device-child" in sys.argv:
         _device_child()
+    elif "--serve-smoke" in sys.argv:
+        # CI gate: no datagen, no device tier — a few seconds of serving
+        # traffic with leak + sanitizer-cycle checks
+        sys.exit(run_serve_smoke())
     else:
         main()

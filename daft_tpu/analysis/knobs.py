@@ -247,6 +247,45 @@ _KNOBS: List[Knob] = [
        "daft_tpu/io/read_planner.py", "io-scan",
        "head-range budget for remote CSV/JSON schema inference (`0` → "
        "whole object)", default_str="1MiB"),
+    # ------------------------------------------------------- serving
+    _k("DAFT_TPU_SERVE_CONCURRENCY", "int", 4,
+       "daft_tpu/serving/scheduler.py", "serving",
+       "worker slots in the query scheduler (concurrently RUNNING "
+       "queries)", config_field="tpu_serve_concurrency"),
+    _k("DAFT_TPU_SERVE_QUEUE_DEPTH", "int", 64,
+       "daft_tpu/serving/scheduler.py", "serving",
+       "max queued (not yet running) queries before submissions are "
+       "rejected `queue_full`", config_field="tpu_serve_queue_depth"),
+    _k("DAFT_TPU_SERVE_QUEUE_TIMEOUT", "float", 30.0,
+       "daft_tpu/serving/scheduler.py", "serving",
+       "seconds a query may wait (in queue, then again in admission) "
+       "before it is rejected `queue_timeout`; `0` waits forever",
+       config_field="tpu_serve_queue_timeout"),
+    _k("DAFT_TPU_SERVE_PLAN_CACHE_BYTES", "bytes", 64 << 20,
+       "daft_tpu/serving/scheduler.py", "serving",
+       "LRU budget for the compiled-plan cache (optimized+translated "
+       "physical plans keyed by plan fingerprint); `0` disables",
+       config_field="tpu_serve_plan_cache_bytes", default_str="64MiB"),
+    _k("DAFT_TPU_SERVE_RESULT_CACHE_BYTES", "bytes", 64 << 20,
+       "daft_tpu/serving/scheduler.py", "serving",
+       "LRU budget for the result cache (materialized PartitionSets for "
+       "identical literal-inclusive fingerprints over unchanged "
+       "sources); `0` disables",
+       config_field="tpu_serve_result_cache_bytes", default_str="64MiB"),
+    _k("DAFT_TPU_SERVE_MEMORY", "bytes", None,
+       "daft_tpu/serving/scheduler.py", "serving",
+       "admission-control byte budget shared by concurrent queries "
+       "(default: `DAFT_TPU_MEMORY_LIMIT`, else the breaker budget; "
+       "`0` disables admission)", default_str="memory limit"),
+    _k("DAFT_TPU_SERVE_OP_TTL", "float", 600.0,
+       "daft_tpu/connect/server.py", "serving",
+       "seconds a FINISHED reattachable Spark Connect operation retains "
+       "its response buffer before the sweep drops it; `0` disables"),
+    _k("DAFT_TPU_SERVE_OP_RETAIN_BYTES", "bytes", 64 << 20,
+       "daft_tpu/connect/server.py", "serving",
+       "per-session retained-response budget across finished "
+       "operations (newest kept first); `0` disables",
+       default_str="64MiB"),
     # ------------------------------------------------- observability
     _k("DAFT_TPU_XPLANE_DIR", "str", None, "daft_tpu/observability.py",
        "observability", "directory capturing a jax profiler "

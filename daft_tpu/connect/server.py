@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import io
 import threading
+import time
 import uuid
 from typing import Dict, Iterator, Optional
 
@@ -62,18 +63,51 @@ class _Operation:
         #                                 (a release must actually free
         #                                 the acknowledged bytes)
         self.error = None               # (grpc code, message) on failure
+        self.finished_at: Optional[float] = None  # monotonic; sweep clock
+        self.retained_bytes = 0         # serialized bytes in self.buffer
+        self._cancel_cbs: list = []     # real cancellation hooks (the
+        #                                 serving scheduler's handle)
+
+    def bind_cancel(self, fn) -> None:
+        """Register a callback fired on INTERRUPT — wires the client's
+        cancel through to the scheduler's cooperative CancelToken so a
+        running query actually unwinds (not just the response stream)."""
+        fire_now = False
+        with self.cond:
+            if self.cancel.is_set():
+                fire_now = True
+            else:
+                self._cancel_cbs.append(fn)
+        if fire_now:
+            try:
+                fn()
+            except Exception:
+                pass
+
+    def request_cancel(self) -> None:
+        with self.cond:
+            self.cancel.set()
+            cbs = list(self._cancel_cbs)
+        for fn in cbs:
+            try:
+                fn()
+            except Exception:
+                pass
 
     def record(self, r) -> None:
         if not self.reattachable:
             return
         with self.cond:
             self.buffer.append(r)
+            self.retained_bytes += r.ByteSize()
             self.cond.notify_all()
 
     def finish(self, error=None) -> None:
         with self.cond:
             if error is not None and self.error is None:
                 self.error = error
+            if self.finished_at is None:
+                self.finished_at = time.monotonic()
             self.done.set()
             self.cond.notify_all()
 
@@ -97,6 +131,8 @@ class _Operation:
         with self.cond:
             for i, r in enumerate(self.buffer):
                 if r.response_id == response_id:
+                    self.retained_bytes -= sum(
+                        b.ByteSize() for b in self.buffer[:i + 1])
                     del self.buffer[:i + 1]
                     self.base += i + 1
                     break
@@ -182,13 +218,55 @@ class SparkConnectServer:
             st = self._sessions.get(session_id)
             if st is None:
                 st = self._sessions[session_id] = _SessionState()
+            self._sweep_operations_locked(st)
             return st
 
+    @staticmethod
+    def _sweep_operations_locked(st: _SessionState) -> None:
+        """Bound finished-operation retention. Finished reattachable
+        operations hold their whole response buffer until the client
+        RELEASEs them; a client that never does (crashed, lazy) used to
+        pin every result it ever produced for the life of the session.
+        Two bounds, swept opportunistically on every RPC that touches the
+        session: a TTL after finish (``DAFT_TPU_SERVE_OP_TTL``) and a
+        per-session retained-byte budget (``DAFT_TPU_SERVE_OP_RETAIN_BYTES``,
+        newest kept first). Running operations are never swept; a swept
+        operation reattaches as NOT_FOUND, same as an explicit release."""
+        from ..analysis import knobs
+        ttl = knobs.env_float("DAFT_TPU_SERVE_OP_TTL")
+        cap = knobs.env_bytes("DAFT_TPU_SERVE_OP_RETAIN_BYTES")
+        now = time.monotonic()
+        finished = [(op.finished_at, oid, op)
+                    for oid, op in st.operations.items()
+                    if op.done.is_set() and op.finished_at is not None]
+        if ttl and ttl > 0:
+            for t, oid, _op in finished:
+                if now - t > ttl:
+                    st.operations.pop(oid, None)
+        if cap and cap > 0:
+            kept = 0
+            still = sorted(((op.finished_at, oid, op)
+                            for oid, op in st.operations.items()
+                            if op.done.is_set()
+                            and op.finished_at is not None),
+                           key=lambda x: x[0], reverse=True)
+            for _t, oid, op in still:
+                kept += max(op.retained_bytes, 0)
+                if kept > cap:
+                    st.operations.pop(oid, None)
+
     def _abort(self, context, exc: Exception):
+        from ..execution.cancellation import QueryCancelled
+        from ..serving import AdmissionRejected
         grpc = self._grpc
         if isinstance(exc, Unsupported):
             context.abort(grpc.StatusCode.UNIMPLEMENTED,
                           f"unsupported by daft_tpu connect: {exc}")
+        if isinstance(exc, AdmissionRejected):
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                          f"admission rejected ({exc.kind}): {exc}")
+        if isinstance(exc, QueryCancelled):
+            context.abort(grpc.StatusCode.CANCELLED, str(exc))
         context.abort(grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: "
                       f"{exc}")
 
@@ -220,7 +298,8 @@ class SparkConnectServer:
                 gen = self._execute_command(request.plan.command, st, resp)
             else:
                 df = st.analyzer.plan_to_df(request.plan)
-                gen = self._stream_df(df, resp)
+                gen = self._stream_df(df, resp, op=op,
+                                      session_id=request.session_id)
             for r in gen:
                 if op.cancel.is_set():
                     op.finish(error=(self._grpc.StatusCode.CANCELLED,
@@ -238,8 +317,14 @@ class SparkConnectServer:
         except Exception as exc:  # noqa: BLE001 - surfaced via grpc status
             if aborting:  # context.abort's unwind exception — re-raise
                 raise
-            op.finish(error=(self._grpc.StatusCode.INTERNAL,
-                             f"{type(exc).__name__}: {exc}"))
+            from ..execution.cancellation import QueryCancelled
+            from ..serving import AdmissionRejected
+            code = self._grpc.StatusCode.INTERNAL
+            if isinstance(exc, QueryCancelled):
+                code = self._grpc.StatusCode.CANCELLED
+            elif isinstance(exc, AdmissionRejected):
+                code = self._grpc.StatusCode.RESOURCE_EXHAUSTED
+            op.finish(error=(code, f"{type(exc).__name__}: {exc}"))
             self._abort(context, exc)
         finally:
             # covers GeneratorExit (client disconnected mid-stream): a
@@ -275,7 +360,10 @@ class SparkConnectServer:
                    or (request.interrupt_type == T.INTERRUPT_TYPE_TAG
                        and request.operation_tag in op.tags))
             if hit:
-                op.cancel.set()
+                # fires the scheduler handle's CancelToken too: the
+                # running executor unwinds at its next morsel boundary
+                # and releases its memory admission
+                op.request_cancel()
                 out.interrupted_ids.append(op.op_id)
         return out
 
@@ -389,8 +477,20 @@ class SparkConnectServer:
             finish_chunked()
         return out
 
-    def _stream_df(self, df, resp) -> Iterator[pb.ExecutePlanResponse]:
-        table = df.to_arrow()
+    def _stream_df(self, df, resp, op: Optional[_Operation] = None,
+                   session_id: str = "default"
+                   ) -> Iterator[pb.ExecutePlanResponse]:
+        # ExecutePlan routes through the process-shared query scheduler:
+        # every Spark Connect session becomes a serving-plane session
+        # (weighted fair queuing + admission control across clients), and
+        # INTERRUPT cancels the RUNNING query cooperatively through the
+        # handle, not just the response stream
+        from .. import serving
+        handle = serving.shared_scheduler().submit(df, session=session_id)
+        if op is not None:
+            op.bind_cancel(handle.cancel)
+        ps = handle.result()
+        table = ps.to_recordbatch().to_arrow_table()
         first = resp()
         first.schema.CopyFrom(schema_to_proto(df.schema()))
         start = 0

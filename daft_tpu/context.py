@@ -71,6 +71,13 @@ class ExecutionConfig:
     tpu_io_range_parallelism: int = 8        # concurrent range GETs / source
     tpu_io_planned_reads: bool = True        # 0 → naive per-chunk ranged GETs
     tpu_scan_prefetch: int = 2               # ScanTasks resolved ahead
+    # serving plane (serving/scheduler.py); env spellings match the
+    # documented serve knobs (DAFT_TPU_SERVE_CONCURRENCY, …)
+    tpu_serve_concurrency: int = 4           # scheduler worker slots
+    tpu_serve_queue_depth: int = 64          # queued-query cap
+    tpu_serve_queue_timeout: float = 30.0    # queue+admission wait bound (s)
+    tpu_serve_plan_cache_bytes: int = 64 << 20    # compiled-plan LRU budget
+    tpu_serve_result_cache_bytes: int = 64 << 20  # result LRU budget
 
 
 def _exec_config_from_env() -> ExecutionConfig:

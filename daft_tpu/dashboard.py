@@ -45,6 +45,9 @@ def broadcast_query(stats) -> None:
             # lock-order sanitizer (DAFT_TPU_SANITIZE=1): graph size,
             # cycles, per-query contention/blocking events
             "sanitizer": dict(getattr(stats, "sanitizer", {}) or {}),
+            # serving plane: session/priority/queue-wait/admission and
+            # plan/result cache outcomes for scheduler-run queries
+            "serving": dict(getattr(stats, "serving", {}) or {}),
         }
     except Exception:
         return
@@ -53,11 +56,32 @@ def broadcast_query(stats) -> None:
         del _history[:-_MAX_HISTORY]
 
 
+def _serving_view() -> dict:
+    """Live scheduler state for the dashboard (never boots a scheduler,
+    never raises — an idle process just shows an empty view)."""
+    try:
+        from . import serving
+        sched = serving.shared_scheduler_if_running()
+        if sched is None:
+            return {}
+        return sched.live_view()
+    except Exception:
+        return {}
+
+
 class _Handler(http.server.BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
 
     def do_GET(self):
+        if self.path.startswith("/api/serving"):
+            body = json.dumps(_serving_view()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if self.path.startswith("/api/queries"):
             with _history_lock:
                 body = json.dumps(_history).encode()
@@ -67,9 +91,31 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        live = _serving_view()
+        live_html = ""
+        if live:
+            sess = live.get("sessions") or {}
+            sess_rows = "".join(
+                f"<tr><td>{html.escape(str(n))}</td>"
+                f"<td>{s.get('queued')}</td><td>{s.get('weight')}</td></tr>"
+                for n, s in sorted(sess.items()))
+            live_html = (
+                "<h2>serving queue (live)</h2>"
+                f"<p>running {live.get('running', 0)}/"
+                f"{live.get('concurrency', 0)} · queued "
+                f"{live.get('queued', 0)} · admitted "
+                f"{live.get('admitted_bytes', 0)} / "
+                f"{live.get('admission_budget')} bytes</p>"
+                + ("<table border=1><tr><th>session</th><th>queued</th>"
+                   "<th>weight</th></tr>" + sess_rows + "</table>"
+                   if sess_rows else ""))
         rows = []
         with _history_lock:
             for i, q in enumerate(reversed(_history)):
+                srv = q.get("serving") or {}
+                srv_html = ("<p><b>serving:</b> "
+                            + html.escape(json.dumps(srv, default=str))
+                            + "</p>" if srv else "")
                 rec = q.get("recovery") or {}
                 rec_html = ("<p><b>recovery events:</b> "
                             + html.escape(json.dumps(rec)) + "</p>"
@@ -91,10 +137,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                             + "</p>" if san else "")
                 rows.append(
                     f"<h3>query {len(_history) - i} — {q['ts']}</h3>"
-                    f"{rec_html}{shf_html}{io_html}{san_html}"
+                    f"{srv_html}{rec_html}{shf_html}{io_html}{san_html}"
                     f"<pre>{html.escape(q['explain'])}</pre>")
         body = ("<html><head><title>daft-tpu dashboard</title></head><body>"
-                "<h1>daft-tpu queries</h1>"
+                "<h1>daft-tpu queries</h1>" + live_html
                 + ("".join(rows) or "<p>no queries yet</p>")
                 + "</body></html>").encode()
         self.send_response(200)

@@ -318,6 +318,15 @@ def ledger_record(kind: str, *, rows: int = 0, nbytes: float = 0.0,
         d["bytes"] += float(nbytes)
         d["flops"] += float(flops)
         d["seconds"] += float(seconds)
+    # outside the ledger lock: also credit the thread-attributed stats
+    # context (concurrent queries must not read each other's dispatches
+    # out of the shared ledger diff)
+    from .. import observability as obs
+    for field, v in (("dispatches", dispatches), ("rows", rows),
+                     ("bytes", float(nbytes)), ("flops", float(flops)),
+                     ("seconds", float(seconds))):
+        if v:
+            obs.bump_plane("device_kernels", f"{kind}\x00{field}", v)
 
 
 def _derive(d: dict) -> dict:
@@ -353,6 +362,23 @@ def ledger_delta(before: dict, after: dict) -> dict:
         if diff["dispatches"] > 0:
             out[kind] = _derive(diff)
     return out
+
+
+def ledger_from_tallies(flat: dict) -> dict:
+    """Derived per-kind ledger from a context-attributed flat tally
+    (``"<kind>\\x00<field>"`` keys, the shape ``ledger_record`` bumps into
+    a RuntimeStatsContext plane) — same output shape as ``ledger_delta``."""
+    kinds: dict = {}
+    for key, v in flat.items():
+        kind, _, field = key.partition("\x00")
+        if field not in _LEDGER_RAW:
+            continue
+        d = kinds.setdefault(
+            kind, {k: 0 if k in ("dispatches", "rows") else 0.0
+                   for k in _LEDGER_RAW})
+        d[field] = int(v) if field in ("dispatches", "rows") else float(v)
+    return {k: _derive(d) for k, d in kinds.items()
+            if d["dispatches"] > 0}
 
 
 def ledger_reset() -> None:

@@ -33,6 +33,15 @@ from . import column as dcol
 from . import compiler, kernels, runtime
 
 _fused_cache: Dict[Tuple, object] = {}
+_fused_counters: Dict[str, int] = {"hits": 0, "misses": 0}
+
+
+def fused_cache_counters() -> Dict[str, int]:
+    """Fused-agg program cache counters (serving-plane evidence that
+    repeated submissions re-enter previously traced device fragments)."""
+    out = dict(_fused_counters)
+    out["entries"] = len(_fused_cache)
+    return out
 
 # static group-capacity buckets for the packed output block: start tiny —
 # TPC-H-style aggregations produce a handful of groups, and transferred bytes
@@ -86,7 +95,9 @@ def get_fused_agg(group_exprs: List[Expression], child_exprs: List[Expression],
            runtime._schema_key(schema))
     hit = _fused_cache.get(key)
     if hit is not None:
+        _fused_counters["hits"] += 1  # GIL-atomic; approximate under race
         return hit if isinstance(hit, FusedAggProgram) else None
+    _fused_counters["misses"] += 1
     proj = list(group_exprs) + list(child_exprs) + \
         ([predicate] if predicate is not None else [])
     try:

@@ -13,6 +13,7 @@ Controls:
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -101,6 +102,23 @@ def _row_output_profitable(batch, needs_cols, n_outputs: int,
 
 
 _projection_cache: Dict[Tuple, compiler.Compiled] = {}
+# single-flight compile coordination for the serving plane: N concurrent
+# identical cold queries must produce ONE trace/lowering, with the other
+# N-1 waiting on the winner instead of burning N duplicate compiles
+_compile_lock = threading.Lock()
+_compile_inflight: Dict[Tuple, threading.Event] = {}
+_compile_counters: Dict[str, int] = {"hits": 0, "misses": 0, "compiles": 0,
+                                     "waits": 0}
+
+
+def compile_cache_counters() -> Dict[str, int]:
+    """Process-wide projection-compile cache counters (the serving
+    bench's evidence that jitted fragments are reused across
+    submissions)."""
+    with _compile_lock:
+        out = dict(_compile_counters)
+    out["entries"] = len(_projection_cache)
+    return out
 
 
 def _schema_key(schema: Schema) -> Tuple:
@@ -110,16 +128,36 @@ def _schema_key(schema: Schema) -> Tuple:
 def _get_compiled(exprs: List[Expression], schema: Schema
                   ) -> Optional[compiler.Compiled]:
     key = (tuple(e._key() for e in exprs), _schema_key(schema))
-    hit = _projection_cache.get(key)
-    if hit is not None:
-        return hit
+    while True:
+        with _compile_lock:
+            hit = _projection_cache.get(key)
+            if hit is not None:
+                _compile_counters["hits"] += 1
+                return hit
+            ev = _compile_inflight.get(key)
+            if ev is None:
+                _compile_inflight[key] = threading.Event()
+                _compile_counters["misses"] += 1
+                break
+            _compile_counters["waits"] += 1
+        # someone else is compiling this projection — wait, then re-check
+        # (compile failures don't cache, so the loop may compile after all)
+        ev.wait()
     try:
-        c = compiler.compile_projection(exprs, schema)
-    except (compiler.NotCompilable, NotImplementedError, ValueError,
-            TypeError, KeyError, OverflowError):
-        return None
-    _projection_cache[key] = c
-    return c
+        try:
+            c = compiler.compile_projection(exprs, schema)
+        except (compiler.NotCompilable, NotImplementedError, ValueError,
+                TypeError, KeyError, OverflowError):
+            return None
+        with _compile_lock:
+            _projection_cache[key] = c
+            _compile_counters["compiles"] += 1
+        return c
+    finally:
+        with _compile_lock:
+            ev2 = _compile_inflight.pop(key, None)
+        if ev2 is not None:
+            ev2.set()
 
 
 def _string_out_source(e: Expression) -> Optional[str]:

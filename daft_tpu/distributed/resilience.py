@@ -109,6 +109,10 @@ _counters: Dict[str, int] = {}
 def count(name: str, n: int = 1) -> None:
     with _counters_lock:
         _counters[name] = _counters.get(name, 0) + n
+    # context-local attribution for the serving plane (overlapping
+    # queries each see only their own recovery events)
+    from .. import observability as obs
+    obs.bump_plane("recovery", name, n)
 
 
 def counters_snapshot() -> Dict[str, int]:

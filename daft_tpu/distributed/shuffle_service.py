@@ -51,6 +51,10 @@ _shuffle_counters: Dict[str, float] = {}
 def shuffle_count(name: str, n: float = 1) -> None:
     with _shuffle_counters_lock:
         _shuffle_counters[name] = _shuffle_counters.get(name, 0) + n
+    # context-local attribution for the serving plane (overlapping
+    # queries each see only their own shuffle traffic)
+    from .. import observability as obs
+    obs.bump_plane("shuffle", name, n)
 
 
 def shuffle_counters_snapshot() -> Dict[str, float]:

@@ -67,6 +67,7 @@ def _ordered_parallel(inputs: Iterator, fn: Callable,
                       width: Optional[int] = None) -> Iterator:
     """Map fn over inputs on the pool, yielding results in order with a
     bounded in-flight window (backpressure)."""
+    from .. import observability as obs
     width = width or max((os.cpu_count() or 4), 4) * 2
     pool = _pool()
     pending: List[cf.Future] = []
@@ -79,7 +80,11 @@ def _ordered_parallel(inputs: Iterator, fn: Callable,
             except StopIteration:
                 done = True
                 break
-            pending.append(pool.submit(fn, x))
+            # carry the submitting thread's stats attribution onto the
+            # pool worker: shared-plane counters bumped inside fn must
+            # credit the query this morsel belongs to
+            pending.append(pool.submit(
+                obs.run_attributed, obs.current_attribution(), fn, x))
         if not pending:
             return
         yield pending.pop(0).result()
@@ -89,9 +94,13 @@ class LocalExecutor:
     """Interprets a physical plan into a stream of MicroPartitions."""
 
     def __init__(self):
-        from . import memory
+        from . import cancellation, memory
         self.cfg = get_context().execution_config
         self.stats = None
+        # cooperative cancellation: the serving scheduler installs the
+        # query's token on the submitting thread (cancel_scope); capture
+        # it here so it rides the executor instance into stage threads
+        self.cancel_token = cancellation.current_token()
         # bounds bytes of scan tasks materializing concurrently
         self.mem = memory.MemoryManager()
         # stage-input bindings for distributed stage fragments
@@ -122,9 +131,29 @@ class LocalExecutor:
 
         def gen():
             xtrace = obs._XplaneTrace(xdir) if xdir else None
+            tok = self.cancel_token
+            it = None
             try:
-                yield from obs.wrap_progress(self._exec(plan))
+                # every pull at this boundary runs with this query's
+                # stats context attributed on the consumer thread, so
+                # shared-plane counters (scan io, shuffle, recovery)
+                # credit THIS query even when others run concurrently;
+                # the token check bounds cancel latency to one morsel
+                with obs.attributed(self.stats):
+                    it = obs.wrap_progress(self._exec(plan))
+                while True:
+                    if tok is not None:
+                        tok.check()
+                    with obs.attributed(self.stats):
+                        try:
+                            item = next(it)
+                        except StopIteration:
+                            break
+                    yield item
             finally:
+                if it is not None and hasattr(it, "close"):
+                    with obs.attributed(self.stats):
+                        it.close()  # producer cleanup counts here too
                 if xtrace is not None:
                     xtrace.stop()
                 self.stats.finish()
@@ -328,7 +357,9 @@ class LocalExecutor:
             except StopIteration:
                 return False
             st = _Stream()
-            pool.submit(produce, t, st)
+            from .. import observability as obs
+            pool.submit(obs.run_attributed, obs.current_attribution(),
+                        produce, t, st)
             inflight.append(st)
             rp.scan_count("prefetch_tasks")
             return True

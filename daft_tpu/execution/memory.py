@@ -73,6 +73,40 @@ class MemoryManager:
                 self._cond.wait()
             self._held += nbytes
 
+    def try_acquire(self, nbytes: int, deadline: Optional[float] = None,
+                    cancel=None) -> bool:
+        """Admission-control variant: wait until the request fits, the
+        monotonic ``deadline`` passes, or ``cancel`` (a CancelToken /
+        Event-like with ``is_set``) fires. Returns True iff the bytes
+        were admitted — the serving scheduler's admit/queue/reject
+        decision rides on this, so unlike :meth:`acquire` it never waits
+        forever. The single-huge-request rule is unchanged: a request
+        larger than the whole budget is admitted when nothing else is in
+        flight (it can spill), but only a *growing* wait is bounded."""
+        import time as _time
+        if self.budget is None:
+            return True
+        with self._cond:
+            while self._held > 0 and self._held + nbytes > self.budget:
+                if cancel is not None and cancel.is_set():
+                    return False
+                timeout = 0.1  # poll so a cancel fires within ~100ms
+                if deadline is not None:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    timeout = min(timeout, remaining)
+                self._cond.wait(timeout)
+            self._held += nbytes
+            return True
+
+    @property
+    def outstanding(self) -> int:
+        """Currently-admitted bytes (0 when unbudgeted) — the serving
+        bench's leak invariant: this must return to zero after drain."""
+        with self._cond:
+            return self._held if self.budget is not None else 0
+
     def release(self, nbytes: int):
         if self.budget is None:
             return

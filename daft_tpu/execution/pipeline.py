@@ -64,6 +64,9 @@ class PipelineContext:
         self.error: Optional[BaseException] = None
         self._lock = threading.Lock()
         self.threads: List[threading.Thread] = []
+        # the owning query's RuntimeStatsContext: installed on every
+        # stage thread so shared-plane counters attribute to this query
+        self.stats_ctx = None
 
     def fail(self, exc: BaseException):
         with self._lock:
@@ -83,8 +86,10 @@ class PipelineContext:
         return t
 
     def _guard(self, fn):
+        from .. import observability as obs
         try:
-            fn()
+            with obs.attributed(self.stats_ctx):
+                fn()
         except PipelineCancelled:
             pass
         except BaseException as exc:  # noqa: BLE001 — first error wins
@@ -298,9 +303,16 @@ class PushExecutor(LocalExecutor):
         if stage_inputs:
             self.stage_inputs = stage_inputs
         from .. import observability as obs
+        from . import cancellation as _cxl
         self.stats = obs.new_query_stats()
         self.stats.plan = plan
+        self.pipe.stats_ctx = self.stats
         xdir = obs.xplane_trace_dir()
+        tok = self.cancel_token
+        if tok is not None:
+            # a fired token must unblock EVERY stage (channels poll the
+            # pipeline's cancel event), not just the driver loop
+            tok.add_callback(self.pipe.cancel)
 
         def gen():
             xtrace = obs._XplaneTrace(xdir) if xdir else None
@@ -308,12 +320,16 @@ class PushExecutor(LocalExecutor):
                 out = self._exec(plan)
                 while True:
                     try:
-                        mp = next(out)
+                        with obs.attributed(self.stats):
+                            mp = next(out)
                     except StopIteration:
                         break
                     except PipelineCancelled:
                         break
                     yield mp
+                if tok is not None and tok.is_set():
+                    raise _cxl.QueryCancelled(
+                        tok.reason or "query cancelled")
                 if self.pipe.error is not None:
                     raise self.pipe.error
             finally:

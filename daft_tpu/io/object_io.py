@@ -73,12 +73,18 @@ def parallel_get_ranges(source: "ObjectSource", path: str,
     pending = {}
     err: List[BaseException] = []
 
+    from .. import observability as obs
+    attr_ctx = obs.current_attribution()
+
     def submit():
         try:
             i, r = next(it)
         except StopIteration:
             return
-        pending[pool.submit(source.get, path, r, stats)] = i
+        # IO-pool workers inherit the submitting query's stats
+        # attribution so per-query io counters stay scoped
+        pending[pool.submit(obs.run_attributed, attr_ctx,
+                            source.get, path, r, stats)] = i
 
     for _ in range(min(par, len(ranges))):
         submit()

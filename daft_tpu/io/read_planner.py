@@ -34,6 +34,10 @@ _counters: Dict[str, float] = {}
 def scan_count(name: str, n: float = 1) -> None:
     with _counters_lock:
         _counters[name] = _counters.get(name, 0) + n
+    # also credit the thread's attributed query context (serving plane:
+    # two overlapping queries must not read each other's io counters)
+    from .. import observability as obs
+    obs.bump_plane("io", name, n)
 
 
 def scan_counters_snapshot() -> Dict[str, float]:
@@ -66,15 +70,12 @@ class _ScanIOStats(IOStatsContext):
 
     def record_get(self, nbytes: int):
         super().record_get(nbytes)
-        with _counters_lock:
-            _counters["gets"] = _counters.get("gets", 0) + 1
-            _counters["bytes_fetched"] = \
-                _counters.get("bytes_fetched", 0) + nbytes
+        scan_count("gets")
+        scan_count("bytes_fetched", nbytes)
 
     def record_list(self):
         super().record_list()
-        with _counters_lock:
-            _counters["lists"] = _counters.get("lists", 0) + 1
+        scan_count("lists")
 
 
 #: process-wide stats context threaded through planner / fetch / scan reads

@@ -16,6 +16,7 @@ Env flags (same spirit as the reference's ``DAFT_DEV_*``):
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -23,6 +24,53 @@ import time
 from typing import Dict, List, Optional
 
 _START_TS = time.perf_counter()
+
+
+# ------------------------------------------------------------ attribution
+#
+# Process-wide planes (shuffle / scan-io / recovery) are shared counters;
+# diffing them per query breaks the moment two queries overlap (both diffs
+# see the union). The serving plane needs per-query numbers, so counter
+# chokepoints ALSO bump the thread's *attributed* RuntimeStatsContext:
+# executors install their stats context on every thread that does work for
+# the query (driver generators, pool workers, pipeline stages, IO fan-out),
+# and `finish()` prefers the context-local tally over the process diff
+# whenever the context was attributed at all.
+
+_attr_tl = threading.local()
+
+
+def current_attribution() -> Optional["RuntimeStatsContext"]:
+    return getattr(_attr_tl, "ctx", None)
+
+
+@contextlib.contextmanager
+def attributed(ctx: Optional["RuntimeStatsContext"]):
+    """Install ``ctx`` as this thread's stats-attribution target."""
+    prev = getattr(_attr_tl, "ctx", None)
+    _attr_tl.ctx = ctx
+    if ctx is not None:
+        ctx._attributed = True
+    try:
+        yield
+    finally:
+        _attr_tl.ctx = prev
+
+
+def run_attributed(ctx, fn, *args, **kwargs):
+    """Run ``fn`` with ``ctx`` attributed — the shape pool-submit sites
+    use to carry the submitting thread's attribution onto the worker."""
+    with attributed(ctx):
+        return fn(*args, **kwargs)
+
+
+def bump_plane(plane: str, key: str, n: float = 1) -> None:
+    """Credit ``n`` to the attributed context's plane tally (no-op when
+    the thread is unattributed — the process-wide counter the caller
+    already bumped remains the only record, as before)."""
+    ctx = current_attribution()
+    if ctx is not None:
+        ctx._bump(plane, key, n)
 
 
 def _now_us() -> int:
@@ -188,6 +236,25 @@ class RuntimeStatsContext:
         # per-query acquisition/contention deltas + current graph size
         self._sanitizer0 = _sanitizer_raw()
         self.sanitizer: Dict[str, float] = {}
+        # context-local plane tallies (shuffle/io/recovery): counter
+        # chokepoints bump these through the thread attribution installed
+        # by the executors; finish() prefers them over the process diffs
+        # so two overlapping queries don't read each other's counters
+        self._plane_lock = threading.Lock()
+        self._planes: Dict[str, Dict[str, float]] = {}
+        self._attributed = False
+        # serving-plane block (queue wait, admission, cache hits) — set
+        # by the query scheduler for queries it ran; empty otherwise
+        self.serving: Dict[str, object] = {}
+
+    def _bump(self, plane: str, key: str, n: float) -> None:
+        with self._plane_lock:
+            d = self._planes.setdefault(plane, {})
+            d[key] = d.get(key, 0) + n
+
+    def _plane(self, plane: str) -> Dict[str, float]:
+        with self._plane_lock:
+            return dict(self._planes.get(plane, {}))
 
     def register(self, node) -> OperatorStats:
         key = id(node)
@@ -220,30 +287,45 @@ class RuntimeStatsContext:
 
     def finish(self):
         self.wall_us = int((time.perf_counter() - self._t0) * 1_000_000)
+        # scoped attribution beats the process-wide diff: an attributed
+        # context's tallies contain exactly this query's events even when
+        # other queries ran concurrently. Unattributed contexts (e.g. the
+        # distributed runner's driver-level context, whose counters come
+        # from worker/fetch threads) keep the legacy diff semantics.
         try:
             from .device import costmodel
-            self.device_kernels = costmodel.ledger_delta(
-                self._ledger0, _ledger_raw())
+            if self._attributed:
+                self.device_kernels = costmodel.ledger_from_tallies(
+                    self._plane("device_kernels"))
+            else:
+                self.device_kernels = costmodel.ledger_delta(
+                    self._ledger0, _ledger_raw())
         except Exception:
             self.device_kernels = {}
-        try:
-            from .distributed import resilience
-            self.recovery = resilience.counters_delta(
-                self._recovery0, _recovery_raw())
-        except Exception:
-            self.recovery = {}
-        try:
-            from .distributed import shuffle_service
-            self.shuffle = shuffle_service.shuffle_counters_delta(
-                self._shuffle0, _shuffle_raw())
-        except Exception:
-            self.shuffle = {}
-        try:
-            from .io import read_planner
-            self.io = read_planner.scan_counters_delta(
-                self._io0, _scan_io_raw())
-        except Exception:
-            self.io = {}
+        if self._attributed:
+            self.recovery = {k: int(v)
+                             for k, v in self._plane("recovery").items()}
+            self.shuffle = self._plane("shuffle")
+            self.io = self._plane("io")
+        else:
+            try:
+                from .distributed import resilience
+                self.recovery = resilience.counters_delta(
+                    self._recovery0, _recovery_raw())
+            except Exception:
+                self.recovery = {}
+            try:
+                from .distributed import shuffle_service
+                self.shuffle = shuffle_service.shuffle_counters_delta(
+                    self._shuffle0, _shuffle_raw())
+            except Exception:
+                self.shuffle = {}
+            try:
+                from .io import read_planner
+                self.io = read_planner.scan_counters_delta(
+                    self._io0, _scan_io_raw())
+            except Exception:
+                self.io = {}
         try:
             from .analysis import lock_sanitizer
             self.sanitizer = lock_sanitizer.counters_delta(
@@ -311,6 +393,7 @@ class RuntimeStatsContext:
         lines.extend(render_shuffle_block(self.shuffle))
         lines.extend(render_io_block(self.io))
         lines.extend(render_sanitizer_block(self.sanitizer))
+        lines.extend(render_serving_block(self.serving))
         return "\n".join(lines)
 
     def as_dict(self) -> Dict[str, dict]:
@@ -405,6 +488,26 @@ def render_io_block(d: Dict[str, float]) -> List[str]:
     if misses or falls:
         lines.append(f"  planner: {misses} miss GETs, "
                      f"{falls} whole-file fallbacks")
+    return lines
+
+
+def render_serving_block(s: Dict[str, object]) -> List[str]:
+    """Human lines for one query's serving-plane record (shared by
+    ``explain(analyze=True)`` and the dashboard; set only for queries run
+    through the query scheduler): which session/priority it ran as, how
+    long it queued, what the admission controller charged it, and whether
+    the plan/result caches served it."""
+    if not s:
+        return []
+    lines = ["serving (query scheduler):"]
+    lines.append(
+        f"  session={s.get('session')} priority={s.get('priority', 0)} "
+        f"queue_wait={float(s.get('queue_wait_us', 0)) / 1e3:.1f}ms "
+        f"admitted={_fmt_bytes(float(s.get('admitted_bytes', 0)))} "
+        f"(running={int(s.get('running_at_admit', 0))} at admit)")
+    lines.append(
+        f"  plan cache: {s.get('plan_cache', 'off')}, "
+        f"result cache: {s.get('result_cache', 'off')}")
     return lines
 
 
@@ -509,10 +612,19 @@ def new_query_stats() -> RuntimeStatsContext:
     return RuntimeStatsContext(tracer)
 
 
+_tl_last = threading.local()
+
+
 def set_last_stats(ctx: RuntimeStatsContext):
     global _last_stats
     with _last_lock:
         _last_stats = ctx
+    # per-thread record too: under the serving plane N queries finish
+    # concurrently and the GLOBAL last-stats slot is whichever finished
+    # last — each scheduler worker reads its own query's context back via
+    # last_query_stats_local() (the executor's finish runs on the thread
+    # that drained it)
+    _tl_last.stats = ctx
     # feed the dashboard when it's up (reference: broadcast_query_plan hook)
     from . import dashboard
     if dashboard._server is not None:
@@ -601,6 +713,13 @@ def last_query_stats() -> Optional[RuntimeStatsContext]:
     """Stats of the most recent execution in this process."""
     with _last_lock:
         return _last_stats
+
+
+def last_query_stats_local() -> Optional[RuntimeStatsContext]:
+    """Stats of the most recent execution drained on THIS thread (nested
+    executions overwrite it in completion order, so after a top-level
+    drain this is the outermost query's context)."""
+    return getattr(_tl_last, "stats", None)
 
 
 def wrap_progress(it, desc: str = "partitions"):

@@ -1,0 +1,635 @@
+"""Multi-tenant query scheduler: the serving plane's control loop.
+
+One process, N concurrent queries, shared engine resources. The pieces:
+
+- **bounded worker pool** — ``DAFT_TPU_SERVE_CONCURRENCY`` workers drain
+  a multi-session queue; everything else (executor thread pools, device,
+  HBM cache, spill dirs) is the same shared engine the single-query path
+  uses.
+- **fair queuing** — weighted round-robin across sessions via stride
+  scheduling (each dispatch advances the session's virtual ``pass`` by
+  ``1/weight``; the non-empty session with the smallest pass goes next),
+  FIFO within a session, higher ``priority`` classes always first.
+- **admission control** — each query declares an estimated footprint from
+  the cost model (``logical/stats.estimate``) and is admitted against a
+  shared :class:`~daft_tpu.execution.memory.MemoryManager` byte budget
+  (``DAFT_TPU_SERVE_MEMORY``, default: the engine memory limit, else the
+  breaker budget) so concurrent queries can't OOM each other: it runs
+  when admitted, waits while others drain, and fails with a structured
+  :class:`AdmissionRejected` when the queue is full, the queue timeout
+  passes, or it could never fit.
+- **plan/result caches** — see ``serving/caches.py``; consulted per
+  submission, keyed by the logical-plan fingerprint.
+- **cooperative cancellation** — every query carries a
+  :class:`~daft_tpu.execution.cancellation.CancelToken` threaded into the
+  executor pipelines; ``QueryHandle.cancel()`` (or a Spark Connect
+  INTERRUPT) unwinds it at the next morsel boundary and releases its
+  admission.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..execution.cancellation import CancelToken, QueryCancelled, cancel_scope
+from ..execution.memory import MemoryManager, breaker_budget_bytes, \
+    memory_limit_bytes
+from .caches import PlanCache, ResultCache
+
+_DEFAULT_EST_BYTES = 64 << 20  # footprint guess when the cost model is blind
+_MIN_EST_BYTES = 1 << 20
+
+
+class AdmissionRejected(RuntimeError):
+    """Structured admission failure. ``kind`` is one of ``queue_full``,
+    ``queue_timeout``, ``memory``, ``shutdown``."""
+
+    def __init__(self, kind: str, message: str,
+                 est_bytes: Optional[int] = None,
+                 budget: Optional[int] = None,
+                 waited_s: float = 0.0):
+        super().__init__(message)
+        self.kind = kind
+        self.est_bytes = est_bytes
+        self.budget = budget
+        self.waited_s = waited_s
+
+
+# ------------------------------------------------------------------ knobs
+
+def _knob_int(name: str, cfg_field: str, default: int) -> int:
+    from ..analysis import knobs
+    v = knobs.env_int(name, default=None)
+    if v is not None:
+        return v
+    try:
+        from ..context import get_context
+        return int(getattr(get_context().execution_config, cfg_field))
+    except Exception:
+        return default
+
+
+def _knob_float(name: str, cfg_field: str, default: float) -> float:
+    from ..analysis import knobs
+    v = knobs.env_float(name, default=None)
+    if v is not None:
+        return v
+    try:
+        from ..context import get_context
+        return float(getattr(get_context().execution_config, cfg_field))
+    except Exception:
+        return default
+
+
+def serve_concurrency() -> int:
+    return max(_knob_int("DAFT_TPU_SERVE_CONCURRENCY",
+                         "tpu_serve_concurrency", 4), 1)
+
+
+def serve_queue_depth() -> int:
+    return max(_knob_int("DAFT_TPU_SERVE_QUEUE_DEPTH",
+                         "tpu_serve_queue_depth", 64), 1)
+
+
+def serve_queue_timeout_s() -> float:
+    return _knob_float("DAFT_TPU_SERVE_QUEUE_TIMEOUT",
+                       "tpu_serve_queue_timeout", 30.0)
+
+
+def _knob_bytes(name: str, cfg_field: str, default: int) -> int:
+    from ..analysis import knobs
+    v = knobs.env_bytes(name, default=None)
+    if v is not None:
+        return v
+    try:
+        from ..context import get_context
+        return int(getattr(get_context().execution_config, cfg_field))
+    except Exception:
+        return default
+
+
+def serve_plan_cache_bytes() -> int:
+    return _knob_bytes("DAFT_TPU_SERVE_PLAN_CACHE_BYTES",
+                       "tpu_serve_plan_cache_bytes", 64 << 20)
+
+
+def serve_result_cache_bytes() -> int:
+    return _knob_bytes("DAFT_TPU_SERVE_RESULT_CACHE_BYTES",
+                       "tpu_serve_result_cache_bytes", 64 << 20)
+
+
+def serve_memory_budget() -> Optional[int]:
+    from ..analysis import knobs
+    v = knobs.env_bytes("DAFT_TPU_SERVE_MEMORY", default=None)
+    if v is not None:
+        return v or None  # 0 = unbudgeted admission
+    lim = memory_limit_bytes()
+    if lim is not None:
+        return lim
+    return breaker_budget_bytes()
+
+
+# ------------------------------------------------------------------ handle
+
+class QueryHandle:
+    """Client-side view of one submitted query."""
+
+    def __init__(self, scheduler: "QueryScheduler", session: str,
+                 priority: int):
+        self._scheduler = scheduler
+        self.session = session
+        self.priority = priority
+        self.token = CancelToken()
+        self._done = threading.Event()
+        self._state_lock = threading.Lock()
+        self.state = "queued"      # queued|running|done|failed|cancelled|
+        #                            rejected
+        self._result = None        # PartitionSet on success
+        self._error: Optional[BaseException] = None
+        self.stats = None          # RuntimeStatsContext (when executed)
+        self.submitted_at = time.monotonic()
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+
+    # -- completion (scheduler-side) -----------------------------------
+    def _finish(self, state: str, result=None,
+                error: Optional[BaseException] = None, stats=None) -> None:
+        with self._state_lock:
+            if self._done.is_set():
+                return
+            self.state = state
+            self._result = result
+            self._error = error
+            if stats is not None:
+                self.stats = stats
+            self.finished_at = time.monotonic()
+            self._done.set()
+
+    def _mark_running(self) -> None:
+        with self._state_lock:
+            if not self._done.is_set():
+                self.state = "running"
+                self.started_at = time.monotonic()
+
+    # -- client api ----------------------------------------------------
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def queue_wait_s(self) -> float:
+        start = self.started_at if self.started_at is not None \
+            else self.finished_at
+        if start is None:
+            return time.monotonic() - self.submitted_at
+        return max(start - self.submitted_at, 0.0)
+
+    def cancel(self, reason: Optional[str] = None) -> None:
+        """Cooperative cancel: a queued query leaves the queue now; a
+        running one unwinds at its next morsel boundary."""
+        self.token.set(reason or "cancelled by client")
+        self._scheduler._cancel_queued(self)
+
+    def result(self, timeout: Optional[float] = None):
+        """The query's PartitionSet; raises the query's failure,
+        AdmissionRejected, or QueryCancelled."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("query still pending")
+        if self.state == "done":
+            return self._result
+        if self._error is not None:
+            raise self._error
+        raise QueryCancelled(self.token.reason or "query cancelled")
+
+
+#: seconds an EMPTY session queue survives before the sweep drops it.
+#: Sessions are keyed by client-supplied names (Spark Connect mints a
+#: fresh UUID per client session), so without a bound the scheduler's
+#: session dict grows for the life of the process; pass/weight memory
+#: older than this horizon is fairness-irrelevant (a re-entering session
+#: starts at the current minimum pass either way).
+_SESSION_IDLE_TTL_S = 60.0
+
+
+class _SessionQ:
+    __slots__ = ("weight", "pass_", "queues", "idle_since")
+
+    def __init__(self, weight: float):
+        self.weight = max(float(weight), 1e-6)
+        self.pass_ = 0.0
+        self.idle_since: Optional[float] = None
+        # priority → FIFO of QueryHandle (higher priority served first)
+        self.queues: Dict[int, collections.deque] = {}
+
+    def depth(self) -> int:
+        return sum(len(d) for d in self.queues.values())
+
+
+# ---------------------------------------------------------------- scheduler
+
+class QueryScheduler:
+    """Admits N concurrent queries against shared engine resources."""
+
+    def __init__(self, concurrency: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 queue_timeout_s: Optional[float] = None,
+                 memory_budget: Optional[int] = None,
+                 plan_cache_bytes: Optional[int] = None,
+                 result_cache_bytes: Optional[int] = None):
+        self.concurrency = concurrency or serve_concurrency()
+        self.queue_depth = queue_depth or serve_queue_depth()
+        self.queue_timeout_s = queue_timeout_s \
+            if queue_timeout_s is not None else serve_queue_timeout_s()
+        budget = memory_budget if memory_budget is not None \
+            else serve_memory_budget()
+        self.admission = MemoryManager(budget)
+        if not budget:
+            # an explicit 0/None means admission is DISABLED — don't let
+            # MemoryManager's own default fall back to the engine limit
+            self.admission.budget = None
+        self.plan_cache = PlanCache(
+            plan_cache_bytes if plan_cache_bytes is not None
+            else serve_plan_cache_bytes())
+        self.result_cache = ResultCache(
+            result_cache_bytes if result_cache_bytes is not None
+            else serve_result_cache_bytes())
+        self._cond = threading.Condition()
+        self._sessions: "collections.OrderedDict[str, _SessionQ]" = \
+            collections.OrderedDict()
+        self._deadlines: Dict[QueryHandle, Optional[float]] = {}
+        self._est: Dict[QueryHandle, int] = {}
+        self._builders: Dict[QueryHandle, object] = {}
+        self._n_queued = 0
+        self._n_running = 0
+        self._shutdown = False
+        self._counts_lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._threads: List[threading.Thread] = []
+        for i in range(self.concurrency):
+            t = threading.Thread(target=self._worker_loop,
+                                 name=f"daft-tpu-serve-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._sweep_loop,
+                             name="daft-tpu-serve-sweep", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # ------------------------------------------------------------ counters
+    def _count(self, name: str, n: float = 1) -> None:
+        with self._counts_lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def counters_snapshot(self) -> Dict[str, float]:
+        with self._counts_lock:
+            out = dict(self._counters)
+        out.update({f"plan_cache_{k}": v
+                    for k, v in self.plan_cache.stats().items()})
+        out.update({f"result_cache_{k}": v
+                    for k, v in self.result_cache.stats().items()})
+        out["admitted_bytes_outstanding"] = self.admission.outstanding
+        return out
+
+    def live_view(self) -> Dict[str, object]:
+        """Current queue/admission state for the dashboard."""
+        with self._cond:
+            sessions = {name: {"queued": s.depth(),
+                               "weight": s.weight,
+                               "pass": round(s.pass_, 3)}
+                        for name, s in self._sessions.items() if s.depth()}
+            queued, running = self._n_queued, self._n_running
+        return {"queued": queued, "running": running,
+                "concurrency": self.concurrency,
+                "sessions": sessions,
+                "admitted_bytes": self.admission.outstanding,
+                "admission_budget": self.admission.budget,
+                "counters": self.counters_snapshot()}
+
+    # -------------------------------------------------------------- submit
+    def submit(self, query, session: str = "default", priority: int = 0,
+               weight: Optional[float] = None,
+               timeout_s: Optional[float] = None,
+               est_bytes: Optional[int] = None) -> QueryHandle:
+        """Enqueue a DataFrame / LogicalPlanBuilder. Always returns a
+        handle; a rejection (queue full / timeout / too big) completes
+        the handle with :class:`AdmissionRejected`."""
+        builder = getattr(query, "_builder", None) or query
+        h = QueryHandle(self, session, priority)
+        if timeout_s is None:
+            timeout_s = self.queue_timeout_s
+        deadline = (time.monotonic() + timeout_s) if timeout_s and \
+            timeout_s > 0 else None
+        # the cost-model estimate may do real IO (remote parquet footer
+        # reads materializing scan tasks) — it must never run under the
+        # scheduler condition, which every worker/sweep/dashboard pull
+        # also needs
+        if est_bytes is None:
+            est_bytes = self._estimate_bytes(builder)
+        with self._cond:
+            self._count("submitted")
+            if self._shutdown:
+                h._finish("rejected", error=AdmissionRejected(
+                    "shutdown", "scheduler is shut down"))
+                self._count("rejected_shutdown")
+                return h
+            if self._n_queued >= self.queue_depth:
+                h._finish("rejected", error=AdmissionRejected(
+                    "queue_full",
+                    f"serving queue is full ({self.queue_depth} deep)"))
+                self._count("rejected_queue_full")
+                return h
+            s = self._sessions.get(session)
+            if s is None:
+                s = self._sessions[session] = _SessionQ(weight or 1.0)
+            if weight is not None:
+                s.weight = max(float(weight), 1e-6)
+            if s.depth() == 0:
+                # re-entering session starts at the current minimum pass:
+                # idle time must not bank a burst of turns
+                active = [t.pass_ for t in self._sessions.values()
+                          if t.depth() > 0]
+                if active:
+                    s.pass_ = max(s.pass_, min(active))
+            s.idle_since = None
+            s.queues.setdefault(priority, collections.deque()).append(h)
+            self._deadlines[h] = deadline
+            self._est[h] = est_bytes
+            self._builders[h] = builder
+            self._n_queued += 1
+            # notify_all, not notify: the sweep thread waits on the same
+            # condition — waking only it would leave the query undispatched
+            # until a worker's 1s timed wait expires
+            self._cond.notify_all()
+        return h
+
+    def _estimate_bytes(self, builder) -> int:
+        try:
+            from ..logical import stats as lstats
+            est = lstats.estimate(builder.plan).size_bytes
+        except Exception:
+            est = None
+        if est is None:
+            return _DEFAULT_EST_BYTES
+        return max(int(est), _MIN_EST_BYTES)
+
+    # ----------------------------------------------------------- dispatch
+    def _pick_locked(self) -> Optional[QueryHandle]:
+        best_prio = None
+        for s in self._sessions.values():
+            for prio, dq in s.queues.items():
+                if dq and (best_prio is None or prio > best_prio):
+                    best_prio = prio
+        if best_prio is None:
+            return None
+        best_s = None
+        for s in self._sessions.values():
+            dq = s.queues.get(best_prio)
+            if dq and (best_s is None or s.pass_ < best_s.pass_):
+                best_s = s
+        h = best_s.queues[best_prio].popleft()
+        best_s.pass_ += 1.0 / best_s.weight
+        self._n_queued -= 1
+        return h
+
+    def _sweep_expired_locked(self) -> None:
+        now = time.monotonic()
+        for s in self._sessions.values():
+            for dq in s.queues.values():
+                kept = [h for h in dq
+                        if not self._expire_locked(h, now)]
+                if len(kept) != len(dq):
+                    dq.clear()
+                    dq.extend(kept)
+        self._n_queued = sum(s.depth() for s in self._sessions.values())
+        # drop sessions that have sat empty past the idle TTL — session
+        # names are client-minted (one UUID per Connect session), so an
+        # unbounded dict here is a slow leak on the process-shared
+        # scheduler and a linear cost on every dispatch
+        drop = []
+        for name, s in self._sessions.items():
+            if s.depth() > 0:
+                s.idle_since = None
+            elif s.idle_since is None:
+                s.idle_since = now
+            elif now - s.idle_since > _SESSION_IDLE_TTL_S:
+                drop.append(name)
+        for name in drop:
+            del self._sessions[name]
+
+    def _expire_locked(self, h: QueryHandle, now: float) -> bool:
+        if h.token.is_set():
+            h._finish("cancelled")
+            self._count("cancelled")
+            self._cleanup(h)
+            return True
+        dl = self._deadlines.get(h)
+        if dl is not None and now > dl:
+            h._finish("rejected", error=AdmissionRejected(
+                "queue_timeout",
+                f"queued {now - h.submitted_at:.1f}s > queue timeout",
+                waited_s=now - h.submitted_at))
+            self._count("rejected_queue_timeout")
+            self._cleanup(h)
+            return True
+        return False
+
+    def _earliest_wait_locked(self) -> Optional[float]:
+        dls = [self._deadlines[h]
+               for s in self._sessions.values()
+               for dq in s.queues.values() for h in dq
+               if self._deadlines.get(h) is not None]
+        if not dls:
+            return None
+        return max(min(dls) - time.monotonic(), 0.05)
+
+    def _cleanup(self, h: QueryHandle) -> None:
+        self._deadlines.pop(h, None)
+        self._est.pop(h, None)
+        self._builders.pop(h, None)
+
+    def _cancel_queued(self, h: QueryHandle) -> None:
+        with self._cond:
+            for s in self._sessions.values():
+                dq = s.queues.get(h.priority)
+                if dq and h in dq:
+                    dq.remove(h)
+                    self._n_queued -= 1
+                    h._finish("cancelled")
+                    self._count("cancelled")
+                    self._cleanup(h)
+                    self._cond.notify_all()
+                    return
+
+    def _next(self):
+        with self._cond:
+            while True:
+                if self._shutdown:
+                    return None
+                self._sweep_expired_locked()
+                h = self._pick_locked()
+                if h is not None:
+                    est = self._est.pop(h, _DEFAULT_EST_BYTES)
+                    builder = self._builders.pop(h, None)
+                    self._deadlines.pop(h, None)
+                    return h, est, builder
+                self._cond.wait(self._earliest_wait_locked() or 1.0)
+
+    def _sweep_loop(self) -> None:
+        """Expire queued entries even when every worker is busy — a
+        queue timeout must fire on time, not at the next dispatch."""
+        with self._cond:
+            while not self._shutdown:
+                self._sweep_expired_locked()
+                self._cond.wait(self._earliest_wait_locked() or 1.0)
+
+    # -------------------------------------------------------------- worker
+    def _worker_loop(self) -> None:
+        while True:
+            item = self._next()
+            if item is None:
+                return
+            h, est, builder = item
+            self._run_query(h, est, builder)
+
+    def _run_query(self, h: QueryHandle, est: int, builder) -> None:
+        from .. import observability as obs
+        if h.token.is_set():
+            h._finish("cancelled")
+            self._count("cancelled")
+            return
+        budget = self.admission.budget
+        if budget is not None and est > budget:
+            h._finish("rejected", error=AdmissionRejected(
+                "memory",
+                f"estimated footprint {est} exceeds the serving "
+                f"admission budget {budget}", est_bytes=est, budget=budget))
+            self._count("rejected_memory")
+            return
+        # block in admission until the footprint fits; the queue deadline
+        # already elapsed into queue wait, so bound this by the same
+        # timeout from NOW (a query admitted late should still run)
+        adm_deadline = time.monotonic() + self.queue_timeout_s \
+            if self.queue_timeout_s and self.queue_timeout_s > 0 else None
+        if not self.admission.try_acquire(est, adm_deadline, h.token):
+            if h.token.is_set():
+                h._finish("cancelled")
+                self._count("cancelled")
+            else:
+                h._finish("rejected", error=AdmissionRejected(
+                    "queue_timeout",
+                    f"admission wait exceeded the queue timeout "
+                    f"({self.queue_timeout_s}s) for {est} bytes",
+                    est_bytes=est, budget=budget,
+                    waited_s=time.monotonic() - h.submitted_at))
+                self._count("rejected_queue_timeout")
+            return
+        with self._cond:
+            self._n_running += 1
+            running_at_admit = self._n_running
+        h._mark_running()
+        queue_wait_us = int(h.queue_wait_s * 1e6)
+        try:
+            with cancel_scope(h.token):
+                ps, stats, info = self._execute(h, builder)
+            info.update({
+                "session": h.session, "priority": h.priority,
+                "queue_wait_us": queue_wait_us, "admitted_bytes": est,
+                "running_at_admit": running_at_admit})
+            if stats is None:
+                # result-cache hit: no execution happened — synthesize an
+                # (attributed, hence plane-empty) context so
+                # explain(analyze=True) still renders the serving block
+                stats = obs.RuntimeStatsContext()
+                stats._attributed = True
+                stats.finish()
+            stats.serving = info
+            h._finish("done", result=ps, stats=stats)
+            self._count("completed")
+            self._count("queue_wait_us", queue_wait_us)
+            self._count("run_us", int((time.monotonic()
+                                       - (h.started_at or 0)) * 1e6))
+        except QueryCancelled:
+            h._finish("cancelled")
+            self._count("cancelled")
+        except BaseException as exc:  # noqa: BLE001 — surfaced via handle
+            h._finish("failed", error=exc)
+            self._count("failed")
+        finally:
+            self.admission.release(est)
+            with self._cond:
+                self._n_running -= 1
+                self._cond.notify_all()
+
+    # ------------------------------------------------------------- execute
+    def _execute(self, h: QueryHandle, builder):
+        from .. import observability as obs
+        from ..context import get_context
+        from ..logical.fingerprint import fingerprint
+        from ..physical.translate import translate
+        from ..runners.native_runner import NativeRunner, make_local_executor
+        from ..runners.runner import PartitionSet
+
+        ctx = get_context()
+        runner = ctx.get_or_create_runner()
+        cfg = ctx.execution_config
+        info: Dict[str, object] = {"plan_cache": "bypass",
+                                   "result_cache": "bypass"}
+        cacheable = isinstance(runner, NativeRunner) \
+            and not cfg.enable_aqe
+        fp = fingerprint(builder.plan, cfg) if cacheable else None
+        if fp is not None and self.result_cache.enabled:
+            ps = self.result_cache.get_result(fp)
+            if ps is not None:
+                info["result_cache"] = "hit"
+                info["plan_cache"] = "skipped"
+                return ps, None, info
+            info["result_cache"] = "miss"
+        if not cacheable:
+            # AQE / distributed runner: the scheduler still provides
+            # fairness + admission; plan shape is dynamic, caches bypass.
+            # These runners don't thread the CancelToken into their own
+            # workers, so check it at every partition boundary here —
+            # INTERRUPT must unwind (and release admission) between
+            # stages, not silently run the query to completion
+            parts = []
+            for p in runner.run_iter(builder):
+                h.token.check()
+                parts.append(p)
+            return (PartitionSet(parts, builder.schema()),
+                    obs.last_query_stats_local(), info)
+        hit = self.plan_cache.get_plan(fp) if self.plan_cache.enabled \
+            else None
+        if hit is not None:
+            _optimized, pplan = hit
+            info["plan_cache"] = "hit"
+        else:
+            optimized = builder.optimize()
+            pplan = translate(optimized.plan)
+            if fp is not None and self.plan_cache.enabled:
+                self.plan_cache.put_plan(fp, optimized.plan, pplan)
+                info["plan_cache"] = "miss"
+        executor = make_local_executor(cfg)
+        parts = list(executor.run(pplan))
+        stats = obs.last_query_stats_local()
+        ps = PartitionSet(parts, builder.schema())
+        if fp is not None and self.result_cache.enabled:
+            self.result_cache.put_result(fp, ps)
+        return ps, stats, info
+
+    # ------------------------------------------------------------ shutdown
+    def shutdown(self, timeout: float = 10.0) -> None:
+        with self._cond:
+            self._shutdown = True
+            for s in self._sessions.values():
+                for dq in s.queues.values():
+                    for h in dq:
+                        h._finish("rejected", error=AdmissionRejected(
+                            "shutdown", "scheduler shut down while queued"))
+                        self._count("rejected_shutdown")
+                    dq.clear()
+            self._n_queued = 0
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=timeout)
