@@ -42,6 +42,10 @@ def broadcast_query(stats) -> None:
             # scan-side IO plane: GETs vs planned ranges (coalescing),
             # bytes fetched vs used, prefetch overlap
             "io": dict(getattr(stats, "io", {}) or {}),
+            # device kernels: per-family dispatch/byte/MFU ledger delta,
+            # incl. the hash-vs-sort strategy + table load factor (r12)
+            "device_kernels": dict(
+                getattr(stats, "device_kernels", {}) or {}),
             # lock-order sanitizer (DAFT_TPU_SANITIZE=1): graph size,
             # cycles, per-query contention/blocking events
             "sanitizer": dict(getattr(stats, "sanitizer", {}) or {}),

@@ -108,6 +108,15 @@ def device_ready() -> bool:
     return backend_name() is not None
 
 
+def is_accelerator() -> bool:
+    """True when the initialized backend is real silicon, not the CPU
+    tier — the SINGLE predicate for buffer donation, compiled-Pallas
+    capability (vs the interpreter), and the hash-strategy gate. New
+    backend strings (gpu, tunneled devices) get classified here once,
+    not at every dispatch site."""
+    return (backend_name() or "cpu") != "cpu"
+
+
 def reset_for_tests() -> None:
     global _probe_thread, _backend, _failed
     with _lock:

@@ -105,7 +105,7 @@ class DeviceColumnCache:
                 out[c] = e.col
             self._masks.move_to_end(fp)
             row_mask, rows, cap = mask
-            return dcol.DeviceTable(out, row_mask, rows, cap)
+            return dcol.DeviceTable(out, row_mask, rows, cap, resident=True)
 
     def put_table(self, fp: Tuple, dt: dcol.DeviceTable) -> None:
         add = 0
@@ -116,6 +116,9 @@ class DeviceColumnCache:
             add += nbytes
         if add > _budget():
             return
+        # the caller's table now SHARES buffers with the cache — it must
+        # never be donated to a fused program from here on
+        dt.resident = True
         with self._lock:
             self._masks[fp] = (dt.row_mask, dt.row_count, dt.capacity)
             for name, col, nbytes in sized:

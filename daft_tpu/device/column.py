@@ -87,6 +87,9 @@ class DeviceTable:
     row_mask: jax.Array              # [capacity] bool — live rows
     row_count: int                   # host-side live count
     capacity: int
+    #: True for HBM-cache-resident tables — their buffers are SHARED with
+    #: the cache and must never be donated to a fused program
+    resident: bool = False
 
     def schema(self) -> Schema:
         return Schema([Field(n, c.dtype) for n, c in self.columns.items()])

@@ -33,7 +33,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -49,9 +49,13 @@ HOST_PIL_BPS = 85e6             # per-image PIL resize, input bytes/s
 # device-side terms: without these a zero-cost link (CPU backend, local
 # HBM) degenerates to "device always wins" no matter how slow the kernel
 DEV_VECTOR_BPS = 8.0e9      # fused elementwise XLA, per byte touched
-DEV_AGG_BPS = 4.0e9         # fused grouped-agg, per byte touched
+DEV_AGG_BPS = 4.0e9         # fused grouped-agg (sort strategy), per byte
+DEV_AGG_HASH_BPS = 8.0e9    # one-pass hash grouped-agg, per byte touched
 DEV_SORT_ROWS_PER_S = 50.0e6    # XLA multi-key sort, rows/s
 DEV_JOIN_ROWS_PER_S = 40.0e6    # sort/searchsorted/expand join, rows/s
+DEV_JOIN_HASH_ROWS_PER_S = 80.0e6  # hash build/probe join, rows/s: ONE
+#                             pass per side instead of the build-side
+#                             radix sort's ≥2 passes per plane
 DEV_DISPATCH_S = 2.0e-3     # per-decision executable launch + (amortized)
 #                             shape-bucket compile overhead
 INVEST_MAX_RATIO = 8.0      # max cache-fill cost vs one host pass (see
@@ -297,41 +301,50 @@ kernel_ledger: dict = {}
 _ledger_lock = threading.Lock()
 
 _LEDGER_RAW = ("dispatches", "rows", "bytes", "flops", "seconds")
+#: strategy accounting (round 12): per-family hash/sort dispatch counts
+#: plus the summed hash-table load factor — the per-query stats block
+#: derives `strategy` and the mean `load_factor` from these
+_LEDGER_STRATEGY = ("strategy_hash", "strategy_sort", "lf_sum")
 
 
 def ledger_record(kind: str, *, rows: int = 0, nbytes: float = 0.0,
                   flops: float = 0.0, seconds: float = 0.0,
-                  dispatches: int = 1) -> None:
+                  dispatches: int = 1, strategy: Optional[str] = None,
+                  load_factor: Optional[float] = None) -> None:
     """Record one real dispatch's achieved work.
 
     ``seconds`` is wall time from dispatch to host-visible result — on a
     tunneled chip that includes link time, so the derived utilization is a
     LOWER bound on silicon utilization (the synthetic ``mfu.report``
     isolates the silicon with in-jit repetition). ``nbytes``/``flops``
-    are the kernel's modeled HBM traffic / arithmetic, conservative."""
+    are the kernel's modeled HBM traffic / arithmetic, conservative.
+    ``strategy`` (``hash``/``sort``) and the hash table's achieved
+    ``load_factor`` land in the same family row for the stats block."""
+    fields = [("dispatches", dispatches), ("rows", rows),
+              ("bytes", float(nbytes)), ("flops", float(flops)),
+              ("seconds", float(seconds))]
+    if strategy in ("hash", "sort"):
+        fields.append((f"strategy_{strategy}", dispatches))
+    if load_factor is not None:
+        fields.append(("lf_sum", float(load_factor) * dispatches))
     with _ledger_lock:
         d = kernel_ledger.setdefault(
             kind, {k: 0 if k in ("dispatches", "rows") else 0.0
                    for k in _LEDGER_RAW})
-        d["dispatches"] += dispatches
-        d["rows"] += rows
-        d["bytes"] += float(nbytes)
-        d["flops"] += float(flops)
-        d["seconds"] += float(seconds)
+        for f, v in fields:
+            d[f] = d.get(f, 0) + v
     # outside the ledger lock: also credit the thread-attributed stats
     # context (concurrent queries must not read each other's dispatches
     # out of the shared ledger diff)
     from .. import observability as obs
-    for field, v in (("dispatches", dispatches), ("rows", rows),
-                     ("bytes", float(nbytes)), ("flops", float(flops)),
-                     ("seconds", float(seconds))):
+    for field, v in fields:
         if v:
             obs.bump_plane("device_kernels", f"{kind}\x00{field}", v)
 
 
 def _derive(d: dict) -> dict:
     out = {k: (round(v, 6) if isinstance(v, float) else v)
-           for k, v in d.items()}
+           for k, v in d.items() if k not in _LEDGER_STRATEGY}
     s = d.get("seconds", 0.0)
     if s > 0:
         out["achieved_gbps"] = round(d["bytes"] / s / 1e9, 3)
@@ -339,6 +352,16 @@ def _derive(d: dict) -> dict:
         if d.get("flops"):
             out["achieved_tflops"] = round(d["flops"] / s / 1e12, 4)
             out["mfu_pct"] = round(100.0 * d["flops"] / s / peak_flops(), 4)
+    nh = int(d.get("strategy_hash", 0))
+    ns = int(d.get("strategy_sort", 0))
+    if nh or ns:
+        out["strategy"] = "mixed" if (nh and ns) else \
+            ("hash" if nh else "sort")
+        if nh and ns:
+            out["strategy_hash"] = nh
+            out["strategy_sort"] = ns
+    if nh and d.get("lf_sum"):
+        out["load_factor"] = round(d["lf_sum"] / nh, 3)
     return out
 
 
@@ -358,7 +381,8 @@ def ledger_delta(before: dict, after: dict) -> dict:
     out = {}
     for kind, d in after.items():
         b = before.get(kind, {})
-        diff = {k: d[k] - b.get(k, 0) for k in _LEDGER_RAW}
+        diff = {k: d.get(k, 0) - b.get(k, 0)
+                for k in _LEDGER_RAW + _LEDGER_STRATEGY}
         if diff["dispatches"] > 0:
             out[kind] = _derive(diff)
     return out
@@ -371,7 +395,7 @@ def ledger_from_tallies(flat: dict) -> dict:
     kinds: dict = {}
     for key, v in flat.items():
         kind, _, field = key.partition("\x00")
-        if field not in _LEDGER_RAW:
+        if field not in _LEDGER_RAW + _LEDGER_STRATEGY:
             continue
         d = kinds.setdefault(
             kind, {k: 0 if k in ("dispatches", "rows") else 0.0
@@ -500,7 +524,8 @@ def argsort_wins(n_rows: int, key_bytes: float, n_keys: int) -> bool:
 
 def agg_upload_wins(bytes_up: float, bytes_down: float,
                     cacheable: bool, round_trips: float = 2.0,
-                    host_bytes: Optional[float] = None) -> bool:
+                    host_bytes: Optional[float] = None,
+                    strategy: str = "sort") -> bool:
     """Aggregation whose inputs are NOT already device-resident.
 
     ``bytes_up`` is the WIRE cost (encoded device bytes: f64 rides f32,
@@ -533,7 +558,11 @@ def agg_upload_wins(bytes_up: float, bytes_down: float,
     lp = link_profile()
     host_s = (host_bytes if host_bytes is not None else bytes_up) \
         / HOST_AGG_BPS
-    kernel_s = DEV_DISPATCH_S + bytes_up / DEV_AGG_BPS
+    # round 12: the fused-agg gate prices the kernel at the strategy the
+    # dispatch would actually take — the one-pass hash kernel streams the
+    # data once where the sort strategy pays ≥2 passes per packed plane
+    bps = DEV_AGG_HASH_BPS if strategy == "hash" else DEV_AGG_BPS
+    kernel_s = DEV_DISPATCH_S + bytes_up / bps
     dev_s = lp.device_seconds(bytes_up, bytes_down, round_trips, kernel_s)
     from ..analysis import knobs
     if cacheable and knobs.env_bool("DAFT_TPU_CACHE_INVEST"):
@@ -612,19 +641,131 @@ def shuffle_combine_wins(rows: Optional[int], groups: Optional[int],
 
 def join_wins(n_left: int, n_right: int, bytes_up: float,
               bytes_down: float) -> bool:
-    """Equi-join as the fused device sort-merge: output is one packed
-    index matrix; host cost is a hash build+probe. ONE dispatch and ONE
-    result transfer (the r5 three-phase pipeline paid 3 dispatches + 4
-    round trips — the fused kernel is why the device tier now affords
-    joins it used to lose on RTT alone)."""
+    """Equi-join as one fused device program (hash build/probe when the
+    strategy model picks it, else sort/searchsorted/expand): output is
+    one packed index matrix; host cost is a hash build+probe. ONE
+    dispatch and ONE result transfer (the r5 three-phase pipeline paid 3
+    dispatches + 4 round trips). Round 12 re-pricing: when the hash
+    strategy would run, the kernel term uses the one-pass hash rate
+    instead of the radix-sort rate — the device now affords joins the
+    sort pricing declined."""
     f = _forced()
     if f is not None:
         return f
     n = n_left + n_right
     host_s = n / HOST_JOIN_ROWS_PER_S
-    kernel_s = DEV_DISPATCH_S + n / DEV_JOIN_ROWS_PER_S
+    rate = DEV_JOIN_HASH_ROWS_PER_S \
+        if _join_strategy(n_left, n_right) == "hash" \
+        else DEV_JOIN_ROWS_PER_S
+    kernel_s = DEV_DISPATCH_S + n / rate
     dev_s = link_profile().device_seconds(bytes_up, bytes_down, 2.0,
                                           kernel_s)
     _log("join", dev_s < host_s, host_s, dev_s,
          n_left=n_left, n_right=n_right, bytes_up=bytes_up)
     return dev_s < host_s
+
+
+# ------------------------------------------------ kernel strategy (round 12)
+
+def _hash_capable_backend() -> bool:
+    """Compiled Pallas needs silicon; the interpreter exists for parity,
+    not speed — in ``auto`` mode a CPU backend keeps the XLA sort path."""
+    from . import backend
+    return backend.is_accelerator()
+
+
+def _join_strategy(n_left: int, n_right: int) -> str:
+    """Hash-vs-sort for the device join, without logging (join_wins
+    pre-prices with it; ``join_strategy`` is the logged decision the
+    dispatch site acts on)."""
+    from ..analysis import knobs
+    from . import pallas_kernels as pk
+    forced = (knobs.env_str("DAFT_TPU_KERNEL_JOIN") or "auto").lower()
+    if forced in ("hash", "sort"):
+        return forced
+    if not _hash_capable_backend():
+        return "sort"
+    from .column import bucket_capacity
+    if pk.join_table_capacity(bucket_capacity(max(n_right, 1))) \
+            > pk.max_table_slots():
+        return "sort"  # build table exceeds the on-chip budget
+    if bucket_capacity(max(n_left, n_right, 1)) > pk.max_table_slots():
+        # the probe kernel pins two output-capacity-sized index planes
+        # on-chip (whole-plane BlockSpecs), and the first dispatch's
+        # bucket is sized from the larger side — past the slot ceiling
+        # those planes belong to the sort kernel, whose buffers live
+        # in HBM
+        return "sort"
+    # the hash build streams each side once; the sort build pays ≥2
+    # passes over the build planes — one-pass wins whenever it fits
+    return "hash"
+
+
+def join_strategy(n_left: int, n_right: int) -> str:
+    """The join kernel strategy for this dispatch, logged like every
+    other decision (``join_strategy`` in decision_counts / the dispatch
+    log; "device" = hash)."""
+    s = _join_strategy(n_left, n_right)
+    _log("join_strategy", s == "hash", 0.0, 0.0,
+         n_left=n_left, n_right=n_right, strategy=s)
+    return s
+
+
+def groupby_strategy(rows: int, groups: Optional[float],
+                     key_dtypes, out_cap: int,
+                     log: bool = True) -> Tuple[str, float]:
+    """Hash-vs-sort for one grouped-agg dispatch → ``(strategy,
+    est_load_factor)``. ``log=False`` for pricing-only pre-asks (upload
+    gates) so decision_counts tallies acted-on dispatches, not estimates.
+
+    Evidence, best-first: the parquet-footer NDV that already flows to
+    the fused-agg gate (``groups``), else the group budget ``out_cap``.
+    The hash path declines when (a) the key set packs wider than the
+    table key budget (``pallas_kernels.hash_pack_words`` → sort handles
+    any width as an LSD radix), (b) the table exceeds the on-chip slot
+    ceiling, (c) footer evidence shows near-unique keys
+    (``DAFT_TPU_KERNEL_HASH_NDV_FRAC``: the table grows as large as the
+    data and the one-pass advantage is gone — TPC-H Q18's shape; absent
+    evidence is NOT evidence of high NDV, matching the fused-agg gate's
+    optimistic default), or (d) the backend can only interpret Pallas.
+    ``DAFT_TPU_KERNEL_GROUPBY=hash|sort`` force-overrides (hash still
+    requires a packable key set). Logged under ``groupby_strategy``
+    ("device" = hash)."""
+    from ..analysis import knobs
+    from . import pallas_kernels as pk
+    words = pk.hash_pack_words(key_dtypes) if key_dtypes else None
+    table = pk.table_capacity(max(out_cap, 1))
+    ndv = groups if groups else float(out_cap)
+    lf = min(ndv / table, 1.0)
+    forced = (knobs.env_str("DAFT_TPU_KERNEL_GROUPBY") or "auto").lower()
+    if forced == "sort" or words is None:
+        s = "sort"
+    elif forced == "hash":
+        s = "hash"
+    elif not _hash_capable_backend():
+        s = "sort"
+    elif table > pk.max_table_slots():
+        s = "sort"
+    elif groups and rows > 0 and ndv / rows > knobs.env_float(
+            "DAFT_TPU_KERNEL_HASH_NDV_FRAC"):
+        s = "sort"
+    else:
+        from . import mfu
+        sort_bytes = mfu.grouped_agg_models(
+            rows, out_cap, max(len(key_dtypes), 1), 1)[1]
+        hash_bytes = mfu.hash_agg_models(rows, out_cap, table, words, 1)[1]
+        s = "hash" if hash_bytes < sort_bytes else "sort"
+    if log:
+        log_strategy_decision("groupby_strategy", s, rows=rows,
+                              groups=float(ndv), out_cap=out_cap,
+                              load_factor=lf)
+    return s, lf
+
+
+def log_strategy_decision(kind: str, strategy: str, **extras) -> None:
+    """Tally an ACTED-ON kernel-strategy decision. Dispatch sites call
+    this once the strategy really ran (after width-gate fallbacks);
+    pricing-only pre-asks pass ``log=False`` to the strategy model and
+    stay out of ``decision_counts`` — the counts and the dispatch log
+    describe what dispatched, not what was estimated."""
+    _log(kind, strategy == "hash", 0.0, 0.0, strategy=strategy, **extras)

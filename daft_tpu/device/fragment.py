@@ -30,7 +30,7 @@ from jax import lax
 from ..expressions.expressions import Expression
 from ..schema import Schema
 from . import column as dcol
-from . import compiler, kernels, runtime
+from . import compiler, kernels, pallas_kernels, runtime
 
 _fused_cache: Dict[Tuple, object] = {}
 _fused_counters: Dict[str, int] = {"hits": 0, "misses": 0}
@@ -74,15 +74,46 @@ def _unpack_i64(row: np.ndarray, dtype) -> np.ndarray:
 
 
 class FusedAggProgram:
-    def __init__(self, packed_fn, compiled: compiler.Compiled, nk: int,
-                 ops: Tuple[str, ...], has_pred: bool, meta: dict):
+    def __init__(self, packed_fn, run_packed, compiled: compiler.Compiled,
+                 nk: int, ops: Tuple[str, ...], has_pred: bool, meta: dict):
         self.packed_fn = packed_fn      # single-transfer path (group
         # overflow re-runs it at a grown static out_cap bucket)
+        self._run_packed = run_packed   # raw traceable fn — donating twin
+        self._donate_fn = None          # lazily jitted with donate_argnums
         self.compiled = compiled
         self.nk = nk
         self.ops = ops
         self.has_pred = has_pred
         self.meta = meta                # trace-time dtype layout
+        #: the hash kernel raised (key set packs wider than the table key
+        #: budget at trace time) — every later dispatch stays on sort
+        self.hash_unfit = False
+
+    def donate_fn(self):
+        """The donating twin executable (round 12 megakernel discipline):
+        the encoded input planes are dead after the in-program aggregation,
+        so XLA reuses their HBM for the fragment's intermediates — no
+        input column survives the dispatch. Only entered for one-shot
+        (non-cache-resident) tables on real chips; jitted lazily so CPU
+        runs never trace it."""
+        if self._donate_fn is None:
+            self._donate_fn = jax.jit(
+                self._run_packed, static_argnames=("out_cap", "strategy"),
+                donate_argnums=(0, 1))
+        return self._donate_fn
+
+    def key_plane_dtypes(self):
+        """Device dtypes of the group-key planes, for the hash-vs-sort
+        strategy width check. String/binary keys ride sorted-dictionary
+        codes (int32, ``column._np_encode``); the kernel's own trace
+        re-derives the exact pack from the real planes and raises if this
+        estimate was too narrow (dispatch sites catch → sort)."""
+        out = []
+        for f in self.compiled.out_fields[:self.nk]:
+            rep = f.dtype.device_repr() \
+                if not (f.dtype.is_string() or f.dtype.is_binary()) else None
+            out.append(np.dtype(rep) if rep is not None else np.dtype("int32"))
+        return out
 
 
 def get_fused_agg(group_exprs: List[Expression], child_exprs: List[Expression],
@@ -123,7 +154,8 @@ def get_fused_agg(group_exprs: List[Expression], child_exprs: List[Expression],
         vvalids = tuple(m for _, m in outs[nk:nk + nv])
         return keys, kvalids, vals, vvalids, row_mask
 
-    def run_packed(arrays, valids, row_mask, scalars, out_cap: int):
+    def run_packed(arrays, valids, row_mask, scalars, out_cap: int,
+                   strategy: str = "sort"):
         keys, kvalids, vals, vvalids, row_mask = eval_inputs(
             arrays, valids, row_mask, scalars)
         if nk == 0:
@@ -131,7 +163,12 @@ def get_fused_agg(group_exprs: List[Expression], child_exprs: List[Expression],
             flat = [v for v, _ in results] + [m for _, m in results]
             meta["global_dtypes"] = [x.dtype for x in flat]
             return jnp.stack([_pack_i64(x.reshape(())) for x in flat])
-        ok, okv, ov, ovv, g = kernels.grouped_agg_block_impl(
+        # round 12: the whole scan→filter→project→agg chain stays ONE jit
+        # program either way — `strategy` only swaps the reduction's inner
+        # loop (one-pass Pallas hash table vs radix sort + segment reduce)
+        impl = pallas_kernels.hash_grouped_agg_impl if strategy == "hash" \
+            else kernels.grouped_agg_block_impl
+        ok, okv, ov, ovv, g = impl(
             keys, kvalids, vals, vvalids, row_mask, ops, out_cap)
         flat = list(ok) + list(okv) + list(ov) + list(ovv)
         meta["grouped_dtypes"] = [x.dtype for x in flat]
@@ -141,31 +178,75 @@ def get_fused_agg(group_exprs: List[Expression], child_exprs: List[Expression],
         return jnp.stack(rows)
 
     prog = FusedAggProgram(
-        jax.jit(run_packed, static_argnames=("out_cap",)),
-        c, nk, ops, has_pred, meta)
+        jax.jit(run_packed, static_argnames=("out_cap", "strategy")),
+        run_packed, c, nk, ops, has_pred, meta)
     _fused_cache[key] = prog
     return prog
 
 
 def run_fused_agg(prog: FusedAggProgram, batch, group_exprs, agg_exprs,
-                  out_schema: Schema):
+                  out_schema: Schema, groups: Optional[float] = None):
     """Execute the fused program on one RecordBatch; returns a RecordBatch of
     partial groups (or None → caller falls back to the host chain)."""
     for nm in prog.compiled.needs_cols:
         if batch.get_column(nm).is_pyobject():
             return None
     dt = dcol.encode_batch(batch, prog.compiled.needs_cols)
-    return run_fused_agg_table(prog, dt, batch.schema, group_exprs,
-                               agg_exprs, out_schema)
+    return run_fused_agg_table(
+        prog, dt, batch.schema, group_exprs, agg_exprs, out_schema,
+        groups=groups,
+        # the donating fast path invalidates the input planes; an overflow
+        # re-dispatch re-encodes from the host batch we still hold
+        reencode=lambda: dcol.encode_batch(batch, prog.compiled.needs_cols))
 
 
 def _dispatch_packed(prog: FusedAggProgram, dt: dcol.DeviceTable,
-                     out_cap: int):
+                     out_cap: int, strategy: str = "sort",
+                     donate: bool = False):
     arrays = {n: col.data for n, col in dt.columns.items()}
     valids = {n: col.validity for n, col in dt.columns.items()}
     scalars = runtime._prep_scalars(prog.compiled, dt)
-    return prog.packed_fn(arrays, valids, dt.row_mask, scalars,
-                          out_cap=out_cap)
+    fn = prog.donate_fn() if donate else prog.packed_fn
+    return fn(arrays, valids, dt.row_mask, scalars, out_cap=out_cap,
+              strategy=strategy)
+
+
+def _donation_ok(dt: dcol.DeviceTable) -> bool:
+    """Donate the encoded input planes to the fused program? Never for
+    HBM-cache-resident tables (their buffers are SHARED with the cache —
+    donating them would poison every later hit) and never on CPU (XLA
+    ignores donation there and warns per executable)."""
+    from . import backend
+    return backend.is_accelerator() and not dt.resident
+
+
+def gate_strategy(prog: FusedAggProgram, rows: int,
+                  groups: Optional[float] = None) -> str:
+    """Pricing-only strategy pre-ask for the upload gates (unlogged —
+    decision_counts should tally acted-on dispatches, not estimates)."""
+    from . import costmodel
+    if prog.nk == 0 or prog.hash_unfit:
+        return "sort"
+    return costmodel.groupby_strategy(rows, groups,
+                                      prog.key_plane_dtypes(), _OUT_CAP0,
+                                      log=False)[0]
+
+
+def strategy_for(prog: FusedAggProgram, dt: dcol.DeviceTable, out_cap: int,
+                 groups: Optional[float] = None) -> Tuple[str, float]:
+    """Hash-vs-sort for one fused-agg dispatch → ``(strategy, load)``.
+    Evidence, best-first: the planner's parquet-footer NDV (``groups``),
+    else the group-capacity bucket. A program whose key set already proved
+    unpackable stays on sort without re-asking. UNLOGGED — the dispatch
+    sites call ``costmodel.log_strategy_decision`` once the dispatch
+    really ran (a width-gate trace failure can still flip the answer),
+    so decision_counts describes what dispatched, not what was asked."""
+    from . import costmodel
+    if prog.nk == 0 or prog.hash_unfit:
+        return "sort", 0.0
+    return costmodel.groupby_strategy(dt.row_count, groups,
+                                      prog.key_plane_dtypes(), out_cap,
+                                      log=False)
 
 
 def _decode_packed_global(prog: FusedAggProgram, packed: np.ndarray,
@@ -244,24 +325,41 @@ def _max_out_cap(prog: FusedAggProgram, dt: dcol.DeviceTable) -> int:
 
 
 def _ledger_grouped(prog: FusedAggProgram, rows: int, cap: int,
-                    out_cap: int, seconds: float, dispatches: int) -> None:
-    """Per-dispatch MFU accounting for the fused grouped-agg family."""
+                    out_cap: int, seconds: float, dispatches: int,
+                    strategy: str = "sort", load_factor: float = 0.0
+                    ) -> None:
+    """Per-dispatch MFU accounting for the fused grouped-agg family; the
+    byte model follows the strategy the dispatch actually ran."""
     from . import costmodel, mfu
-    flops, nbytes = mfu.grouped_agg_models(cap, out_cap, max(prog.nk, 1),
-                                           len(prog.ops))
+    if strategy == "hash":
+        words = pallas_kernels.hash_pack_words(prog.key_plane_dtypes()) or 2
+        flops, nbytes = mfu.hash_agg_models(
+            cap, out_cap, pallas_kernels.table_capacity(out_cap), words,
+            len(prog.ops))
+    else:
+        flops, nbytes = mfu.grouped_agg_models(cap, out_cap,
+                                               max(prog.nk, 1),
+                                               len(prog.ops))
     costmodel.ledger_record("grouped_agg", rows=rows,
                             nbytes=dispatches * nbytes,
                             flops=dispatches * flops, seconds=seconds,
-                            dispatches=dispatches)
+                            dispatches=dispatches, strategy=strategy,
+                            load_factor=load_factor or None)
 
 
 def run_fused_agg_table(prog: FusedAggProgram, dt: dcol.DeviceTable,
                         in_schema: Schema, group_exprs, agg_exprs,
-                        out_schema: Schema, start_out_cap: int = _OUT_CAP0):
+                        out_schema: Schema, start_out_cap: int = _OUT_CAP0,
+                        groups: Optional[float] = None, reencode=None):
     """Execute on one encoded DeviceTable (possibly HBM-cache-resident).
     Returns None (→ host fallback) when the group count exceeds the
-    link-budgeted packed-output ceiling."""
+    link-budgeted packed-output ceiling. With ``reencode`` (a thunk
+    rebuilding the DeviceTable from host data), one-shot tables DONATE
+    their input planes to the fused program on real chips — an overflow
+    re-dispatch then re-encodes instead of reusing dead buffers."""
     import time as _time
+
+    from . import costmodel
     key_fields = [e.to_field(in_schema) for e in group_exprs]
     agg_fields = [out_schema[e.name()] for e in agg_exprs]
     if prog.nk == 0:
@@ -270,25 +368,68 @@ def run_fused_agg_table(prog: FusedAggProgram, dt: dcol.DeviceTable,
         return _decode_packed_global(prog, packed, agg_fields)
     cap_limit = _max_out_cap(prog, dt)
     out_cap = min(start_out_cap, cap_limit)
+    donate = reencode is not None and _donation_ok(dt)
     t0 = _time.perf_counter()
-    dispatches = 0
+    acct: Dict[str, list] = {}  # strategy → [dispatches, lf, out_cap]
+    strategy = lf = None
     while True:
-        packed = np.asarray(jax.device_get(
-            _dispatch_packed(prog, dt, out_cap)))
-        dispatches += 1
+        if strategy is None:
+            strategy, lf = strategy_for(prog, dt, out_cap, groups)
+        try:
+            packed = np.asarray(jax.device_get(
+                _dispatch_packed(prog, dt, out_cap, strategy, donate)))
+        except pallas_kernels.HashKeyWidthError:
+            # key set packs wider than the hash-table key budget — the
+            # kernel's trace is the exact check; remember and re-dispatch
+            # on the sort path (donation untouched: the trace failed
+            # before any executable could consume the buffers). Any
+            # OTHER error propagates — it is a real defect, not a
+            # routing signal.
+            prog.hash_unfit = True
+            strategy, lf = "sort", 0.0
+            continue
+        acct[strategy] = [acct.get(strategy, [0])[0] + 1, lf, out_cap]
+        # the decision that actually dispatched (post width-gate fallback)
+        costmodel.log_strategy_decision(
+            "groupby_strategy", strategy, rows=dt.row_count,
+            out_cap=out_cap, load_factor=lf)
         out = _decode_packed_grouped(prog, packed, dt, group_exprs,
                                      key_fields, agg_fields)
         if out is not None:
-            _ledger_grouped(prog, dt.row_count, dt.capacity, out_cap,
-                            _time.perf_counter() - t0, dispatches)
+            # per-strategy accounting: an overflow ladder can MIX
+            # strategies (hash saturation falls back to sort), and each
+            # family row must count its own dispatches and byte model.
+            # The row count and whole-ladder wall go to the completing
+            # strategy's record.
+            secs = _time.perf_counter() - t0
+            for s_, (cnt, l_, oc) in acct.items():
+                final = s_ == strategy
+                _ledger_grouped(prog, dt.row_count if final else 0,
+                                dt.capacity, oc, secs if final else 0.0,
+                                cnt, s_, l_)
             return out
-        # the packed header carries the TRUE group count: jump straight
-        # to a fitting bucket, or bail to host when the link can't afford
-        # the packed transfer
+        # the packed header carries the group count — TRUE for the sort
+        # strategy; the hash strategy saturates at the table size, so a
+        # saturated count is only a LOWER bound on the real NDV
         g = int(packed[0, 0])
         if g > cap_limit:
             return None
+        if donate:
+            dt = reencode()
+        saturated = strategy == "hash" \
+            and g >= pallas_kernels.table_capacity(out_cap)
         out_cap = min(dcol.bucket_capacity(max(g, _OUT_CAP0)), cap_limit)
+        if saturated:
+            # a completely full table means the true count is unknown
+            # and high — re-dispatch on the sort path, whose header is
+            # exact, instead of geometrically doubling the hash bucket
+            # one full row pass (and, when donating, one re-encode) at
+            # a time; NDV this high is sort's territory anyway
+            strategy, lf = "sort", 0.0
+        else:
+            # the bucket changed: re-ask the strategy model (a grown
+            # group budget can push the table past the slot ceiling)
+            strategy = None
 
 
 _stack_cache: Dict[int, object] = {}
@@ -304,28 +445,44 @@ def _stack(packs):
 
 
 def run_fused_agg_tables(prog: FusedAggProgram, tables, in_schema: Schema,
-                         group_exprs, agg_exprs, out_schema: Schema):
+                         group_exprs, agg_exprs, out_schema: Schema,
+                         groups: Optional[float] = None):
     """Batched execution over many DeviceTables: dispatch every fused
     program asynchronously, then fetch ALL packed results in a single
     device→host transfer (one RTT for the whole scan instead of one per
     task). Returns a list parallel to ``tables`` (None → caller falls back
-    per-table)."""
+    per-table). Inputs are never donated here: the batched overflow retry
+    re-dispatches over the same tables, and cache-resident tables share
+    their buffers with the HBM column cache anyway."""
     import time as _time
     if not tables:
         return []
     key_fields = [e.to_field(in_schema) for e in group_exprs]
     agg_fields = [out_schema[e.name()] for e in agg_exprs]
+    strategy, lf = strategy_for(prog, tables[0], _OUT_CAP0, groups)
     t0 = _time.perf_counter()
     try:
-        packs = [_dispatch_packed(prog, dt, _OUT_CAP0) for dt in tables]
+        packs = [_dispatch_packed(prog, dt, _OUT_CAP0, strategy)
+                 for dt in tables]
         stacked = np.asarray(jax.device_get(_stack(packs))) \
             if len(packs) > 1 else [np.asarray(jax.device_get(packs[0]))]
+    except pallas_kernels.HashKeyWidthError:
+        prog.hash_unfit = True
+        return run_fused_agg_tables(prog, tables, in_schema, group_exprs,
+                                    agg_exprs, out_schema, groups)
     except Exception:
         return [None] * len(tables)
     if prog.nk:
+        from . import costmodel
+        # ONE decision acted on across the whole batch (post any
+        # width-gate recursion above)
+        costmodel.log_strategy_decision(
+            "groupby_strategy", strategy,
+            rows=sum(dt.row_count for dt in tables), out_cap=_OUT_CAP0,
+            load_factor=lf, tables=len(packs))
         _ledger_grouped(prog, sum(dt.row_count for dt in tables),
                         max(dt.capacity for dt in tables), _OUT_CAP0,
-                        _time.perf_counter() - t0, len(packs))
+                        _time.perf_counter() - t0, len(packs), strategy, lf)
     results: list = [None] * len(tables)
     retry: list = []  # (index, out_cap) — re-dispatched as ONE batch, not
     # per-table (each serial round trip costs ~0.1 s on the tunnel)
@@ -347,10 +504,19 @@ def run_fused_agg_tables(prog: FusedAggProgram, tables, in_schema: Schema,
         except Exception:
             results[i] = None
     if retry:
+        # a grown bucket can flip the strategy (table slot ceiling);
+        # re-ask per retried table
+        retry_strats = [strategy_for(prog, tables[i], cap, groups)
+                        for i, cap in retry]
         try:
-            packs2 = [_dispatch_packed(prog, tables[i], cap)
-                      for i, cap in retry]
+            packs2 = [_dispatch_packed(prog, tables[i], cap, s)
+                      for (i, cap), (s, _l) in zip(retry, retry_strats)]
             mats = [np.asarray(m) for m in jax.device_get(packs2)]
+            from . import costmodel
+            for (i, cap), (s, l_) in zip(retry, retry_strats):
+                costmodel.log_strategy_decision(
+                    "groupby_strategy", s, rows=tables[i].row_count,
+                    out_cap=cap, load_factor=l_)
         except Exception:
             mats = [None] * len(retry)
         for (i, _cap), mat in zip(retry, mats):

@@ -361,14 +361,26 @@ def try_agg(batch, to_agg: List[Expression], group_by: List[Expression]):
     for nm in c.needs_cols:
         if batch.get_column(nm).is_pyobject():
             return None
-    # in-memory batch: no HBM-cache identity, the upload is one-shot
+    # in-memory batch: no HBM-cache identity, the upload is one-shot.
+    # The strategy model runs FIRST so the gate prices the kernel the
+    # dispatch would actually take (one-pass hash vs radix sort) —
+    # UNLOGGED here: the gate below may still decline the upload, and
+    # decision_counts tallies acted-on dispatches, not estimates.
+    nk = len(group_by)
+    cap = dcol.bucket_capacity(max(len(batch), 1))
+    strategy, load_factor = ("sort", 0.0) if nk == 0 else \
+        costmodel.groupby_strategy(
+            len(batch), None,
+            [np.dtype(f.dtype.device_repr() or "int32")
+             for f in key_fields], cap, log=False)
     from .fragment import _OUT_CAP0, packed_bytes_per_group
     packed_out = packed_bytes_per_group(len(group_by),
                                         len(to_agg)) * _OUT_CAP0
     if not costmodel.agg_upload_wins(
             dcol.encoded_nbytes(batch, c.needs_cols),
             packed_out, cacheable=False,
-            host_bytes=_batch_cols_nbytes(batch, c.needs_cols)):
+            host_bytes=_batch_cols_nbytes(batch, c.needs_cols),
+            strategy=strategy):
         return None
 
     dt, outs = _run_compiled(c, batch, proj)
@@ -399,19 +411,43 @@ def try_agg(batch, to_agg: List[Expression], group_by: List[Expression]):
     vals_b = [bcast(v, m) for v, m in val_outs]
     import time as _time
 
-    from . import mfu
+    from . import mfu, pallas_kernels as pk
     t0 = _time.perf_counter()
-    out_keys, out_kvalids, out_vals, out_valids, gcount = \
-        kernels.grouped_agg_kernel(
-            tuple(v for v, _ in keys_b), tuple(m for _, m in keys_b),
+    karg = (tuple(v for v, _ in keys_b), tuple(m for _, m in keys_b),
             tuple(v for v, _ in vals_b), tuple(m for _, m in vals_b),
             dt.row_mask, ops)
+    if strategy == "hash":
+        try:
+            # [capacity]-wide group budget: groups ≤ live rows ≤ capacity,
+            # so the hash path can never overflow here
+            out_keys, out_kvalids, out_vals, out_valids, gcount = \
+                pk.hash_grouped_agg_kernel(*karg, out_cap=dt.capacity)
+        except pk.HashKeyWidthError:
+            # key set packs wider than the table key budget (the pre-ask
+            # estimated from declared dtypes; the kernel's own trace is
+            # the exact check) — run the any-width sort path instead
+            strategy, load_factor = "sort", 0.0
+    if strategy == "sort":
+        out_keys, out_kvalids, out_vals, out_valids, gcount = \
+            kernels.grouped_agg_kernel(*karg)
+    # the decision that actually dispatched (post width-gate fallback)
+    costmodel.log_strategy_decision("groupby_strategy", strategy,
+                                    rows=len(batch), out_cap=cap,
+                                    load_factor=load_factor)
     g = int(jax.device_get(gcount))
-    # segment-scatter formulation: bytes-bound, no MXU flops to claim
-    _, nbytes = mfu.grouped_agg_models(dt.capacity, dt.capacity, nk,
-                                       len(ops))
+    # both formulations are bytes-bound: no MXU flops to claim
+    if strategy == "hash":
+        words = pk.hash_pack_words([v.dtype for v, _ in keys_b]) or 2
+        _, nbytes = mfu.hash_agg_models(
+            dt.capacity, dt.capacity, pk.table_capacity(dt.capacity),
+            words, len(ops))
+    else:
+        _, nbytes = mfu.grouped_agg_models(dt.capacity, dt.capacity, nk,
+                                           len(ops))
     costmodel.ledger_record("grouped_agg", rows=len(batch), nbytes=nbytes,
-                            seconds=_time.perf_counter() - t0)
+                            seconds=_time.perf_counter() - t0,
+                            strategy=strategy,
+                            load_factor=load_factor or None)
     cols = []
     for e, f, kv, km in zip(group_by, key_fields, out_keys, out_kvalids):
         cols.append(decode_group_key(e, f, kv, km, dt, g))

@@ -574,11 +574,14 @@ class LocalExecutor:
                     dcol.encoded_nbytes(rb, prog.compiled.needs_cols),
                     packed_out, cacheable=False,
                     host_bytes=drt._batch_cols_nbytes(
-                        rb, prog.compiled.needs_cols)):
+                        rb, prog.compiled.needs_cols),
+                    strategy=fragment.gate_strategy(
+                        prog, len(rb), getattr(node, "group_ndv", None))):
                 return None
             try:
-                out = fragment.run_fused_agg(prog, rb, node.group_by,
-                                             agg_cols, node.schema())
+                out = fragment.run_fused_agg(
+                    prog, rb, node.group_by, agg_cols, node.schema(),
+                    groups=getattr(node, "group_ndv", None))
             except Exception:  # device OOM / lowering failure → host tier
                 return None
             if out is None:
@@ -674,7 +677,9 @@ class LocalExecutor:
                     cacheable=fp is not None and fits,
                     round_trips=2.0 / max(1, n_sharing),
                     host_bytes=drt._batch_cols_nbytes(
-                        rb, prog.compiled.needs_cols)):
+                        rb, prog.compiled.needs_cols),
+                    strategy=dfrag.gate_strategy(
+                        prog, len(rb), getattr(node, "group_ndv", None))):
                 return ("host", rb, t)
             try:
                 dt = dcol.encode_batch(rb, prog.compiled.needs_cols)
@@ -704,7 +709,8 @@ class LocalExecutor:
                         for c in classified]
             outs = fragment.run_fused_agg_tables(
                 prog, [dt for kind, dt, _ in resolved if kind == "dev"],
-                src.schema(), node.group_by, agg_cols, node.schema())
+                src.schema(), node.group_by, agg_cols, node.schema(),
+                groups=getattr(node, "group_ndv", None))
             di = 0
             for kind, val, t in resolved:
                 if kind == "dev":

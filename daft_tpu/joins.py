@@ -164,14 +164,20 @@ def _take_nullable(s: Series, idx: np.ndarray, valid: np.ndarray) -> Series:
 
 
 def _device_match_indices(l_gids, r_gids, l_valid, r_valid):
-    """Fused single-dispatch device join index generation: build-side
-    sort + probe counts + prefix-sum expansion run as ONE jit program
-    returning ONE packed index matrix (r5's three-phase pipeline paid two
-    host round-trips between phases — fetching the match total before the
-    expansion — which dominated tunneled-link joins). The output bucket
-    is sized FK-shaped (≈ one match per probe row); a larger true total
-    re-dispatches once at the fitting bucket, the grouped-agg overflow
-    discipline. None on device-off."""
+    """Fused single-dispatch device join index generation, at the
+    strategy the cost model picks per dispatch (round 12):
+
+    - ``hash``: Pallas build/probe — ONE streaming pass per side through
+      an HBM/VMEM-resident chained hash table
+      (``pallas_kernels.hash_join_kernel``);
+    - ``sort``: build-side sort + probe counts + prefix-sum expansion
+      (``kernels.join_fused_kernel``, the r6 kernel).
+
+    Either way it is ONE jit program returning ONE packed index matrix
+    (r5's three-phase pipeline paid two host round-trips between phases).
+    The output bucket is sized FK-shaped (≈ one match per probe row); a
+    larger true total re-dispatches once at the fitting bucket, the
+    grouped-agg overflow discipline. None on device-off."""
     from .device import runtime as drt
     if not drt.device_enabled():
         return None
@@ -181,6 +187,7 @@ def _device_match_indices(l_gids, r_gids, l_valid, r_valid):
     import jax.numpy as jnp
 
     from .device import costmodel, kernels as K, mfu
+    from .device import pallas_kernels as pk
     from .device.column import bucket_capacity
 
     def pad(a, cap, fill=0):
@@ -194,12 +201,15 @@ def _device_match_indices(l_gids, r_gids, l_valid, r_valid):
     lmask[:n_l] = True
     rmask = np.zeros(c_r, bool)
     rmask[:n_r] = True
+    strategy = costmodel.join_strategy(n_l, n_r)
+    kernel = pk.hash_join_kernel if strategy == "hash" \
+        else K.join_fused_kernel
 
     def dispatch(cap):
-        # device arrays are rebuilt per dispatch: the kernel DONATES the
+        # device arrays are rebuilt per dispatch: both kernels DONATE the
         # build side's buffers on real chips, so an overflow re-dispatch
         # cannot reuse them
-        return np.asarray(jax.device_get(K.join_fused_kernel(
+        return np.asarray(jax.device_get(kernel(
             jnp.asarray(pad(l_gids.astype(np.int64), c_l)),
             jnp.asarray(pad(l_valid, c_l)), jnp.asarray(lmask),
             jnp.asarray(pad(r_gids.astype(np.int64), c_r)),
@@ -211,15 +221,42 @@ def _device_match_indices(l_gids, r_gids, l_valid, r_valid):
     packed = dispatch(cap)
     counts = packed[2, :n_l].astype(np.int64)
     total = int(counts.sum())
-    dispatches = 1
+    hist = [(strategy, cap)]  # one entry per dispatch that ran
     if total > cap:  # rare: many-to-many blowup past the FK estimate
         cap = bucket_capacity(total)
+        if strategy == "hash" and cap > pk.max_table_slots():
+            # the probe kernel pins two cap-sized output index planes
+            # on-chip (whole-plane BlockSpecs); a many-to-many blowup
+            # bucket past the slot ceiling belongs to the sort kernel,
+            # whose buffers live in HBM
+            strategy, kernel = "sort", K.join_fused_kernel
         packed = dispatch(cap)
-        dispatches = 2
-    costmodel.ledger_record(
-        "join", rows=n_l + n_r,
-        nbytes=dispatches * mfu.join_bytes_model(c_l, c_r, cap),
-        seconds=_time.perf_counter() - t0, dispatches=dispatches)
+        hist.append((strategy, cap))
+
+    def _model(strat, c):
+        return mfu.hash_join_bytes_model(c_l, c_r, c) if strat == "hash" \
+            else mfu.join_bytes_model(c_l, c_r, c)
+
+    # per-strategy accounting (the overflow re-dispatch can switch the
+    # ladder to sort): each family record carries its own dispatch count
+    # and byte model; the row count and whole-ladder wall go to the
+    # completing strategy's record — the same discipline as the fused-agg
+    # ladder in device/fragment.py
+    secs = _time.perf_counter() - t0
+    acct: dict = {}
+    for s_, c_ in hist:
+        d = acct.setdefault(s_, [0, 0])
+        d[0] += 1
+        d[1] += _model(s_, c_)
+    for s_, (n_disp, nbytes) in acct.items():
+        final = s_ == strategy
+        # live build rows over the 2× build-capacity table: ≤ 0.5 by
+        # construction (the table can never fill)
+        lf = n_r / pk.join_table_capacity(c_r) if s_ == "hash" else None
+        costmodel.ledger_record(
+            "join", rows=(n_l + n_r) if final else 0, nbytes=nbytes,
+            seconds=secs if final else 0.0, dispatches=n_disp,
+            strategy=s_, load_factor=lf)
     return (packed[0, :total].astype(np.int64),
             packed[1, :total].astype(np.int64), counts)
 

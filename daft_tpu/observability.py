@@ -383,6 +383,10 @@ class RuntimeStatsContext:
                              f" ({d.get('roofline_pct', 0)}% roofline)")
                 if "mfu_pct" in d:
                     extra += f" {d['mfu_pct']}% MFU"
+                if "strategy" in d:
+                    extra += f" strategy={d['strategy']}"
+                    if "load_factor" in d:
+                        extra += f" load={d['load_factor']}"
                 lines.append(
                     f"  {kind}: dispatches={d['dispatches']} "
                     f"rows={d['rows']} time={d['seconds']:.3f}s{extra}")
