@@ -888,6 +888,165 @@ def run_serve_smoke() -> int:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def run_obs_bench():
+    """``--obs``: tracing-overhead measurement on the serve-bench mixed
+    workload. Three runs of the same closed-loop traffic: tracing OFF,
+    SAMPLED (10%), and FULL — the artifact records QPS and p99 deltas
+    vs the off baseline. Gate (documented in README): full tracing must
+    cost < 5% QPS."""
+    modes = [("off", {"DAFT_TPU_TRACE": "0"}),
+             ("sampled", {"DAFT_TPU_TRACE": "1",
+                          "DAFT_TPU_TRACE_SAMPLE": "0.1"}),
+             ("full", {"DAFT_TPU_TRACE": "1",
+                       "DAFT_TPU_TRACE_SAMPLE": "1.0"})]
+    duration = float(os.environ.get("BENCH_OBS_SECONDS", "12"))
+    # discarded FULL-LENGTH warm-up: the first serve run pays datagen +
+    # per-shape jit warm-up (7 query shapes); charging any of that to
+    # the "off" baseline would fake a tracing speedup — a 6s warm-up
+    # measurably wasn't enough (first committed r13 attempt)
+    run_serve_bench(duration_s=duration)
+    out = {}
+    for name, env in modes:
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            r = run_serve_bench(duration_s=duration)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        out[name] = {"qps": r.get("qps"),
+                     "latency_p50_ms": r.get("latency_p50_ms"),
+                     "latency_p99_ms": r.get("latency_p99_ms"),
+                     "completed": r.get("completed")}
+    base_qps = out["off"]["qps"] or 1e-9
+    for name in ("sampled", "full"):
+        qps = out[name]["qps"] or 0
+        out[name]["qps_overhead_pct"] = round(
+            100.0 * (base_qps - qps) / base_qps, 2)
+        p99b = out["off"]["latency_p99_ms"] or 1e-9
+        out[name]["p99_delta_pct"] = round(
+            100.0 * ((out[name]["latency_p99_ms"] or 0) - p99b) / p99b, 2)
+    out["gate_full_overhead_pct"] = 5.0
+    out["gate_pass"] = out["full"]["qps_overhead_pct"] < 5.0
+    return out
+
+
+def run_obs_smoke() -> int:
+    """``--obs-smoke``: the observability CI gate. Runs a traced local
+    query and a traced distributed query, validates the exported Chrome
+    trace against the schema (required fields, monotonic non-negative
+    timestamps, matched phases), checks parent-child consistency (no
+    orphan spans), scrapes the dashboard's ``/metrics`` with the strict
+    text-format parser, and exercises the flight recorder's byte-cap
+    rotation. Exit 1 on any failure (daft-lint runs as its own CI
+    step)."""
+    import tempfile
+    import urllib.request
+
+    import daft_tpu as dt
+    import daft_tpu.context as dctx
+    from daft_tpu import col, dashboard, tracing
+    from daft_tpu import observability as obs
+    from daft_tpu.runners.distributed_runner import DistributedRunner
+
+    failures = []
+    tmp = tempfile.mkdtemp(prefix="daft_tpu_obs_smoke_")
+    os.environ["DAFT_TPU_TRACE"] = "1"
+    os.environ["DAFT_TPU_TRACE_DIR"] = os.path.join(tmp, "traces")
+    os.environ["DAFT_TPU_QUERY_LOG"] = os.path.join(tmp, "queries.jsonl")
+    os.environ["DAFT_TPU_QUERY_LOG_BYTES"] = "20000"
+    try:
+        # 1) traced local query → exported chrome trace validates
+        df = (dt.from_pydict({"x": list(range(5000)),
+                              "g": [i % 11 for i in range(5000)]})
+              .where(col("x") > 10)
+              .groupby("g").agg(col("x").sum().alias("s")))
+        assert len(df.sort("g").to_pydict()["g"]) == 11
+        import glob as g
+        files = g.glob(os.path.join(tmp, "traces", "trace_*.json"))
+        if not files:
+            failures.append("no chrome trace exported for local query")
+        else:
+            doc = json.load(open(files[0]))
+            probs = tracing.validate_chrome_trace(doc)
+            if probs:
+                failures.append(f"chrome trace invalid: {probs[:3]}")
+            names = {e["name"] for e in doc["traceEvents"]
+                     if e.get("ph") == "X"}
+            for want in ("query", "plan:optimize"):
+                if want not in names:
+                    failures.append(f"trace missing {want!r} span")
+
+        # 2) traced distributed query → merged trace, no orphans,
+        #    worker/fetch spans present
+        runner = DistributedRunner(num_workers=2)
+        old = dctx.get_context()._runner
+        dctx.get_context().set_runner(runner)
+        try:
+            ddf = (dt.from_pydict({"k": [i % 5 for i in range(4000)],
+                                   "v": [float(i) for i in range(4000)]})
+                   .into_partitions(3)
+                   .groupby("k").agg(col("v").sum().alias("s")))
+            assert len(ddf.sort("k").to_pydict()["k"]) == 5
+        finally:
+            dctx.get_context().set_runner(old)
+            if runner._manager is not None:
+                runner._manager.shutdown()
+        stats = obs.last_query_stats()
+        rec = stats.trace_ctx.recorder if stats.trace_ctx else None
+        if rec is None:
+            failures.append("distributed query produced no trace")
+        else:
+            orph = tracing.orphan_spans(rec)
+            if orph:
+                failures.append(f"{len(orph)} orphan spans")
+            kinds = {s["name"] for s in rec.spans()}
+            for want in ("task", "task:run", "stage"):
+                if want not in kinds:
+                    failures.append(f"merged trace missing {want!r}")
+
+        # 3) /metrics scrapes and parses strictly
+        port = dashboard.launch(0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                text = r.read().decode()
+            metrics = tracing.parse_prometheus_text(text)
+            if "daft_tpu_flight_recorder_queries_total" not in metrics:
+                failures.append("flight recorder metric missing")
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/api/history",
+                    timeout=10) as r:
+                hist = json.loads(r.read())
+            if not hist:
+                failures.append("/api/history empty after traced queries")
+        finally:
+            dashboard.shutdown()
+
+        # 4) flight recorder rotates at its byte cap
+        for i in range(200):
+            tracing.flight_record({"ts": "t", "wall_us": i,
+                                   "pad": "x" * 256})
+        qlog = os.environ["DAFT_TPU_QUERY_LOG"]
+        if not os.path.exists(qlog + ".1"):
+            failures.append("flight recorder never rotated at byte cap")
+        elif os.path.getsize(qlog) > 20000:
+            failures.append("flight recorder exceeded its byte cap")
+
+        print(json.dumps({"obs_smoke": {
+            "failures": failures[:10], "ok": not failures}}), flush=True)
+        return 1 if failures else 0
+    finally:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+        for k in ("DAFT_TPU_TRACE", "DAFT_TPU_TRACE_DIR",
+                  "DAFT_TPU_QUERY_LOG", "DAFT_TPU_QUERY_LOG_BYTES"):
+            os.environ.pop(k, None)
+
+
 def run_kernels_bench():
     """``--kernels``: the hash-vs-sort device kernel sweep (round 12).
 
@@ -1339,6 +1498,13 @@ def main():
         if r is not None:
             detail["kernels_bench"] = r
 
+    if "--obs" in sys.argv:
+        # tracing-overhead measurement: off vs sampled vs full tracing on
+        # the serve-bench mixed workload (QPS/p99 deltas, <5% full gate)
+        r = section("obs", run_obs_bench, min_needed=120.0)
+        if r is not None:
+            detail["obs_bench"] = r
+
     if "--serve" in sys.argv:
         # serving plane: sustained mixed traffic through the query
         # scheduler — QPS, p50/p99 latency, queue wait, rejections,
@@ -1397,7 +1563,7 @@ def main():
 
     results_dir = os.path.join(REPO, "benchmarking", "results")
     os.makedirs(results_dir, exist_ok=True)
-    artifact = os.path.join(results_dir, "r12_bench_driver.json")
+    artifact = os.path.join(results_dir, "r13_bench_driver.json")
     with open(artifact, "w") as f:
         json.dump(full, f, indent=1)
     # progress/bulk lines first (NOT last): full detail for humans reading
@@ -1483,12 +1649,20 @@ def main():
             "repeat_x": sv.get("repeat_speedup"),
             "rc_hit": sv.get("result_cache_hit_rate"),
             "leak": sv.get("admitted_bytes_outstanding_after_drain")}
+    ob = detail.get("obs_bench")
+    if isinstance(ob, dict) and "error" not in ob:
+        compact["obs"] = {
+            "full_overhead_pct": ob.get("full", {}).get(
+                "qps_overhead_pct"),
+            "sampled_overhead_pct": ob.get("sampled", {}).get(
+                "qps_overhead_pct"),
+            "gate_pass": ob.get("gate_pass")}
     if skipped:
         compact["n_skipped"] = len(skipped)
     if errors:
         compact["n_errors"] = len(errors)
     # hard cap: drop optional keys until the line fits the driver's window
-    for drop in ("kernels", "serve", "scan", "shuffle", "chaos",
+    for drop in ("obs", "kernels", "serve", "scan", "shuffle", "chaos",
                  "ledger_dispatches",
                  "mfu", "families", "q1_winner", "backend"):
         if len(json.dumps(compact)) <= 1500:
@@ -1506,5 +1680,9 @@ if __name__ == "__main__":
         # CI gate: no datagen, no device tier — a few seconds of serving
         # traffic with leak + sanitizer-cycle checks
         sys.exit(run_serve_smoke())
+    elif "--obs-smoke" in sys.argv:
+        # CI gate: traced local + distributed queries, chrome-trace schema
+        # validation, strict /metrics parse, flight-recorder rotation
+        sys.exit(run_obs_smoke())
     else:
         main()

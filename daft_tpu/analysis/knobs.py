@@ -304,6 +304,37 @@ _KNOBS: List[Knob] = [
        "sanitizer (cycle detection, contention + blocking-while-held "
        "accounting; reported at pytest session end and in "
        "`explain(analyze=True)`)"),
+    _k("DAFT_TPU_TRACE", "bool", False, "daft_tpu/tracing.py",
+       "observability", "`1` enables the query-wide tracing plane: one "
+       "span tree per query across scheduler/planner/device/pipeline/"
+       "distributed layers, exported as Chrome trace JSON + OTLP spans"),
+    _k("DAFT_TPU_TRACE_SAMPLE", "float", 1.0, "daft_tpu/tracing.py",
+       "observability", "fraction of queries traced when tracing is on "
+       "(deterministic per-query decision hashed from the trace key, "
+       "never RNG)"),
+    _k("DAFT_TPU_TRACE_DIR", "str", None, "daft_tpu/tracing.py",
+       "observability", "directory receiving one perfetto-loadable "
+       "`trace_<id>.json` per traced query (unset: traces stay "
+       "in-memory for OTLP/flight-recorder export only)"),
+    _k("DAFT_TPU_TRACE_MAX_SPANS", "int", 8192, "daft_tpu/tracing.py",
+       "observability", "per-query span-buffer bound; spans past it are "
+       "counted as dropped, never allocated"),
+    _k("DAFT_TPU_OTLP_TIMEOUT", "float", 5.0, "daft_tpu/observability.py",
+       "observability", "seconds an OTLP/HTTP export POST may take; a "
+       "hung or failing collector is counted in `otlp_export_errors` "
+       "and never stalls or fails the query"),
+    _k("DAFT_TPU_QUERY_LOG", "str", None, "daft_tpu/tracing.py",
+       "observability", "flight-recorder JSONL path persisting every "
+       "query's stat blocks + trace summary + slow-query flag "
+       "(size-capped rotation; served at `/api/history`)"),
+    _k("DAFT_TPU_QUERY_LOG_BYTES", "bytes", 16 << 20,
+       "daft_tpu/tracing.py", "observability",
+       "flight-recorder rotation cap: when the JSONL exceeds it, it "
+       "rotates to `<path>.1` (one generation kept)",
+       default_str="16MiB"),
+    _k("DAFT_TPU_SLOW_QUERY_MS", "float", 0.0, "daft_tpu/tracing.py",
+       "observability", "wall-time threshold flagging a flight-recorder "
+       "entry `slow: true` (`0` disables the flag)"),
     # -------------------------------------------------------- kernels
     _k("DAFT_TPU_KERNEL_GROUPBY", "str", "auto",
        "daft_tpu/device/costmodel.py", "kernels",

@@ -21,7 +21,12 @@ DEFAULT_PORT = 3238
 
 _history_lock = threading.Lock()
 _history: List[dict] = []
+_history_bytes: List[int] = []  # parallel to _history: entry JSON sizes
+#: broadcast-history bounds — BOTH apply: a count cap and a byte cap
+#: (one query with a giant explain must not let 49 more like it pin
+#: hundreds of MB in a long-lived --serve process)
 _MAX_HISTORY = 50
+_MAX_HISTORY_BYTES = 4 << 20
 _server: Optional[http.server.ThreadingHTTPServer] = None
 
 
@@ -52,12 +57,21 @@ def broadcast_query(stats) -> None:
             # serving plane: session/priority/queue-wait/admission and
             # plan/result cache outcomes for scheduler-run queries
             "serving": dict(getattr(stats, "serving", {}) or {}),
+            # tracing plane: merged-trace summary (id, span count)
+            "trace": dict(getattr(stats, "trace_summary", {}) or {}),
         }
+        size = len(json.dumps(entry, default=str))
     except Exception:
         return
     with _history_lock:
         _history.append(entry)
-        del _history[:-_MAX_HISTORY]
+        _history_bytes.append(size)
+        # count cap, then byte cap: evict oldest-first until both hold
+        while len(_history) > _MAX_HISTORY \
+                or (sum(_history_bytes) > _MAX_HISTORY_BYTES
+                    and len(_history) > 1):
+            _history.pop(0)
+            _history_bytes.pop(0)
 
 
 def _serving_view() -> dict:
@@ -77,7 +91,28 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
 
+    def _reply(self, body: bytes, ctype: str) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
+        if self.path.startswith("/metrics"):
+            # Prometheus text-format scrape: process-wide serving /
+            # shuffle / io / recovery / kernel counters + queue-depth
+            # and cache-hit-rate gauges
+            from . import tracing
+            self._reply(tracing.prometheus_text().encode(),
+                        "text/plain; version=0.0.4")
+            return
+        if self.path.startswith("/api/history"):
+            # flight-recorder history (DAFT_TPU_QUERY_LOG JSONL)
+            from . import tracing
+            self._reply(json.dumps(tracing.flight_history()).encode(),
+                        "application/json")
+            return
         if self.path.startswith("/api/serving"):
             body = json.dumps(_serving_view()).encode()
             self.send_response(200)
@@ -143,8 +178,27 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     f"<h3>query {len(_history) - i} — {q['ts']}</h3>"
                     f"{srv_html}{rec_html}{shf_html}{io_html}{san_html}"
                     f"<pre>{html.escape(q['explain'])}</pre>")
+        # flight-recorder history view (persisted across restarts, unlike
+        # the in-memory broadcast list above)
+        hist_html = ""
+        try:
+            from . import tracing
+            entries = tracing.flight_history(limit=20)
+        except Exception:
+            entries = []
+        if entries:
+            hist_rows = "".join(
+                f"<tr><td>{html.escape(str(e.get('ts')))}</td>"
+                f"<td>{float(e.get('wall_us', 0)) / 1e3:.1f}ms</td>"
+                f"<td>{'SLOW' if e.get('slow') else ''}</td>"
+                f"<td>{html.escape(str((e.get('trace') or {}).get('trace_id', '')))}</td>"
+                f"</tr>" for e in entries)
+            hist_html = ("<h2>query history (flight recorder)</h2>"
+                         "<table border=1><tr><th>ts</th><th>wall</th>"
+                         "<th>slow</th><th>trace</th></tr>"
+                         + hist_rows + "</table>")
         body = ("<html><head><title>daft-tpu dashboard</title></head><body>"
-                "<h1>daft-tpu queries</h1>" + live_html
+                "<h1>daft-tpu queries</h1>" + live_html + hist_html
                 + ("".join(rows) or "<p>no queries yet</p>")
                 + "</body></html>").encode()
         self.send_response(200)

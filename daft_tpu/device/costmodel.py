@@ -340,6 +340,30 @@ def ledger_record(kind: str, *, rows: int = 0, nbytes: float = 0.0,
     for field, v in fields:
         if v:
             obs.bump_plane("device_kernels", f"{kind}\x00{field}", v)
+    # tracing plane: one span per real dispatch, carrying the ledger's
+    # roofline story onto the query timeline (guard-checked: untraced
+    # queries build nothing here)
+    from .. import tracing
+    tctx = tracing.current()
+    if tctx is not None:
+        attrs = {"rows": rows, "bytes": int(nbytes), "flops": int(flops)}
+        if strategy:
+            attrs["strategy"] = strategy
+        if load_factor is not None:
+            attrs["load_factor"] = round(float(load_factor), 3)
+        if seconds > 0:
+            attrs["gbps"] = round(nbytes / seconds / 1e9, 3)
+            attrs["roofline_pct"] = round(
+                100.0 * nbytes / seconds / hbm_bps(), 4)
+            if flops:
+                attrs["mfu_pct"] = round(
+                    100.0 * flops / seconds / peak_flops(), 4)
+        dur_us = int(seconds * 1e6)
+        rec = tctx.recorder
+        rec.add(f"device:{kind}",
+                rec.unique_span_id(f"device:{kind}"), tctx.span_id,
+                tracing._now_us() - dur_us, dur_us, attrs=attrs,
+                lane="device")
 
 
 def _derive(d: dict) -> dict:

@@ -107,20 +107,57 @@ class WorkerServer:
         if host != "127.0.0.1":
             from .shuffle_service import configure_local_shuffle_server
             configure_local_shuffle_server(host, self._advertise)
-        pool = cf.ThreadPoolExecutor(max_workers=num_slots)
+        import os as _os
+
+        # daft-tpu prefix so run_task's lane parser yields a stable
+        # per-worker-process lane instead of "ThreadPoolExecutor-0"
+        pool = cf.ThreadPoolExecutor(
+            max_workers=num_slots,
+            thread_name_prefix=f"daft-tpu-remote-{_os.getpid()}")
+        # per-trace span buffers for foreign-driver tasks: refcounted so
+        # two concurrent tasks of ONE trace share a buffer and each
+        # response drains (never double-ships, never drops) its spans
+        trace_bufs: Dict[str, list] = {}
+        trace_bufs_lock = threading.Lock()
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def log_message(self, *a):
                 pass
 
             def do_POST(self):
+                import time as _time
+
+                from .. import tracing
                 n = int(self.headers.get("Content-Length", 0))
                 blob = self.rfile.read(n)
+                temp_rec = None
+                trace_ctx = None
                 try:
                     task_plan, inputs_wire, shuffle_out, *rest = \
                         pickle.loads(blob)
                     fault_key = rest[0] if rest else ""
                     attempt = rest[1] if len(rest) > 1 else 0
+                    trace_ctx = rest[2] if len(rest) > 2 else None
+                    if trace_ctx is not None:
+                        # foreign driver: buffer this task's spans
+                        # locally and ship them back with the result.
+                        # Refcounted get-or-create under ONE lock: two
+                        # concurrent tasks of the same trace share the
+                        # buffer (a bare check-then-register would let
+                        # the loser's spans vanish into an unregistered
+                        # recorder)
+                        with trace_bufs_lock:
+                            ent = trace_bufs.get(trace_ctx[0])
+                            if ent is None \
+                                    and tracing.recorder_for(
+                                        trace_ctx[0]) is None:
+                                ent = [tracing.SpanRecorder(trace_ctx[0]),
+                                       0]
+                                trace_bufs[trace_ctx[0]] = ent
+                                tracing.register_recorder(ent[0])
+                            if ent is not None:
+                                ent[1] += 1
+                                temp_rec = ent[0]
                     # cloudpickle-serialized closures need cloudpickle's
                     # reducers importable on this host; plan fragments
                     # without closure UDFs decode with plain pickle
@@ -135,22 +172,41 @@ class WorkerServer:
                         return run_task(StageTask(
                             -1, plan, stage_inputs,
                             shuffle_out=shuffle_out,
-                            fault_key=fault_key, attempt=attempt))
+                            fault_key=fault_key, attempt=attempt,
+                            trace_ctx=trace_ctx))
 
                     res = pool.submit(run).result()
                     from .worker import ShuffleResult
                     if isinstance(res, ShuffleResult):
-                        body = pickle.dumps(("shuffle", res))
+                        body = ("shuffle", res)
                     else:
-                        body = pickle.dumps(("parts", _parts_to_ipc(res)))
+                        body = ("parts", _parts_to_ipc(res))
                     status = 200
                 except Exception as exc:
                     # serialize the REAL exception (type + traceback, and
                     # the object itself when picklable) so the scheduler's
                     # retry classification sees the true cause instead of
                     # an opaque text blob
-                    body = pickle.dumps(("error", _exc_payload(exc)))
+                    body = ("error", _exc_payload(exc))
                     status = 500
+                trace_payload = None
+                if temp_rec is not None:
+                    # this task's run_task has fully recorded by now;
+                    # drain (not snapshot) so a concurrent sibling task's
+                    # later spans ship with ITS response, and only the
+                    # last task out unregisters the shared buffer
+                    with trace_bufs_lock:
+                        ent = trace_bufs.get(temp_rec.trace_id)
+                        if ent is not None:
+                            ent[1] -= 1
+                            if ent[1] <= 0:
+                                trace_bufs.pop(temp_rec.trace_id)
+                                tracing.unregister_recorder(
+                                    temp_rec.trace_id)
+                        spans = temp_rec.drain()
+                    trace_payload = {"spans": spans,
+                                     "now_us": int(_time.time() * 1e6)}
+                body = pickle.dumps(body + (trace_payload,))
                 self.send_response(status)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -185,46 +241,77 @@ class RemoteWorker(Worker):
 
     def _post(self, task: StageTask):
         import os
+        import time as _time
         import urllib.error
 
+        from .. import tracing
         from .resilience import active_fault_plan
         from .worker import FetchSpec
-        plan = active_fault_plan()
-        if plan is not None:  # injection site 3: remote-worker RPC
-            plan.maybe_fail("rpc", task.fault_key or f"rpc.{self.id}",
-                            attempt=task.attempt)
-        inputs_wire = {}
-        for k, v in task.stage_inputs.items():
-            if isinstance(v, FetchSpec):
-                inputs_wire[k] = ("fetch", v)
-            else:
-                inputs_wire[k] = ("parts", _parts_to_ipc(v))
-        blob = pickle.dumps((_dumps(task.plan), inputs_wire,
-                             task.shuffle_out, task.fault_key, task.attempt))
-        req = urllib.request.Request(self.address, data=blob, method="POST")
-        from ..analysis import knobs
-        timeout = knobs.env_float("DAFT_TPU_WORKER_TIMEOUT")
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as r:
-                body = r.read()
-        except urllib.error.HTTPError as exc:
-            # the body carries the serialized worker-side exception:
-            # re-raise the original object (retry classification and
-            # lineage recovery see the true cause) or a RemoteTaskError
-            # with the remote type + traceback
-            raw = exc.read()
+        rec = None
+        if task.trace_ctx is not None:
+            rec = tracing.recorder_for(task.trace_ctx[0])
+        with tracing.attach(
+                tracing.SpanContext(rec, task.trace_ctx[2])
+                if rec is not None else None), \
+                tracing.span("rpc:post",
+                             key=f"rpc:{task.fault_key}#a{task.attempt}",
+                             attrs={"worker": self.id}):
+            plan = active_fault_plan()
+            if plan is not None:  # injection site 3: remote-worker RPC
+                plan.maybe_fail("rpc", task.fault_key or f"rpc.{self.id}",
+                                attempt=task.attempt)
+            inputs_wire = {}
+            for k, v in task.stage_inputs.items():
+                if isinstance(v, FetchSpec):
+                    inputs_wire[k] = ("fetch", v)
+                else:
+                    inputs_wire[k] = ("parts", _parts_to_ipc(v))
+            blob = pickle.dumps((_dumps(task.plan), inputs_wire,
+                                 task.shuffle_out, task.fault_key,
+                                 task.attempt, task.trace_ctx))
+            req = urllib.request.Request(self.address, data=blob,
+                                         method="POST")
+            from ..analysis import knobs
+            timeout = knobs.env_float("DAFT_TPU_WORKER_TIMEOUT")
+            t0_us = int(_time.time() * 1e6)
             try:
-                kind, payload = pickle.loads(raw)
-            except Exception:
-                raise RuntimeError("remote worker failed:\n"
-                                   + raw.decode(errors="replace")) from exc
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    body = r.read()
+            except urllib.error.HTTPError as exc:
+                # the body carries the serialized worker-side exception:
+                # re-raise the original object (retry classification and
+                # lineage recovery see the true cause) or a
+                # RemoteTaskError with the remote type + traceback
+                raw = exc.read()
+                try:
+                    kind, payload, *rest = pickle.loads(raw)
+                except Exception:
+                    raise RuntimeError(
+                        "remote worker failed:\n"
+                        + raw.decode(errors="replace")) from exc
+                self._merge_spans(rec, rest, t0_us,
+                                  int(_time.time() * 1e6))
+                if kind == "error":
+                    _raise_remote(payload)
+                raise RuntimeError(
+                    f"remote worker failed: {payload!r}") from exc
+            kind, payload, *rest = pickle.loads(body)
+            self._merge_spans(rec, rest, t0_us, int(_time.time() * 1e6))
             if kind == "error":
                 _raise_remote(payload)
-            raise RuntimeError(f"remote worker failed: {payload!r}") from exc
-        kind, payload = pickle.loads(body)
-        if kind == "shuffle":
-            return payload
-        return _parts_from_ipc(payload)
+            if kind == "shuffle":
+                return payload
+            return _parts_from_ipc(payload)
+
+    def _merge_spans(self, rec, rest, t0_us: int, t1_us: int) -> None:
+        """Fold the worker's shipped spans into the driver's recorder,
+        correcting their wall clock by the measured offset (worker send
+        time vs the RPC's midpoint on the driver clock)."""
+        tp = rest[0] if rest else None
+        if rec is None or not tp:
+            return
+        offset_us = (t0_us + t1_us) // 2 - tp["now_us"]
+        rec.add_remote(tp["spans"], offset_us, worker=self.address)
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)

@@ -546,18 +546,57 @@ class TaskSupervisor:
         abandoned: Dict = {}         # future -> _Run (discard on arrival)
         delayed: List = []           # (due_time, idx, attempt, exclude)
 
+        # tracing: one parent span per TASK (stable fault-key id), child
+        # attempt/retry/speculation/recompute spans hang off it; all ids
+        # hash planner-minted identities, so chaos replays mint the same
+        from .. import tracing as _tr
+        tctx = _tr.current()
+        trec = tctx.recorder if tctx is not None else None
+        t_span: List = [None] * n    # (span_id, start_unix_us) per task
+        self._trace = (trec, tctx.span_id if tctx is not None else None)
+
+        def task_span(idx: int):
+            if trec is None:
+                return None
+            if t_span[idx] is None:
+                key = tasks[idx].fault_key \
+                    or f"s{tasks[idx].stage_id}.t{tasks[idx].task_idx}"
+                t_span[idx] = (trec.unique_span_id(f"task:{key}"),
+                               _tr._now_us())
+            return t_span[idx][0]
+
+        def end_task_span(idx: int, status: str = "ok") -> None:
+            if trec is None or t_span[idx] is None:
+                return
+            sid, t0 = t_span[idx]
+            key = tasks[idx].fault_key or str(idx)
+            trec.add("task", sid, tctx.span_id, t0,
+                     _tr._now_us() - t0, attrs={"task": key},
+                     status=status)
+
         def launch(idx: int, attempt: int, exclude: Optional[str] = None,
                    backup: bool = False) -> None:
             task = tasks[idx]
+            fkey = task.fault_key or f"s{task.stage_id}.t{task.task_idx}"
+            trace_ctx = None
+            if trec is not None:
+                parent = task_span(idx)
+                run_id = trec.unique_span_id(
+                    f"run:{fkey}#a{attempt}{'b' if backup else ''}")
+                trace_ctx = (trec.trace_id, run_id, parent)
             dtask = dataclasses.replace(
                 task,
                 stage_inputs=self.ctx.lineage.translate_inputs(
                     task.stage_inputs),
-                fault_key=task.fault_key or f"s{task.stage_id}"
-                                            f".t{task.task_idx}",
-                attempt=attempt + (500 if backup else 0))
+                fault_key=fkey,
+                attempt=attempt + (500 if backup else 0),
+                trace_ctx=trace_ctx)
             states = pol.eligible(self.manager.snapshot(), exclude=exclude)
             wid = self.scheduler.pick(dtask, states)
+            if backup and trec is not None:
+                _tr.event("task:speculative", key=f"spec:{fkey}",
+                          attrs={"worker": wid},
+                          ctx=_tr.SpanContext(trec, task_span(idx)))
             fut = self.manager.dispatch(dtask, wid)
             live[idx] += 1
             if backup:
@@ -568,6 +607,27 @@ class TaskSupervisor:
         durations: List[float] = []
         for i in range(n):
             launch(i, 0)
+
+        try:
+            self._run_loop(tasks, pol, results, done, attempts,
+                           fetch_states, sig_workers, has_backup, live,
+                           runs, abandoned, delayed, durations, launch,
+                           task_span, end_task_span, speculate)
+        except BaseException:
+            # fatal failure (retries exhausted / fail-fast / recovery
+            # dead end): still close every started task span so no
+            # recorded child span is left orphaned
+            for i in range(n):
+                if not done[i]:
+                    end_task_span(i, status="error")
+            raise
+        return results
+
+    def _run_loop(self, tasks, pol, results, done, attempts, fetch_states,
+                  sig_workers, has_backup, live, runs, abandoned, delayed,
+                  durations, launch, task_span, end_task_span,
+                  speculate) -> None:
+        import concurrent.futures as cf
 
         while not all(done):
             if runs:
@@ -593,6 +653,7 @@ class TaskSupervisor:
                     done[run.idx] = True
                     durations.append(pol.clock() - run.t0)
                     pol.record_success(run.worker_id)
+                    end_task_span(run.idx)
                     if has_backup[run.idx]:
                         count("speculative_wins" if run.backup
                               else "speculative_losses")
@@ -612,7 +673,8 @@ class TaskSupervisor:
                 # speculative win/loss, may speculate again)
                 has_backup[run.idx] = False
                 self._handle_failure(run, exc, tasks, attempts,
-                                     fetch_states, sig_workers, delayed)
+                                     fetch_states, sig_workers, delayed,
+                                     task_span_id=task_span(run.idx))
 
             now = pol.clock()
             for item in [d for d in delayed if d[0] <= now]:
@@ -639,7 +701,7 @@ class TaskSupervisor:
                             f"task exceeded DAFT_TPU_TASK_TIMEOUT="
                             f"{pol.task_timeout}s"),
                         tasks, attempts, fetch_states, sig_workers,
-                        delayed)
+                        delayed, task_span_id=task_span(run.idx))
                     continue
                 if (speculate and pol.speculative_multiplier > 0
                         and not run.backup and not has_backup[run.idx]
@@ -654,29 +716,46 @@ class TaskSupervisor:
                 abandoned.pop(fut)
                 self._discard(fut)
 
-        return results
-
     # ---- failure classification ------------------------------------
     def _handle_failure(self, run: _Run, exc: BaseException, tasks,
                         attempts, fetch_states, sig_workers,
-                        delayed) -> None:
+                        delayed, task_span_id: Optional[str] = None
+                        ) -> None:
+        from .. import tracing as _tr
         pol = self.ctx.policy
         idx = run.idx
+        trec, _root = getattr(self, "_trace", (None, None))
+        tspan_ctx = _tr.SpanContext(trec, task_span_id) \
+            if trec is not None and task_span_id else None
+        fkey = tasks[idx].fault_key or str(idx)
         if isinstance(exc, ShuffleFetchError):
             # the executing worker is healthy — its INPUT is gone; don't
             # charge its circuit breaker or the fail-fast classifier
             if fetch_states[idx].should_recover(exc):
                 # failed again after a plain refetch: the data is gone —
-                # recompute only the producing map task (lineage)
-                if not self.recover_source((exc.address, exc.shuffle_id),
-                                           exc):
-                    raise exc
+                # recompute only the producing map task (lineage);
+                # attach the failing task's span so the recompute chain
+                # nests under it in the merged trace
+                with _tr.attach(tspan_ctx):
+                    if not self.recover_source(
+                            (exc.address, exc.shuffle_id), exc):
+                        raise exc
             count("retries")
+            if tspan_ctx is not None:
+                _tr.event("task:retry",
+                          key=f"retry:{fkey}#f{fetch_states[idx].attempts}",
+                          attrs={"error": type(exc).__name__,
+                                 "detail": str(exc)[:120]},
+                          ctx=tspan_ctx)
             delayed.append((pol.clock()
                             + pol.backoff_s(tasks[idx].fault_key or str(idx),
                                             fetch_states[idx].attempts),
                             idx, run.attempt + 1, None))
             return
+        if tspan_ctx is not None:
+            _tr.event("task:retry", key=f"retry:{fkey}#a{run.attempt}",
+                      attrs={"error": type(exc).__name__,
+                             "detail": str(exc)[:120]}, ctx=tspan_ctx)
         if not isinstance(exc, TaskTimeout):
             # fail-fast classification — timeouts are exempt: their
             # signature is timing-dependent, not task-deterministic, so
@@ -707,11 +786,18 @@ class TaskSupervisor:
                 "lineage recovery recursion limit reached") from exc
 
         def rerun(map_task):
+            from .. import tracing as _tr
             self.ctx.depth += 1  # serialized under the lineage lock
             try:
-                child = TaskSupervisor(self.ctx, self.manager,
-                                       self.scheduler)
-                return child.run([map_task], speculate=False)[0]
+                # recompute span: child of whatever span context the
+                # caller attached (the consuming task's span); the child
+                # supervisor's own task spans nest under it
+                with _tr.span("lineage:recompute",
+                              key=f"recompute:{map_task.fault_key or 'map'}",
+                              attrs={"task": map_task.fault_key}):
+                    child = TaskSupervisor(self.ctx, self.manager,
+                                           self.scheduler)
+                    return child.run([map_task], speculate=False)[0]
             finally:
                 self.ctx.depth -= 1
 

@@ -452,8 +452,14 @@ class StageRunner:
     def _collect(self, tasks: List[StageTask]) -> list:
         """Dispatch one batch of tasks through the resilient task
         supervisor (retry/quarantine/lineage/speculation live there) and
-        flatten the per-task results in task order."""
-        per_task = self._supervisor().run(tasks)
+        flatten the per-task results in task order. A traced query gets
+        one ``stage`` span per batch; the supervisor's per-task spans
+        nest under it."""
+        from .. import tracing
+        sid = tasks[0].stage_id if tasks else -1
+        with tracing.span("stage", key=f"stage:s{sid}",
+                          attrs={"tasks": len(tasks)}):
+            per_task = self._supervisor().run(tasks)
         out: list = []
         for res in per_task:
             out.extend(res if isinstance(res, list) else [res])

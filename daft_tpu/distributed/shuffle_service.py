@@ -285,6 +285,7 @@ class ShuffleServer:
                 self.end_headers()
 
             def do_GET(self):
+                from .. import tracing
                 parts = self.path.strip("/").split("/")
                 if len(parts) != 3 or parts[0] != "shuffle":
                     self.send_response(404)
@@ -298,18 +299,30 @@ class ShuffleServer:
                     self.end_headers()
                     return
                 cache.touch()
-                # chunked send off the spill file: resident memory is one
-                # chunk, never the partition (Content-Length comes from a
-                # stat, and partition_chunks sends exactly that many bytes
-                # even under a concurrent straggler append)
-                size = cache.partition_size(pidx)
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "application/vnd.apache.arrow.stream")
-                self.send_header("Content-Length", str(size))
-                self.end_headers()
-                for chunk in cache.partition_chunks(pidx, limit=size):
-                    self.wfile.write(chunk)
+                # serve-side child span when the fetch carried span
+                # context headers and the trace lives in this process
+                # span key derives from the PARENT (fetch) span id — a
+                # stable identity; the shuffle uuid is run-specific and
+                # would break bit-identical chaos replay
+                tctx = tracing.context_from_headers(self.headers)
+                skey = f"serve:{tctx.span_id}" if tctx is not None \
+                    else None
+                with tracing.attach(tctx), \
+                        tracing.span("shuffle:serve", key=skey,
+                                     lane="shuffle") as sp:
+                    # chunked send off the spill file: resident memory is
+                    # one chunk, never the partition (Content-Length comes
+                    # from a stat, and partition_chunks sends exactly that
+                    # many bytes even under a concurrent straggler append)
+                    size = cache.partition_size(pidx)
+                    sp.set("bytes", size)
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/vnd.apache.arrow.stream")
+                    self.send_header("Content-Length", str(size))
+                    self.end_headers()
+                    for chunk in cache.partition_chunks(pidx, limit=size):
+                        self.wfile.write(chunk)
 
         self._server = http.server.ThreadingHTTPServer((self._host, port),
                                                        Handler)
@@ -366,7 +379,19 @@ class FlightShuffleServer:
                     f"unknown action {action.type!r}")
 
             def do_get(self, context, ticket):
-                sid, _, pidx = ticket.ticket.decode().partition("/")
+                from .. import tracing
+                # ticket: <sid>/<part>[/<trace_id>/<parent_span>] — the
+                # trailing pair is the span context riding the Flight wire
+                fields = ticket.ticket.decode().split("/")
+                sid, pidx = fields[0], fields[1]
+                if len(fields) >= 4:
+                    tctx = tracing.remote_context(fields[2], fields[3])
+                    if tctx is not None:
+                        # keyed on the stable parent (fetch) span id, not
+                        # the run-specific shuffle uuid — replay contract
+                        tracing.event("shuffle:serve",
+                                      key=f"serve:{fields[3]}",
+                                      lane="shuffle", ctx=tctx)
                 with outer._lock:
                     cache = outer._caches.get(sid)
                 if cache is None:
@@ -615,38 +640,45 @@ def fetch_partition(address: str, shuffle_id: str, partition: int,
     identity the scheduler's lineage recovery keys on. ``fault_key`` is
     the stable (run-independent) source identity used for deterministic
     fault injection; it defaults to the shuffle id."""
+    from .. import tracing
     from .resilience import ShuffleFetchError, active_fault_plan
     key = fault_key or shuffle_id
-    plan = active_fault_plan()
-    if plan is not None:  # injection site 2: partition fetch
-        if plan.decide("crash", f"{key}/p{partition}"):
-            # a dead map worker: the served data is really gone — every
-            # later fetch of this shuffle fails too, until the scheduler
-            # recomputes the producing map task
-            try:
-                unregister_remote(address, shuffle_id)
-            except Exception:
-                pass
+    # one span per fetch attempt, keyed by the stable source identity —
+    # injected faults and transport failures land as error-status spans
+    with tracing.span("shuffle:fetch", key=f"fetch:{key}/p{partition}",
+                      attrs={"address": address, "partition": partition},
+                      lane="shuffle") as sp:
+        plan = active_fault_plan()
+        if plan is not None:  # injection site 2: partition fetch
+            if plan.decide("crash", f"{key}/p{partition}"):
+                # a dead map worker: the served data is really gone —
+                # every later fetch of this shuffle fails too, until the
+                # scheduler recomputes the producing map task
+                try:
+                    unregister_remote(address, shuffle_id)
+                except Exception:
+                    pass
+                raise ShuffleFetchError(address, shuffle_id, partition,
+                                        detail="injected worker crash",
+                                        injected=True)
+            if plan.decide("fetch", f"{key}/p{partition}"):
+                raise ShuffleFetchError(address, shuffle_id, partition,
+                                        detail="injected fetch fault",
+                                        injected=True)
+        import time as _time
+        t0 = _time.perf_counter()
+        try:
+            out = _fetch_partition_raw(address, shuffle_id, partition)
+        except Exception as exc:
             raise ShuffleFetchError(address, shuffle_id, partition,
-                                    detail="injected worker crash",
-                                    injected=True)
-        if plan.decide("fetch", f"{key}/p{partition}"):
-            raise ShuffleFetchError(address, shuffle_id, partition,
-                                    detail="injected fetch fault",
-                                    injected=True)
-    import time as _time
-    t0 = _time.perf_counter()
-    try:
-        out = _fetch_partition_raw(address, shuffle_id, partition)
-    except Exception as exc:
-        raise ShuffleFetchError(address, shuffle_id, partition,
-                                detail=f"{type(exc).__name__}: "
-                                       f"{str(exc)[:200]}") from exc
-    # serial-equivalent fetch time: the per-call sum the parallel fetch's
-    # span is compared against in the stats/bench overlap evidence
-    shuffle_count("fetch_wall_us", (_time.perf_counter() - t0) * 1e6)
-    shuffle_count("fetches")
-    return out
+                                    detail=f"{type(exc).__name__}: "
+                                           f"{str(exc)[:200]}") from exc
+        # serial-equivalent fetch time: the per-call sum the parallel
+        # fetch's span is compared against in the overlap evidence
+        shuffle_count("fetch_wall_us", (_time.perf_counter() - t0) * 1e6)
+        shuffle_count("fetches")
+        sp.set("rows", out.num_rows if out is not None else 0)
+        return out
 
 
 class _CountingStream:
@@ -733,6 +765,7 @@ def _iter_stream_tables(f: "_CountingStream"):
 
 def _fetch_partition_raw(address: str, shuffle_id: str, partition: int
                          ) -> Optional[pa.Table]:
+    from .. import tracing
     if address.startswith("grpc://"):
         if paflight is None:
             raise RuntimeError(
@@ -741,8 +774,14 @@ def _fetch_partition_raw(address: str, shuffle_id: str, partition: int
                 "DAFT_TPU_SHUFFLE_TRANSPORT=http on the serving hosts")
         client = paflight.connect(address)
         try:
-            ticket = paflight.Ticket(f"{shuffle_id}/{partition}".encode())
-            reader = client.do_get(ticket)
+            # span context rides the ticket (Flight's header equivalent):
+            # <sid>/<part>[/<trace_id>/<parent_span>]
+            tstr = f"{shuffle_id}/{partition}"
+            tctx = tracing.current()
+            if tctx is not None:
+                tid, psid = tctx.wire()
+                tstr += f"/{tid}/{psid}"
+            reader = client.do_get(paflight.Ticket(tstr.encode()))
             t = reader.read_all()
         finally:
             client.close()
@@ -756,7 +795,10 @@ def _fetch_partition_raw(address: str, shuffle_id: str, partition: int
     from ..analysis import knobs
     timeout = knobs.env_float("DAFT_TPU_SHUFFLE_TIMEOUT")
     try:
-        r = urllib.request.urlopen(url, timeout=timeout)
+        # span context propagates as headers over the HTTP shuffle wire
+        r = urllib.request.urlopen(
+            urllib.request.Request(url, headers=tracing.wire_headers()),
+            timeout=timeout)
     except urllib.error.HTTPError as exc:
         # urlopen raises on every non-200 — surface the status explicitly
         # so ShuffleFetchError.detail carries it (a 404 here usually means

@@ -104,6 +104,11 @@ class StageTask:
     # by the task supervisor; travels over the remote-worker wire)
     fault_key: str = ""
     attempt: int = 0
+    # tracing plane: (trace_id, run_span_id, parent_span_id) minted by
+    # the task supervisor from the stable fault key — the worker records
+    # its task-run span under exactly these ids (travels over the
+    # remote-worker wire too); None = untraced query
+    trace_ctx: Optional[tuple] = None
 
 
 def _chaos_serialized() -> bool:
@@ -197,6 +202,7 @@ class _ParallelFetch:
       ``resolve_stage_inputs``) and this class is never constructed."""
 
     def __init__(self, spec: FetchSpec, streaming: bool = False):
+        from .. import tracing
         self.spec = spec
         self.streaming = streaming
         self._pool: Optional[cf.ThreadPoolExecutor] = None
@@ -206,10 +212,14 @@ class _ParallelFetch:
         k = min(fetch_parallelism(), max(len(spec.sources), 1))
         if k > 1:
             from .shuffle_service import fetch_partition
+            # carry the task thread's span context onto the fetch pool so
+            # per-source fetch spans join the query trace
+            tctx = tracing.current()
             self._pool = cf.ThreadPoolExecutor(
                 max_workers=k, thread_name_prefix="daft-tpu-fetch")
             self._futs = [
-                self._pool.submit(fetch_partition, address, shuffle_id,
+                self._pool.submit(tracing.run_attached, tctx,
+                                  fetch_partition, address, shuffle_id,
                                   spec.partition, fault_key=self._key(j))
                 for j, (address, shuffle_id) in enumerate(spec.sources)]
 
@@ -325,9 +335,50 @@ def resolve_stage_inputs(stage_inputs: Dict[int, object],
     return out
 
 
+def _worker_lane() -> str:
+    """Trace lane for this worker thread (the InProcessWorker pool names
+    threads ``daft-tpu-<worker_id>_N``)."""
+    name = threading.current_thread().name
+    if name.startswith("daft-tpu-"):
+        name = name[len("daft-tpu-"):]
+    return f"worker:{name.rsplit('_', 1)[0]}"
+
+
 def run_task(task: StageTask) -> object:
     """Execute one stage task on the local streaming executor. Returns a
-    partition list, or a ShuffleResult when the task shuffles out."""
+    partition list, or a ShuffleResult when the task shuffles out. A
+    traced task (``task.trace_ctx``) records its ``task:run`` span —
+    and everything under it (fetches, operators, device dispatches) —
+    under the supervisor-minted span ids."""
+    import time as _time
+
+    from .. import observability as obs
+    from .. import tracing
+    rec = span_id = parent_id = None
+    if task.trace_ctx is not None:
+        trace_id, span_id, parent_id = task.trace_ctx
+        rec = tracing.recorder_for(trace_id)
+    t0_us = int(_time.time() * 1e6)
+    status = "ok"
+    try:
+        with obs.nested_scope(), \
+                tracing.attach(tracing.SpanContext(rec, span_id)
+                               if rec is not None else None):
+            return _run_task_body(task)
+    except BaseException:
+        status = "error"
+        raise
+    finally:
+        if rec is not None:
+            rec.add("task:run", span_id, parent_id, t0_us,
+                    int(_time.time() * 1e6) - t0_us,
+                    attrs={"task": task.fault_key
+                           or f"s{task.stage_id}.t{task.task_idx}",
+                           "attempt": task.attempt},
+                    lane=_worker_lane(), status=status)
+
+
+def _run_task_body(task: StageTask) -> object:
     from ..execution.executor import LocalExecutor
     from .resilience import active_fault_plan
     plan = active_fault_plan()

@@ -307,35 +307,42 @@ class LocalExecutor:
         class _ScanAbandoned(Exception):
             pass
 
-        def produce(task, st: _Stream):
+        def produce(task, st: _Stream, task_idx: int):
             if st.dead.is_set():  # consumer gone before we even started
                 return
+            from .. import tracing
             t0 = _time.perf_counter()
             est = task.size_bytes() or 0
+            # producer span keyed by the deterministic task index; the
+            # producer thread carries the query's span context through
+            # the same attribution the io counters ride
+            sp = tracing.span("scan:prefetch", key=f"scan.t{task_idx}",
+                              attrs={"est_bytes": est}, lane="scan")
             self.mem.acquire(est)
             try:
-                if st.dead.is_set():
-                    return
-                schema = task.materialized_schema()
-                produced = False
-                try:
-                    for rb in task.stream_batches():
-                        st.put(("batch",
-                                MicroPartition.from_recordbatch(
-                                    rb.cast_to_schema(schema))))
-                        produced = True
-                except OSError:
-                    if produced:
-                        raise  # can't re-stream mid-task without dup rows
-                    _time.sleep(0.2)  # transient remote IO: one clean retry
-                    for rb in task.stream_batches():
-                        st.put(("batch",
-                                MicroPartition.from_recordbatch(
-                                    rb.cast_to_schema(schema))))
-                        produced = True
-                if not produced:
-                    st.put(("batch", MicroPartition.empty(schema)))
-                st.put(("done", None))
+                with sp:
+                    if st.dead.is_set():
+                        return
+                    schema = task.materialized_schema()
+                    produced = False
+                    try:
+                        for rb in task.stream_batches():
+                            st.put(("batch",
+                                    MicroPartition.from_recordbatch(
+                                        rb.cast_to_schema(schema))))
+                            produced = True
+                    except OSError:
+                        if produced:
+                            raise  # can't re-stream mid-task: dup rows
+                        _time.sleep(0.2)  # transient IO: one clean retry
+                        for rb in task.stream_batches():
+                            st.put(("batch",
+                                    MicroPartition.from_recordbatch(
+                                        rb.cast_to_schema(schema))))
+                            produced = True
+                    if not produced:
+                        st.put(("batch", MicroPartition.empty(schema)))
+                    st.put(("done", None))
             except _ScanAbandoned:
                 pass
             except BaseException as exc:  # noqa: BLE001
@@ -350,6 +357,7 @@ class LocalExecutor:
 
         inflight = collections.deque()
         it = iter(tasks)
+        submitted = [0]
 
         def submit() -> bool:
             try:
@@ -359,7 +367,8 @@ class LocalExecutor:
             st = _Stream()
             from .. import observability as obs
             pool.submit(obs.run_attributed, obs.current_attribution(),
-                        produce, t, st)
+                        produce, t, st, submitted[0])
+            submitted[0] += 1
             inflight.append(st)
             rp.scan_count("prefetch_tasks")
             return True

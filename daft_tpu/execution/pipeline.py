@@ -87,9 +87,16 @@ class PipelineContext:
 
     def _guard(self, fn):
         from .. import observability as obs
+        from .. import tracing
         try:
             with obs.attributed(self.stats_ctx):
-                fn()
+                # one span per stage-thread lifetime; the thread name is
+                # deterministic (plan-derived), so span ids replay
+                name = threading.current_thread().name
+                with tracing.span("pipeline:stage", key=f"stage:{name}",
+                                  attrs={"thread": name},
+                                  lane="pipeline"):
+                    fn()
         except PipelineCancelled:
             pass
         except BaseException as exc:  # noqa: BLE001 — first error wins

@@ -46,15 +46,46 @@ def current_attribution() -> Optional["RuntimeStatsContext"]:
 
 @contextlib.contextmanager
 def attributed(ctx: Optional["RuntimeStatsContext"]):
-    """Install ``ctx`` as this thread's stats-attribution target."""
+    """Install ``ctx`` as this thread's stats-attribution target (and,
+    when the context belongs to a traced query, its span context — the
+    tracing plane rides the same propagation: pool workers, pipeline
+    stage threads and prefetch producers all come through here)."""
+    from . import tracing  # hot path: resolved from sys.modules
     prev = getattr(_attr_tl, "ctx", None)
     _attr_tl.ctx = ctx
+    tctx = ctx.trace_ctx if ctx is not None else None
+    tprev = tracing._set_current(tctx) if tctx is not None else None
     if ctx is not None:
         ctx._attributed = True
     try:
         yield
     finally:
+        if tctx is not None:
+            tracing._set_current(tprev)
         _attr_tl.ctx = prev
+
+
+# nested-execution marker: worker-side stage fragments and
+# coordinator-deferred executions run their own executors (each with its
+# own RuntimeStatsContext + set_last_stats); per-query EXPORTS (otlp,
+# trace files, flight recorder) must fire once per top-level query, so
+# nested scopes suppress them and the outermost owner finalizes.
+
+_nested_tl = threading.local()
+
+
+@contextlib.contextmanager
+def nested_scope():
+    prev = getattr(_nested_tl, "n", 0)
+    _nested_tl.n = prev + 1
+    try:
+        yield
+    finally:
+        _nested_tl.n = prev
+
+
+def in_nested_scope() -> bool:
+    return getattr(_nested_tl, "n", 0) > 0
 
 
 def run_attributed(ctx, fn, *args, **kwargs):
@@ -210,6 +241,7 @@ class RuntimeStatsContext:
     """
 
     def __init__(self, tracer: Optional[ChromeTracer] = None):
+        from . import tracing
         self._ops: Dict[int, OperatorStats] = {}
         self._children: Dict[int, List[int]] = {}
         self._lock = threading.Lock()
@@ -217,6 +249,13 @@ class RuntimeStatsContext:
         self.wall_us: Optional[int] = None
         self.plan = None  # physical plan root, set by the executor
         self._t0 = time.perf_counter()
+        self._t0_unix_us = int(time.time() * 1e6)
+        # tracing plane: adopt the thread's current span context (the
+        # runner / serving scheduler started the trace before building
+        # this context); None = this query is untraced — every span
+        # site guard-checks that and stays allocation-free
+        self.trace_ctx = tracing.current()
+        self.trace_summary: Dict[str, object] = {}
         # per-dispatch device-kernel MFU/roofline accounting: snapshot the
         # process-wide ledger now, diff at finish() → this query's share
         self._ledger0 = _ledger_raw()
@@ -332,6 +371,29 @@ class RuntimeStatsContext:
                 self._sanitizer0, _sanitizer_raw())
         except Exception:
             self.sanitizer = {}
+        self._emit_trace_spans()
+
+    def _emit_trace_spans(self) -> None:
+        """Fold this executor's per-operator timings into the query
+        trace as one span per physical operator (children of the span
+        context this executor ran under — the task:run span for worker
+        fragments, the query root locally)."""
+        ctx = self.trace_ctx
+        if ctx is None:
+            return
+        rec = ctx.recorder
+        try:
+            for key, st in list(self._ops.items()):
+                rec.add(f"op:{st.name}",
+                        rec.unique_span_id(f"op:{st.name}"),
+                        ctx.span_id, self._t0_unix_us, st.inclusive_us,
+                        attrs={"rows_out": st.rows_out,
+                               "batches": st.batches_out,
+                               "self_us": self.exclusive_us(key)},
+                        lane="pipeline")
+            self.trace_summary = rec.summary()
+        except Exception:
+            pass  # observability must never take the query down
 
     # ---- reporting ---------------------------------------------------
     def exclusive_us(self, key: int) -> int:
@@ -398,6 +460,11 @@ class RuntimeStatsContext:
         lines.extend(render_io_block(self.io))
         lines.extend(render_sanitizer_block(self.sanitizer))
         lines.extend(render_serving_block(self.serving))
+        if self.trace_summary:
+            t = self.trace_summary
+            lines.append(f"trace: id={t.get('trace_id')} "
+                         f"spans={t.get('spans')} "
+                         f"dropped={t.get('dropped', 0)}")
         return "\n".join(lines)
 
     def as_dict(self) -> Dict[str, dict]:
@@ -612,8 +679,17 @@ def progress_enabled() -> bool:
 
 
 def new_query_stats() -> RuntimeStatsContext:
+    from . import tracing
     tracer = ChromeTracer() if chrome_trace_path() else None
-    return RuntimeStatsContext(tracer)
+    ctx = RuntimeStatsContext(tracer)
+    # fallback trace start for executors driven without a runner (the
+    # runners/serving scheduler normally start the trace earlier, so the
+    # planner spans land too); nested scopes never start traces — the
+    # query-wide sampling decision was the top level's to make
+    if ctx.trace_ctx is None and not in_nested_scope() \
+            and tracing.trace_enabled():
+        ctx.trace_ctx = tracing.maybe_start_trace("query")
+    return ctx
 
 
 _tl_last = threading.local()
@@ -633,10 +709,88 @@ def set_last_stats(ctx: RuntimeStatsContext):
     from . import dashboard
     if dashboard._server is not None:
         dashboard.broadcast_query(ctx)
+    # per-query exports fire once per TOP-LEVEL query: nested scopes
+    # (worker stage fragments, scheduler-deferred executions) suppress
+    # them and the outermost coordinator calls finalize_query itself
+    if not in_nested_scope():
+        finalize_query(ctx)
+
+
+# ------------------------------------------------- observability counters
+# Export-plane accounting (otlp_export_errors & co): process-wide like
+# the shuffle/recovery counters, surfaced through the /metrics scrape.
+
+_obs_counters_lock = threading.Lock()
+_obs_counters: Dict[str, float] = {}
+
+
+def obs_count(name: str, n: float = 1) -> None:
+    with _obs_counters_lock:
+        _obs_counters[name] = _obs_counters.get(name, 0) + n
+
+
+def obs_counters_snapshot() -> Dict[str, float]:
+    with _obs_counters_lock:
+        return dict(_obs_counters)
+
+
+def finalize_query(ctx: RuntimeStatsContext) -> None:
+    """One top-level query's export hooks: OTLP metrics (+spans for
+    traced queries), the merged Chrome trace file, and the flight
+    recorder. Idempotent per trace; never raises into the query path."""
+    from . import tracing
     from .analysis import knobs
     endpoint = knobs.env_str("DAFT_TPU_OTLP_ENDPOINT")
     if endpoint:
         export_otlp(ctx, endpoint)
+    try:
+        tctx = ctx.trace_ctx
+        rec = tctx.recorder if tctx is not None else None
+        if rec is not None and not rec.exported:
+            rec.exported = True
+            rec.finish()
+            ctx.trace_summary = rec.summary()
+            tracing.unregister_recorder(rec.trace_id)
+            out_dir = knobs.env_str("DAFT_TPU_TRACE_DIR")
+            if out_dir:
+                try:
+                    os.makedirs(out_dir, exist_ok=True)
+                    path = os.path.join(out_dir,
+                                        f"trace_{rec.trace_id}.json")
+                    with open(path, "w") as f:
+                        json.dump(tracing.chrome_trace_json(rec), f)
+                except Exception:
+                    obs_count("trace_export_errors")
+            if endpoint:
+                _post_otlp_async(endpoint, "/v1/traces",
+                                 tracing.otlp_spans_payload(rec))
+        if tracing._flight_path():  # don't build entries nobody records
+            tracing.flight_record(flight_entry(ctx))
+    except Exception:
+        obs_count("finalize_errors")
+
+
+def flight_entry(ctx: RuntimeStatsContext) -> dict:
+    """One flight-recorder record: the query's stat blocks, trace
+    summary and slow-query flag."""
+    from . import tracing
+    wall_us = ctx.wall_us or 0
+    slow_ms = tracing.slow_query_ms()
+    entry = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "wall_us": wall_us,
+        "slow": bool(slow_ms and slow_ms > 0
+                     and wall_us / 1e3 > slow_ms),
+        "operators": ctx.as_dict(),
+    }
+    for block in ("recovery", "shuffle", "io", "device_kernels",
+                  "serving", "sanitizer"):
+        v = getattr(ctx, block, None)
+        if v:
+            entry[block] = dict(v)
+    if ctx.trace_summary:
+        entry["trace"] = dict(ctx.trace_summary)
+    return entry
 
 
 # ------------------------------------------------------------------ OTLP
@@ -686,31 +840,45 @@ def otlp_payload(ctx: RuntimeStatsContext) -> dict:
             "metrics": metrics}]}]}
 
 
-def export_otlp(ctx: RuntimeStatsContext, endpoint: str) -> None:
-    """Fire-and-forget POST of the query's operator counters to an
-    OTLP/HTTP collector (``<endpoint>/v1/metrics``). Never fails or
-    blocks the query: everything — including payload construction and
-    thread spawn (which can raise at interpreter shutdown) — is
-    swallowed."""
+def _post_otlp_async(endpoint: str, route: str, payload_obj: dict) -> None:
+    """Fire-and-forget OTLP/HTTP POST on a daemon thread with a bounded
+    timeout (``DAFT_TPU_OTLP_TIMEOUT``). A hung or erroring collector
+    can neither stall nor fail the query — every failure (including a
+    non-2xx status, a read that outlives the timeout, or a thread spawn
+    at interpreter shutdown) is swallowed and counted in
+    ``otlp_export_errors``."""
     import urllib.request
 
     try:
-        payload = json.dumps(otlp_payload(ctx)).encode()
-        url = endpoint.rstrip("/") + "/v1/metrics"
+        from .analysis import knobs
+        timeout = knobs.env_float("DAFT_TPU_OTLP_TIMEOUT")
+        payload = json.dumps(payload_obj).encode()
+        url = endpoint.rstrip("/") + route
 
         def post():
             try:
                 req = urllib.request.Request(
                     url, data=payload,
                     headers={"Content-Type": "application/json"})
-                urllib.request.urlopen(req, timeout=5).read()
+                urllib.request.urlopen(req, timeout=timeout).read()
             except Exception:
-                pass
+                obs_count("otlp_export_errors")
 
         threading.Thread(target=post, name="daft-tpu-otlp",
                          daemon=True).start()
     except Exception:
-        pass  # observability must never break the query
+        obs_count("otlp_export_errors")
+
+
+def export_otlp(ctx: RuntimeStatsContext, endpoint: str) -> None:
+    """Fire-and-forget POST of the query's operator counters to an
+    OTLP/HTTP collector (``<endpoint>/v1/metrics``); traced queries
+    additionally export their span tree to ``/v1/traces`` (see
+    ``finalize_query``). Never fails or blocks the query."""
+    try:
+        _post_otlp_async(endpoint, "/v1/metrics", otlp_payload(ctx))
+    except Exception:
+        obs_count("otlp_export_errors")
 
 
 def last_query_stats() -> Optional[RuntimeStatsContext]:

@@ -46,20 +46,38 @@ class DistributedRunner(Runner):
     def run_iter(self, builder, results_buffer_size: Optional[int] = None
                  ) -> Iterator[MicroPartition]:
         from .. import observability as obs
-        optimized = builder.optimize()
-        pplan = translate(optimized.plan)
-        stage_plan = StagePlan.from_physical(pplan)
-        runner = StageRunner(self._get_manager(),
-                             self._scheduler or LeastLoadedScheduler())
-        # driver-level query stats: each stage task runs its own local
-        # executor (whose stats only cover that fragment); this context
-        # spans the whole query, so its resilience-counter delta carries
-        # every recovery event of the run into explain_analyze and the
-        # dashboard
-        stats = obs.new_query_stats()
-        stats.plan = pplan
+        from .. import tracing
+        tctx = tracing.maybe_start_trace("distributed")
+        with tracing.attach(tctx):
+            with tracing.span("plan:optimize", lane="planner"):
+                optimized = builder.optimize()
+            with tracing.span("plan:translate", lane="planner"):
+                pplan = translate(optimized.plan)
+            stage_plan = StagePlan.from_physical(pplan)
+            runner = StageRunner(self._get_manager(),
+                                 self._scheduler or LeastLoadedScheduler())
+            # driver-level query stats: each stage task runs its own local
+            # executor (whose stats only cover that fragment); this context
+            # spans the whole query, so its resilience-counter delta
+            # carries every recovery event of the run into explain_analyze
+            # and the dashboard
+            stats = obs.new_query_stats()
+            stats.plan = pplan
+        it = runner.run(stage_plan)
         try:
-            yield from runner.run(stage_plan)
+            # each pull runs under (a) the query's span context, so the
+            # stage runner / task supervisor / driver-side exchange spans
+            # join the merged trace, and (b) a nested scope, so fragment
+            # executors' set_last_stats never fire the per-query exports
+            while True:
+                with obs.nested_scope(), tracing.attach(stats.trace_ctx):
+                    try:
+                        p = next(it)
+                    except StopIteration:
+                        break
+                yield p
         finally:
+            with obs.nested_scope(), tracing.attach(stats.trace_ctx):
+                it.close()
             stats.finish()
             obs.set_last_stats(stats)

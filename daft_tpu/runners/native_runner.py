@@ -34,15 +34,24 @@ class NativeRunner(Runner):
 
     def run_iter(self, builder, results_buffer_size: Optional[int] = None
                  ) -> Iterator[MicroPartition]:
+        from .. import tracing
         from ..context import get_context
         cfg = get_context().execution_config
         if cfg.enable_aqe:
             yield from self._run_adaptive(builder, cfg)
             return
-        optimized = builder.optimize()
-        pplan = translate(optimized.plan)
-        executor = make_local_executor(cfg)
-        yield from executor.run(pplan)
+        # the trace (when sampled in) starts HERE so the planner spans
+        # land on it; the executor's stats context adopts it and the
+        # export fires at set_last_stats
+        tctx = tracing.maybe_start_trace("query")
+        with tracing.attach(tctx):
+            with tracing.span("plan:optimize", lane="planner"):
+                optimized = builder.optimize()
+            with tracing.span("plan:translate", lane="planner"):
+                pplan = translate(optimized.plan)
+            executor = make_local_executor(cfg)
+            it = executor.run(pplan)
+        yield from it
 
     # ------------------------------------------------------------- AQE
     def _run_adaptive(self, builder, cfg) -> Iterator[MicroPartition]:

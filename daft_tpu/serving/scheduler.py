@@ -151,8 +151,13 @@ class QueryHandle:
         self._error: Optional[BaseException] = None
         self.stats = None          # RuntimeStatsContext (when executed)
         self.submitted_at = time.monotonic()
+        self.submitted_at_us = int(time.time() * 1e6)
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        # tracing: the query's trace starts at SUBMIT so queue wait is
+        # on the timeline; None when tracing is off / sampled out
+        from .. import tracing
+        self.trace_ctx = tracing.maybe_start_trace("serve")
 
     # -- completion (scheduler-side) -----------------------------------
     def _finish(self, state: str, result=None,
@@ -167,6 +172,12 @@ class QueryHandle:
                 self.stats = stats
             self.finished_at = time.monotonic()
             self._done.set()
+        if state in ("rejected", "cancelled"):
+            # rejected/cancelled queries never executed — close their
+            # trace here so the recorder can't leak ("failed" queries DO
+            # export: the run worker finalizes them with error status,
+            # they're exactly the traces an operator needs)
+            self._end_trace(state)
 
     def _mark_running(self) -> None:
         with self._state_lock:
@@ -189,8 +200,24 @@ class QueryHandle:
     def cancel(self, reason: Optional[str] = None) -> None:
         """Cooperative cancel: a queued query leaves the queue now; a
         running one unwinds at its next morsel boundary."""
+        from .. import tracing
+        tracing.event("serve:cancel", key="serve:cancel",
+                      attrs={"reason": reason or "cancelled by client"},
+                      lane="serving", ctx=self.trace_ctx)
         self.token.set(reason or "cancelled by client")
         self._scheduler._cancel_queued(self)
+
+    def _end_trace(self, status: str) -> None:
+        """Close and drop a trace that will never reach the per-query
+        export path (rejections, cancellations)."""
+        if self.trace_ctx is None:
+            return
+        from .. import tracing
+        rec = self.trace_ctx.recorder
+        if not rec.exported:
+            rec.exported = True
+            rec.finish(status)
+            tracing.unregister_recorder(rec.trace_id)
 
     def result(self, timeout: Optional[float] = None):
         """The query's PartitionSet; raises the query's failure,
@@ -530,8 +557,23 @@ class QueryScheduler:
             running_at_admit = self._n_running
         h._mark_running()
         queue_wait_us = int(h.queue_wait_s * 1e6)
+        from .. import tracing
+        if h.trace_ctx is not None:
+            # the queue-wait span: submit → run start, on the timeline
+            rec = h.trace_ctx.recorder
+            rec.add("serve:queue", rec.unique_span_id("serve:queue"),
+                    h.trace_ctx.span_id, h.submitted_at_us,
+                    queue_wait_us,
+                    attrs={"session": h.session, "priority": h.priority,
+                           "admitted_bytes": est},
+                    lane="serving")
         try:
-            with cancel_scope(h.token):
+            # nested scope: the executor's set_last_stats must not fire
+            # the per-query exports mid-flight — the serving info isn't
+            # attached yet; finalize_query below is the single exporter
+            with cancel_scope(h.token), obs.nested_scope(), \
+                    tracing.attach(h.trace_ctx), \
+                    tracing.span("serve:run", lane="serving"):
                 ps, stats, info = self._execute(h, builder)
             info.update({
                 "session": h.session, "priority": h.priority,
@@ -542,9 +584,13 @@ class QueryScheduler:
                 # (attributed, hence plane-empty) context so
                 # explain(analyze=True) still renders the serving block
                 stats = obs.RuntimeStatsContext()
+                stats.trace_ctx = h.trace_ctx
                 stats._attributed = True
                 stats.finish()
             stats.serving = info
+            # finalize BEFORE completing the handle: a result() waiter
+            # must be able to read the exported trace / flight record
+            obs.finalize_query(stats)
             h._finish("done", result=ps, stats=stats)
             self._count("completed")
             self._count("queue_wait_us", queue_wait_us)
@@ -554,6 +600,24 @@ class QueryScheduler:
             h._finish("cancelled")
             self._count("cancelled")
         except BaseException as exc:  # noqa: BLE001 — surfaced via handle
+            # a failed query is the one an operator most needs to see:
+            # export its trace (error status) + flight-recorder entry
+            # BEFORE completing the handle (result() waiters may read it)
+            try:
+                stats = obs.RuntimeStatsContext()
+                stats.trace_ctx = h.trace_ctx
+                stats._attributed = True
+                stats.finish()
+                stats.serving = {
+                    "session": h.session, "priority": h.priority,
+                    "queue_wait_us": queue_wait_us,
+                    "admitted_bytes": est, "state": "failed",
+                    "error": f"{type(exc).__name__}: {str(exc)[:200]}"}
+                if h.trace_ctx is not None:
+                    h.trace_ctx.recorder.status = "error"
+                obs.finalize_query(stats)
+            except Exception:
+                pass  # export must never mask the query's real failure
             h._finish("failed", error=exc)
             self._count("failed")
         finally:
@@ -565,6 +629,7 @@ class QueryScheduler:
     # ------------------------------------------------------------- execute
     def _execute(self, h: QueryHandle, builder):
         from .. import observability as obs
+        from .. import tracing
         from ..context import get_context
         from ..logical.fingerprint import fingerprint
         from ..physical.translate import translate
@@ -578,12 +643,14 @@ class QueryScheduler:
                                    "result_cache": "bypass"}
         cacheable = isinstance(runner, NativeRunner) \
             and not cfg.enable_aqe
-        fp = fingerprint(builder.plan, cfg) if cacheable else None
+        with tracing.span("plan:fingerprint", lane="planner"):
+            fp = fingerprint(builder.plan, cfg) if cacheable else None
         if fp is not None and self.result_cache.enabled:
             ps = self.result_cache.get_result(fp)
             if ps is not None:
                 info["result_cache"] = "hit"
                 info["plan_cache"] = "skipped"
+                tracing.event("cache:result_hit", lane="planner")
                 return ps, None, info
             info["result_cache"] = "miss"
         if not cacheable:
@@ -604,9 +671,12 @@ class QueryScheduler:
         if hit is not None:
             _optimized, pplan = hit
             info["plan_cache"] = "hit"
+            tracing.event("cache:plan_hit", lane="planner")
         else:
-            optimized = builder.optimize()
-            pplan = translate(optimized.plan)
+            with tracing.span("plan:optimize", lane="planner"):
+                optimized = builder.optimize()
+            with tracing.span("plan:translate", lane="planner"):
+                pplan = translate(optimized.plan)
             if fp is not None and self.plan_cache.enabled:
                 self.plan_cache.put_plan(fp, optimized.plan, pplan)
                 info["plan_cache"] = "miss"

@@ -1,0 +1,654 @@
+"""Query-wide tracing plane tests: span propagation, deterministic ids
+under chaos, Chrome/OTLP export, /metrics scrape, flight recorder."""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import daft_tpu as daft
+from daft_tpu import col, tracing
+from daft_tpu import observability as obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.reset_for_tests()
+    yield
+    tracing.reset_for_tests()
+
+
+def _run_distributed(monkeypatch, n_workers=2, fault_spec=None, seed="7"):
+    """One distributed grouped-agg query; returns (answer, recorder)."""
+    import daft_tpu.context as dctx
+    from daft_tpu.distributed import resilience as rz
+    from daft_tpu.runners.distributed_runner import DistributedRunner
+
+    monkeypatch.setenv("DAFT_TPU_TRACE", "1")
+    monkeypatch.setenv("DAFT_TPU_CHAOS_SERIALIZE", "1")
+    monkeypatch.setenv("DAFT_TPU_RETRY_BACKOFF", "0.01")
+    if fault_spec:
+        monkeypatch.setenv("DAFT_TPU_FAULT_SPEC", fault_spec)
+        monkeypatch.setenv("DAFT_TPU_FAULT_SEED", seed)
+    rz.reset_for_tests()
+    runner = DistributedRunner(num_workers=n_workers)
+    old = dctx.get_context()._runner
+    dctx.get_context().set_runner(runner)
+    try:
+        df = (daft.from_pydict({"k": [i % 7 for i in range(4000)],
+                                "v": [float(i) for i in range(4000)]})
+              .into_partitions(3)
+              .groupby("k").agg(col("v").sum().alias("s")))
+        out = df.to_pydict()
+    finally:
+        dctx.get_context().set_runner(old)
+        if runner._manager is not None:
+            runner._manager.shutdown()
+        rz.reset_for_tests()
+    stats = obs.last_query_stats()
+    assert stats is not None and stats.trace_ctx is not None
+    rows = sorted(zip(out["k"], [round(s, 6) for s in out["s"]]))
+    return rows, stats.trace_ctx.recorder
+
+
+# ------------------------------------------------------------ gating
+
+def test_tracing_off_by_default():
+    df = daft.from_pydict({"x": [1, 2, 3]}).where(col("x") > 1)
+    df.collect()
+    stats = obs.last_query_stats()
+    assert stats.trace_ctx is None
+    assert stats.trace_summary == {}
+    # span sites are no-ops on untraced threads
+    assert tracing.current() is None
+    sp = tracing.span("anything")
+    assert sp is tracing._NOOP
+
+
+def test_sampling_zero_traces_nothing(monkeypatch):
+    monkeypatch.setenv("DAFT_TPU_TRACE", "1")
+    monkeypatch.setenv("DAFT_TPU_TRACE_SAMPLE", "0.0")
+    daft.from_pydict({"x": [1, 2, 3]}).where(col("x") > 1).collect()
+    assert obs.last_query_stats().trace_ctx is None
+
+
+def test_span_ids_are_pure_functions_of_keys():
+    assert tracing.span_id_from("task:s0.t1") == \
+        tracing.span_id_from("task:s0.t1")
+    assert tracing.span_id_from("task:s0.t1") != \
+        tracing.span_id_from("task:s0.t2")
+    assert len(tracing.span_id_from("x")) == 16
+
+
+def test_recorder_bounded(monkeypatch):
+    rec = tracing.SpanRecorder("t" * 32, max_spans=5)
+    for i in range(10):
+        rec.add("s", tracing.span_id_from(f"k{i}"), None, i, 1)
+    assert len(rec.spans()) == 5
+    assert rec.dropped == 5
+    assert rec.summary()["dropped"] == 5
+
+
+# ----------------------------------------------------- local tracing
+
+def test_local_query_trace_exports_chrome(tmp_path, monkeypatch):
+    monkeypatch.setenv("DAFT_TPU_TRACE", "1")
+    monkeypatch.setenv("DAFT_TPU_TRACE_DIR", str(tmp_path))
+    df = (daft.from_pydict({"x": list(range(500)),
+                            "g": [i % 5 for i in range(500)]})
+          .where(col("x") > 10).groupby("g").agg(col("x").sum().alias("s")))
+    df.collect()
+    stats = obs.last_query_stats()
+    assert stats.trace_ctx is not None
+    assert stats.trace_summary.get("spans", 0) > 0
+    files = glob.glob(str(tmp_path / "trace_*.json"))
+    assert files, "no chrome trace exported"
+    doc = json.load(open(files[0]))
+    assert tracing.validate_chrome_trace(doc) == []
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert "query" in names
+    assert "plan:optimize" in names and "plan:translate" in names
+    assert any(n.startswith("op:") for n in names)
+    # explain(analyze=True) renders the trace line
+    assert "trace: id=" in stats.render()
+
+
+def test_trace_registry_unregisters_after_export(tmp_path, monkeypatch):
+    monkeypatch.setenv("DAFT_TPU_TRACE", "1")
+    daft.from_pydict({"x": [1, 2, 3]}).where(col("x") > 1).collect()
+    rec = obs.last_query_stats().trace_ctx.recorder
+    assert rec.exported
+    assert tracing.recorder_for(rec.trace_id) is None
+
+
+# ------------------------------------------------- distributed chaos
+
+def test_chaos_trace_deterministic_and_complete(monkeypatch):
+    """The satellite contract: a seeded chaotic distributed query yields
+    a merged trace where every retry/lineage-recompute is a child of its
+    task span, span ids replay bit-identically across two runs, and no
+    span is orphaned."""
+    spec = "task:0.1,fetch:0.1,crash:0.1"
+    rows1, rec1 = _run_distributed(monkeypatch, fault_spec=spec)
+    rows2, rec2 = _run_distributed(monkeypatch, fault_spec=spec)
+    assert rows1 == rows2
+
+    # bit-identical span ids across runs
+    assert sorted(rec1.span_ids()) == sorted(rec2.span_ids())
+
+    # no orphans: every parent id resolves
+    assert tracing.orphan_spans(rec1) == []
+
+    spans = rec1.spans()
+    kinds = {s["name"] for s in spans}
+    # the merged trace covers driver, stage, worker-task and fetch tiers
+    for want in ("query", "stage", "task", "task:run", "shuffle:fetch"):
+        assert want in kinds, (want, sorted(kinds))
+    # chaos actually fired: retries and/or recomputes present…
+    assert "task:retry" in kinds
+    # …and every retry / recompute hangs off a task span
+    by_id = {s["span_id"]: s for s in spans}
+    for s in spans:
+        if s["name"] in ("task:retry", "lineage:recompute"):
+            parent = by_id.get(s["parent_id"])
+            assert parent is not None and parent["name"] == "task", s
+        if s["name"] == "task:run":
+            parent = by_id.get(s["parent_id"])
+            assert parent is not None and parent["name"] == "task", s
+    # chrome export of the merged trace validates
+    assert tracing.validate_chrome_trace(
+        tracing.chrome_trace_json(rec1)) == []
+
+
+def test_faultfree_distributed_trace(monkeypatch):
+    rows, rec = _run_distributed(monkeypatch)
+    kinds = {s["name"] for s in rec.spans()}
+    assert "task:run" in kinds and "stage" in kinds
+    assert tracing.orphan_spans(rec) == []
+
+
+def test_remote_worker_ships_spans_cross_process(monkeypatch):
+    """A worker in ANOTHER process buffers its spans and ships them back
+    with the task result; the driver merges them with clock-offset
+    correction into the one query trace."""
+    import subprocess
+    import sys
+
+    from daft_tpu.distributed import (LeastLoadedScheduler, StagePlan,
+                                      StageRunner, WorkerManager)
+    from daft_tpu.distributed.remote_worker import RemoteWorker
+    from daft_tpu.physical.translate import translate
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DAFT_TPU_TRACE", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "daft_tpu.distributed.remote_worker",
+         "--port", "0", "--host", "127.0.0.1"],
+        stdout=subprocess.PIPE, text=True, env=env, cwd=repo)
+    try:
+        line = proc.stdout.readline()  # "daft-tpu worker on http://…"
+        addr = line.strip().split()[-1]
+        assert addr.startswith("http://"), line
+        monkeypatch.setenv("DAFT_TPU_TRACE", "1")
+        tctx = tracing.maybe_start_trace("xproc")
+        assert tctx is not None
+        df = (daft.from_pydict({"k": [i % 5 for i in range(300)],
+                                "v": [float(i) for i in range(300)]})
+              .into_partitions(2)
+              .groupby("k").agg(col("v").sum().alias("s")))
+        with tracing.attach(tctx):
+            sp = StagePlan.from_physical(
+                translate(df._builder.optimize().plan))
+            mgr = WorkerManager([RemoteWorker("remote-0", addr)])
+            runner = StageRunner(mgr, LeastLoadedScheduler())
+            parts = list(runner.run(sp))
+        got = {}
+        for p in parts:
+            d = p.to_pydict()
+            for k, s in zip(d.get("k", []), d.get("s", [])):
+                got[k] = s
+        assert set(got) == {0, 1, 2, 3, 4}
+        rec = tctx.recorder
+        kinds = {s["name"] for s in rec.spans()}
+        assert "rpc:post" in kinds
+        assert "task:run" in kinds, sorted(kinds)
+        # the worker's spans really crossed the wire: worker-lane spans
+        # exist and a clock offset was measured for the worker address
+        assert any(s["lane"].startswith("worker:")
+                   for s in rec.spans() if s["name"] == "task:run")
+        assert addr in rec.summary().get("clock_offsets_us", {})
+        assert tracing.orphan_spans(rec) == []
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+# ------------------------------------------------------ wire context
+
+def test_wire_headers_roundtrip():
+    rec = tracing.SpanRecorder("ab" * 16)
+    tracing.register_recorder(rec)
+    ctx = tracing.SpanContext(rec, rec.root_id)
+    hdrs = tracing.wire_headers(ctx)
+    assert hdrs["X-Daft-Trace-Id"] == rec.trace_id
+    back = tracing.context_from_headers(hdrs)
+    assert back is not None
+    assert back.recorder is rec and back.span_id == rec.root_id
+    # unknown trace (other process) → None
+    tracing.unregister_recorder(rec.trace_id)
+    assert tracing.context_from_headers(hdrs) is None
+    assert tracing.context_from_headers({}) is None
+
+
+def test_remote_span_merge_applies_clock_offset():
+    rec = tracing.SpanRecorder("cd" * 16)
+    remote = [{"name": "task:run", "span_id": tracing.span_id_from("r"),
+               "parent_id": rec.root_id, "ts_us": 1_000_000,
+               "dur_us": 5, "lane": "worker:w9"}]
+    rec.add_remote(remote, offset_us=250, worker="http://w9:1")
+    s = rec.spans()[0]
+    assert s["ts_us"] == 1_000_250
+    assert rec.summary()["clock_offsets_us"] == {"http://w9:1": 250}
+    # malformed remote spans are counted, not raised
+    rec.add_remote([{"nope": 1}], 0, "w")
+    assert rec.dropped == 1
+
+
+# ------------------------------------------------------ chrome schema
+
+def test_chrome_validator_catches_bad_traces():
+    assert tracing.validate_chrome_trace({}) == \
+        ["traceEvents is not a list"]
+    bad_phase = {"traceEvents": [
+        {"name": "x", "ph": "Q", "pid": 1, "tid": 1}]}
+    assert tracing.validate_chrome_trace(bad_phase)
+    neg_ts = {"traceEvents": [
+        {"name": "x", "ph": "X", "pid": 1, "tid": 1, "ts": -5, "dur": 1}]}
+    assert tracing.validate_chrome_trace(neg_ts)
+    non_monotonic = {"traceEvents": [
+        {"name": "a", "ph": "X", "pid": 1, "tid": 1, "ts": 10, "dur": 1},
+        {"name": "b", "ph": "X", "pid": 1, "tid": 1, "ts": 5, "dur": 1}]}
+    assert any("non-monotonic" in p
+               for p in tracing.validate_chrome_trace(non_monotonic))
+    unmatched = {"traceEvents": [
+        {"name": "a", "ph": "B", "pid": 1, "tid": 1, "ts": 1}]}
+    assert any("unmatched B" in p
+               for p in tracing.validate_chrome_trace(unmatched))
+    ok = {"traceEvents": [
+        {"name": "a", "ph": "B", "pid": 1, "tid": 1, "ts": 1},
+        {"name": "a", "ph": "E", "pid": 1, "tid": 1, "ts": 2}]}
+    assert tracing.validate_chrome_trace(ok) == []
+
+
+# ---------------------------------------------------------- /metrics
+
+def test_prometheus_text_parses_strictly():
+    text = tracing.prometheus_text()
+    metrics = tracing.parse_prometheus_text(text)
+    assert "daft_tpu_flight_recorder_queries_total" in metrics
+    assert "daft_tpu_traces_active" in metrics
+    for bad in ("no value\n", "0badname 1\n", "m 1 2 3\n", "m notanum\n",
+                "# TYPE m sometype\n"):
+        with pytest.raises(ValueError):
+            tracing.parse_prometheus_text(bad)
+
+
+def test_metrics_endpoint_and_serving_gauges(monkeypatch):
+    import urllib.request
+
+    from daft_tpu import dashboard, serving
+
+    sched = serving.QueryScheduler(concurrency=1)
+    monkeypatch.setattr(serving, "_shared", sched)
+    port = dashboard.launch(0)
+    try:
+        df = daft.from_pydict({"x": list(range(100)),
+                               "g": [i % 4 for i in range(100)]}) \
+            .groupby("g").agg(col("x").sum().alias("s"))
+        sched.submit(df).result(timeout=60)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        metrics = tracing.parse_prometheus_text(text)
+        assert metrics.get("daft_tpu_serving_completed_total", 0) >= 1
+        assert "daft_tpu_serving_queue_depth" in metrics
+        assert "daft_tpu_serving_running" in metrics
+    finally:
+        dashboard.shutdown()
+        monkeypatch.setattr(serving, "_shared", None)
+        sched.shutdown()
+
+
+# ----------------------------------------------------- flight recorder
+
+def test_flight_recorder_records_and_rotates(tmp_path, monkeypatch):
+    path = str(tmp_path / "queries.jsonl")
+    monkeypatch.setenv("DAFT_TPU_QUERY_LOG", path)
+    monkeypatch.setenv("DAFT_TPU_QUERY_LOG_BYTES", "4000")
+    monkeypatch.setenv("DAFT_TPU_SLOW_QUERY_MS", "0.000001")
+    daft.from_pydict({"x": list(range(50))}).where(col("x") > 5).collect()
+    entries = tracing.flight_history()
+    assert entries, "no flight-recorder entry for the query"
+    e = entries[0]
+    assert e["wall_us"] > 0 and "operators" in e
+    assert e["slow"] is True  # any query beats a 1ns threshold
+    # rotation: write entries past the byte cap
+    for i in range(100):
+        tracing.flight_record({"i": i, "pad": "x" * 128})
+    assert os.path.exists(path + ".1"), "no rotated generation"
+    assert os.path.getsize(path) <= 4000
+    # history reads across generations, newest first
+    hist = tracing.flight_history(limit=10)
+    assert len(hist) == 10 and hist[0]["i"] == 99
+
+
+def test_flight_recorder_history_endpoint(tmp_path, monkeypatch):
+    import urllib.request
+
+    from daft_tpu import dashboard
+
+    monkeypatch.setenv("DAFT_TPU_QUERY_LOG",
+                       str(tmp_path / "queries.jsonl"))
+    daft.from_pydict({"x": [1, 2, 3]}).where(col("x") > 1).collect()
+    port = dashboard.launch(0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/history", timeout=10) as r:
+            hist = json.loads(r.read())
+        assert hist and "wall_us" in hist[0]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=10) as r:
+            page = r.read().decode()
+        assert "flight recorder" in page
+    finally:
+        dashboard.shutdown()
+
+
+# ------------------------------------------------- dashboard history cap
+
+def test_dashboard_history_bounded_by_count_and_bytes(monkeypatch):
+    from daft_tpu import dashboard
+
+    monkeypatch.setattr(dashboard, "_history", [])
+    monkeypatch.setattr(dashboard, "_history_bytes", [])
+    monkeypatch.setattr(dashboard, "_MAX_HISTORY", 10)
+    monkeypatch.setattr(dashboard, "_MAX_HISTORY_BYTES", 3000)
+
+    class FakeStats:
+        def as_dict(self):
+            return {"Op": {"rows_out": 1}}
+
+        def render(self, plan=None):
+            return "explain " + "y" * 400  # ~420B entries
+
+    for _ in range(50):
+        dashboard.broadcast_query(FakeStats())
+    assert len(dashboard._history) <= 10
+    assert sum(dashboard._history_bytes) <= 3000
+    # byte cap binds before the count cap with these sizes
+    assert len(dashboard._history) < 10
+    # the newest entry always survives
+    assert dashboard._history[-1]["explain"].startswith("explain")
+
+
+# -------------------------------------------------- otlp hardening
+
+class _StubCollector:
+    """OTLP collector stub: mode 'ok' | 'hang' | '500'."""
+
+    def __init__(self, mode):
+        import http.server
+
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                if stub.mode == "hang":
+                    stub.hung.wait(20)
+                    return
+                stub.received.append((self.path, json.loads(body)))
+                code = 500 if stub.mode == "500" else 200
+                self.send_response(code)
+                self.end_headers()
+                self.wfile.write(b"{}")
+                stub.got.set()
+
+            def log_message(self, *a):
+                pass
+
+        import http.server as hs
+        self.mode = mode
+        self.received = []
+        self.got = threading.Event()
+        self.hung = threading.Event()
+        self.srv = hs.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.srv.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def endpoint(self):
+        return f"http://127.0.0.1:{self.srv.server_port}"
+
+    def shutdown(self):
+        self.hung.set()
+        self.srv.shutdown()
+
+
+def test_otlp_hung_collector_never_stalls_query(monkeypatch):
+    stub = _StubCollector("hang")
+    try:
+        monkeypatch.setenv("DAFT_TPU_OTLP_ENDPOINT", stub.endpoint)
+        monkeypatch.setenv("DAFT_TPU_OTLP_TIMEOUT", "0.3")
+        before = obs.obs_counters_snapshot().get("otlp_export_errors", 0)
+        t0 = time.monotonic()
+        out = daft.from_pydict({"x": [1, 2, 3]}).where(col("x") > 1) \
+            .count_rows()
+        elapsed = time.monotonic() - t0
+        assert out == 2
+        # the query path never blocks on the hung POST
+        assert elapsed < 10
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if obs.obs_counters_snapshot().get(
+                    "otlp_export_errors", 0) > before:
+                break
+            time.sleep(0.05)
+        assert obs.obs_counters_snapshot().get(
+            "otlp_export_errors", 0) > before
+    finally:
+        stub.shutdown()
+
+
+def test_otlp_500_counted_not_raised(monkeypatch):
+    stub = _StubCollector("500")
+    try:
+        monkeypatch.setenv("DAFT_TPU_OTLP_ENDPOINT", stub.endpoint)
+        before = obs.obs_counters_snapshot().get("otlp_export_errors", 0)
+        daft.from_pydict({"x": [1, 2, 3]}).where(col("x") > 1).collect()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if obs.obs_counters_snapshot().get(
+                    "otlp_export_errors", 0) > before:
+                break
+            time.sleep(0.05)
+        assert obs.obs_counters_snapshot().get(
+            "otlp_export_errors", 0) > before
+    finally:
+        stub.shutdown()
+
+
+def test_otlp_spans_posted_for_traced_query(monkeypatch):
+    stub = _StubCollector("ok")
+    try:
+        monkeypatch.setenv("DAFT_TPU_OTLP_ENDPOINT", stub.endpoint)
+        monkeypatch.setenv("DAFT_TPU_TRACE", "1")
+        daft.from_pydict({"x": [1, 2, 3]}).where(col("x") > 1).collect()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if any(p == "/v1/traces" for p, _ in stub.received):
+                break
+            time.sleep(0.05)
+        traces = [b for p, b in stub.received if p == "/v1/traces"]
+        assert traces, [p for p, _ in stub.received]
+        scope = traces[0]["resourceSpans"][0]["scopeSpans"][0]
+        names = {s["name"] for s in scope["spans"]}
+        assert "query" in names
+        # metrics still export beside spans
+        assert any(p == "/v1/metrics" for p, _ in stub.received)
+    finally:
+        stub.shutdown()
+
+
+# ------------------------------------------------------- serving plane
+
+def test_serving_trace_has_queue_and_run_spans(monkeypatch):
+    from daft_tpu import serving
+
+    monkeypatch.setenv("DAFT_TPU_TRACE", "1")
+    sched = serving.QueryScheduler(concurrency=1)
+    try:
+        df = daft.from_pydict({"x": list(range(200)),
+                               "g": [i % 3 for i in range(200)]}) \
+            .groupby("g").agg(col("x").sum().alias("s"))
+        h = sched.submit(df, session="traced")
+        h.result(timeout=60)
+        assert h.trace_ctx is not None
+        rec = h.trace_ctx.recorder
+        assert rec.exported  # finalized by the scheduler, once
+        kinds = {s["name"] for s in rec.spans()}
+        assert "serve:queue" in kinds and "serve:run" in kinds
+        assert "plan:fingerprint" in kinds
+        q = next(s for s in rec.spans() if s["name"] == "serve:queue")
+        assert q["attrs"]["session"] == "traced"
+        assert tracing.orphan_spans(rec) == []
+        # the handle's stats carry the summary for explain/history
+        assert h.stats.trace_summary.get("trace_id") == rec.trace_id
+    finally:
+        sched.shutdown()
+
+
+def test_serving_failed_query_still_exported(tmp_path, monkeypatch):
+    """A FAILED serving query is the one an operator most needs: it must
+    still land in the flight recorder (with the error) and export its
+    trace with error status — only rejected/cancelled queries skip."""
+    from daft_tpu import DataType, serving, udf
+
+    monkeypatch.setenv("DAFT_TPU_TRACE", "1")
+    monkeypatch.setenv("DAFT_TPU_QUERY_LOG", str(tmp_path / "q.jsonl"))
+    monkeypatch.setenv("DAFT_TPU_TRACE_DIR", str(tmp_path))
+
+    @udf(return_dtype=DataType.int64())
+    def boom(x):
+        raise RuntimeError("intentional test failure")
+
+    sched = serving.QueryScheduler(concurrency=1)
+    try:
+        df = daft.from_pydict({"x": [1, 2, 3]}).select(boom(col("x")))
+        h = sched.submit(df)
+        with pytest.raises(Exception):
+            h.result(timeout=60)
+        assert h.state == "failed"
+        entries = [e for e in tracing.flight_history()
+                   if (e.get("serving") or {}).get("state") == "failed"]
+        assert entries, tracing.flight_history()
+        assert "intentional test failure" in entries[0]["serving"]["error"]
+        if h.trace_ctx is not None:
+            rec = h.trace_ctx.recorder
+            assert rec.exported
+            root = next(s for s in rec.spans() if s["name"] == "query")
+            assert root.get("status") == "error"
+            assert glob.glob(str(tmp_path / "trace_*.json"))
+    finally:
+        sched.shutdown()
+
+
+def test_worker_concurrent_tasks_one_trace_no_span_loss(monkeypatch):
+    """Two tasks of ONE trace running concurrently on the same
+    cross-process worker: the per-trace ship-back buffer is refcounted
+    and drained, so neither task's run span is lost (the regression was
+    the loser of the check-then-register race vanishing into an
+    unregistered recorder)."""
+    import subprocess
+    import sys
+
+    from daft_tpu.distributed.remote_worker import RemoteWorker
+    from daft_tpu.distributed.worker import StageTask
+    from daft_tpu.micropartition import MicroPartition
+    from daft_tpu.physical import plan as pp
+    from daft_tpu.recordbatch import RecordBatch
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "daft_tpu.distributed.remote_worker",
+         "--port", "0", "--host", "127.0.0.1", "--slots", "2"],
+        stdout=subprocess.PIPE, text=True, env=env, cwd=repo)
+    rw = None
+    try:
+        addr = proc.stdout.readline().strip().split()[-1]
+        rec = tracing.SpanRecorder("ee" * 16)
+        tracing.register_recorder(rec)
+        rw = RemoteWorker("r0", addr, num_slots=2)
+        mp = MicroPartition.from_recordbatch(
+            RecordBatch.from_pydict({"x": list(range(50))}))
+        schema = mp.schema
+
+        def mk_task(i):
+            return StageTask(
+                0, pp.InMemorySource([mp], schema), {}, task_idx=i,
+                fault_key=f"s0.t{i}",
+                trace_ctx=(rec.trace_id,
+                           tracing.span_id_from(f"run:s0.t{i}"),
+                           rec.root_id))
+
+        futs = [rw.submit(mk_task(i)) for i in range(2)]
+        for f in futs:
+            assert f.result(timeout=120)
+        runs = {s["span_id"] for s in rec.spans()
+                if s["name"] == "task:run"}
+        assert tracing.span_id_from("run:s0.t0") in runs
+        assert tracing.span_id_from("run:s0.t1") in runs
+        tracing.unregister_recorder(rec.trace_id)
+    finally:
+        if rw is not None:
+            rw.shutdown()
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_serving_cancel_event_and_trace_close(monkeypatch):
+    from daft_tpu import serving
+
+    monkeypatch.setenv("DAFT_TPU_TRACE", "1")
+    sched = serving.QueryScheduler(concurrency=1)
+    try:
+        blocker = threading.Event()
+
+        class SlowStats:
+            pass
+
+        # a queued query cancelled before it runs
+        df = daft.from_pydict({"x": [1]}).where(col("x") > 0)
+        h1 = sched.submit(df)       # will run
+        h2 = sched.submit(df)       # may queue behind h1
+        h2.cancel("test cancel")
+        try:
+            h2.result(timeout=30)
+        except Exception:
+            pass
+        blocker.set()
+        if h2.state == "cancelled" and h2.trace_ctx is not None:
+            rec = h2.trace_ctx.recorder
+            assert rec.exported  # closed, not leaked
+            assert tracing.recorder_for(rec.trace_id) is None
+    finally:
+        sched.shutdown()
