@@ -20,6 +20,19 @@ Layout:
   module-state mutation
 - ``rule_jit.py`` — device-kernel jit hygiene + jaxpr dispatch-contract
   re-verification (shared with tests/test_device_kernels.py)
+- ``dataflow.py`` — per-function CFGs (try/except/finally/with edges),
+  the must-reach-on-all-paths solver, and one-level call summaries —
+  the flow engine under the v2 rule families
+- ``rule_resources.py`` — declarative acquire/release contract table
+  (memory admission, trace recorders, shuffle caches, pools) proved on
+  every exit path, incl. exception edges
+- ``rule_donation.py`` — donated-buffer safety: no reads of donated
+  device planes after dispatch; ``DeviceTable.resident`` guards every
+  donation
+- ``rule_cancellation.py`` — every partition-drain loop polls the
+  CancelToken (or pragmas the mechanism that covers it)
+- ``rule_attribution.py`` — thread/pool spawns in engine modules thread
+  per-query attribution onto their workers
 - ``lock_sanitizer.py`` — runtime lock-order graph + cycle detection
   (``DAFT_TPU_SANITIZE=1``)
 """

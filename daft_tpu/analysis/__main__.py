@@ -21,7 +21,15 @@ def main(argv=None) -> int:
                     help="repo-relative files/dirs to scan "
                          "(default: daft_tpu tests bench.py)")
     ap.add_argument("--json", action="store_true",
-                    help="machine-readable findings")
+                    help="machine-readable findings (incl. family + "
+                         "fix hint)")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="ID",
+                    help="only report findings of this rule id "
+                         "(repeatable) — burn-down filtering")
+    ap.add_argument("--stats", action="store_true",
+                    help="print a summary line: files scanned, functions "
+                         "analyzed, per-family finding counts")
     ap.add_argument("--no-contracts", action="store_true",
                     help="skip the jaxpr dispatch-contract re-verification "
                          "(no jax import)")
@@ -50,16 +58,45 @@ def main(argv=None) -> int:
             print(f"### {group}\n{knobs.knob_table_markdown(group)}\n")
         return 0
 
+    from .framework import known_rules
+    if args.rule:
+        rules = known_rules()
+        unknown = [r for r in args.rule if r not in rules]
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(unknown)}; known: "
+                  f"{', '.join(sorted(rules))}")
+            return 2
+
     subdirs = tuple(args.paths) if args.paths else DEFAULT_SUBDIRS
+    stats = {} if args.stats else None
     findings = run_analysis(root, subdirs=subdirs,
                             contracts=not args.no_contracts,
-                            readme=not args.no_readme)
+                            readme=not args.no_readme, stats=stats)
+    if args.rule:
+        findings = [f for f in findings if f.rule in args.rule]
+        if stats is not None:
+            # the stats line must describe the same (filtered) findings
+            # the listing and exit code do
+            by_family = {}
+            for f in findings:
+                by_family[f.family or "?"] = by_family.get(
+                    f.family or "?", 0) + 1
+            stats["findings_by_family"] = by_family
     if args.json:
         print(json.dumps([f.__dict__ for f in findings], indent=2))
     else:
         for f in findings:
             print(f.render())
+            if f.hint:
+                print(f"    hint: {f.hint}")
         print(f"daft-lint: {len(findings)} finding(s)")
+    if stats is not None:
+        fam = ", ".join(f"{k}={v}" for k, v in
+                        sorted(stats["findings_by_family"].items())) \
+            or "none"
+        print(f"daft-lint stats: files={stats['files_scanned']} "
+              f"functions={stats['functions_analyzed']} "
+              f"rules={len(stats['rules'])} findings_by_family: {fam}")
     return 1 if findings else 0
 
 

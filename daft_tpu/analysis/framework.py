@@ -7,7 +7,7 @@ parsed ASTs of the repo tree and return :class:`Finding`\\ s.
 
 Suppression is explicit and justified::
 
-    something_flagged()  # daft-lint: allow(rule-name) -- why it is safe
+    something_flagged()  # daft-lint: allow(<rule-id>) -- why it is safe
 
 The pragma may sit on the finding's line or the line directly above it.
 An ``allow(...)`` without a ``-- reason`` string is itself a finding
@@ -51,12 +51,74 @@ class Finding:
     path: str      # repo-relative, forward slashes
     line: int
     message: str
+    family: str = ""   # rule family (filled from the registry)
+    hint: str = ""     # one-line fix hint (filled from the registry)
 
     def key(self) -> str:
         return f"{self.path}:{self.rule}:{self.line}"
 
     def render(self) -> str:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def known_rules() -> Dict[str, Tuple[str, str]]:
+    """rule id → (family, one-line fix hint) for EVERY rule the linter
+    can emit — the registry behind pragma validation (`allow(<id>)` must
+    name a live rule), `--rule` filtering, and the JSON `family`/`hint`
+    fields. New rule modules contribute via their ``RULE_IDS`` dict."""
+    from . import (rule_attribution, rule_cancellation, rule_donation,
+                   rule_resources)
+    out: Dict[str, Tuple[str, str]] = {
+        # r10 families, single-sourced here (their modules predate the
+        # registry); hints stay one line by policy
+        "knob-unregistered": (
+            "knobs", "declare the knob in analysis/knobs.py"),
+        "knob-direct-read": (
+            "knobs", "read via knobs.env_* accessors, not os.environ"),
+        "knob-type-mismatch": (
+            "knobs", "use the accessor matching the registered type"),
+        "knob-unused": (
+            "knobs", "drop the stale registry entry or use the knob"),
+        "knob-config-drift": (
+            "knobs", "sync the registry's config_field with "
+                     "ExecutionConfig"),
+        "knob-doc-drift": (
+            "knobs", "regenerate: python -m daft_tpu.analysis "
+                     "--knob-docs --write"),
+        "unseeded-random": (
+            "determinism", "use a seeded instance keyed on a stable "
+                           "identity"),
+        "wallclock-decision": (
+            "determinism", "inject a clock (RetryPolicy pattern) instead "
+                           "of reading time in a decision"),
+        "unordered-pool-iteration": (
+            "determinism", "iterate futures in submit order, not "
+                           "completion order"),
+        "blocking-under-lock": (
+            "locks", "move the blocking call outside the `with <lock>:` "
+                     "scope"),
+        "unguarded-global-mutation": (
+            "locks", "rebind module state under its lock "
+                     "(check-then-set races)"),
+        "host-effect-in-jit": (
+            "jit", "hoist the host effect out of the traced function"),
+        "np-in-jit": (
+            "jit", "use jnp on traced values; np only on static "
+                   "metadata"),
+        "dispatch-contract": (
+            "jit", "restore the proven jaxpr shape (operand count / "
+                   "kernel structure)"),
+        "pragma-missing-reason": (
+            "pragma", "append `-- <reason>` to the allow(...) pragma"),
+        "pragma-unknown-rule": (
+            "pragma", "name a live rule id (see --stats for the list) "
+                      "or drop the stale pragma"),
+    }
+    out.update(rule_resources.RULE_IDS)
+    out.update(rule_donation.RULE_IDS)
+    out.update(rule_cancellation.RULE_IDS)
+    out.update(rule_attribution.RULE_IDS)
+    return out
 
 
 _PRAGMA_RE = re.compile(
@@ -172,20 +234,45 @@ def apply_baseline(findings: List[Finding],
     return [f for f in findings if f.key() not in grandfathered]
 
 
+def pragma_rule_findings(sources: List["SourceFile"],
+                         rules: Dict[str, Tuple[str, str]]
+                         ) -> List[Finding]:
+    """A pragma naming a removed/renamed rule id is itself a finding —
+    stale suppressions silently stop suppressing the day a rule is
+    renamed, so they must not linger."""
+    out: List[Finding] = []
+    for sf in sources:
+        for ln, (names, _reason) in sf.pragmas.items():
+            for name in names:
+                if name not in rules:
+                    out.append(Finding(
+                        "pragma-unknown-rule", sf.path, ln,
+                        f"pragma allows {name!r}, which is not a rule "
+                        f"this linter has — stale suppression"))
+    return out
+
+
 def run_analysis(root: Optional[str] = None,
                  subdirs: Iterable[str] = DEFAULT_SUBDIRS,
                  contracts: bool = True,
                  readme: bool = True,
-                 baseline: Optional[List[str]] = None) -> List[Finding]:
+                 baseline: Optional[List[str]] = None,
+                 stats: Optional[Dict] = None) -> List[Finding]:
     """Run every rule family over the tree; returns non-baselined,
-    non-pragma'd findings sorted by location."""
-    from . import rule_determinism, rule_jit, rule_knobs, rule_locks
+    non-pragma'd findings sorted by location. Pass a dict as ``stats``
+    to collect the burn-down summary (files scanned, functions
+    analyzed, per-family finding counts)."""
+    from . import (rule_attribution, rule_cancellation, rule_determinism,
+                   rule_donation, rule_jit, rule_knobs, rule_locks,
+                   rule_resources)
 
     root = root or repo_root()
     sources = walk_sources(root, subdirs)
+    rules = known_rules()
     findings: List[Finding] = []
     for sf in sources:
         findings.extend(sf.pragma_findings())
+    findings.extend(pragma_rule_findings(sources, rules))
 
     findings.extend(rule_knobs.check(sources))
     if readme:
@@ -195,20 +282,43 @@ def run_analysis(root: Optional[str] = None,
     findings.extend(rule_jit.check(sources))
     if contracts:
         findings.extend(rule_jit.check_dispatch_contracts())
+    findings.extend(rule_resources.check(sources))
+    findings.extend(rule_donation.check(sources))
+    findings.extend(rule_cancellation.check(sources))
+    findings.extend(rule_attribution.check(sources))
 
-    # pragma suppression (a pragma never suppresses pragma-missing-reason)
+    # pragma suppression (a pragma never suppresses the pragma rules)
     by_path = {sf.path: sf for sf in sources}
     kept = []
     for f in findings:
         sf = by_path.get(f.path)
-        if (f.rule != "pragma-missing-reason" and sf is not None
+        if (not f.rule.startswith("pragma-") and sf is not None
                 and sf.allowed(f.rule, f.line)):
             continue
         kept.append(f)
 
     kept = apply_baseline(kept, load_baseline() if baseline is None
                           else baseline)
+    for f in kept:
+        fam, hint = rules.get(f.rule, ("", ""))
+        f.family = f.family or fam
+        f.hint = f.hint or hint
     kept.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    if stats is not None:
+        from . import dataflow
+        by_family: Dict[str, int] = {}
+        for f in kept:
+            by_family[f.family or "?"] = by_family.get(
+                f.family or "?", 0) + 1
+        stats.update({
+            "files_scanned": len(sources),
+            "functions_analyzed": sum(
+                len(list(dataflow.iter_functions(sf.tree)))
+                for sf in sources),
+            "rules": sorted(rules),
+            "findings_by_family": by_family,
+        })
     return kept
 
 

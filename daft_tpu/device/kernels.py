@@ -627,6 +627,10 @@ def join_fused_kernel(l_key, l_valid, l_mask, r_key, r_valid, r_mask,
     donation and would warn per call, so the donating executable is only
     built off-cpu."""
     from . import backend
+    # daft-lint: allow(donation-unguarded) -- the donated build-side
+    # planes are per-dispatch packed key codes minted by the caller for
+    # exactly this call; they are never DeviceTable buffers, so the
+    # HBM-cache resident guard does not apply (only the backend gate does)
     donate = (backend.backend_name() or "cpu") != "cpu"
     fn = _join_fused_cache.get(donate)
     if fn is None:

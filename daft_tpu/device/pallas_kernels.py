@@ -670,6 +670,10 @@ def hash_join_kernel(l_key, l_valid, l_mask, r_key, r_valid, r_mask,
     their HBM for the table planes) — the same discipline as
     ``kernels.join_fused_kernel``."""
     from . import backend
+    # daft-lint: allow(donation-unguarded) -- same as join_fused_kernel:
+    # the donated build planes are per-dispatch packed key codes owned by
+    # this call, never cache-shared DeviceTable buffers; residency is not
+    # a concept for them
     donate = backend.is_accelerator()
     key = (donate, out_capacity, interpret_default(),
            block_rows(l_key.shape[0]), block_rows(r_key.shape[0]))

@@ -154,6 +154,15 @@ class WorkerServer:
                                 ent = [tracing.SpanRecorder(trace_ctx[0]),
                                        0]
                                 trace_bufs[trace_ctx[0]] = ent
+                                # daft-lint: allow(recorder-registration-leak) -- refcounted
+                                # pairing: the drain block after the try
+                                # decrements under the same lock and the
+                                # LAST task out unregisters; the path-
+                                # insensitive solver cannot see the
+                                # refcount invariant, and the registry
+                                # cap bounds the worst case (a
+                                # BaseException escaping do_POST kills
+                                # the server anyway)
                                 tracing.register_recorder(ent[0])
                             if ent is not None:
                                 ent[1] += 1
@@ -175,6 +184,11 @@ class WorkerServer:
                             fault_key=fault_key, attempt=attempt,
                             trace_ctx=trace_ctx))
 
+                    # daft-lint: allow(unattributed-worker) -- run_task
+                    # (worker.py, cross-module so the one-level summary
+                    # can't see it) installs the span context itself from
+                    # StageTask.trace_ctx; stats attribution is driver-
+                    # side — this process ships spans back instead
                     res = pool.submit(run).result()
                     from .worker import ShuffleResult
                     if isinstance(res, ShuffleResult):

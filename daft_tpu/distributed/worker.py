@@ -399,45 +399,58 @@ def _run_task_body(task: StageTask) -> object:
     cache = ShuffleCache()
     rows = 0
     samples_ipc = None
-    if spec.kind == "hash":
-        if spec.combine_aggs:
-            rows = _hash_shuffle_combined(stream, cache, spec, by)
-        else:
+    # a failure while draining the stream (task fault, fetch fault on a
+    # lazily resolved input, partitioning error) must delete the cache's
+    # spill directory NOW: until server.register() below transfers
+    # ownership, nothing else will — the orphan TTL sweep only covers
+    # crashed processes, so every retried task used to leak a
+    # daft_tpu_shuffle dir for the process lifetime (found by daft-lint's
+    # shuffle-cache-leak flow check)
+    try:
+        if spec.kind == "hash":
+            if spec.combine_aggs:
+                rows = _hash_shuffle_combined(stream, cache, spec, by)
+            else:
+                for mp in stream:
+                    rows += len(mp)
+                    for i, piece in enumerate(
+                            mp.partition_by_hash(by, spec.num_partitions)):
+                        if len(piece):
+                            cache.push(i,
+                                       piece.combined().to_arrow_table())
+        elif spec.kind == "store":
+            sampled = []
+            for mp in stream:
+                rows += len(mp)
+                if len(mp):
+                    rb = mp.combined()
+                    cache.push(0, rb.to_arrow_table())
+                    if spec.sample_k > 0:
+                        s = rb.sample(size=min(spec.sample_k, len(rb)))
+                        sampled.append(s.eval_expression_list(by))
+            if sampled:
+                merged = RecordBatch.concat(sampled)
+                if len(merged) > spec.sample_k:
+                    merged = merged.sample(size=spec.sample_k)
+                samples_ipc = _ipc_bytes(merged.to_arrow_table())
+        elif spec.kind == "range":
+            boundaries = RecordBatch.from_arrow_table(
+                _ipc_table(spec.boundaries_ipc))
+            desc = list(spec.descending) or [False] * len(by)
             for mp in stream:
                 rows += len(mp)
                 for i, piece in enumerate(
-                        mp.partition_by_hash(by, spec.num_partitions)):
+                        mp.combined().partition_by_range(
+                            by, boundaries, desc)):
                     if len(piece):
-                        cache.push(i, piece.combined().to_arrow_table())
-    elif spec.kind == "store":
-        sampled = []
-        for mp in stream:
-            rows += len(mp)
-            if len(mp):
-                rb = mp.combined()
-                cache.push(0, rb.to_arrow_table())
-                if spec.sample_k > 0:
-                    s = rb.sample(size=min(spec.sample_k, len(rb)))
-                    sampled.append(s.eval_expression_list(by))
-        if sampled:
-            merged = RecordBatch.concat(sampled)
-            if len(merged) > spec.sample_k:
-                merged = merged.sample(size=spec.sample_k)
-            samples_ipc = _ipc_bytes(merged.to_arrow_table())
-    elif spec.kind == "range":
-        boundaries = RecordBatch.from_arrow_table(
-            _ipc_table(spec.boundaries_ipc))
-        desc = list(spec.descending) or [False] * len(by)
-        for mp in stream:
-            rows += len(mp)
-            for i, piece in enumerate(mp.combined().partition_by_range(
-                    by, boundaries, desc)):
-                if len(piece):
-                    cache.push(i, piece.to_arrow_table())
-    else:
-        raise ValueError(f"shuffle-out kind {spec.kind!r}")
-    server = get_local_shuffle_server()
-    server.register(cache)
+                        cache.push(i, piece.to_arrow_table())
+        else:
+            raise ValueError(f"shuffle-out kind {spec.kind!r}")
+        server = get_local_shuffle_server()
+        server.register(cache)
+    except BaseException:
+        cache.cleanup()
+        raise
     return ShuffleResult(server.address, cache.shuffle_id,
                          spec.num_partitions, rows, samples_ipc)
 

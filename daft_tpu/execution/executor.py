@@ -117,6 +117,17 @@ class LocalExecutor:
             self._aqe_planner = adaptive.new_planner(self.cfg)
         return self._aqe_planner
 
+    def _poll_cancel(self) -> None:
+        """Cancellation poll for blocking drain loops. The driver loop
+        checks the token at every YIELD boundary, but a pipeline breaker
+        (sort consume, exchange fanout, join bucket store) drains its
+        whole child before yielding anything — without this poll,
+        INTERRUPT on a breaker-heavy query ran it to completion while
+        holding its admission (daft-lint: uncancellable-loop)."""
+        tok = self.cancel_token
+        if tok is not None:
+            tok.check()
+
     def run(self, plan: pp.PhysicalPlan,
             stage_inputs=None) -> Iterator[MicroPartition]:
         if stage_inputs:
@@ -538,6 +549,7 @@ class LocalExecutor:
             buf, rows = [], 0
 
         for mp in stream:
+            self._poll_cancel()
             buf.append(mp)
             rows += len(mp)
             if rows >= max(self._MERGE_AGG_REAGG_ROWS,
@@ -947,6 +959,7 @@ class LocalExecutor:
         buf = memory.SpillBuffer(memory.breaker_budget_bytes())
         samples: List[RecordBatch] = []
         for p in stream:
+            self._poll_cancel()
             rb = p.combined()
             if len(rb):
                 s = rb.sample(size=min(k, len(rb)))
@@ -971,6 +984,7 @@ class LocalExecutor:
         store = memory.PartitionedSpillStore(n)
         try:
             for mp in buf:
+                self._poll_cancel()
                 for i, piece in enumerate(
                         mp.partition_by_range(by, boundaries, desc)):
                     if len(piece):
@@ -1092,6 +1106,7 @@ class LocalExecutor:
         try:
             for i, mp in enumerate(stream if stream is not None
                                    else self._exec(node.children[0])):
+                self._poll_cancel()
                 for j, piece in enumerate(fan(mp, i)):
                     if len(piece):
                         store.push(j, piece.combined())
@@ -1147,6 +1162,7 @@ class LocalExecutor:
         cache = ShuffleCache(dirs=list(self.cfg.flight_shuffle_dirs) or None)
         try:
             for mp in self._exec(node.children[0]):
+                self._poll_cancel()
                 for i, piece in enumerate(mp.partition_by_hash(by, n)):
                     if len(piece):
                         cache.push(i, piece.combined().to_arrow_table())
@@ -1334,6 +1350,7 @@ class LocalExecutor:
         from . import memory
         store = memory.PartitionedSpillStore(n)
         for mp in stream:
+            self._poll_cancel()
             for j, piece in enumerate(mp.partition_by_hash(by, n)):
                 if len(piece):
                     store.push(j, piece.combined())

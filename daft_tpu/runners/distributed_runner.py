@@ -48,22 +48,30 @@ class DistributedRunner(Runner):
         from .. import observability as obs
         from .. import tracing
         tctx = tracing.maybe_start_trace("distributed")
-        with tracing.attach(tctx):
-            with tracing.span("plan:optimize", lane="planner"):
-                optimized = builder.optimize()
-            with tracing.span("plan:translate", lane="planner"):
-                pplan = translate(optimized.plan)
-            stage_plan = StagePlan.from_physical(pplan)
-            runner = StageRunner(self._get_manager(),
-                                 self._scheduler or LeastLoadedScheduler())
-            # driver-level query stats: each stage task runs its own local
-            # executor (whose stats only cover that fragment); this context
-            # spans the whole query, so its resilience-counter delta
-            # carries every recovery event of the run into explain_analyze
-            # and the dashboard
-            stats = obs.new_query_stats()
-            stats.plan = pplan
-        it = runner.run(stage_plan)
+        # a planning failure strikes before the driver stats context
+        # below takes ownership of the recorder — close and unregister it
+        # on that path or it leaks (daft-lint: trace-recorder-leak)
+        try:
+            with tracing.attach(tctx):
+                with tracing.span("plan:optimize", lane="planner"):
+                    optimized = builder.optimize()
+                with tracing.span("plan:translate", lane="planner"):
+                    pplan = translate(optimized.plan)
+                stage_plan = StagePlan.from_physical(pplan)
+                runner = StageRunner(
+                    self._get_manager(),
+                    self._scheduler or LeastLoadedScheduler())
+                # driver-level query stats: each stage task runs its own
+                # local executor (whose stats only cover that fragment);
+                # this context spans the whole query, so its
+                # resilience-counter delta carries every recovery event
+                # of the run into explain_analyze and the dashboard
+                stats = obs.new_query_stats()
+                stats.plan = pplan
+            it = runner.run(stage_plan)
+        except BaseException:
+            tracing.abort_trace(tctx)
+            raise
         try:
             # each pull runs under (a) the query's span context, so the
             # stage runner / task supervisor / driver-side exchange spans
@@ -77,7 +85,13 @@ class DistributedRunner(Runner):
                         break
                 yield p
         finally:
-            with obs.nested_scope(), tracing.attach(stats.trace_ctx):
-                it.close()
-            stats.finish()
-            obs.set_last_stats(stats)
+            # the export chain (set_last_stats → finalize_query) must
+            # run even when the stage runner's generator cleanup — or
+            # finish() itself — raises; otherwise the trace recorder
+            # outlives the query (daft-lint: trace-recorder-leak)
+            try:
+                with obs.nested_scope(), tracing.attach(stats.trace_ctx):
+                    it.close()
+                stats.finish()
+            finally:
+                obs.set_last_stats(stats)

@@ -42,15 +42,22 @@ class NativeRunner(Runner):
             return
         # the trace (when sampled in) starts HERE so the planner spans
         # land on it; the executor's stats context adopts it and the
-        # export fires at set_last_stats
+        # export fires at set_last_stats. Until that adoption the
+        # recorder has no owner: a planner failure must close and
+        # unregister it here or it leaks in the registry with the trace
+        # silently lost (found by daft-lint's trace-recorder-leak check)
         tctx = tracing.maybe_start_trace("query")
-        with tracing.attach(tctx):
-            with tracing.span("plan:optimize", lane="planner"):
-                optimized = builder.optimize()
-            with tracing.span("plan:translate", lane="planner"):
-                pplan = translate(optimized.plan)
-            executor = make_local_executor(cfg)
-            it = executor.run(pplan)
+        try:
+            with tracing.attach(tctx):
+                with tracing.span("plan:optimize", lane="planner"):
+                    optimized = builder.optimize()
+                with tracing.span("plan:translate", lane="planner"):
+                    pplan = translate(optimized.plan)
+                executor = make_local_executor(cfg)
+                it = executor.run(pplan)
+        except BaseException:
+            tracing.abort_trace(tctx)
+            raise
         yield from it
 
     # ------------------------------------------------------------- AQE

@@ -299,6 +299,10 @@ class QueryScheduler:
                                  name=f"daft-tpu-serve-{i}", daemon=True)
             t.start()
             self._threads.append(t)
+        # daft-lint: allow(unattributed-worker) -- the sweep thread only
+        # expires queued handles and idle sessions under the scheduler
+        # condition; it never executes query work or touches plane
+        # counters, so there is no attribution to thread through
         t = threading.Thread(target=self._sweep_loop,
                              name="daft-tpu-serve-sweep", daemon=True)
         t.start()
@@ -552,22 +556,33 @@ class QueryScheduler:
                     waited_s=time.monotonic() - h.submitted_at))
                 self._count("rejected_queue_timeout")
             return
-        with self._cond:
-            self._n_running += 1
-            running_at_admit = self._n_running
-        h._mark_running()
-        queue_wait_us = int(h.queue_wait_s * 1e6)
-        from .. import tracing
-        if h.trace_ctx is not None:
-            # the queue-wait span: submit → run start, on the timeline
-            rec = h.trace_ctx.recorder
-            rec.add("serve:queue", rec.unique_span_id("serve:queue"),
-                    h.trace_ctx.span_id, h.submitted_at_us,
-                    queue_wait_us,
-                    attrs={"session": h.session, "priority": h.priority,
-                           "admitted_bytes": est},
-                    lane="serving")
+        # EVERYTHING after a successful try_acquire runs under the
+        # try/finally that releases it — the run-state bump, the handle
+        # transition and the queue-wait span emission all make calls, and
+        # an exception on any of them used to leak the admitted bytes
+        # (and a worker slot: _n_running never decremented) for the
+        # process lifetime. Found by daft-lint's memory-admission-leak
+        # flow check.
+        queue_wait_us = 0
+        running = False
         try:
+            with self._cond:
+                self._n_running += 1
+                running_at_admit = self._n_running
+            running = True
+            h._mark_running()
+            queue_wait_us = int(h.queue_wait_s * 1e6)
+            from .. import tracing
+            if h.trace_ctx is not None:
+                # the queue-wait span: submit → run start, on the timeline
+                rec = h.trace_ctx.recorder
+                rec.add("serve:queue", rec.unique_span_id("serve:queue"),
+                        h.trace_ctx.span_id, h.submitted_at_us,
+                        queue_wait_us,
+                        attrs={"session": h.session,
+                               "priority": h.priority,
+                               "admitted_bytes": est},
+                        lane="serving")
             # nested scope: the executor's set_last_stats must not fire
             # the per-query exports mid-flight — the serving info isn't
             # attached yet; finalize_query below is the single exporter
@@ -623,7 +638,8 @@ class QueryScheduler:
         finally:
             self.admission.release(est)
             with self._cond:
-                self._n_running -= 1
+                if running:
+                    self._n_running -= 1
                 self._cond.notify_all()
 
     # ------------------------------------------------------------- execute

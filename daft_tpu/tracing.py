@@ -373,6 +373,26 @@ def maybe_start_trace(kind: str = "query") -> Optional[SpanContext]:
     return SpanContext(rec, rec.root_id)
 
 
+def abort_trace(ctx: Optional[SpanContext],
+                status: str = "error") -> None:
+    """Close and unregister a trace whose query died before anything
+    could adopt it (a planner failure between :func:`maybe_start_trace`
+    and the executor's stats context taking ownership). Idempotent and
+    no-op for None / already-exported contexts — safe to call from any
+    error path. Without this, every failed optimize/translate left its
+    recorder registered for the process lifetime (the registry cap made
+    it a rotation of leaks rather than growth, but the trace itself was
+    silently lost)."""
+    if ctx is None:
+        return
+    rec = ctx.recorder
+    if rec is None or getattr(rec, "exported", False):
+        return
+    rec.exported = True
+    rec.finish(status)
+    unregister_recorder(rec.trace_id)
+
+
 def remote_context(trace_id: str, span_id: str,
                    parent_id: Optional[str] = None
                    ) -> Optional[SpanContext]:

@@ -622,3 +622,623 @@ def test_cli_knob_docs_prints_all_groups(capsys):
     for group in knobs.GROUPS:
         assert f"### {group}" in out
     assert "DAFT_TPU_SANITIZE" in out
+
+
+# =====================================================================
+# v2: flow-sensitive families (dataflow engine + four rule families)
+
+from daft_tpu.analysis import (dataflow, rule_attribution,  # noqa: E402
+                               rule_cancellation, rule_donation,
+                               rule_resources)
+
+
+def _rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------- dataflow engine unit
+
+def test_cfg_finally_credits_exception_paths():
+    import ast as _ast
+    code = (
+        "def f(self, est):\n"
+        "    self.mem.acquire(est)\n"
+        "    try:\n"
+        "        return work(est)\n"
+        "    finally:\n"
+        "        self.mem.release(est)\n")
+    fn = _ast.parse(code).body[0]
+    cfg = dataflow.CFG(fn)
+
+    def credit(node):
+        return node.stmt is not None and "release" in _ast.unparse(
+            node.stmt)
+    # from the acquire onward, every path (normal return AND work()
+    # raising) passes the finally's release — the per-continuation
+    # finally instantiation is what makes the exception copy credited
+    acquire_stmt = fn.body[0]
+    starts = [t for n in cfg.nodes_for(acquire_stmt)
+              for t, is_exc in n.succ if not is_exc]
+    assert dataflow.find_escape(cfg, starts, credit) is None
+
+
+def test_cfg_exception_edge_escapes_without_finally():
+    import ast as _ast
+    code = (
+        "def f(self, est):\n"
+        "    self.mem.acquire(est)\n"
+        "    mid(est)\n"
+        "    self.mem.release(est)\n")
+    fn = _ast.parse(code).body[0]
+    cfg = dataflow.CFG(fn)
+
+    def credit(node):
+        return node.stmt is not None and "release" in _ast.unparse(
+            node.stmt)
+    # mid() raising exits before the release: NOT all paths credited
+    assert not dataflow.hits_on_all_paths(cfg, credit)
+
+
+def test_cfg_except_baseexception_is_catch_all():
+    import ast as _ast
+    code = (
+        "def f(x):\n"
+        "    try:\n"
+        "        work(x)\n"
+        "    except BaseException:\n"
+        "        stop(x)\n"
+        "        raise\n"
+        "    stop(x)\n")
+    fn = _ast.parse(code).body[0]
+    cfg = dataflow.CFG(fn)
+
+    def credit(node):
+        return node.stmt is not None and "stop" in _ast.unparse(node.stmt)
+    assert dataflow.find_escape(
+        cfg, [cfg.entry], credit, exc_only=True) is None
+
+
+# -------------------------------------- family: resource pairing (5)
+
+def test_admission_leak_on_exception_edge_flagged(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def run(self, est):\n"
+        "    self.mem.acquire(est)\n"
+        "    do_work(est)\n"
+        "    self.mem.release(est)\n")
+    assert "memory-admission-leak" in _rules_of(rule_resources.check(srcs))
+
+
+def test_try_finally_release_is_credited(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def run(self, est):\n"
+        "    self.mem.acquire(est)\n"
+        "    try:\n"
+        "        return do_work(est)\n"
+        "    finally:\n"
+        "        self.mem.release(est)\n")
+    assert "memory-admission-leak" not in _rules_of(
+        rule_resources.check(srcs))
+
+
+def test_helper_release_call_summary_is_credited(tmp_path):
+    # the helper releases on ALL its paths → calling it credits the
+    # caller's exception edges (one-level call summary)
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def _done(self, est):\n"
+        "    self.mem.release(est)\n"
+        "\n"
+        "def run(self, est):\n"
+        "    self.mem.acquire(est)\n"
+        "    try:\n"
+        "        return do_work(est)\n"
+        "    finally:\n"
+        "        self._done(est)\n")
+    assert "memory-admission-leak" not in _rules_of(
+        rule_resources.check(srcs))
+
+
+def test_helper_that_may_not_release_is_not_credited(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def _done(self, est):\n"
+        "    if maybe():\n"
+        "        self.mem.release(est)\n"
+        "\n"
+        "def run(self, est):\n"
+        "    self.mem.acquire(est)\n"
+        "    try:\n"
+        "        return do_work(est)\n"
+        "    finally:\n"
+        "        self._done(est)\n")
+    assert "memory-admission-leak" in _rules_of(rule_resources.check(srcs))
+
+
+def test_conditional_try_acquire_tracks_success_branch(tmp_path):
+    # `if not try_acquire(): return` — the reject branch needs no
+    # release; the success continuation does (and has one here)
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def run(self, est):\n"
+        "    if not self.admission.try_acquire(est):\n"
+        "        return None\n"
+        "    try:\n"
+        "        return do_work(est)\n"
+        "    finally:\n"
+        "        self.admission.release(est)\n")
+    assert "memory-admission-leak" not in _rules_of(
+        rule_resources.check(srcs))
+
+
+def test_admission_leak_pragma_suppresses(tmp_path):
+    code = (
+        "def run(self, est):\n"
+        "    " + PRAGMA + "allow(memory-admission-leak) -- test dummy\n"
+        "    self.mem.acquire(est)\n"
+        "    do_work(est)\n"
+        "    self.mem.release(est)\n")
+    p = tmp_path / "daft_tpu" / "foo.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(code)
+    findings = run_analysis(str(tmp_path), subdirs=("daft_tpu",),
+                            contracts=False, readme=False, baseline=[])
+    assert "memory-admission-leak" not in _rules_of(findings)
+
+
+def test_shuffle_cache_ownership_transfer_credits(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def task(stream):\n"
+        "    cache = ShuffleCache()\n"
+        "    try:\n"
+        "        for mp in stream:\n"
+        "            cache.push(0, mp)\n"
+        "        server.register(cache)\n"
+        "    except BaseException:\n"
+        "        cache.cleanup()\n"
+        "        raise\n")
+    assert "shuffle-cache-leak" not in _rules_of(rule_resources.check(srcs))
+
+
+def test_shuffle_cache_leak_on_drain_failure_flagged(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def task(stream):\n"
+        "    cache = ShuffleCache()\n"
+        "    for mp in stream:\n"
+        "        cache.push(0, mp)\n"
+        "    server.register(cache)\n")
+    assert "shuffle-cache-leak" in _rules_of(rule_resources.check(srcs))
+
+
+def test_trace_recorder_exception_path_needs_abort(tmp_path):
+    bad = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def run(builder):\n"
+        "    tctx = tracing.maybe_start_trace('q')\n"
+        "    plan = builder.optimize()\n"
+        "    return execute(plan)\n")
+    assert "trace-recorder-leak" in _rules_of(rule_resources.check(bad))
+
+
+def test_trace_recorder_abort_on_error_path_is_clean(tmp_path):
+    # mirrors the fixed NativeRunner.run_iter: everything that can raise
+    # before the executor adopts the trace sits under the abort handler
+    good = _sources_from(
+        tmp_path, "daft_tpu/bar.py",
+        "def run(builder):\n"
+        "    tctx = tracing.maybe_start_trace('q')\n"
+        "    try:\n"
+        "        plan = builder.optimize()\n"
+        "        it = execute(plan)\n"
+        "    except BaseException:\n"
+        "        tracing.abort_trace(tctx)\n"
+        "        raise\n"
+        "    yield from it\n")
+    assert "trace-recorder-leak" not in _rules_of(rule_resources.check(good))
+
+
+def test_pool_with_form_and_attr_escape_are_clean(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def a(xs):\n"
+        "    with ThreadPoolExecutor(4) as pool:\n"
+        "        return [f.result() for f in map(pool.submit, xs)]\n"
+        "\n"
+        "def b(self):\n"
+        "    self._pool = ThreadPoolExecutor(4)\n")
+    assert "pool-leak" not in _rules_of(rule_resources.check(srcs))
+
+
+def test_local_pool_without_shutdown_flagged(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def a(xs):\n"
+        "    pool = ThreadPoolExecutor(4)\n"
+        "    return pool.submit(work).result()\n")
+    assert "pool-leak" in _rules_of(rule_resources.check(srcs))
+
+
+def test_scope_helper_outside_with_flagged(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def run(tok, stats):\n"
+        "    cancel_scope(tok)\n"
+        "    with obs.attributed(stats):\n"
+        "        pass\n")
+    rules = _rules_of(rule_resources.check(srcs))
+    assert rules.count("scope-helper-not-with") == 1
+
+
+def test_scope_helper_assigned_then_entered_is_clean(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def run():\n"
+        "    sp = tracing.span('scan', key='k')\n"
+        "    with sp:\n"
+        "        pass\n")
+    assert "scope-helper-not-with" not in _rules_of(
+        rule_resources.check(srcs))
+
+
+# ---------------------------------------- family: donation safety (6)
+
+_DONATING_HELPER = (
+    "def _dispatch(prog, dt, out_cap, donate=False):\n"
+    "    arrays = {n: c.data for n, c in dt.columns.items()}\n"
+    "    valids = {n: c.validity for n, c in dt.columns.items()}\n"
+    "    fn = prog.donate_fn() if donate else prog.packed_fn\n"
+    "    return fn(arrays, valids, out_cap=out_cap)\n"
+    "\n")
+
+
+def test_donated_then_read_across_one_call_level_flagged(tmp_path):
+    # run() donates dt via the _dispatch helper, then reads its planes
+    # through a second helper — the solver must catch it one level deep
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/fragment.py",
+        _DONATING_HELPER +
+        "def _nbytes(dt):\n"
+        "    return sum(c.data.nbytes for c in dt.columns.values())\n"
+        "\n"
+        "def run(prog, dt, donate):\n"
+        "    packed = _dispatch(prog, dt, 64, donate)\n"
+        "    return packed, _nbytes(dt)\n")
+    assert "donated-buffer-read" in _rules_of(rule_donation.check(srcs))
+
+
+def test_donated_direct_plane_read_flagged(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/fragment.py",
+        _DONATING_HELPER +
+        "def run(prog, dt, donate):\n"
+        "    packed = _dispatch(prog, dt, 64, donate)\n"
+        "    return packed, dt.row_mask\n")
+    assert "donated-buffer-read" in _rules_of(rule_donation.check(srcs))
+
+
+def test_rebind_before_reuse_kills_taint(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/fragment.py",
+        _DONATING_HELPER +
+        "def run(prog, dt, donate, reencode):\n"
+        "    packed = _dispatch(prog, dt, 64, donate)\n"
+        "    if donate:\n"
+        "        dt = reencode()\n"
+        "    return _dispatch(prog, dt, 128, donate)\n")
+    assert "donated-buffer-read" not in _rules_of(rule_donation.check(srcs))
+
+
+def test_scalar_metadata_read_after_donation_is_clean(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/fragment.py",
+        _DONATING_HELPER +
+        "def run(prog, dt, donate):\n"
+        "    packed = _dispatch(prog, dt, 64, donate)\n"
+        "    return packed, dt.row_count, dt.capacity\n")
+    assert "donated-buffer-read" not in _rules_of(rule_donation.check(srcs))
+
+
+def test_statically_disabled_donation_not_tainted(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/fragment.py",
+        _DONATING_HELPER +
+        "def run(prog, dt):\n"
+        "    packed = _dispatch(prog, dt, 64)\n"   # donate defaults False
+        "    return packed, dt.row_mask\n")
+    assert "donated-buffer-read" not in _rules_of(rule_donation.check(srcs))
+
+
+def test_unguarded_donate_flag_flagged(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/fragment.py",
+        "def run(prog, dt):\n"
+        "    donate = fast_mode_enabled()\n"
+        "    return dispatch(prog, dt, donate=donate)\n")
+    assert "donation-unguarded" in _rules_of(rule_donation.check(srcs))
+
+
+def test_resident_guarded_donate_flag_clean(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/fragment.py",
+        "def _donation_ok(dt):\n"
+        "    return backend.is_accelerator() and not dt.resident\n"
+        "\n"
+        "def run(prog, dt, reencode):\n"
+        "    donate = reencode is not None and _donation_ok(dt)\n"
+        "    return dispatch(prog, dt, donate=donate)\n")
+    assert "donation-unguarded" not in _rules_of(rule_donation.check(srcs))
+
+
+def test_donation_pragma_suppresses(tmp_path):
+    code = (
+        "def run(prog, dt):\n"
+        "    " + PRAGMA + "allow(donation-unguarded) -- test dummy\n"
+        "    donate = fast_mode_enabled()\n"
+        "    return dispatch(prog, dt, donate=donate)\n")
+    p = tmp_path / "daft_tpu" / "device"
+    p.mkdir(parents=True)
+    (p / "fragment.py").write_text(code)
+    findings = run_analysis(str(tmp_path), subdirs=("daft_tpu",),
+                            contracts=False, readme=False, baseline=[])
+    assert "donation-unguarded" not in _rules_of(findings)
+
+
+# ---------------------------------- family: cancellation checks (7)
+
+def test_uncancellable_drain_loop_flagged(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/execution/executor.py",
+        "def consume(self, stream):\n"
+        "    out = []\n"
+        "    for mp in stream:\n"
+        "        out.append(mp)\n"
+        "    return out\n")
+    assert "uncancellable-loop" in _rules_of(rule_cancellation.check(srcs))
+
+
+def test_polled_drain_loop_clean(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/execution/executor.py",
+        "def consume(self, stream):\n"
+        "    out = []\n"
+        "    for mp in stream:\n"
+        "        self._poll_cancel()\n"
+        "        out.append(mp)\n"
+        "    return out\n")
+    assert "uncancellable-loop" not in _rules_of(
+        rule_cancellation.check(srcs))
+
+
+def test_yielding_loop_is_boundary_checked(tmp_path):
+    # a pipelined loop yields every morsel: the driver checks the token
+    # at the yield boundary, no in-loop poll needed
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/execution/executor.py",
+        "def passthrough(self, stream):\n"
+        "    for mp in stream:\n"
+        "        yield transform(mp)\n")
+    assert "uncancellable-loop" not in _rules_of(
+        rule_cancellation.check(srcs))
+
+
+def test_channel_put_loop_is_credited(tmp_path):
+    # Channel.put polls the pipeline cancel event on every blocked try
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/execution/pipeline.py",
+        "def dispatch(self, child, out):\n"
+        "    for mp in child:\n"
+        "        out.put(mp)\n")
+    assert "uncancellable-loop" not in _rules_of(
+        rule_cancellation.check(srcs))
+
+
+def test_loop_checking_via_helper_is_credited(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/execution/executor.py",
+        "def _poll(self):\n"
+        "    tok = self.cancel_token\n"
+        "    if tok is not None:\n"
+        "        tok.check()\n"
+        "\n"
+        "def consume(self, stream):\n"
+        "    for mp in stream:\n"
+        "        self._poll()\n"
+        "        use(mp)\n")
+    assert "uncancellable-loop" not in _rules_of(
+        rule_cancellation.check(srcs))
+
+
+def test_out_of_scope_module_loops_exempt(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/io/readers.py",
+        "def consume(stream):\n"
+        "    return [mp for mp in stream]\n")
+    assert rule_cancellation.check(srcs) == []
+
+
+def test_cancellation_pragma_suppresses(tmp_path):
+    code = (
+        "def consume(self, stream):\n"
+        "    " + PRAGMA + "allow(uncancellable-loop) -- iterator polls\n"
+        "    for mp in stream:\n"
+        "        use(mp)\n")
+    p = tmp_path / "daft_tpu" / "execution"
+    p.mkdir(parents=True)
+    (p / "executor.py").write_text(code)
+    findings = run_analysis(str(tmp_path), subdirs=("daft_tpu",),
+                            contracts=False, readme=False, baseline=[])
+    assert "uncancellable-loop" not in _rules_of(findings)
+
+
+# --------------------------------- family: attribution threading (8)
+
+def test_unwrapped_pool_submit_flagged(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/execution/executor.py",
+        "def fan(pool, fn, xs):\n"
+        "    return [pool.submit(fn, x) for x in xs]\n")
+    assert "unattributed-worker" in _rules_of(rule_attribution.check(srcs))
+
+
+def test_wrapped_pool_submit_clean(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/execution/executor.py",
+        "def fan(pool, fn, xs):\n"
+        "    return [pool.submit(obs.run_attributed,\n"
+        "                        obs.current_attribution(), fn, x)\n"
+        "            for x in xs]\n")
+    assert "unattributed-worker" not in _rules_of(
+        rule_attribution.check(srcs))
+
+
+def test_thread_target_installing_attribution_credited(tmp_path):
+    # the target installs the scope itself (found transitively)
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/execution/pipeline.py",
+        "def _guard(self, fn):\n"
+        "    with obs.attributed(self.stats_ctx):\n"
+        "        fn()\n"
+        "\n"
+        "def spawn(self, fn, name):\n"
+        "    t = threading.Thread(target=self._guard, args=(fn,),\n"
+        "                         name=name, daemon=True)\n"
+        "    t.start()\n")
+    assert "unattributed-worker" not in _rules_of(
+        rule_attribution.check(srcs))
+
+
+def test_bare_thread_target_flagged(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/serving/scheduler.py",
+        "def _loop(self):\n"
+        "    while True:\n"
+        "        self._step()\n"
+        "\n"
+        "def start(self):\n"
+        "    threading.Thread(target=self._loop, daemon=True).start()\n")
+    assert "unattributed-worker" in _rules_of(rule_attribution.check(srcs))
+
+
+def test_foreign_bound_method_target_skipped(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/serving/scheduler.py",
+        "def start(self, server):\n"
+        "    threading.Thread(target=server.serve_forever,\n"
+        "                     daemon=True).start()\n")
+    assert rule_attribution.check(srcs) == []
+
+
+# ------------------------------------------------ pragma hygiene (v2)
+
+def test_pragma_naming_unknown_rule_is_flagged(tmp_path):
+    code = ("x = 1  " + PRAGMA + "allow(no-such-" + "rule) -- stale\n")
+    p = tmp_path / "daft_tpu" / "foo.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(code)
+    findings = run_analysis(str(tmp_path), subdirs=("daft_tpu",),
+                            contracts=False, readme=False, baseline=[])
+    assert "pragma-unknown-rule" in _rules_of(findings)
+
+
+def test_pragma_naming_live_rule_not_flagged(tmp_path):
+    code = ("import os\n"
+            "v = os.environ.get('DAFT_TPU_MAX_RETRIES')  "
+            + PRAGMA + "allow(knob-direct-read) -- bootstrap\n")
+    p = tmp_path / "daft_tpu" / "foo.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(code)
+    findings = run_analysis(str(tmp_path), subdirs=("daft_tpu",),
+                            contracts=False, readme=False, baseline=[])
+    assert "pragma-unknown-rule" not in _rules_of(findings)
+
+
+def test_every_emitted_rule_is_registered():
+    """known_rules() is the pragma-validation registry: every rule id a
+    family can emit must be present with a family and a fix hint."""
+    rules = framework.known_rules()
+    for rid, (family, hint) in rules.items():
+        assert family and hint, rid
+    for mod in (rule_resources, rule_donation, rule_cancellation,
+                rule_attribution):
+        for rid in mod.RULE_IDS:
+            assert rid in rules
+
+
+def test_findings_carry_family_and_hint(tmp_path):
+    p = tmp_path / "daft_tpu" / "foo.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("def run(self, est):\n"
+                 "    self.mem.acquire(est)\n"
+                 "    do_work(est)\n")
+    findings = run_analysis(str(tmp_path), subdirs=("daft_tpu",),
+                            contracts=False, readme=False, baseline=[])
+    leak = [f for f in findings if f.rule == "memory-admission-leak"]
+    assert leak and leak[0].family == "resources" and leak[0].hint
+
+
+# ------------------------------------------------------ CLI additions
+
+def test_cli_rule_filter_and_stats(capsys, monkeypatch, tmp_path):
+    from daft_tpu.analysis.__main__ import main
+    # unknown rule id → usage error
+    assert main(["--rule", "definitely-not-a-rule"]) == 2
+    out = capsys.readouterr().out
+    assert "unknown rule id" in out
+
+
+def test_cli_stats_line_on_repo(capsys):
+    from daft_tpu.analysis.__main__ import main
+    rc = main(["--stats", "--no-contracts", "--no-readme"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "daft-lint stats:" in out
+    assert "findings_by_family" in out
+
+
+def test_cli_json_findings_carry_family_and_hint():
+    import json as _json
+    import subprocess
+    import sys
+
+    # a tree with one planted finding, driven through the real CLI path
+    # (runs in a subprocess so repo_root() still resolves; the planted
+    # file is passed as an explicit path argument)
+    code = ("import os\n"
+            "v = os.environ.get('DAFT_TPU_" + "PLANTED')\n")
+    import tempfile
+    # planted INSIDE daft_tpu/ (the knob rules scope there), removed on
+    # exit; the suite runs serially so no other lint test sees it
+    with tempfile.TemporaryDirectory(
+            dir=os.path.join(REPO, "daft_tpu")) as td:
+        rel = os.path.relpath(td, REPO)
+        with open(os.path.join(td, "planted.py"), "w") as f:
+            f.write(code)
+        r = subprocess.run(
+            [sys.executable, "-m", "daft_tpu.analysis", "--json",
+             "--no-contracts", "--no-readme", rel],
+            capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1, r.stdout + r.stderr
+    data = _json.loads(r.stdout)
+    planted = [d for d in data if d["rule"] == "knob-unregistered"]
+    assert planted and planted[0]["family"] == "knobs" \
+        and planted[0]["hint"]
+
+
+def test_nested_def_in_loop_body_does_not_credit(tmp_path):
+    # a callback defined inside the loop body may contain put()/yield,
+    # but it runs on some other call — the drain loop itself still
+    # never polls the token (review finding, pinned)
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/execution/executor.py",
+        "def consume(self, stream, q):\n"
+        "    cbs = []\n"
+        "    for mp in stream:\n"
+        "        def cb(mp=mp):\n"
+        "            q.put(mp)\n"
+        "        cbs.append(cb)\n"
+        "    return cbs\n")
+    assert "uncancellable-loop" in _rules_of(rule_cancellation.check(srcs))

@@ -762,3 +762,80 @@ def test_cancel_unwinds_noncacheable_runner_drain(monkeypatch):
         assert s.admission.outstanding == 0
     finally:
         s.shutdown()
+
+
+# ----------------------- r14 lifecycle regressions (daft-lint flow pass)
+
+def test_admission_released_when_prerun_bookkeeping_raises(
+        parquet_table, monkeypatch):
+    """r14 regression (found by daft-lint memory-admission-leak): an
+    exception between a successful try_acquire and the run-worker's
+    try-block — here the handle's running transition — used to leak the
+    admitted bytes AND the worker's running slot for the process
+    lifetime (the worker thread died, so the handle never completed)."""
+    from daft_tpu.serving import scheduler as sched_mod
+    sched = QueryScheduler(concurrency=1, memory_budget=1 << 30,
+                           queue_timeout_s=30.0)
+    try:
+        orig = sched_mod.QueryHandle._mark_running
+
+        def boom(self):
+            raise RuntimeError("bookkeeping exploded")
+
+        monkeypatch.setattr(sched_mod.QueryHandle, "_mark_running", boom)
+        h = sched.submit(_agg_query(parquet_table))
+        with pytest.raises(RuntimeError, match="bookkeeping exploded"):
+            h.result(30)
+        assert h.state == "failed"
+        assert sched.admission.outstanding == 0
+        # the worker slot survived: a healthy query still runs on it
+        monkeypatch.setattr(sched_mod.QueryHandle, "_mark_running", orig)
+        h2 = sched.submit(_agg_query(parquet_table))
+        assert h2.result(30).to_recordbatch().to_pydict() \
+            == _agg_query(parquet_table).to_pydict()
+        assert sched.admission.outstanding == 0
+    finally:
+        sched.shutdown()
+
+
+def test_breaker_drain_polls_cancellation():
+    """r14 regression (daft-lint uncancellable-loop): a pipeline
+    breaker's consume loop (sort sampling, bucket stores) drains its
+    whole child before yielding — without the in-loop poll, INTERRUPT
+    ran the drain to completion while holding admission."""
+    from daft_tpu.execution.executor import LocalExecutor
+    from daft_tpu.micropartition import MicroPartition
+
+    tok = CancelToken()
+    with cancel_scope(tok):
+        ex = LocalExecutor()  # captures the scope's token
+    mp = MicroPartition.from_pydict({"x": [1.0, 2.0, 3.0]})
+    seen = {"n": 0}
+
+    def stream():
+        for _ in range(100):
+            seen["n"] += 1
+            if seen["n"] == 3:
+                tok.set("client interrupt")
+            yield mp
+
+    with pytest.raises(QueryCancelled):
+        ex._consume_sampling(stream(), [col("x")])
+    assert seen["n"] <= 4, "drain kept running after the token fired"
+
+    # the bucket-store drain polls too
+    tok2 = CancelToken()
+    with cancel_scope(tok2):
+        ex2 = LocalExecutor()
+    seen["n"] = 0
+
+    def stream2():
+        for _ in range(100):
+            seen["n"] += 1
+            if seen["n"] == 3:
+                tok2.set("client interrupt")
+            yield mp
+
+    with pytest.raises(QueryCancelled):
+        ex2._key_bucket_store(stream2(), [col("x")], 4)
+    assert seen["n"] <= 4

@@ -157,3 +157,48 @@ def test_unregister_cleans_spill_files(server):
     assert os.path.isdir(root)
     server.unregister(cache.shuffle_id)
     assert not os.path.isdir(root)
+
+
+def test_failed_shuffle_task_cleans_spill_dir(monkeypatch):
+    """r14 regression (found by daft-lint shuffle-cache-leak): a failure
+    while draining the task's stream — a fetch fault on a lazily
+    resolved input, a partitioning error — orphaned the ShuffleCache's
+    spill directory until process exit; ownership only transfers at
+    server.register(), so the error path must cleanup() itself."""
+    import os
+
+    import pytest
+
+    from daft_tpu import col
+    from daft_tpu.distributed import worker as w
+    from daft_tpu.distributed.shuffle_service import ShuffleCache
+    from daft_tpu.execution.executor import LocalExecutor
+    from daft_tpu.micropartition import MicroPartition
+    from daft_tpu.physical import plan as pp
+
+    made = []
+    orig_init = ShuffleCache.__init__
+
+    def spy_init(self, *a, **k):
+        orig_init(self, *a, **k)
+        made.append(self)
+
+    monkeypatch.setattr(ShuffleCache, "__init__", spy_init)
+
+    def boom_stream(self, plan, stage_inputs=None):
+        def gen():
+            yield MicroPartition.from_pydict({"k": [1, 2],
+                                              "v": [1.0, 2.0]})
+            raise RuntimeError("fetch fault mid-drain")
+        return gen()
+
+    monkeypatch.setattr(LocalExecutor, "run", boom_stream)
+    task = w.StageTask(
+        0, pp.InMemorySource([], None), {},
+        shuffle_out=w.ShuffleOutSpec(num_partitions=2, by=(col("k"),)))
+    with pytest.raises(RuntimeError, match="fetch fault mid-drain"):
+        w._run_task_body(task)
+    assert made, "no ShuffleCache constructed"
+    # the spill dir was deleted on the error path (first batch HAD been
+    # pushed, so the dir existed with a partition file in it)
+    assert all(not os.path.isdir(c._root) for c in made)

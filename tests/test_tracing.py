@@ -652,3 +652,34 @@ def test_serving_cancel_event_and_trace_close(monkeypatch):
             assert tracing.recorder_for(rec.trace_id) is None
     finally:
         sched.shutdown()
+
+
+def test_planner_failure_aborts_and_unregisters_trace(monkeypatch):
+    """r14 regression (found by daft-lint trace-recorder-leak): a
+    translate/optimize failure between maybe_start_trace and the
+    executor's stats-context adoption left the recorder registered for
+    the process lifetime, with the trace silently lost."""
+    monkeypatch.setenv("DAFT_TPU_TRACE", "1")
+
+    def boom(plan):
+        raise RuntimeError("translate exploded")
+
+    monkeypatch.setattr("daft_tpu.runners.native_runner.translate", boom)
+    df = daft.from_pydict({"x": [1, 2, 3]}).where(col("x") > 1)
+    with pytest.raises(RuntimeError, match="translate exploded"):
+        df.to_pydict()
+    # the aborted trace closed and left the registry
+    with tracing._reg_lock:
+        assert dict(tracing._recorders) == {}
+
+
+def test_abort_trace_is_idempotent_and_none_safe():
+    tracing.abort_trace(None)  # no-op
+    rec = tracing.SpanRecorder("t" * 32)
+    tracing.register_recorder(rec)
+    ctx = tracing.SpanContext(rec, rec.root_id)
+    tracing.abort_trace(ctx)
+    tracing.abort_trace(ctx)  # second call: already exported, no-op
+    assert rec.exported and rec.status == "error"
+    with tracing._reg_lock:
+        assert rec.trace_id not in tracing._recorders
