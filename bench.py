@@ -1357,6 +1357,122 @@ def _try_device_tier(budget_s: float):
     return _merge_lines(proc.stdout or "")
 
 
+def _warmup_child():
+    """``--warmup-child``: one cold process measuring the recompile tax.
+    Runs q1/q6/q3 twice each under the armed retrace sanitizer and
+    reports first/hot latency plus per-run trace/compile counters (the
+    shape-discipline evidence: hot runs must show ZERO trace events).
+    With BENCH_WARMUP_AOT=1 it runs the AOT warm-up first, so a
+    populated DAFT_TPU_COMPILE_CACHE_DIR turns compiles into disk
+    reads."""
+    os.environ.setdefault("DAFT_TPU_DEVICE", "1")
+    from daft_tpu.analysis import retrace_sanitizer as rs
+    if not rs.is_enabled():
+        rs.enable(1)
+    out = {}
+    if os.environ.get("BENCH_WARMUP_AOT") == "1":
+        from daft_tpu.device import warmup
+        t0 = time.time()
+        st = warmup.warmup_session()
+        out["aot"] = {"seconds": round(time.time() - t0, 3),
+                      "size_classes": st.get("size_classes"),
+                      "kernels": st.get("kernels"),
+                      "fragments": st.get("fragments")}
+    for qn in ("q1", "q6", "q3"):
+        s0 = rs.counters_snapshot()
+        _out, first, hot = run_tpch_query(DATA, qn)
+        s2 = rs.counters_snapshot()
+        # run_tpch_query runs warm+hot internally; re-split the counters
+        # with one more hot run so the HOT figures are isolated
+        s_hot0 = rs.counters_snapshot()
+        t0 = time.time()
+        run_tpch_query_once(DATA, qn)
+        hot2 = time.time() - t0
+        s_hot1 = rs.counters_snapshot()
+        out[qn] = {
+            "first_s": round(first, 3), "hot_s": round(min(hot, hot2), 3),
+            "first_traces": int(s2.get("traces", 0) - s0.get("traces", 0)),
+            "first_compiles": int(s2.get("compiles", 0)
+                                  - s0.get("compiles", 0)),
+            "first_compile_s": round(s2.get("compile_seconds", 0)
+                                     - s0.get("compile_seconds", 0), 3),
+            "hot_traces": int(s_hot1.get("traces", 0)
+                              - s_hot0.get("traces", 0)),
+            "hot_compiles": int(s_hot1.get("compiles", 0)
+                                - s_hot0.get("compiles", 0)),
+        }
+    s = rs.summary()
+    out["retrace_violations"] = s.get("violations", [])
+    print(json.dumps(out))
+
+
+def run_tpch_query_once(root, qname: str):
+    from benchmarking.tpch import queries as Q
+    get_df = _get_df_factory(root)
+    return getattr(Q, qname)(get_df).to_pydict()
+
+
+def run_warmup_bench():
+    """``--warmup``: cold-process → first-query latency and hot repeat,
+    with and without AOT warm-up + a persisted XLA compilation cache,
+    plus per-query retrace counts (ROADMAP item 1's <5s warm-up gate).
+    Three children: cold baseline; cache-populating AOT run; warm-start
+    run re-reading the persisted cache."""
+    import shutil
+    import tempfile
+
+    def child(extra, budget=420.0):
+        # NOTE: no DAFT_TPU_SANITIZE here — the lock sanitizer's proxy
+        # overhead would skew the latency numbers; _warmup_child arms
+        # the retrace listener directly, which is passive off the
+        # trace path
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--warmup-child"],
+            capture_output=True, text=True, timeout=budget, cwd=REPO,
+            env={**os.environ, "DAFT_TPU_DEVICE": "1", **extra})
+        merged = _merge_lines(proc.stdout or "")
+        if merged is None:
+            raise RuntimeError(
+                f"warmup child rc={proc.returncode}: "
+                f"{(proc.stderr or '')[-500:]}")
+        return merged
+
+    cold = child({})
+    cache_dir = tempfile.mkdtemp(prefix="daft_tpu_aot_cache_")
+    try:
+        aot_env = {"DAFT_TPU_COMPILE_CACHE_DIR": cache_dir,
+                   "DAFT_TPU_AOT_WARMUP": "1", "BENCH_WARMUP_AOT": "1"}
+        populate = child(aot_env)
+        persisted = child(aot_env)
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    out = {"cold": cold, "aot_populate": populate,
+           "aot_persisted": persisted}
+    # the violations gate FIRST and unconditionally: a missing derived
+    # metric below must never silently drop real violations from the
+    # committed artifact
+    out["violations"] = [
+        v for child in (cold, populate, persisted)
+        for v in child.get("retrace_violations", [])]
+    try:
+        cold_first = cold["q1"]["first_s"]
+        warm_first = persisted["q1"]["first_s"]
+        out["q1_cold_first_s"] = cold_first
+        out["q1_aot_persisted_first_s"] = warm_first
+        out["q1_first_query_speedup"] = round(cold_first / warm_first, 3) \
+            if warm_first else None
+        out["hot_zero_retraces"] = all(
+            child[q]["hot_traces"] == 0
+            for child in (cold, populate, persisted)
+            for q in ("q1", "q6", "q3"))
+        out["compile_s_cold_vs_persisted"] = [
+            cold["q1"]["first_compile_s"],
+            persisted["q1"]["first_compile_s"]]
+    except (KeyError, TypeError):
+        pass
+    return out
+
+
 def _merge_lines(text: str):
     merged = {}
     for line in text.strip().splitlines():
@@ -1491,6 +1607,13 @@ def main():
         if r is not None:
             detail["scan_bench"] = r
 
+    if "--warmup" in sys.argv:
+        # shape-discipline bench: cold vs AOT+persisted-cache first-query
+        # latency + per-query retrace counts (hot repeats must be zero)
+        r = section("warmup", run_warmup_bench, min_needed=60.0)
+        if r is not None:
+            detail["warmup_bench"] = r
+
     if "--kernels" in sys.argv:
         # hash-vs-sort kernel sweep: parity over NDV × rows × key widths,
         # dispatch-contract re-proof, roofline ratios on silicon
@@ -1563,7 +1686,7 @@ def main():
 
     results_dir = os.path.join(REPO, "benchmarking", "results")
     os.makedirs(results_dir, exist_ok=True)
-    artifact = os.path.join(results_dir, "r13_bench_driver.json")
+    artifact = os.path.join(results_dir, "r16_bench_driver.json")
     with open(artifact, "w") as f:
         json.dump(full, f, indent=1)
     # progress/bulk lines first (NOT last): full detail for humans reading
@@ -1676,6 +1799,8 @@ def main():
 if __name__ == "__main__":
     if "--device-child" in sys.argv:
         _device_child()
+    elif "--warmup-child" in sys.argv:
+        _warmup_child()
     elif "--serve-smoke" in sys.argv:
         # CI gate: no datagen, no device tier — a few seconds of serving
         # traffic with leak + sanitizer-cycle checks

@@ -12,6 +12,11 @@ from .analysis import knobs as _knobs
 if _knobs.env_bool("DAFT_TPU_SANITIZE"):
     from .analysis import lock_sanitizer as _lock_sanitizer
     _lock_sanitizer.enable()
+    # …and the retrace sanitizer hooks jax's trace/compile events the
+    # same way, so even import-time jit constructions are accounted
+    from .analysis import retrace_sanitizer as _retrace_sanitizer
+    if _retrace_sanitizer.enabled_by_env():
+        _retrace_sanitizer.enable()
 
 from .datatype import DataType, ImageFormat, ImageMode, TimeUnit
 from .expressions import (

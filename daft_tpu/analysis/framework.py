@@ -67,7 +67,7 @@ def known_rules() -> Dict[str, Tuple[str, str]]:
     name a live rule), `--rule` filtering, and the JSON `family`/`hint`
     fields. New rule modules contribute via their ``RULE_IDS`` dict."""
     from . import (rule_attribution, rule_cancellation, rule_donation,
-                   rule_resources)
+                   rule_resources, rule_shapes)
     out: Dict[str, Tuple[str, str]] = {
         # r10 families, single-sourced here (their modules predate the
         # registry); hints stay one line by policy
@@ -118,6 +118,7 @@ def known_rules() -> Dict[str, Tuple[str, str]]:
     out.update(rule_donation.RULE_IDS)
     out.update(rule_cancellation.RULE_IDS)
     out.update(rule_attribution.RULE_IDS)
+    out.update(rule_shapes.RULE_IDS)
     return out
 
 
@@ -264,7 +265,7 @@ def run_analysis(root: Optional[str] = None,
     analyzed, per-family finding counts)."""
     from . import (rule_attribution, rule_cancellation, rule_determinism,
                    rule_donation, rule_jit, rule_knobs, rule_locks,
-                   rule_resources)
+                   rule_resources, rule_shapes)
 
     root = root or repo_root()
     sources = walk_sources(root, subdirs)
@@ -286,6 +287,7 @@ def run_analysis(root: Optional[str] = None,
     findings.extend(rule_donation.check(sources))
     findings.extend(rule_cancellation.check(sources))
     findings.extend(rule_attribution.check(sources))
+    findings.extend(rule_shapes.check(sources))
 
     # pragma suppression (a pragma never suppresses the pragma rules)
     by_path = {sf.path: sf for sf in sources}

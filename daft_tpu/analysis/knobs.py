@@ -92,6 +92,25 @@ _KNOBS: List[Knob] = [
        "compiles across processes)"),
     _k("DAFT_TPU_COMPILE_CACHE", "str", None, "daft_tpu/device/backend.py",
        "device", "legacy alias of `DAFT_TPU_COMPILATION_CACHE`"),
+    _k("DAFT_TPU_COMPILE_CACHE_DIR", "str", None,
+       "daft_tpu/device/backend.py", "device",
+       "explicit persistent XLA compilation-cache directory for ANY "
+       "backend (CPU included — same-machine opt-in, bypassing the "
+       "TPU-only default): AOT warm-up compiles survive process "
+       "restarts"),
+    _k("DAFT_TPU_SIZE_CLASSES", "str", "pow2", "daft_tpu/device/column.py",
+       "device", "size-class ladder batches pad to: `pow2` (default), "
+       "`pow4` (coarser: 4x steps, fewer distinct programs, more "
+       "padding), or an explicit comma list of capacities (e.g. "
+       "`1024,65536,1048576`); above the ladder top, capacities keep "
+       "doubling", config_field="tpu_size_classes"),
+    _k("DAFT_TPU_AOT_WARMUP", "bool", False, "daft_tpu/device/warmup.py",
+       "device", "`1` AOT-compiles (`jit(...).lower().compile()`) the "
+       "device kernel library — and any already-compiled fused "
+       "fragments — over the size-class x strategy grid at serving "
+       "startup, so first queries re-enter warm programs; pairs with "
+       "`DAFT_TPU_COMPILE_CACHE_DIR` to survive restarts",
+       config_field="tpu_aot_warmup"),
     _k("DAFT_TPU_HBM_CACHE_BYTES", "bytes", 8 * 1024 ** 3,
        "daft_tpu/device/cache.py", "device",
        "HBM budget for the resident-column cache (byte suffixes accepted)",
@@ -304,6 +323,13 @@ _KNOBS: List[Knob] = [
        "sanitizer (cycle detection, contention + blocking-while-held "
        "accounting; reported at pytest session end and in "
        "`explain(analyze=True)`)"),
+    _k("DAFT_TPU_SANITIZE_RETRACE", "int", 0,
+       "daft_tpu/analysis/retrace_sanitizer.py", "observability",
+       "with `DAFT_TPU_SANITIZE=1`: arms the runtime retrace sanitizer "
+       "— JAX trace events are charged against each registered dispatch "
+       "site's per-signature budget x this multiplier; budget "
+       "violations fail the pytest session; `0` = off (no listener, "
+       "allocation-free scopes)"),
     _k("DAFT_TPU_TRACE", "bool", False, "daft_tpu/tracing.py",
        "observability", "`1` enables the query-wide tracing plane: one "
        "span tree per query across scheduler/planner/device/pipeline/"

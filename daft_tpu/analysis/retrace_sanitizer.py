@@ -1,0 +1,377 @@
+"""Runtime retrace sanitizer (opt-in: ``DAFT_TPU_SANITIZE=1`` +
+``DAFT_TPU_SANITIZE_RETRACE=<budget>``).
+
+``rule_shapes`` proves statically that row counts reach shapes only
+through the size-class chokepoint and that every jit program is
+memoized; this sanitizer proves the *consequence* at test time: a
+registered dispatch site re-traces only when its declared signature
+changes.  The recompile tax ROADMAP item 1 measures (23.3s hot device q1
+vs 2.2s host; 55s warm-up) is exactly what this turns from a profile
+into a failing test.
+
+Mechanics:
+
+- ``enable()`` registers a ``jax.monitoring`` duration listener; JAX
+  fires ``/jax/core/compile/jaxpr_trace_duration`` once per tracing
+  cache miss (a re-trace) and ``…/backend_compile_duration`` once per
+  XLA compile — the exact events the tax is made of.
+- Dispatch chokepoints wrap their jitted call in
+  ``dispatch_scope(site_id, signature_key)``.  The site must be declared
+  in ``analysis/dispatch_registry.py``; the key spells everything the
+  site's trace cache key is ALLOWED to depend on (capacity class,
+  out-cap bucket, strategy, …).  A trace event inside the scope charges
+  that (site, key); exceeding ``traces_per_key × DAFT_TPU_SANITIZE_RETRACE``
+  is a budget violation: the same signature traced twice means the
+  surrounding code leaked shape instability (a raw row count, a fresh
+  wrapper object, a non-weak-typed literal) into the cache key.
+- Trace events OUTSIDE any scope are attributed to the innermost
+  ``daft_tpu`` stack frame and counted (``unscoped``) but never
+  budget-enforced — tests and benches call kernels directly on purpose.
+- ``tests/conftest.py`` reports at session end and FAILS the session on
+  any budget violation; per-query deltas land in
+  ``explain(analyze=True)`` / ``/metrics`` / the flight recorder via
+  ``observability.RuntimeStatsContext`` (the lock-sanitizer pattern).
+
+Off by default and allocation-free when off: ``dispatch_scope`` returns
+a shared no-op singleton, and ``enable()`` is never called unless both
+knobs arm it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from . import dispatch_registry
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ANALYSIS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+#: jax's monitoring event names (stable since 0.4.x; re-spelled here so
+#: enable() works even if jax._src.dispatch moves the constants)
+TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class RetraceSanitizer:
+    """Per-(site, signature) trace accounting + budget enforcement.
+    One global instance backs the armed session; tests may build their
+    own and drive :meth:`note_event` directly."""
+
+    def __init__(self, budget_multiplier: int = 1):
+        self._meta = threading.Lock()
+        self.budget_multiplier = max(int(budget_multiplier), 1)
+        self._scopes = threading.local()
+        # monotonic counters
+        self.traces = 0               # scoped + unscoped trace events
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.unscoped_traces = 0
+        # per-site / per-key books
+        self._site_traces: Dict[str, int] = {}
+        self._key_traces: Dict[Tuple[str, object], int] = {}
+        self._unscoped_sites: Dict[str, int] = {}
+        self.violations: List[str] = []
+        self._violation_keys: set = set()
+
+    # ---- scopes ------------------------------------------------------
+    def _stack(self) -> List[list]:
+        st = getattr(self._scopes, "stack", None)
+        if st is None:
+            st = []
+            self._scopes.stack = st
+        return st
+
+    def push(self, site_id: str, key: object) -> None:
+        # [site, key, traced?] — one logical dispatch traces ONE program
+        # but fires a trace event per nested jit boundary it traces
+        # through; only the FIRST event in a scope entry charges the
+        # budget (a retrace is a LATER entry tracing again)
+        self._stack().append([site_id, key, False])
+
+    def pop(self) -> None:
+        st = self._stack()
+        if st:
+            st.pop()
+
+    # ---- event intake ------------------------------------------------
+    def note_event(self, event: str, duration: float) -> None:
+        if event == COMPILE_EVENT:
+            with self._meta:
+                self.compiles += 1
+                self.compile_seconds += duration
+            return
+        if event != TRACE_EVENT:
+            return
+        st = self._stack()
+        if st:
+            entry = st[-1]
+            if entry[2]:    # nested trace of the same dispatch
+                with self._meta:
+                    self.traces += 1
+                return
+            entry[2] = True
+            self._charge(entry[0], entry[1])
+        else:
+            site = _engine_frame() or "foreign"
+            with self._meta:
+                self.traces += 1
+                self.unscoped_traces += 1
+                self._unscoped_sites[site] = \
+                    self._unscoped_sites.get(site, 0) + 1
+
+    def _charge(self, site_id: str, key: object) -> None:
+        budget = dispatch_registry.budget_for(site_id)
+        with self._meta:
+            self.traces += 1
+            self._site_traces[site_id] = \
+                self._site_traces.get(site_id, 0) + 1
+            try:
+                kk = (site_id, key)
+                n = self._key_traces.get(kk, 0) + 1
+                self._key_traces[kk] = n
+            except TypeError:   # unhashable key: site-level count only
+                return
+            if budget is None:
+                return          # exempt site (bench / AOT warm-up)
+            if n > budget * self.budget_multiplier \
+                    and kk not in self._violation_keys:
+                self._violation_keys.add(kk)
+                s = dispatch_registry.site(site_id)
+                contract = f" (contract: {s.budget})" if s else ""
+                self.violations.append(
+                    f"{site_id}: {n} traces for one signature "
+                    f"{_fmt_key(key)} — budget is "
+                    f"{budget * self.budget_multiplier} per "
+                    f"signature{contract}")
+
+    # ---- reporting ---------------------------------------------------
+    def summary(self) -> dict:
+        with self._meta:
+            return {
+                "traces": self.traces,
+                "compiles": self.compiles,
+                "compile_seconds": round(self.compile_seconds, 6),
+                "unscoped_traces": self.unscoped_traces,
+                "site_traces": dict(self._site_traces),
+                "unscoped_sites": dict(self._unscoped_sites),
+                "violations": list(self.violations),
+            }
+
+    def report(self) -> str:
+        s = self.summary()
+        lines = [
+            f"retrace sanitizer: {s['traces']} traces, "
+            f"{s['compiles']} XLA compiles "
+            f"({s['compile_seconds']:.2f}s compiling), "
+            f"{s['unscoped_traces']} unscoped",
+        ]
+        for site, n in sorted(s["site_traces"].items()):
+            lines.append(f"  {site}: {n} trace(s)")
+        if s["violations"]:
+            lines.append(f"RETRACE BUDGET VIOLATIONS "
+                         f"({len(s['violations'])}):")
+            lines.extend(f"  {v}" for v in s["violations"])
+        else:
+            lines.append("no retrace-budget violations")
+        return "\n".join(lines)
+
+
+def _fmt_key(key: object, limit: int = 120) -> str:
+    try:
+        s = repr(key)
+    except Exception:
+        s = "<unreprable>"
+    return s if len(s) <= limit else s[:limit] + "…"
+
+
+def _engine_frame() -> Optional[str]:
+    """file:line of the innermost daft_tpu frame (excluding this
+    package's analysis machinery), for unscoped-trace attribution."""
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        af = os.path.abspath(fn)
+        if af.startswith(_PKG_ROOT + os.sep) \
+                and not af.startswith(_ANALYSIS_DIR + os.sep):
+            rel = os.path.relpath(af, os.path.dirname(_PKG_ROOT))
+            return f"unscoped:{rel.replace(os.sep, '/')}:{f.f_lineno}"
+        f = f.f_back
+    return None
+
+
+# ----------------------------------------------------------- global state
+
+_global: Optional[RetraceSanitizer] = None
+_enabled = False
+
+
+class _Scope:
+    """Reusable scope guard; one allocation per dispatch, none when the
+    sanitizer is off (the module hands out ``_NOOP`` instead)."""
+
+    __slots__ = ("_site", "_key")
+
+    def __init__(self, site_id: str, key: object):
+        self._site = site_id
+        self._key = key
+
+    def __enter__(self):
+        san = _global
+        if san is not None:
+            san.push(self._site, self._key)
+        return self
+
+    def __exit__(self, *exc):
+        san = _global
+        if san is not None:
+            san.pop()
+        return False
+
+
+class _NoopScope:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopScope()
+
+
+def dispatch_scope(site_id: str, key: object):
+    """Enter around a jitted dispatch: trace events inside are charged
+    to ``(site_id, key)``.  The shared no-op singleton when disarmed —
+    zero allocation on the hot path."""
+    if not _enabled:
+        return _NOOP
+    return _Scope(site_id, key)
+
+
+def scoped_callable(site_id: str, key: object, fn):
+    """Wrap an ESCAPING jitted callable (one handed back to callers,
+    like the memoized mesh-exchange programs) so every call runs under
+    its dispatch scope.  The per-call signature extends ``key`` with the
+    argument shapes/dtypes — one program legitimately traces once per
+    input shape class, and only a repeat of the SAME shapes is a
+    retrace.  The wrapper checks the armed flag per call: programs
+    built before ``enable()`` still get charged after it."""
+
+    def call(*args, **kwargs):
+        if not _enabled:
+            return fn(*args, **kwargs)
+        shapes = tuple(
+            (getattr(a, "shape", None), str(getattr(a, "dtype", "")))
+            for a in args)
+        with _Scope(site_id, (key, shapes)):
+            return fn(*args, **kwargs)
+
+    call.__wrapped__ = fn
+    return call
+
+
+def _listener(event: str, duration: float, **kwargs) -> None:
+    san = _global
+    if san is not None:
+        san.note_event(event, duration)
+
+
+def enabled_by_env() -> bool:
+    from . import knobs
+    return bool(knobs.env_bool("DAFT_TPU_SANITIZE")) \
+        and (knobs.env_int("DAFT_TPU_SANITIZE_RETRACE") or 0) > 0
+
+
+def budget_multiplier_from_env() -> int:
+    from . import knobs
+    return max(knobs.env_int("DAFT_TPU_SANITIZE_RETRACE") or 1, 1)
+
+
+def enable(multiplier: Optional[int] = None) -> None:
+    """Install the jax.monitoring listener + arm the global sanitizer.
+    Idempotent; call as early as possible (``daft_tpu/__init__`` arms it
+    next to the lock sanitizer so even import-time jits are seen)."""
+    global _global, _enabled
+    if _enabled:
+        return
+    import jax.monitoring as monitoring
+    # daft-lint: allow(unguarded-global-mutation) -- single-threaded
+    # bootstrap: enable() runs in conftest/__init__ before engine threads
+    _global = RetraceSanitizer(
+        multiplier if multiplier is not None
+        else budget_multiplier_from_env())
+    monitoring.register_event_duration_secs_listener(_listener)
+    # daft-lint: allow(unguarded-global-mutation) -- same bootstrap; the
+    # flag flips only after the listener + sanitizer are fully installed
+    _enabled = True
+
+
+def disable() -> None:
+    """Disarm and best-effort unregister the listener (jax only exposes
+    clear-all, so we surgically drop ours from the private list; if that
+    ever breaks, the listener no-ops on a None global anyway)."""
+    global _global, _enabled
+    if not _enabled:
+        return
+    # daft-lint: allow(unguarded-global-mutation) -- mirror of enable():
+    # teardown runs on the single main thread at session/test end
+    _enabled = False
+    # daft-lint: allow(unguarded-global-mutation) -- same teardown; the
+    # listener no-ops on a None global either way
+    _global = None
+    try:
+        from jax._src import monitoring as _m
+        _m._event_duration_secs_listeners = [
+            cb for cb in _m.get_event_duration_listeners()
+            if cb is not _listener]
+    except Exception:
+        pass
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def sanitizer() -> Optional[RetraceSanitizer]:
+    return _global
+
+
+def summary() -> dict:
+    return _global.summary() if _global is not None else {}
+
+
+def report() -> str:
+    return _global.report() if _global is not None \
+        else "retrace sanitizer: disabled"
+
+
+# -------------------------------------------- observability integration
+
+def counters_snapshot() -> Dict[str, float]:
+    """Monotonic counters for per-query deltas (observability pattern:
+    snapshot at query start, diff at finish)."""
+    san = _global
+    if not _enabled or san is None:
+        return {}
+    s = san.summary()
+    return {"traces": s["traces"],
+            "compiles": s["compiles"],
+            "compile_seconds": s["compile_seconds"],
+            "unscoped_traces": s["unscoped_traces"],
+            "violations": len(s["violations"])}
+
+
+def counters_delta(before: Dict[str, float],
+                   after: Dict[str, float]) -> Dict[str, float]:
+    out = {k: round(after.get(k, 0) - before.get(k, 0), 6)
+           for k in after}
+    # total violations is a level, not a delta — report the absolute too
+    san = _global
+    if _enabled and san is not None:
+        out["total_violations"] = len(san.summary()["violations"])
+    return out

@@ -1,0 +1,447 @@
+"""Rule family — shape stability & retrace discipline (round 16).
+
+The device tier loses to the host on hot TPC-H not because the kernels
+are slow but because the *dispatch surroundings* re-trace and re-compile
+(ROADMAP item 1: 23.3s device vs 2.2s host on hot q1, 55s warm-up).
+This family makes "this dispatch is shape-stable" a proven invariant:
+
+- ``dispatch-site-unregistered`` / ``dispatch-site-stale`` — every
+  ``jax.jit`` / ``pallas_call`` construction site in the engine tree is
+  declared ONCE in ``analysis/dispatch_registry.py`` with its trace
+  signature and retrace budget; the AST scan proves the registry neither
+  under- nor over-claims.
+- ``shape-unbucketed`` — raw row-count-derived values (``len(batch)``,
+  ``.num_rows``, ``.row_count``) must reach argument shapes and
+  shape-like static args (``out_cap=``, ``capacity=``, array-constructor
+  shapes) only through the sanctioned ``column.bucket_capacity``
+  size-class chokepoint.  An un-bucketed row count in a shape is a fresh
+  XLA program per literal row count — the recompile tax in one line.
+- ``jit-not-memoized`` — a ``jax.jit(...)`` constructed inside a
+  function body without a memo store (module-level cache dict, object
+  attribute, or a declared-``global`` rebind) is a fresh Python callable
+  per call, which can never hit jax's trace cache.  The sanctioned shape
+  is ``fragment.py``'s ``_stack_cache`` pattern; the historical first
+  hit was ``parallel/exchange.py`` returning a fresh ``jax.jit(mapped)``
+  per mesh exchange.
+
+The runtime twin of this family is ``analysis/retrace_sanitizer.py``,
+which charges real JAX trace events against the same registry's budgets.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import dispatch_registry
+from .framework import Finding, SourceFile, call_name, dotted_name
+
+RULE_IDS: Dict[str, Tuple[str, str]] = {
+    "dispatch-site-unregistered": (
+        "shapes", "declare the jit/pallas construction site in "
+                  "analysis/dispatch_registry.py"),
+    "dispatch-site-stale": (
+        "shapes", "drop (or repoint) the registry entry — no jit/pallas "
+                  "construction there anymore"),
+    "shape-unbucketed": (
+        "shapes", "route the row count through column.bucket_capacity "
+                  "(the size-class chokepoint) before it becomes a "
+                  "shape"),
+    "jit-not-memoized": (
+        "shapes", "memoize the jitted program in a module-level cache "
+                  "(the fragment._stack_cache pattern) keyed on its "
+                  "static signature"),
+}
+
+#: modules whose shapes feed device programs — the taint rule's scope
+_SHAPE_SCOPE_PREFIXES = ("daft_tpu/device/", "daft_tpu/parallel/")
+_SHAPE_SCOPE_FILES = ("daft_tpu/joins.py", "daft_tpu/functions/image.py",
+                      "daft_tpu/window_exec.py")
+
+#: the sanctioned size-class chokepoints: a value that passed through one
+#: of these is by construction a canonical bucket, not a raw row count
+SANCTIONED_CALLS = ("bucket_capacity", "size_classes", "table_capacity",
+                    "join_table_capacity")
+
+#: shape-like keyword sinks at dispatch/kernel calls
+SHAPE_KWARGS = {"out_cap", "out_capacity", "capacity",
+                "out_capacity_per_shard", "table_cap"}
+
+#: DEVICE array constructors whose first positional argument is a shape
+#: — host-side numpy allocations are free to be row-sized (they never
+#: become an XLA program shape; the encode path pads them)
+_ARRAY_CTORS = {"jnp.zeros", "jnp.full", "jnp.empty", "jnp.ones",
+                "jnp.arange", "jax.numpy.zeros", "jax.numpy.full",
+                "jax.numpy.empty", "jax.numpy.ones", "jax.numpy.arange"}
+
+#: row-count attribute seeds
+_ROWCOUNT_ATTRS = {"num_rows", "row_count"}
+
+
+# ------------------------------------------------------------ site scan
+
+def _is_jit_ctor(node: ast.Call) -> bool:
+    """``jax.jit(...)`` or ``partial(jax.jit, ...)`` construction."""
+    name = call_name(node)
+    if name in ("jax.jit", "jit"):
+        return True
+    if name.endswith("partial") and node.args \
+            and dotted_name(node.args[0]) in ("jax.jit", "jit"):
+        return True
+    return False
+
+
+def _is_pallas_ctor(node: ast.Call) -> bool:
+    return call_name(node).endswith("pallas_call")
+
+
+class _SiteCollector(ast.NodeVisitor):
+    """(enclosing function name | MODULE_LEVEL, lineno, kind) for every
+    jit/pallas construction in a module."""
+
+    def __init__(self):
+        self.sites: List[Tuple[str, int, str]] = []
+        self._stack: List[str] = []
+
+    def _enclosing(self) -> str:
+        return self._stack[-1] if self._stack \
+            else dispatch_registry.MODULE_LEVEL
+
+    def visit_FunctionDef(self, node):
+        # a decorator executes in the scope DECLARING the function —
+        # record it (and any jit/pallas call inside it) before pushing
+        for dec in node.decorator_list:
+            if dotted_name(dec) in ("jax.jit", "jit"):
+                self.sites.append((self._enclosing(), node.lineno, "jit"))
+            else:
+                self.visit(dec)
+        self._stack.append(node.name)
+        for child in node.body:
+            self.visit(child)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        if _is_jit_ctor(node):
+            self.sites.append((self._enclosing(), node.lineno, "jit"))
+        elif _is_pallas_ctor(node):
+            self.sites.append((self._enclosing(), node.lineno, "pallas"))
+        self.generic_visit(node)
+
+
+def _collect_sites(sf: SourceFile) -> List[Tuple[str, int, str]]:
+    c = _SiteCollector()
+    c.visit(sf.tree)
+    # a partial(jax.jit, …)(impl) wrap reports the inner partial call
+    # too; dedupe per (func, line)
+    seen, out = set(), []
+    for fn, ln, kind in c.sites:
+        if (fn, ln) not in seen:
+            seen.add((fn, ln))
+            out.append((fn, ln, kind))
+    return out
+
+
+def check_registry(sources: List[SourceFile]) -> List[Finding]:
+    out: List[Finding] = []
+    scanned: Dict[str, List[Tuple[str, int, str]]] = {}
+    for sf in sources:
+        if not sf.path.startswith("daft_tpu/") \
+                or sf.path.startswith("daft_tpu/analysis/"):
+            continue
+        sites = _collect_sites(sf)
+        scanned[sf.path] = sites
+        allowed = dispatch_registry.MODULE_FUNCS.get(sf.path, set())
+        for fn, ln, kind in sites:
+            if fn not in allowed:
+                out.append(Finding(
+                    "dispatch-site-unregistered", sf.path, ln,
+                    f"{kind} program constructed in {fn}() but "
+                    f"({sf.path}, {fn}) is not declared in "
+                    f"analysis/dispatch_registry.py — every dispatch "
+                    f"site needs a trace-signature contract"))
+    # reverse direction: registry entries must resolve to real sites
+    for site in dispatch_registry.SITES:
+        if site.module not in scanned:
+            continue  # partial-tree scan: can't judge staleness
+        found = {fn for fn, _ln, _k in scanned[site.module]}
+        for fn in site.funcs:
+            if fn not in found:
+                out.append(Finding(
+                    "dispatch-site-stale", site.module, 1,
+                    f"registry site {site.id!r} claims a jit/pallas "
+                    f"construction in {fn}() but none exists — stale "
+                    f"contract"))
+    return out
+
+
+# --------------------------------------------------------- jit memo rule
+
+def _iter_scoped_functions(tree: ast.Module):
+    """Every (FunctionDef, its own direct AST nodes) — nested defs are
+    yielded separately and EXCLUDED from the parent's node set, so a
+    memo decision is judged against the function that actually runs
+    per call."""
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in funcs:
+        own: List[ast.AST] = []
+        stack: List[ast.AST] = list(fn.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # the nested def's decorators execute in THIS scope
+                own.extend(n.decorator_list)
+                continue
+            own.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        yield fn, own
+
+
+def _memo_stored(fn: ast.AST, own_nodes: List[ast.AST],
+                 jit_call: ast.Call) -> bool:
+    """True when the jit result (directly, via its assigned name, or via
+    an object constructed from it) is stored into a cache: a Subscript
+    or Attribute target, or a declared-``global`` name."""
+    # the statement whose value expression contains the jit call
+    stmt = None
+    for n in own_nodes:
+        if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            if any(x is jit_call for x in ast.walk(n)):
+                stmt = n
+                break
+    if stmt is None:
+        return False
+    targets = stmt.targets if isinstance(stmt, ast.Assign) \
+        else [stmt.target]
+    for tgt in targets:
+        if isinstance(tgt, (ast.Subscript, ast.Attribute)):
+            return True
+    globals_: Set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Global):
+            globals_.update(n.names)
+    tainted: Set[str] = set()
+    for tgt in targets:
+        for n in ast.walk(tgt):
+            if isinstance(n, ast.Name):
+                tainted.add(n.id)
+    if tainted & globals_:
+        return True
+    # follow the name through later statements: a store into a
+    # Subscript/Attribute (or a re-assignment that keeps the taint)
+    for _ in range(4):
+        grew = False
+        for n in own_nodes:
+            if not isinstance(n, ast.Assign):
+                continue
+            names = {x.id for x in ast.walk(n.value)
+                     if isinstance(x, ast.Name)}
+            if not names & tainted:
+                continue
+            for tgt in n.targets:
+                if isinstance(tgt, (ast.Subscript, ast.Attribute)):
+                    return True
+                for x in ast.walk(tgt):
+                    if isinstance(x, ast.Name) and x.id not in tainted:
+                        tainted.add(x.id)
+                        grew = True
+            if tainted & globals_:
+                return True
+        if not grew:
+            break
+    return False
+
+
+def check_jit_memo(sources: List[SourceFile]) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in sources:
+        if not sf.path.startswith("daft_tpu/") \
+                or sf.path.startswith("daft_tpu/analysis/"):
+            continue
+        for fn, own in _iter_scoped_functions(sf.tree):
+            owner = dispatch_registry.memo_owner(sf.path, fn.name)
+            if owner in ("caller", "exempt"):
+                # the registry declares who holds this program's memo
+                # (caller-owned cache) or that re-jitting is the point
+                # (bench/warm-up harnesses timing compiles)
+                continue
+            for n in own:
+                if isinstance(n, ast.Call) and _is_jit_ctor(n):
+                    if not _memo_stored(fn, own, n):
+                        out.append(Finding(
+                            "jit-not-memoized", sf.path, n.lineno,
+                            f"jax.jit(...) constructed inside "
+                            f"{fn.name}() without a memo store — a "
+                            f"fresh callable per call can never hit "
+                            f"jax's trace cache (every call re-traces)"))
+    return out
+
+
+# ------------------------------------------------------ shape taint rule
+
+def _contains_sanctioned(expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            name = call_name(n)
+            if name.split(".")[-1] in SANCTIONED_CALLS:
+                return True
+    return False
+
+
+def _is_seed(expr: ast.AST) -> bool:
+    """A raw row-count expression: ``len(...)`` or ``.num_rows`` /
+    ``.row_count`` attribute reads."""
+    if isinstance(expr, ast.Call) and call_name(expr) == "len":
+        return True
+    if isinstance(expr, ast.Attribute) and expr.attr in _ROWCOUNT_ATTRS:
+        return True
+    return False
+
+
+#: calls a row count flows THROUGH unchanged; every other call's result
+#: is a fresh value the taint does not survive (a kernel returning group
+#: blocks from a tainted plane is not itself a raw row count)
+_PASSTHROUGH_CALLS = {"min", "max", "int", "round", "abs", "float", "len"}
+
+
+def _taint_signal(expr: ast.AST, tainted: Set[str]) -> bool:
+    """True when ``expr`` evaluates to a raw row count: it contains a
+    seed or a tainted name OUTSIDE non-passthrough call arguments, and
+    no sanctioned size-class chokepoint on the way."""
+    if _contains_sanctioned(expr):
+        return False
+    stack = [expr]
+    while stack:
+        n = stack.pop()
+        if _is_seed(n):
+            return True
+        if isinstance(n, ast.Name) and n.id in tainted:
+            return True
+        if isinstance(n, ast.Call):
+            # len(x) seeds via _is_seed above; min/max/etc. pass the
+            # count through; other calls LAUNDER the taint (their result
+            # is not a row count even when their arguments were)
+            if call_name(n).split(".")[-1] in _PASSTHROUGH_CALLS:
+                stack.extend(n.args)
+                stack.extend(kw.value for kw in n.keywords)
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _direct_nodes(fn: ast.AST):
+    """(own AST nodes, nested function defs) — a nested def is its own
+    scope; judging its sinks against the parent's taint conflates two
+    bindings of the same name (the exchange closures rebind ``fk``)."""
+    own: List[ast.AST] = []
+    nested: List[ast.AST] = []
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            nested.append(n)
+            continue
+        own.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return own, nested
+
+
+def _local_bindings(fn: ast.AST, own: List[ast.AST]) -> Set[str]:
+    bound: Set[str] = set()
+    a = fn.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+        bound.add(arg.arg)
+    if a.vararg:
+        bound.add(a.vararg.arg)
+    if a.kwarg:
+        bound.add(a.kwarg.arg)
+    for n in own:
+        if isinstance(n, ast.Assign):
+            for tgt in n.targets:
+                for x in ast.walk(tgt):
+                    if isinstance(x, ast.Name):
+                        bound.add(x.id)
+        elif isinstance(n, (ast.For, ast.comprehension)):
+            for x in ast.walk(n.target):
+                if isinstance(x, ast.Name):
+                    bound.add(x.id)
+    return bound
+
+
+def _tainted_names(fn: ast.AST, own: List[ast.AST],
+                   inherited: Set[str]) -> Set[str]:
+    """Names carrying a raw (un-bucketed) row count in THIS scope, by
+    fixpoint over its direct assignments.  Starts from the closure's
+    taint minus locally re-bound names; an assignment whose value passes
+    through a sanctioned size-class chokepoint stays clean."""
+    tainted = set(inherited) - _local_bindings(fn, own)
+    for _ in range(6):
+        grew = False
+        for n in own:
+            if not isinstance(n, ast.Assign):
+                continue
+            if _taint_signal(n.value, tainted):
+                for tgt in n.targets:
+                    for x in ast.walk(tgt):
+                        if isinstance(x, ast.Name) \
+                                and x.id not in tainted:
+                            tainted.add(x.id)
+                            grew = True
+        if not grew:
+            break
+    return tainted
+
+
+def _expr_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    return _taint_signal(expr, tainted)
+
+
+def check_shape_taint(sources: List[SourceFile]) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in sources:
+        in_scope = sf.path in _SHAPE_SCOPE_FILES or any(
+            sf.path.startswith(p) for p in _SHAPE_SCOPE_PREFIXES)
+        if not in_scope:
+            continue
+        top = [n for n in sf.tree.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        top.extend(m for c in sf.tree.body if isinstance(c, ast.ClassDef)
+                   for m in c.body
+                   if isinstance(m, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)))
+        stack = [(fn, set()) for fn in top]
+        while stack:
+            fn, inherited = stack.pop()
+            own, nested = _direct_nodes(fn)
+            tainted = _tainted_names(fn, own, inherited)
+            for n in own:
+                if not isinstance(n, ast.Call):
+                    continue
+                for kw in n.keywords:
+                    if kw.arg in SHAPE_KWARGS \
+                            and _expr_tainted(kw.value, tainted):
+                        out.append(Finding(
+                            "shape-unbucketed", sf.path, n.lineno,
+                            f"raw row-count-derived value reaches "
+                            f"{kw.arg}= at {call_name(n) or 'a call'} — "
+                            f"a fresh XLA program per literal row "
+                            f"count; bucket it first"))
+                if call_name(n) in _ARRAY_CTORS and n.args \
+                        and _expr_tainted(n.args[0], tainted):
+                    out.append(Finding(
+                        "shape-unbucketed", sf.path, n.lineno,
+                        f"raw row-count-derived shape at "
+                        f"{call_name(n)}() — pad to a size-class "
+                        f"bucket so literal row counts share one "
+                        f"program"))
+            stack.extend((nf, tainted) for nf in nested)
+    return out
+
+
+def check(sources: List[SourceFile]) -> List[Finding]:
+    out = check_registry(sources)
+    out.extend(check_jit_memo(sources))
+    out.extend(check_shape_taint(sources))
+    return out

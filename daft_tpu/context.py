@@ -62,6 +62,11 @@ class ExecutionConfig:
     device_min_rows: int = 0
     device_enabled: bool = True
     target_partition_size_bytes: int = 512 * 1024 * 1024
+    # shape discipline (round 16): the size-class ladder batches pad to
+    # (DAFT_TPU_SIZE_CLASSES) and the AOT warm-up toggle
+    # (DAFT_TPU_AOT_WARMUP) — env spellings match the documented knobs
+    tpu_size_classes: str = "pow2"
+    tpu_aot_warmup: bool = False
     # scan fast path (io/read_planner.py). Field names are chosen so the
     # DAFT_<NAME> env override spells the documented knob names
     # (DAFT_TPU_IO_COALESCE_GAP, DAFT_TPU_SCAN_PREFETCH, …); byte values

@@ -55,7 +55,35 @@ def _probe_body() -> None:
         from ..analysis import knobs
         cache = knobs.env_str("DAFT_TPU_COMPILATION_CACHE") \
             or knobs.env_str("DAFT_TPU_COMPILE_CACHE") or ""
-        if cache != "0" and _backend == "tpu":
+        # DAFT_TPU_COMPILE_CACHE_DIR is the round-16 explicit opt-in:
+        # a persistent cache on ANY backend (CPU included), for AOT
+        # warm-up artifacts that must survive process restarts on the
+        # SAME machine.  The TPU-only default below stays: CPU AOT
+        # artifacts are machine-feature-pinned and unsafe to share.
+        explicit = knobs.env_str("DAFT_TPU_COMPILE_CACHE_DIR")
+        if explicit:
+            try:
+                os.makedirs(explicit, exist_ok=True)
+            except OSError as exc:
+                # an EXPLICIT opt-in pointing at an unwritable path is
+                # misconfiguration, not version skew — say so instead of
+                # silently recompiling from scratch on every replica
+                import sys
+                print(f"daft-tpu: DAFT_TPU_COMPILE_CACHE_DIR="
+                      f"{explicit!r} is unusable ({exc}); persistent "
+                      f"compile cache DISABLED", file=sys.stderr)
+            else:
+                try:
+                    jax.config.update("jax_compilation_cache_dir",
+                                      explicit)
+                    jax.config.update(
+                        "jax_persistent_cache_min_compile_time_secs",
+                        0.0)
+                    jax.config.update(
+                        "jax_persistent_cache_min_entry_size_bytes", -1)
+                except Exception:
+                    pass  # older jax without the knobs: in-memory only
+        elif cache != "0" and _backend == "tpu":
             path = cache or os.path.join(
                 os.path.expanduser("~"), ".cache", "daft_tpu_xla")
             try:

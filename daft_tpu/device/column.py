@@ -33,18 +33,128 @@ jax.config.update("jax_enable_x64", True)
 
 _MIN_CAPACITY = 16
 
+# parsed DAFT_TPU_SIZE_CLASSES memo: (raw spec value, (step, explicit
+# ladder)) — the knob is read per bucket_capacity call (morsel-rate), so
+# the parse is cached against the raw string
+_ladder_memo: "tuple" = (None, (2, None))
+# context handle memo: get_context() takes the process-wide context
+# lock on EVERY call — cache the singleton so the env-unset default
+# path stays lock-free at morsel rate (the execution_config attr read
+# itself is a GIL-atomic load of whatever config is current)
+_ctx_memo = None
+
+
+def _config_spec() -> str:
+    global _ctx_memo
+    if _ctx_memo is None:
+        try:
+            from ..context import get_context
+            # daft-lint: allow(unguarded-global-mutation) -- benign
+            # last-wins memo of the process context singleton
+            _ctx_memo = get_context()
+        except Exception:
+            return "pow2"
+    try:
+        return _ctx_memo.execution_config.tpu_size_classes or "pow2"
+    except Exception:
+        return "pow2"
+
+
+def _ladder() -> "tuple":
+    """(geometric step, explicit capacities|None) from the
+    ``DAFT_TPU_SIZE_CLASSES`` ladder spec: ``pow2`` (default) /
+    ``pow4`` / an explicit comma list.  The env var is the per-process
+    override; unset, the per-query ``ExecutionConfig.tpu_size_classes``
+    field applies (the registry's config_field contract)."""
+    global _ladder_memo
+    from ..analysis import knobs
+    raw = knobs.env_raw("DAFT_TPU_SIZE_CLASSES") or _config_spec()
+    memo_raw, memo_val = _ladder_memo
+    if raw == memo_raw:
+        return memo_val
+    if raw == "pow2":
+        val = (2, None)
+    elif raw == "pow4":
+        val = (4, None)
+    else:
+        try:
+            caps = sorted({max(int(x), _MIN_CAPACITY)
+                           for x in raw.split(",") if x.strip()})
+        except ValueError:
+            raise ValueError(
+                f"DAFT_TPU_SIZE_CLASSES={raw!r}: expected 'pow2', "
+                f"'pow4', or a comma list of integer capacities")
+        val = (2, tuple(caps) or None)
+    # daft-lint: allow(unguarded-global-mutation) -- benign last-wins
+    # memo of a pure parse; a racing duplicate computes the same value
+    _ladder_memo = (raw, val)
+    return val
+
 
 def bucket_capacity(n: int) -> int:
-    """Pad row counts to power-of-two buckets to bound jit recompiles."""
+    """Pad row counts to canonical size-class buckets so literal-
+    different row counts re-enter already-jitted programs instead of
+    re-tracing.  THE sanctioned chokepoint between row counts and
+    shapes: ``rule_shapes`` statically flags any raw count reaching a
+    device shape without passing through here.  The ladder is
+    power-of-two by default (``DAFT_TPU_SIZE_CLASSES``)."""
+    step, explicit = _ladder()
+    if explicit is not None:
+        for c in explicit:
+            if c >= n:
+                return c
+        c = explicit[-1]
+        while c < n:   # above the ladder top: keep doubling
+            c <<= 1
+        return c
     c = _MIN_CAPACITY
     while c < n:
-        c <<= 1
+        c *= step
     return c
+
+
+def size_classes(max_capacity: int, min_capacity: int = _MIN_CAPACITY
+                 ) -> "List[int]":
+    """The ladder's capacities in ``[min_capacity, max_capacity]`` — the
+    AOT warm-up grid (device/warmup.py) compiles each of these once so
+    cold queries land on warm programs."""
+    out = []
+    c = bucket_capacity(min_capacity)
+    while c <= max_capacity:
+        out.append(c)
+        nxt = bucket_capacity(c + 1)
+        if nxt <= c:
+            break
+        c = nxt
+    return out
 
 
 def _backend() -> str:
     from . import backend
     return backend.backend_name() or "cpu"
+
+
+def device_np_dtype(dt: DataType) -> np.dtype:
+    """The numpy dtype a column of this logical type encodes to on
+    device (mirrors ``_np_encode``'s physical lowering) — the AOT
+    warm-up grid builds abstract ``ShapeDtypeStruct`` inputs from it.
+    Raises ``ValueError`` for non-device-representable types."""
+    if dt.is_null() or dt.is_string() or dt.is_binary():
+        return np.dtype("int32")      # dict codes / zero payload plane
+    if dt.kind == "date":
+        return np.dtype("int32")
+    if dt.is_boolean():
+        return np.dtype("bool")
+    if dt.is_temporal():
+        return np.dtype("int64")
+    rep = np.float64 if dt.is_decimal() \
+        else dt.to_physical().device_repr()
+    if rep is None:
+        raise ValueError(f"{dt!r} is not device-representable")
+    d = np.dtype(rep)
+    if d == np.float64 and not supports_f64():
+        d = np.dtype("float32")
+    return d
 
 
 def supports_f64() -> bool:

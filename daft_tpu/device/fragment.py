@@ -88,6 +88,9 @@ class FusedAggProgram:
         #: the hash kernel raised (key set packs wider than the table key
         #: budget at trace time) — every later dispatch stays on sort
         self.hash_unfit = False
+        #: column → device numpy dtype (set by get_fused_agg; None when
+        #: an input is not device-representable) — the AOT warm-up grid
+        self.in_np_dtypes = None
 
     def donate_fn(self):
         """The donating twin executable (round 12 megakernel discipline):
@@ -180,8 +183,23 @@ def get_fused_agg(group_exprs: List[Expression], child_exprs: List[Expression],
     prog = FusedAggProgram(
         jax.jit(run_packed, static_argnames=("out_cap", "strategy")),
         run_packed, c, nk, ops, has_pred, meta)
+    try:
+        # device input dtypes per needed column — the AOT warm-up grid
+        # (device/warmup.py) rebuilds abstract inputs from this
+        prog.in_np_dtypes = {
+            n: dcol.device_np_dtype(schema[n].dtype)
+            for n in c.needs_cols}
+    except (ValueError, KeyError):
+        prog.in_np_dtypes = None
     _fused_cache[key] = prog
     return prog
+
+
+def fused_programs() -> List[FusedAggProgram]:
+    """Every fused-agg program compiled so far (the 'fragment library'
+    the AOT warm-up iterates)."""
+    return [p for p in _fused_cache.values()
+            if isinstance(p, FusedAggProgram)]
 
 
 def run_fused_agg(prog: FusedAggProgram, batch, group_exprs, agg_exprs,
@@ -203,12 +221,21 @@ def run_fused_agg(prog: FusedAggProgram, batch, group_exprs, agg_exprs,
 def _dispatch_packed(prog: FusedAggProgram, dt: dcol.DeviceTable,
                      out_cap: int, strategy: str = "sort",
                      donate: bool = False):
+    from ..analysis import retrace_sanitizer
     arrays = {n: col.data for n, col in dt.columns.items()}
     valids = {n: col.validity for n, col in dt.columns.items()}
     scalars = runtime._prep_scalars(prog.compiled, dt)
     fn = prog.donate_fn() if donate else prog.packed_fn
-    return fn(arrays, valids, dt.row_mask, scalars, out_cap=out_cap,
-              strategy=strategy)
+    # the declared trace signature (dispatch_registry: fragment.packed /
+    # fragment.donate) — everything the jit cache key may depend on; a
+    # second trace for the SAME key is the retrace tax and a sanitizer
+    # budget violation
+    with retrace_sanitizer.dispatch_scope(
+            "fragment.donate" if donate else "fragment.packed",
+            (id(prog), dt.capacity, out_cap, strategy,
+             tuple(s.shape for s in scalars))):
+        return fn(arrays, valids, dt.row_mask, scalars, out_cap=out_cap,
+                  strategy=strategy)
 
 
 def _donation_ok(dt: dcol.DeviceTable) -> bool:
@@ -436,12 +463,17 @@ _stack_cache: Dict[int, object] = {}
 
 
 def _stack(packs):
+    from ..analysis import retrace_sanitizer
     n = len(packs)
     fn = _stack_cache.get(n)
     if fn is None:
         fn = jax.jit(lambda *xs: jnp.stack(xs))
         _stack_cache[n] = fn
-    return fn(*packs)
+    # one trace per pack count (+ the packed matrix shapes the jit cache
+    # also keys on — out_cap buckets, so bounded)
+    with retrace_sanitizer.dispatch_scope(
+            "fragment.stack", (n, tuple(p.shape for p in packs))):
+        return fn(*packs)
 
 
 def run_fused_agg_tables(prog: FusedAggProgram, tables, in_schema: Schema,

@@ -199,8 +199,15 @@ def decode_group_key(e: Expression, field, kv, km, dt: dcol.DeviceTable,
 
 def _run_compiled(c: compiler.Compiled, batch, exprs: List[Expression]):
     """Encode inputs, run the fused program, return per-expr device outputs."""
+    from ..analysis import retrace_sanitizer
     dt, arrays, valids, scalars = encode_for(c, batch)
-    outs = c.fn(arrays, valids, dt.row_mask, scalars)
+    # declared trace signature (dispatch_registry: compiler.projection):
+    # one trace per compiled projection x capacity class x scalar-plane
+    # shapes — never per raw row count
+    with retrace_sanitizer.dispatch_scope(
+            "compiler.projection",
+            (id(c), dt.capacity, tuple(s.shape for s in scalars))):
+        outs = c.fn(arrays, valids, dt.row_mask, scalars)
     return dt, outs
 
 
@@ -300,12 +307,17 @@ def try_argsort(key_series: List[Series], descending: List[bool],
     mask[:n] = True
     import time as _time
 
+    from ..analysis import retrace_sanitizer
     from . import mfu
     t0 = _time.perf_counter()
-    perm = kernels.argsort_kernel(
-        tuple(c.data for c in cols), tuple(c.validity for c in cols),
-        jnp.asarray(mask), tuple(bool(d) for d in descending),
-        tuple(bool(x) for x in nulls_first))
+    desc = tuple(bool(d) for d in descending)
+    nf = tuple(bool(x) for x in nulls_first)
+    with retrace_sanitizer.dispatch_scope(
+            "kernels.argsort",
+            (tuple(str(c.data.dtype) for c in cols), cap, desc, nf)):
+        perm = kernels.argsort_kernel(
+            tuple(c.data for c in cols), tuple(c.validity for c in cols),
+            jnp.asarray(mask), desc, nf)
     out = np.asarray(jax.device_get(perm))[:n].astype(np.int64)
     costmodel.ledger_record(
         "argsort", rows=n,
@@ -395,11 +407,16 @@ def try_agg(batch, to_agg: List[Expression], group_by: List[Expression]):
             m = jnp.broadcast_to(m, dt.row_mask.shape)
         return v, m
 
+    from ..analysis import retrace_sanitizer
     if nk == 0:
         vals, valids = zip(*[bcast(v, m) for v, m in val_outs]) if val_outs \
             else ((), ())
-        results = kernels.global_agg_kernel(tuple(vals), tuple(valids),
-                                            dt.row_mask, ops)
+        with retrace_sanitizer.dispatch_scope(
+                "kernels.grouped_agg",
+                ("global", ops, tuple(str(v.dtype) for v in vals),
+                 dt.capacity)):
+            results = kernels.global_agg_kernel(tuple(vals), tuple(valids),
+                                                dt.row_mask, ops)
         cols = []
         for (op, child, name, params), f, (rv, rm) in zip(specs, out_fields, results):
             v = np.asarray(jax.device_get(rv)).reshape(1)
@@ -416,20 +433,28 @@ def try_agg(batch, to_agg: List[Expression], group_by: List[Expression]):
     karg = (tuple(v for v, _ in keys_b), tuple(m for _, m in keys_b),
             tuple(v for v, _ in vals_b), tuple(m for _, m in vals_b),
             dt.row_mask, ops)
+    kdtypes = tuple(str(v.dtype) for v, _ in keys_b)
+    vdtypes = tuple(str(v.dtype) for v, _ in vals_b)
     if strategy == "hash":
         try:
             # [capacity]-wide group budget: groups ≤ live rows ≤ capacity,
             # so the hash path can never overflow here
-            out_keys, out_kvalids, out_vals, out_valids, gcount = \
-                pk.hash_grouped_agg_kernel(*karg, out_cap=dt.capacity)
+            with retrace_sanitizer.dispatch_scope(
+                    "pallas.hash_agg",
+                    (ops, kdtypes, vdtypes, dt.capacity)):
+                out_keys, out_kvalids, out_vals, out_valids, gcount = \
+                    pk.hash_grouped_agg_kernel(*karg, out_cap=dt.capacity)
         except pk.HashKeyWidthError:
             # key set packs wider than the table key budget (the pre-ask
             # estimated from declared dtypes; the kernel's own trace is
             # the exact check) — run the any-width sort path instead
             strategy, load_factor = "sort", 0.0
     if strategy == "sort":
-        out_keys, out_kvalids, out_vals, out_valids, gcount = \
-            kernels.grouped_agg_kernel(*karg)
+        with retrace_sanitizer.dispatch_scope(
+                "kernels.grouped_agg",
+                (ops, kdtypes, vdtypes, dt.capacity)):
+            out_keys, out_kvalids, out_vals, out_valids, gcount = \
+                kernels.grouped_agg_kernel(*karg)
     # the decision that actually dispatched (post width-gate fallback)
     costmodel.log_strategy_decision("groupby_strategy", strategy,
                                     rows=len(batch), out_cap=cap,

@@ -97,8 +97,13 @@ def _device_batch_resize(imgs, w: int, h: int):
         lo, hi = float(info.min), float(info.max)
     else:
         lo = hi = None  # float images: no clamp, match PIL/NumPy behavior
-    out = _get_resize_jit()(jnp.asarray(stack), h, w, lo, hi,
-                            jnp.dtype(dtype))
+    from ..analysis import retrace_sanitizer
+    # declared trace signature (dispatch_registry: image.resize): the
+    # batch shape + static resize spec — the jit cache key, spelled out
+    with retrace_sanitizer.dispatch_scope(
+            "image.resize", (stack.shape, str(dtype), h, w, lo, hi)):
+        out = _get_resize_jit()(jnp.asarray(stack), h, w, lo, hi,
+                                jnp.dtype(dtype))
     res = np.asarray(jax.device_get(out))
     if len(shape) == 2:
         res = res[..., 0]

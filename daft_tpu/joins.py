@@ -209,12 +209,19 @@ def _device_match_indices(l_gids, r_gids, l_valid, r_valid):
         # device arrays are rebuilt per dispatch: both kernels DONATE the
         # build side's buffers on real chips, so an overflow re-dispatch
         # cannot reuse them
-        return np.asarray(jax.device_get(kernel(
-            jnp.asarray(pad(l_gids.astype(np.int64), c_l)),
-            jnp.asarray(pad(l_valid, c_l)), jnp.asarray(lmask),
-            jnp.asarray(pad(r_gids.astype(np.int64), c_r)),
-            jnp.asarray(pad(r_valid, c_r)), jnp.asarray(rmask),
-            out_capacity=cap)))
+        from .analysis import retrace_sanitizer
+        site = "pallas.hash_join" if kernel is pk.hash_join_kernel \
+            else "kernels.join_fused"
+        # declared trace signature: build/probe capacity classes + the
+        # out-capacity bucket; the same signature must re-enter the jit
+        # cache, never re-trace
+        with retrace_sanitizer.dispatch_scope(site, (c_l, c_r, cap)):
+            return np.asarray(jax.device_get(kernel(
+                jnp.asarray(pad(l_gids.astype(np.int64), c_l)),
+                jnp.asarray(pad(l_valid, c_l)), jnp.asarray(lmask),
+                jnp.asarray(pad(r_gids.astype(np.int64), c_r)),
+                jnp.asarray(pad(r_valid, c_r)), jnp.asarray(rmask),
+                out_capacity=cap)))
 
     t0 = _time.perf_counter()
     cap = max(bucket_capacity(max(n_l, n_r, 1)), 1024)

@@ -162,6 +162,17 @@ def _sanitizer_raw() -> Dict[str, float]:
         return {}
 
 
+def _retrace_raw() -> Dict[str, float]:
+    """Raw snapshot of the retrace-sanitizer counters (trace events, XLA
+    compiles + seconds, budget violations) — empty unless the retrace
+    sanitizer is armed; never raises, like the device ledger."""
+    try:
+        from .analysis import retrace_sanitizer
+        return retrace_sanitizer.counters_snapshot()
+    except Exception:
+        return {}
+
+
 def device_kernel_ledger() -> Dict[str, dict]:
     """Process-wide per-dispatch achieved-bytes/flops ledger with derived
     roofline/MFU percentages (``costmodel.ledger_record`` feeds it at
@@ -275,6 +286,10 @@ class RuntimeStatsContext:
         # per-query acquisition/contention deltas + current graph size
         self._sanitizer0 = _sanitizer_raw()
         self.sanitizer: Dict[str, float] = {}
+        # …and the retrace sanitizer (DAFT_TPU_SANITIZE_RETRACE): this
+        # query's trace/recompile events — the per-query recompile tax
+        self._retrace0 = _retrace_raw()
+        self.retrace: Dict[str, float] = {}
         # context-local plane tallies (shuffle/io/recovery): counter
         # chokepoints bump these through the thread attribution installed
         # by the executors; finish() prefers them over the process diffs
@@ -371,6 +386,12 @@ class RuntimeStatsContext:
                 self._sanitizer0, _sanitizer_raw())
         except Exception:
             self.sanitizer = {}
+        try:
+            from .analysis import retrace_sanitizer
+            self.retrace = retrace_sanitizer.counters_delta(
+                self._retrace0, _retrace_raw())
+        except Exception:
+            self.retrace = {}
         self._emit_trace_spans()
 
     def _emit_trace_spans(self) -> None:
@@ -459,6 +480,7 @@ class RuntimeStatsContext:
         lines.extend(render_shuffle_block(self.shuffle))
         lines.extend(render_io_block(self.io))
         lines.extend(render_sanitizer_block(self.sanitizer))
+        lines.extend(render_retrace_block(self.retrace))
         lines.extend(render_serving_block(self.serving))
         if self.trace_summary:
             t = self.trace_summary
@@ -599,6 +621,28 @@ def render_sanitizer_block(s: Dict[str, float]) -> List[str]:
                  f"acquisitions, {int(s.get('contended', 0))} contended, "
                  f"{int(s.get('blocking_while_held', 0))} "
                  f"blocking-while-held")
+    return lines
+
+
+def render_retrace_block(s: Dict[str, float]) -> List[str]:
+    """Human lines for one query's retrace-sanitizer delta (shared by
+    ``explain(analyze=True)`` and the dashboard; empty unless the
+    retrace sanitizer is armed): trace events + XLA compiles this query
+    paid — a hot query's line should read all zeros."""
+    if not s:
+        return []
+    viol = int(s.get("violations", 0))
+    lines = ["shape discipline (retrace sanitizer):"]
+    lines.append(
+        f"  this query: {int(s.get('traces', 0))} trace events, "
+        f"{int(s.get('compiles', 0))} XLA compiles "
+        f"({float(s.get('compile_seconds', 0.0)):.3f}s compiling), "
+        f"{int(s.get('unscoped_traces', 0))} unscoped")
+    lines.append(
+        f"  budget violations: {viol} this query, "
+        f"{int(s.get('total_violations', 0))} total"
+        + (" (RETRACE TAX — see retrace_sanitizer.report())"
+           if viol else ""))
     return lines
 
 
@@ -784,7 +828,7 @@ def flight_entry(ctx: RuntimeStatsContext) -> dict:
         "operators": ctx.as_dict(),
     }
     for block in ("recovery", "shuffle", "io", "device_kernels",
-                  "serving", "sanitizer"):
+                  "serving", "sanitizer", "retrace"):
         v = getattr(ctx, block, None)
         if v:
             entry[block] = dict(v)

@@ -26,15 +26,65 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..device import kernels
 
 
+#: (fn code + closure values, mesh, specs) → jitted collective program.
+#: The callers below build their mapped fns as per-call closures, so the
+#: function OBJECT differs every call while the program it traces to is
+#: identical — keying on the code object plus the closure's cell values
+#: (the shard count, op tuple, plane counts the closure captured) makes
+#: repeated mesh exchanges re-enter jax's trace cache instead of paying
+#: a fresh trace + compile per exchange (the round-16 retrace tax:
+#: ~70 s eager vs milliseconds compiled was already fixed in r6; this
+#: removes the remaining per-call re-trace of the SAME collective).
+_program_cache: dict = {}
+_program_counters = {"hits": 0, "misses": 0, "uncacheable": 0}
+
+
+def exchange_cache_counters() -> dict:
+    """Collective-program cache counters (the regression test's evidence
+    that two same-shape exchanges share one trace)."""
+    out = dict(_program_counters)
+    out["entries"] = len(_program_cache)
+    return out
+
+
+def _program_key(f, mesh, in_specs, out_specs, check_vma):
+    """Hashable identity of the collective program, or None when a
+    closure cell holds something unhashable (those fall back to a fresh
+    jit, exactly the old behavior)."""
+    try:
+        cells = tuple(c.cell_contents for c in (f.__closure__ or ()))
+        # defaults are the THIRD identity channel besides code + cells:
+        # two fns differing only in a default-argument value must not
+        # share one compiled program
+        defaults = (f.__defaults__ or (),
+                    tuple(sorted((f.__kwdefaults__ or {}).items())))
+        key = (f.__code__, cells, defaults, mesh, tuple(in_specs),
+               tuple(out_specs), check_vma)
+        return hash(key), key
+    except (TypeError, ValueError):
+        return None
+
+
 def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
     """``jax.shard_map`` across jax versions: new jax exports it top-level
     with ``check_vma``; older releases ship ``jax.experimental.shard_map``
     whose equivalent knob is ``check_rep``.
 
-    The program is returned JITTED: un-jitted shard_map executes eagerly
-    (per-op dispatch over every mesh shard — measured ~70 s for one tiny
-    mesh-exchanged Q1 on the 8-device CPU mesh, vs milliseconds compiled),
-    and every caller here wants the compiled collective anyway."""
+    The program is returned JITTED and MEMOIZED on (fn identity, mesh,
+    in/out specs): un-jitted shard_map executes eagerly (per-op dispatch
+    over every mesh shard — measured ~70 s for one tiny mesh-exchanged
+    Q1 on the 8-device CPU mesh, vs milliseconds compiled), and a fresh
+    ``jax.jit`` wrapper per call could never hit jax's trace cache, so
+    every exchange re-traced the same collective."""
+    keyed = _program_key(f, mesh, in_specs, out_specs, check_vma)
+    if keyed is not None:
+        hit = _program_cache.get(keyed[1])
+        if hit is not None:
+            _program_counters["hits"] += 1  # GIL-atomic; approx. on race
+            return hit
+        _program_counters["misses"] += 1
+    else:
+        _program_counters["uncacheable"] += 1
     try:
         from jax import shard_map as sm
         mapped = sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
@@ -46,7 +96,18 @@ def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
         from jax.experimental.shard_map import shard_map as sm
         mapped = sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                     check_rep=check_vma)
-    return jax.jit(mapped)
+    from ..analysis import retrace_sanitizer
+    program = jax.jit(mapped)
+    # uncacheable programs (unhashable closure cell) each get a UNIQUE
+    # scope key: they legitimately trace once apiece, and sharing one
+    # key would spuriously trip the per-signature retrace budget
+    scope_key = keyed[1] if keyed is not None \
+        else ("uncacheable", id(program))
+    jitted = retrace_sanitizer.scoped_callable(
+        "exchange.shard_map", scope_key, program)
+    if keyed is not None:
+        _program_cache[keyed[1]] = jitted
+    return jitted
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:

@@ -307,6 +307,24 @@ class QueryScheduler:
                              name="daft-tpu-serve-sweep", daemon=True)
         t.start()
         self._threads.append(t)
+        # AOT warm-up (DAFT_TPU_AOT_WARMUP=1): compile the device
+        # program library over the size-class grid BEFORE traffic
+        # arrives, so first queries re-enter warm programs; with
+        # DAFT_TPU_COMPILE_CACHE_DIR the executables persist across
+        # restarts and amortize across replicas.  Never raises; the
+        # stats land in the counters for the serve bench to report.
+        try:
+            from ..device import warmup as _warmup
+            w = _warmup.maybe_warmup_session()
+            if w:
+                self._count("aot_warmup_programs",
+                            sum(d.get("programs", 0)
+                                for d in w.values()
+                                if isinstance(d, dict)))
+                self._count("aot_warmup_seconds",
+                            float(w.get("seconds", 0.0)))
+        except Exception:
+            pass
 
     # ------------------------------------------------------------ counters
     def _count(self, name: str, n: float = 1) -> None:

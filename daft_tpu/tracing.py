@@ -639,6 +639,12 @@ def prometheus_text() -> str:
     except Exception:
         pass
     try:
+        from .analysis import retrace_sanitizer
+        plane("retrace", retrace_sanitizer.counters_snapshot(),
+              "retrace sanitizer counter")
+    except Exception:
+        pass
+    try:
         from .device import costmodel
         for kind, d in sorted(costmodel.ledger_snapshot(raw=True).items()):
             emit(_prom_name("kernel", f"{kind}_dispatches") + "_total",

@@ -50,6 +50,7 @@ import pytest
 import daft_tpu
 from daft_tpu import DataType, col
 from daft_tpu.analysis import lock_sanitizer as _lock_sanitizer
+from daft_tpu.analysis import retrace_sanitizer as _retrace_sanitizer
 
 
 @pytest.fixture(params=[False, True], ids=["host", "device"])
@@ -83,7 +84,16 @@ def pytest_collection_modifyitems(config, items):
 def pytest_sessionfinish(session, exitstatus):
     """DAFT_TPU_SANITIZE=1: print the lock-order sanitizer report at
     session end and FAIL the session on any acquisition-order cycle (a
-    potential deadlock two threads haven't hit yet)."""
+    potential deadlock two threads haven't hit yet).  With
+    DAFT_TPU_SANITIZE_RETRACE also armed, print the retrace-sanitizer
+    report and FAIL on any retrace-budget violation (a dispatch site
+    that traced twice for one declared signature — the recompile tax)."""
+    if _retrace_sanitizer.is_enabled():
+        print("\n" + _retrace_sanitizer.report())
+        if _retrace_sanitizer.summary().get("violations"):
+            print("daft-lint retrace sanitizer: retrace-budget "
+                  "violations detected — failing the session")
+            session.exitstatus = 1
     if not _lock_sanitizer.is_enabled():
         return
     print("\n" + _lock_sanitizer.report())

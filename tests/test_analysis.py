@@ -1242,3 +1242,198 @@ def test_nested_def_in_loop_body_does_not_credit(tmp_path):
         "        cbs.append(cb)\n"
         "    return cbs\n")
     assert "uncancellable-loop" in _rules_of(rule_cancellation.check(srcs))
+
+
+# --------------------------------------- rule family: shapes (round 16)
+
+from daft_tpu.analysis import dispatch_registry, rule_shapes
+
+
+def test_unregistered_jit_site_flagged(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/newmod.py",
+        "import jax\n"
+        "def f(x):\n"
+        "    return x\n"
+        "g = jax.jit(f)\n")
+    assert "dispatch-site-unregistered" in _rules_of(
+        rule_shapes.check_registry(srcs))
+
+
+def test_unregistered_pallas_site_flagged(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/newmod.py",
+        "from jax.experimental import pallas as pl\n"
+        "def build(kernel, C, B):\n"
+        "    return pl.pallas_call(kernel, grid=(C // B,))\n")
+    assert "dispatch-site-unregistered" in _rules_of(
+        rule_shapes.check_registry(srcs))
+
+
+def test_registered_site_is_clean(tmp_path):
+    # same (module, function) coordinates as a live registry entry
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/fragment.py",
+        "import jax\n"
+        "_fused_cache = {}\n"
+        "def get_fused_agg(key, run):\n"
+        "    prog = jax.jit(run)\n"
+        "    _fused_cache[key] = prog\n"
+        "    return prog\n"
+        "def donate_fn(self):\n"
+        "    self._d = jax.jit(self.run)\n"
+        "    return self._d\n"
+        "def _stack(packs):\n"
+        "    fn = jax.jit(len)\n"
+        "    _fused_cache[len(packs)] = fn\n"
+        "    return fn\n")
+    assert "dispatch-site-unregistered" not in _rules_of(
+        rule_shapes.check_registry(srcs))
+
+
+def test_stale_registry_entry_flagged(tmp_path):
+    # a scanned module the registry claims sites in, with none present
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/functions/image.py",
+        "def _get_resize_jit():\n"
+        "    return None\n")
+    assert "dispatch-site-stale" in _rules_of(
+        rule_shapes.check_registry(srcs))
+
+
+def test_jit_not_memoized_flagged(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/newmod.py",
+        "import jax\n"
+        "def dispatch(f, x):\n"
+        "    return jax.jit(f)(x)\n")
+    assert "jit-not-memoized" in _rules_of(
+        rule_shapes.check_jit_memo(srcs))
+
+
+def test_jit_memo_store_patterns_are_clean(tmp_path):
+    # the sanctioned _stack_cache shapes: dict store (direct and via a
+    # wrapping constructor), attribute store, declared-global store
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/newmod.py",
+        "import jax\n"
+        "_cache = {}\n"
+        "_memo = None\n"
+        "def a(key, f):\n"
+        "    fn = jax.jit(f)\n"
+        "    _cache[key] = fn\n"
+        "    return fn\n"
+        "def b(key, f):\n"
+        "    prog = Wrapper(jax.jit(f), f)\n"
+        "    _cache[key] = prog\n"
+        "    return prog\n"
+        "def c(self, f):\n"
+        "    self._fn = jax.jit(f)\n"
+        "    return self._fn\n"
+        "def d(f):\n"
+        "    global _memo\n"
+        "    _memo = jax.jit(f)\n"
+        "    return _memo\n")
+    assert "jit-not-memoized" not in _rules_of(
+        rule_shapes.check_jit_memo(srcs))
+
+
+def test_jit_memo_pragma_suppresses(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/newmod.py",
+        "import jax\n"
+        "def compile_it(f):\n"
+        "    " + PRAGMA + "allow(jit-not-memoized) -- caller memoizes\n"
+        "    return jax.jit(f)\n")
+    from daft_tpu.analysis.framework import run_analysis
+    findings = run_analysis(str(tmp_path), subdirs=("daft_tpu",),
+                            contracts=False, readme=False, baseline=[])
+    assert "jit-not-memoized" not in [f.rule for f in findings]
+
+
+def test_shape_unbucketed_rowcount_flagged(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/newmod.py",
+        "import jax.numpy as jnp\n"
+        "def encode(batch, kernel):\n"
+        "    n = len(batch)\n"
+        "    mask = jnp.zeros(n)\n"
+        "    return kernel(mask, out_cap=n)\n")
+    rules = _rules_of(rule_shapes.check_shape_taint(srcs))
+    assert rules.count("shape-unbucketed") == 2
+
+
+def test_shape_bucketed_chokepoint_is_clean(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/newmod.py",
+        "import jax.numpy as jnp\n"
+        "from .column import bucket_capacity\n"
+        "def encode(batch, kernel):\n"
+        "    cap = bucket_capacity(len(batch))\n"
+        "    mask = jnp.zeros(cap)\n"
+        "    return kernel(mask, out_cap=min(cap, 1024))\n")
+    assert "shape-unbucketed" not in _rules_of(
+        rule_shapes.check_shape_taint(srcs))
+
+
+def test_shape_taint_does_not_cross_kernel_calls(tmp_path):
+    # a kernel RESULT computed from a tainted plane is not itself a raw
+    # row count (the exchange closures' fk/ok blocks)
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/newmod.py",
+        "import jax.numpy as jnp\n"
+        "def run(keys, kernel):\n"
+        "    nk = len(keys)\n"
+        "    ok = kernel(keys, nk)\n"
+        "    return jnp.arange(ok[0].shape[0])\n")
+    assert "shape-unbucketed" not in _rules_of(
+        rule_shapes.check_shape_taint(srcs))
+
+
+def test_shape_taint_scopes_nested_defs_separately(tmp_path):
+    # the outer fn taints `fk`; the closure REBINDS fk from a kernel
+    # result — the inner binding must not inherit the outer taint
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/newmod.py",
+        "import jax.numpy as jnp\n"
+        "def outer(keys, flat, kernel):\n"
+        "    nk = len(keys)\n"
+        "    fk = flat[:nk]\n"
+        "    def run(args):\n"
+        "        fk = kernel(args)\n"
+        "        return jnp.arange(fk[0].shape[0])\n"
+        "    return run, fk\n")
+    assert "shape-unbucketed" not in _rules_of(
+        rule_shapes.check_shape_taint(srcs))
+
+
+def test_shape_unbucketed_pragma_suppresses(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/device/newmod.py",
+        "import jax.numpy as jnp\n"
+        "def encode(batch):\n"
+        "    " + PRAGMA + "allow(shape-unbucketed) -- one-shot debug\n"
+        "    return jnp.zeros(len(batch))\n")
+    from daft_tpu.analysis.framework import run_analysis
+    findings = run_analysis(str(tmp_path), subdirs=("daft_tpu",),
+                            contracts=False, readme=False, baseline=[])
+    assert "shape-unbucketed" not in [f.rule for f in findings]
+
+
+def test_dispatch_registry_matches_tree():
+    """The registry neither under- nor over-claims on the REAL tree:
+    zero unregistered construction sites, zero stale entries."""
+    srcs = walk_sources(REPO, ("daft_tpu",))
+    assert _rules_of(rule_shapes.check_registry(srcs)) == []
+
+
+def test_registry_budgets_resolve():
+    for site in dispatch_registry.SITES:
+        b = dispatch_registry.budget_for(site.id)
+        assert (b is None) == site.exempt
+        assert site.signature and site.budget
+    assert dispatch_registry.budget_for("nope") is None
+    assert dispatch_registry.memo_owner(
+        "daft_tpu/device/compiler.py", "compile_projection") == "caller"
+    assert dispatch_registry.memo_owner(
+        "daft_tpu/device/mfu.py", "measure_join") == "exempt"

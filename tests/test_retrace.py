@@ -1,0 +1,354 @@
+"""Shape discipline at runtime: the retrace sanitizer, the size-class
+ladder, exchange program memoization, and AOT warm-up (round 16).
+
+The contract under test: a registered dispatch site re-traces only when
+its declared signature changes — two literal-different row counts in one
+size class share ONE fragment trace (a structure hit, not a retrace),
+and a same-shape mesh exchange re-enters the memoized collective program
+with zero new trace events.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.analysis import dispatch_registry
+from daft_tpu.analysis import retrace_sanitizer as rs
+
+
+# --------------------------------------------------------- unit: budgets
+
+def _traced_dispatch(san, site, key):
+    """Simulate one dispatch that traces once (the first TRACE event in
+    a scope charges; nested events don't)."""
+    san.push(site, key)
+    san.note_event(rs.TRACE_EVENT, 0.01)
+    san.note_event(rs.TRACE_EVENT, 0.001)   # nested jit boundary
+    san.pop()
+
+
+def test_budget_violation_detected_and_attributed():
+    san = rs.RetraceSanitizer(budget_multiplier=1)
+    key = ("prog", 128, "sort")
+    _traced_dispatch(san, "fragment.packed", key)
+    assert san.summary()["violations"] == []
+    # the SAME signature tracing again is the retrace tax
+    _traced_dispatch(san, "fragment.packed", key)
+    v = san.summary()["violations"]
+    assert len(v) == 1
+    # attribution names the dispatch site AND its declared contract
+    assert "fragment.packed" in v[0]
+    assert dispatch_registry.site("fragment.packed").budget in v[0]
+    # a third trace doesn't duplicate the violation entry
+    _traced_dispatch(san, "fragment.packed", key)
+    assert len(san.summary()["violations"]) == 1
+
+
+def test_distinct_signatures_do_not_violate():
+    san = rs.RetraceSanitizer(budget_multiplier=1)
+    for cap in (128, 256, 512):
+        _traced_dispatch(san, "fragment.packed", ("prog", cap, "sort"))
+    assert san.summary()["violations"] == []
+    assert san.summary()["site_traces"]["fragment.packed"] == 3
+
+
+def test_budget_multiplier_relaxes():
+    san = rs.RetraceSanitizer(budget_multiplier=2)
+    key = ("prog", 128, "sort")
+    _traced_dispatch(san, "fragment.packed", key)
+    _traced_dispatch(san, "fragment.packed", key)
+    assert san.summary()["violations"] == []
+    _traced_dispatch(san, "fragment.packed", key)
+    assert len(san.summary()["violations"]) == 1
+
+
+def test_exempt_site_never_violates():
+    san = rs.RetraceSanitizer()
+    for _ in range(5):
+        _traced_dispatch(san, "warmup.aot", ("kernels", 128))
+    assert san.summary()["violations"] == []
+
+
+def test_nested_trace_events_charge_once():
+    san = rs.RetraceSanitizer()
+    san.push("fragment.packed", ("p", 1))
+    for _ in range(20):       # one dispatch tracing through 20 inner jits
+        san.note_event(rs.TRACE_EVENT, 0.001)
+    san.pop()
+    s = san.summary()
+    assert s["site_traces"]["fragment.packed"] == 1
+    assert s["traces"] == 20
+    assert s["violations"] == []
+
+
+def test_unscoped_traces_counted_not_enforced():
+    san = rs.RetraceSanitizer()
+    for _ in range(3):
+        san.note_event(rs.TRACE_EVENT, 0.001)
+    s = san.summary()
+    assert s["unscoped_traces"] == 3
+    assert s["violations"] == []
+
+
+def test_compile_events_accumulate_seconds():
+    san = rs.RetraceSanitizer()
+    san.note_event(rs.COMPILE_EVENT, 1.5)
+    san.note_event(rs.COMPILE_EVENT, 0.5)
+    s = san.summary()
+    assert s["compiles"] == 2
+    assert s["compile_seconds"] == pytest.approx(2.0)
+    assert "2 XLA compiles" in san.report()
+
+
+def test_off_by_default_is_allocation_free():
+    if rs.is_enabled():
+        pytest.skip("retrace sanitizer armed for this session")
+    # the disarmed scope is one shared singleton — no per-dispatch
+    # allocation on the hot path
+    a = rs.dispatch_scope("fragment.packed", ("k", 1))
+    b = rs.dispatch_scope("kernels.argsort", ("k", 2))
+    assert a is b is rs._NOOP
+    assert rs.counters_snapshot() == {}
+    assert rs.summary() == {}
+
+
+# ------------------------------------------------- enable/disable global
+
+def _armed(multiplier=1):
+    """Arm the GLOBAL sanitizer for one test, restoring prior state."""
+    class _Ctx:
+        def __enter__(self):
+            self.was = rs.is_enabled()
+            if not self.was:
+                rs.enable(multiplier)
+            return rs.sanitizer()
+
+        def __exit__(self, *exc):
+            if not self.was:
+                rs.disable()
+            return False
+    return _Ctx()
+
+
+def test_enable_hooks_real_jax_traces():
+    import jax
+    import jax.numpy as jnp
+    # deltas, not absolutes: under a session-armed sanitizer the global
+    # books already carry every earlier test's dispatches
+    with _armed() as san:
+        t0 = san.summary()["traces"]
+        s0 = san.summary()["site_traces"].get("fragment.stack", 0)
+        v0 = len(san.summary()["violations"])
+        fn = jax.jit(lambda x: x + 1)
+        with rs.dispatch_scope("fragment.stack", ("t", 16)):
+            fn(jnp.zeros(16))
+        mid = san.summary()
+        assert mid["traces"] > t0
+        assert mid["site_traces"].get("fragment.stack", 0) == s0 + 1
+        # same shapes again: jit cache hit, NO new trace events
+        with rs.dispatch_scope("fragment.stack", ("t", 16)):
+            fn(jnp.zeros(16))
+        assert san.summary()["site_traces"]["fragment.stack"] == s0 + 1
+        assert len(san.summary()["violations"]) == v0
+
+
+def test_scoped_callable_charges_after_enable():
+    import jax
+    import jax.numpy as jnp
+    # programs built while DISARMED still get charged once armed
+    wrapped = rs.scoped_callable("exchange.shard_map", ("k",),
+                                 jax.jit(lambda x: x * 2))
+    with _armed() as san:
+        before = san.summary()["site_traces"].get("exchange.shard_map", 0)
+        wrapped(jnp.ones(8))
+        assert san.summary()["site_traces"].get(
+            "exchange.shard_map", 0) == before + 1
+
+
+# ------------------------------------------- exchange memo (satellite 1)
+
+def test_exchange_same_shape_reuses_one_trace():
+    """Regression for parallel/exchange.py:49: two same-shape mesh
+    exchanges must share ONE trace — the memoized collective program
+    re-enters jax's cache instead of re-tracing per call."""
+    from daft_tpu.parallel import exchange, mesh as M
+    m = M.get_mesh()
+    if m is None:
+        pytest.skip("no device mesh")
+    n = m.shape["data"]
+    keys = (np.arange(n * 128, dtype=np.int64) % 7)
+    vals = np.ones(n * 128)
+    mask = np.ones(n * 128, bool)
+    ks = exchange.shard_blocks(m, keys)
+    vs = exchange.shard_blocks(m, vals)
+    ms = exchange.shard_blocks(m, mask)
+    with _armed() as san:
+        exchange.sharded_grouped_sum(m, ks, vs, ms)
+        t1 = san.summary()["traces"]
+        c1 = dict(exchange.exchange_cache_counters())
+        exchange.sharded_grouped_sum(m, ks, vs, ms)
+        t2 = san.summary()["traces"]
+        c2 = exchange.exchange_cache_counters()
+    assert t2 == t1, "second same-shape exchange re-traced"
+    assert c2["hits"] >= c1["hits"] + 1
+
+
+def test_exchange_cache_key_covers_closure_params():
+    """Different closure captures (op tuples, plane counts) must NOT
+    collide in the program cache."""
+    from daft_tpu.parallel import exchange
+
+    def mk(npl):
+        def f(x):
+            return x * npl
+        return f
+
+    k1 = exchange._program_key(mk(1), None, ("a",), ("b",), False)
+    k2 = exchange._program_key(mk(2), None, ("a",), ("b",), False)
+    assert k1 is not None and k2 is not None
+    assert k1[1] != k2[1]
+    # same code + same captures: equal keys
+    k3 = exchange._program_key(mk(1), None, ("a",), ("b",), False)
+    assert k1[1] == k3[1]
+
+
+# -------------------------------- e2e: one trace per size class (sat. 3)
+
+def test_two_row_counts_one_size_class_one_fragment_trace(monkeypatch):
+    """Literal-different row counts (100 vs 120) bucket to ONE capacity
+    class (128) and must produce ONE fragment trace — the repeat is a
+    structure hit on the already-jitted program, not a retrace."""
+    monkeypatch.setenv("DAFT_TPU_DEVICE_FORCE", "1")
+
+    def q(n):
+        data = {"sd_k": [j % 4 for j in range(n)],
+                "sd_v": [float(j) for j in range(n)]}
+        df = daft_tpu.from_pydict(data)
+        return df.groupby("sd_k").agg(col("sd_v").sum()).to_pydict()
+
+    with _armed() as san:
+        out1 = q(100)
+        frag1 = san.summary()["site_traces"].get("fragment.packed", 0)
+        out2 = q(120)
+        s = san.summary()
+        frag2 = s["site_traces"].get("fragment.packed", 0)
+    assert sorted(out1["sd_k"]) == [0, 1, 2, 3]
+    assert len(out2["sd_k"]) == 4
+    assert frag1 >= 1, "first query should dispatch the fused fragment"
+    assert frag2 == frag1, \
+        "literal-different row count in the same size class re-traced"
+    assert s["violations"] == []
+
+
+# ------------------------------------------- size-class ladder + warm-up
+
+def test_bucket_capacity_ladders(monkeypatch):
+    from daft_tpu.device import column as dcol
+    assert dcol.bucket_capacity(100) == 128
+    assert dcol.bucket_capacity(128) == 128
+    monkeypatch.setenv("DAFT_TPU_SIZE_CLASSES", "pow4")
+    assert dcol.bucket_capacity(100) == 256      # 16, 64, 256 …
+    monkeypatch.setenv("DAFT_TPU_SIZE_CLASSES", "1024,8192")
+    assert dcol.bucket_capacity(100) == 1024
+    assert dcol.bucket_capacity(5000) == 8192
+    # above the ladder top: keep doubling (never crash, never truncate)
+    assert dcol.bucket_capacity(10000) == 16384
+    monkeypatch.setenv("DAFT_TPU_SIZE_CLASSES", "pow2")
+    assert dcol.bucket_capacity(100) == 128
+
+
+def test_size_classes_grid(monkeypatch):
+    from daft_tpu.device import column as dcol
+    monkeypatch.setenv("DAFT_TPU_SIZE_CLASSES", "pow2")
+    assert dcol.size_classes(256, 16) == [16, 32, 64, 128, 256]
+    monkeypatch.setenv("DAFT_TPU_SIZE_CLASSES", "pow4")
+    assert dcol.size_classes(256, 16) == [16, 64, 256]
+
+
+def test_warmup_kernels_compiles_grid():
+    from daft_tpu.device import warmup
+    st = warmup.warmup_kernels([256])
+    assert st["errors"] == 0
+    assert st["programs"] >= 3
+
+
+def test_warmup_fragments_and_session(monkeypatch):
+    from daft_tpu.device import fragment, warmup
+    monkeypatch.setenv("DAFT_TPU_DEVICE_FORCE", "1")
+    # populate the fragment library with one program
+    data = {"wu_k": [j % 3 for j in range(50)],
+            "wu_v": [float(j) for j in range(50)]}
+    daft_tpu.from_pydict(data).groupby("wu_k") \
+        .agg(col("wu_v").sum()).to_pydict()
+    assert fragment.fused_programs()
+    st = warmup.warmup_fragments([128, 256])
+    assert st["programs"] >= 2
+    assert st["errors"] == 0
+    # knob-gated session entry: off → None, on → stats
+    monkeypatch.delenv("DAFT_TPU_AOT_WARMUP", raising=False)
+    assert warmup.maybe_warmup_session() is None
+    monkeypatch.setenv("DAFT_TPU_AOT_WARMUP", "1")
+    out = warmup.maybe_warmup_session()
+    assert out is not None and out["size_classes"]
+
+
+def test_observability_renders_retrace_block():
+    from daft_tpu.observability import render_retrace_block
+    assert render_retrace_block({}) == []
+    lines = render_retrace_block(
+        {"traces": 3, "compiles": 2, "compile_seconds": 1.25,
+         "unscoped_traces": 1, "violations": 1, "total_violations": 4})
+    text = "\n".join(lines)
+    assert "shape discipline (retrace sanitizer):" in lines[0]
+    assert "3 trace events" in text and "2 XLA compiles" in text
+    assert "RETRACE TAX" in text
+
+
+def test_flight_entry_carries_retrace_block():
+    from daft_tpu import observability as obs
+    ctx = obs.RuntimeStatsContext()
+    ctx.finish()
+    ctx.retrace = {"traces": 1.0, "compiles": 1.0}
+    entry = obs.flight_entry(ctx)
+    assert entry["retrace"] == {"traces": 1.0, "compiles": 1.0}
+
+
+def test_config_fields_mirror_without_env(monkeypatch):
+    """The registry documents tpu_size_classes / tpu_aot_warmup as
+    ExecutionConfig mirrors: with the env var unset, the per-query
+    config field must actually apply (review finding, pinned)."""
+    import daft_tpu.context as ctx
+    from daft_tpu.device import column as dcol, warmup
+    monkeypatch.delenv("DAFT_TPU_SIZE_CLASSES", raising=False)
+    monkeypatch.delenv("DAFT_TPU_AOT_WARMUP", raising=False)
+    base = ctx.get_context().execution_config
+    monkeypatch.setattr(
+        ctx.get_context(), "execution_config",
+        dataclasses.replace(base, tpu_size_classes="pow4",
+                            tpu_aot_warmup=True))
+    assert dcol.bucket_capacity(100) == 256
+    assert warmup.warmup_enabled() is True
+    # env var (when set) overrides the config field
+    monkeypatch.setenv("DAFT_TPU_SIZE_CLASSES", "pow2")
+    monkeypatch.setenv("DAFT_TPU_AOT_WARMUP", "0")
+    assert dcol.bucket_capacity(100) == 128
+    assert warmup.warmup_enabled() is False
+
+
+def test_exchange_cache_key_covers_defaults():
+    """Two mapped fns differing only in a DEFAULT-argument value must
+    not collide in the program cache (review finding, pinned)."""
+    from daft_tpu.parallel import exchange
+
+    def mk(s):
+        def f(x, scale=s):
+            return x * scale
+        return f
+
+    k1 = exchange._program_key(mk(1), None, ("a",), ("b",), False)
+    k2 = exchange._program_key(mk(2), None, ("a",), ("b",), False)
+    assert k1 is not None and k2 is not None
+    assert k1[1] != k2[1]
