@@ -1473,6 +1473,143 @@ def run_warmup_bench():
     return out
 
 
+def _device_pipeline_child():
+    """``--device-pipeline-child``: one process running device-forced
+    q1/q6 at a given ``DAFT_TPU_DEVICE_INFLIGHT``, optionally with a
+    simulated transfer-bound link: ``BENCH_PIPE_LINK_MS`` sleeps at the
+    engine's real upload/download chokepoints (``column.encode_batch``,
+    ``pipeline.fetch_host``) — the scan bench's latency-injected object
+    store, applied to the device link, so a CPU dev box exercises the
+    overlap a tunneled chip would see.  Reports hot walls, answers
+    (parity evidence), the pipeline overlap ledger row, and residency
+    counters."""
+    os.environ["DAFT_TPU_DEVICE"] = "1"
+    os.environ.setdefault("DAFT_TPU_DEVICE_FORCE", "1")
+    delay_ms = float(os.environ.get("BENCH_PIPE_LINK_MS", "0"))
+    link_mbps = float(os.environ.get("BENCH_PIPE_LINK_MBPS", "40"))
+    if delay_ms > 0:
+        import jax
+
+        import daft_tpu.device.column as dcol
+        import daft_tpu.device.pipeline as dpipe
+        real_fetch, real_encode = dpipe.fetch_host, dcol.encode_batch
+
+        def _link_sleep(nbytes):
+            # one RTT per transfer + wire time at the simulated
+            # bandwidth — the r9 scan bench's latency-injected object
+            # store, applied to the device link
+            time.sleep(delay_ms / 1e3 + nbytes / (link_mbps * 1e6))
+
+        def slow_fetch(tree):
+            # charge the link only for REAL device transfers — numpy
+            # passthroughs (already-fetched planes re-entering decode)
+            # cost nothing on a real wire either
+            dev = [x for x in jax.tree_util.tree_leaves(tree)
+                   if isinstance(x, jax.Array)]
+            if dev:
+                _link_sleep(sum(int(x.nbytes) for x in dev))
+            return real_fetch(tree)
+
+        def slow_encode(batch, columns=None):
+            dt = real_encode(batch, columns)
+            # residency-reuse hits perform no upload — a real wire
+            # carries nothing for them (symmetric with slow_fetch's
+            # numpy-passthrough filter)
+            if not dt.resident:
+                _link_sleep(sum(
+                    int(c.data.nbytes) + int(c.validity.nbytes)
+                    for c in dt.columns.values()))
+            return dt
+
+        dpipe.fetch_host = slow_fetch
+        dcol.encode_batch = slow_encode
+    if os.environ.get("DAFT_TPU_AOT_WARMUP") == "1":
+        from daft_tpu.device import warmup
+        warmup.warmup_session()
+    from daft_tpu.device import costmodel, pipeline as dpipe2
+    out = {"window": int(os.environ.get("DAFT_TPU_DEVICE_INFLIGHT", "2")),
+           "link_delay_ms": delay_ms}
+    for qn in ("q1", "q6"):
+        res, warm, hot = run_tpch_query(DATA, qn)
+        out[qn] = {"warm_s": round(warm, 3), "hot_s": round(hot, 3),
+                   "answer": {k: v[:8] for k, v in res.items()}}
+    snap = costmodel.ledger_snapshot()
+    out["pipeline_ledger"] = snap.get("pipeline", {})
+    # per-dispatch-family evidence (grouped_agg / projection / argsort
+    # rows with seconds + overlap fields where the pipeline drove them)
+    out["mfu_ledger"] = snap
+    out["residency"] = dpipe2.residency_counters()
+    print(json.dumps(out))
+
+
+def run_device_pipeline_bench():
+    """``--device-pipeline``: pipelined vs synchronous device execution.
+    Five cold children — windows {0 (synchronous), 2, BENCH_PIPE_WINDOW
+    (default 4)} on the simulated slow link plus a bare {0, deep} pair —
+    measure q1/q6 hot walls, verify bit-identical answers, and report
+    the overlap ratio (serial-equivalent stage seconds vs pipelined
+    active wall) plus the transfer seconds the window hid.  The
+    headline gate: pipelined device q1 hot ≤ 0.6× the synchronous
+    path on the transfer-bound configuration."""
+    def child(window, delay_ms):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--device-pipeline-child"],
+            capture_output=True, text=True, timeout=420, cwd=REPO,
+            env={**os.environ, "DAFT_TPU_DEVICE": "1",
+                 "DAFT_TPU_DEVICE_FORCE": "1",
+                 "DAFT_TPU_DEVICE_INFLIGHT": str(window),
+                 # r16 AOT warm-up rides along so the walls measure the
+                 # pipeline, not first-trace compiles
+                 "DAFT_TPU_AOT_WARMUP": "1",
+                 # finer scan tasks → enough windows for the in-flight
+                 # ladder to actually overlap on SF1
+                 "DAFT_SCAN_TASKS_MIN_SIZE_BYTES": str(8 << 20),
+                 "BENCH_PIPE_LINK_MS": str(delay_ms)})
+        merged = _merge_lines(proc.stdout or "")
+        if merged is None:
+            raise RuntimeError(
+                f"device-pipeline child rc={proc.returncode}: "
+                f"{(proc.stderr or '')[-500:]}")
+        return merged
+
+    delay = float(os.environ.get("BENCH_PIPE_LINK_MS", "50"))
+    deep = int(os.environ.get("BENCH_PIPE_WINDOW", "4"))
+    sync = child(0, delay)
+    piped2 = child(2, delay)
+    piped_deep = child(deep, delay)
+    bare_sync = child(0, 0)
+    bare_piped = child(deep, 0)
+    out = {"link_delay_ms": delay, "sync": sync,
+           "pipelined_w2": piped2, f"pipelined_w{deep}": piped_deep,
+           "bare_sync_hot_s": {qn: bare_sync[qn]["hot_s"]
+                               for qn in ("q1", "q6")},
+           "bare_pipelined_hot_s": {qn: bare_piped[qn]["hot_s"]
+                                    for qn in ("q1", "q6")}}
+    out["parity_all"] = all(
+        piped2[qn]["answer"] == sync[qn]["answer"]
+        and piped_deep[qn]["answer"] == sync[qn]["answer"]
+        and bare_piped[qn]["answer"] == bare_sync[qn]["answer"]
+        for qn in ("q1", "q6"))
+    best = piped_deep if piped_deep["q1"]["hot_s"] <= piped2["q1"]["hot_s"] \
+        else piped2
+    out["best_window"] = best["window"]
+    for qn in ("q1", "q6"):
+        s, p = sync[qn]["hot_s"], best[qn]["hot_s"]
+        out[f"{qn}_hot_ratio"] = round(p / s, 3) if s else None
+        out[f"{qn}_hot_ratio_w2"] = round(
+            piped2[qn]["hot_s"] / s, 3) if s else None
+    led = best.get("pipeline_ledger") or {}
+    if led.get("serial_equiv_s") and led.get("seconds"):
+        out["overlap_x"] = led.get("overlap_x")
+        out["transfer_s_hidden"] = round(
+            led["serial_equiv_s"] - led["seconds"], 3)
+    out["gate_q1_ratio_le_0.6"] = bool(
+        out.get("q1_hot_ratio") is not None
+        and out["q1_hot_ratio"] <= 0.6)
+    return out
+
+
 def _merge_lines(text: str):
     merged = {}
     for line in text.strip().splitlines():
@@ -1607,6 +1744,14 @@ def main():
         if r is not None:
             detail["scan_bench"] = r
 
+    if "--device-pipeline" in sys.argv:
+        # async device pipeline: pipelined vs synchronous q1/q6 device
+        # walls (simulated transfer-bound link), parity, overlap ratio
+        r = section("device_pipeline", run_device_pipeline_bench,
+                    min_needed=60.0)
+        if r is not None:
+            detail["device_pipeline_bench"] = r
+
     if "--warmup" in sys.argv:
         # shape-discipline bench: cold vs AOT+persisted-cache first-query
         # latency + per-query retrace counts (hot repeats must be zero)
@@ -1686,7 +1831,7 @@ def main():
 
     results_dir = os.path.join(REPO, "benchmarking", "results")
     os.makedirs(results_dir, exist_ok=True)
-    artifact = os.path.join(results_dir, "r16_bench_driver.json")
+    artifact = os.path.join(results_dir, "r17_bench_driver.json")
     with open(artifact, "w") as f:
         json.dump(full, f, indent=1)
     # progress/bulk lines first (NOT last): full detail for humans reading
@@ -1799,6 +1944,8 @@ def main():
 if __name__ == "__main__":
     if "--device-child" in sys.argv:
         _device_child()
+    elif "--device-pipeline-child" in sys.argv:
+        _device_pipeline_child()
     elif "--warmup-child" in sys.argv:
         _warmup_child()
     elif "--serve-smoke" in sys.argv:

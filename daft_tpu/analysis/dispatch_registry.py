@@ -52,7 +52,7 @@ class DispatchSite:
     # purpose: counted, never budget-enforced
     memo: str = "local"       # who owns the program memo: "local" (the
     # constructing function must store it — rule_shapes enforces the
-    # _stack_cache pattern) or "caller" (the construction is returned
+    # _mask_cache pattern) or "caller" (the construction is returned
     # and the CALLERS hold the cache, e.g. compile_projection →
     # runtime._projection_cache / fragment._fused_cache)
 
@@ -97,10 +97,11 @@ SITES: Tuple[DispatchSite, ...] = (
        "(program, capacity class, out_cap bucket, strategy, "
        "scalar-plane shapes)",
        "donating twin of fragment.packed; same signature contract"),
-    _s("fragment.stack", "daft_tpu/device/fragment.py",
-       ("_stack",),
-       "(pack count,)",
-       "one trace per batched-transfer pack count"),
+    _s("pipeline.mask", "daft_tpu/device/pipeline.py",
+       ("_masked_validity",),
+       "(validity-plane capacity class,)",
+       "one trace per capacity class (live count rides as a traced "
+       "scalar, never a literal)"),
     _s("compiler.projection", "daft_tpu/device/compiler.py",
        ("compile_projection",),
        "(expression keys, schema, capacity class, scalar-plane shapes)",

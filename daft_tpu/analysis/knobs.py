@@ -61,6 +61,13 @@ _KNOBS: List[Knob] = [
     _k("DAFT_TPU_DEVICE_JOIN", "str", None, "daft_tpu/joins.py",
        "core", "`1`/`0` force-overrides the cost model's device-join "
        "routing; unset = modeled", default_str="auto"),
+    _k("DAFT_TPU_DEVICE_INFLIGHT", "int", 2,
+       "daft_tpu/device/pipeline.py", "core",
+       "in-flight device pipeline slots: morsel N+1's host encode/upload "
+       "overlaps morsel N's device compute and morsel N−1's "
+       "download/decode; `0` = synchronous dispatch (forced under "
+       "`DAFT_TPU_CHAOS_SERIALIZE=1` or an active fault plan)",
+       config_field="tpu_device_inflight"),
     _k("DAFT_TPU_NATIVE", "bool", True, "daft_tpu/native/__init__.py",
        "core", "`0` disables the native (C-accelerated) expression paths"),
     _k("DAFT_TPU_ACTOR_POOL", "bool", True, "daft_tpu/actor_pool.py",

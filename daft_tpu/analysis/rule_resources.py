@@ -113,6 +113,15 @@ CONTRACTS: Tuple[Contract, ...] = (
              "can raise",
     ),
     Contract(
+        rule="device-slot-leak", style="object", mode="all",
+        acquire=("acquire_slot",), release=("release_slot",),
+        defining=("daft_tpu/device/pipeline.py",),
+        hint="release_slot(slot) on every decline/error path, or hand "
+             "the slot off whole (InflightItem) so the pipeline driver "
+             "releases it on drain — an in-flight slot owns window "
+             "occupancy AND memory admission",
+    ),
+    Contract(
         rule="pool-leak", style="object", mode="all",
         acquire=("ThreadPoolExecutor",), release=("shutdown",),
         hint="shutdown() the locally created pool on every exit path, "

@@ -20,7 +20,7 @@ This family makes "this dispatch is shape-stable" a proven invariant:
   function body without a memo store (module-level cache dict, object
   attribute, or a declared-``global`` rebind) is a fresh Python callable
   per call, which can never hit jax's trace cache.  The sanctioned shape
-  is ``fragment.py``'s ``_stack_cache`` pattern; the historical first
+  is ``pipeline.py``'s ``_mask_cache`` pattern; the historical first
   hit was ``parallel/exchange.py`` returning a fresh ``jax.jit(mapped)``
   per mesh exchange.
 
@@ -49,7 +49,7 @@ RULE_IDS: Dict[str, Tuple[str, str]] = {
                   "shape"),
     "jit-not-memoized": (
         "shapes", "memoize the jitted program in a module-level cache "
-                  "(the fragment._stack_cache pattern) keyed on its "
+                  "(the pipeline._mask_cache pattern) keyed on its "
                   "static signature"),
 }
 

@@ -61,6 +61,10 @@ class ExecutionConfig:
     # TPU-specific knobs
     device_min_rows: int = 0
     device_enabled: bool = True
+    # async device pipeline (round 17, device/pipeline.py): in-flight
+    # morsel slots; env override spells the documented knob
+    # (DAFT_TPU_DEVICE_INFLIGHT); 0 = synchronous dispatch
+    tpu_device_inflight: int = 2
     target_partition_size_bytes: int = 512 * 1024 * 1024
     # shape discipline (round 16): the size-class ladder batches pad to
     # (DAFT_TPU_SIZE_CLASSES) and the AOT warm-up toggle

@@ -249,7 +249,18 @@ def _np_encode(s: Series) -> "tuple[np.ndarray, np.ndarray, Optional[pa.Array]]"
     return vals, validity, None
 
 
-def encode_series(s: Series, capacity: int) -> DeviceColumn:
+def encode_series(s: Series, capacity: int,
+                  allow_resident: bool = False) -> DeviceColumn:
+    # device-resident hand-off (round 17): a series decoded from a device
+    # op whose planes are still resident re-enters the device without a
+    # host round trip (pipeline.py bounds + reaps the registry).  Opt-in
+    # only: the returned planes are SHARED with the registry, so callers
+    # that might donate buffers must stay on the fresh-encode path (the
+    # all-or-nothing table reuse in encode_batch marks its table
+    # ``resident`` instead).
+    res = _resident_column(s, capacity) if allow_resident else None
+    if res is not None:
+        return res
     vals, validity, dictionary = _np_encode(s)
     n = len(vals)
     if n < capacity:
@@ -259,6 +270,20 @@ def encode_series(s: Series, capacity: int) -> DeviceColumn:
             [validity, np.zeros(capacity - n, dtype=np.bool_)])
     return DeviceColumn(jnp.asarray(vals), jnp.asarray(validity),
                         s.datatype(), dictionary)
+
+
+def _resident_column(s: Series, capacity: int) -> Optional[DeviceColumn]:
+    """Resident device planes for a decoded series, when their capacity
+    matches the requested bucket exactly (encode_batch's table-wide reuse
+    handles the larger-bucket case)."""
+    from . import pipeline
+    hit = pipeline.resident_planes(s, len(s))
+    if hit is None:
+        return None
+    data, validity, dictionary, cap = hit
+    if cap != capacity:
+        return None
+    return DeviceColumn(data, validity, s.datatype(), dictionary)
 
 
 def encoded_nbytes(batch, columns) -> int:
@@ -293,16 +318,84 @@ def encode_batch(batch, columns: Optional[List[str]] = None) -> DeviceTable:
     names = columns if columns is not None else batch.column_names()
     n = len(batch)
     cap = bucket_capacity(n)
+    resident = _resident_batch(batch, names, n, cap)
+    if resident is not None:
+        return resident
     cols = {nm: encode_series(batch.get_column(nm), cap) for nm in names}
     mask = np.zeros(cap, dtype=np.bool_)
     mask[:n] = True
     return DeviceTable(cols, jnp.asarray(mask), n, cap)
 
 
+def _resident_batch(batch, names, n: int, cap: int
+                    ) -> Optional[DeviceTable]:
+    """Table-wide residency reuse: when EVERY requested column's decoded
+    device planes are still resident at one shared capacity ≥ the
+    requested bucket, rebuild the DeviceTable from them — zero uploads
+    beyond the tiny live-row mask.  Marked ``resident``: the planes are
+    shared with the registry (and the host Series that keys it), so the
+    donation discipline must never hand them to a fused program."""
+    from . import pipeline
+    hits = {}
+    shared_cap = None
+    for nm in names:
+        hit = pipeline.resident_planes(batch.get_column(nm), n)
+        if hit is None:
+            return None
+        data, validity, dictionary, ccap = hit
+        if ccap < cap or (shared_cap is not None and ccap != shared_cap):
+            return None
+        shared_cap = ccap
+        hits[nm] = DeviceColumn(data, validity,
+                                batch.get_column(nm).datatype(), dictionary)
+    if shared_cap is None:
+        return None
+    mask = np.zeros(shared_cap, dtype=np.bool_)
+    mask[:n] = True
+    return DeviceTable(hits, jnp.asarray(mask), n, shared_cap,
+                       resident=True)
+
+
 def decode_column(name: str, col: DeviceColumn, count: int) -> Series:
-    """DeviceColumn → Series, taking the first ``count`` rows (post-compaction)."""
-    vals = np.asarray(jax.device_get(col.data))[:count]
-    validity = np.asarray(jax.device_get(col.validity))[:count]
+    """DeviceColumn → Series, taking the first ``count`` rows (post-compaction).
+    Data + validity come back in ONE batched ``device_get`` (round 17: the
+    two sequential blocking gets here were a full extra RTT per column on
+    a transfer-bound link)."""
+    return decode_columns([(name, col)], count)[0]
+
+
+def decode_columns(named: "List[tuple]", count: int) -> "List[Series]":
+    """Decode many DeviceColumns with ONE batched pytree ``device_get``
+    for every data+validity plane (round 17's single-transfer
+    discipline).  Each decoded Series registers its still-live device
+    planes for residency hand-off when the async pipeline is enabled —
+    a downstream device op then re-enters without a host round trip."""
+    from . import pipeline
+    fetched = pipeline.fetch_host([(c.data, c.validity) for _, c in named])
+    register = pipeline.inflight_window() > 0
+    out = []
+    for (name, col), (vals, validity) in zip(named, fetched):
+        s = _decode_np(name, col, np.asarray(vals)[:count],
+                       np.asarray(validity)[:count], count)
+        if register and _is_device_array(col.data) \
+                and not col.dtype.is_decimal() and not col.dtype.is_null():
+            # decimals are excluded: their f64 encoding is lossy, so a
+            # reuse would not be bit-identical with a fresh re-encode
+            pipeline.note_decoded(s, col.data, col.validity,
+                                  col.dictionary, count,
+                                  int(col.data.shape[0]))
+        out.append(s)
+    return out
+
+
+def _is_device_array(x) -> bool:
+    return isinstance(x, jax.Array)
+
+
+def _decode_np(name: str, col: DeviceColumn, vals: np.ndarray,
+               validity: np.ndarray, count: int) -> Series:
+    """Host-side decode of already-fetched planes (the single-transfer
+    table path lands here with numpy arrays)."""
     dt = col.dtype
     if dt.is_null():
         return Series(name, dt, arrow=pa.nulls(count))
@@ -334,13 +427,18 @@ def decode_column(name: str, col: DeviceColumn, count: int) -> Series:
 
 def decode_table(dt: DeviceTable, compact_perm: Optional[np.ndarray] = None):
     """DeviceTable → RecordBatch. If rows are not already compacted (live rows
-    first), pass a permutation from ``kernels.compaction_perm``."""
+    first), pass a permutation from ``kernels.compaction_perm``.
+
+    The whole table downloads as ONE pytree ``device_get`` (round 17):
+    every column's data+validity host copies start together instead of
+    2×n_cols sequential blocking round trips."""
     from ..recordbatch import RecordBatch
-    cols = []
+    named = []
     for name, col in dt.columns.items():
         if compact_perm is not None:
             data = jnp.take(col.data, compact_perm, axis=0)
             valid = jnp.take(col.validity, compact_perm, axis=0)
             col = DeviceColumn(data, valid, col.dtype, col.dictionary)
-        cols.append(decode_column(name, col, dt.row_count))
+        named.append((name, col))
+    cols = decode_columns(named, dt.row_count)
     return RecordBatch.from_series(cols) if cols else RecordBatch.empty()

@@ -79,6 +79,22 @@ class LinkProfile:
         return (bytes_up / self.up_bps + bytes_down / self.down_bps
                 + round_trips * self.rtt_s + kernel_s)
 
+    def pipelined_seconds(self, bytes_up: float, bytes_down: float,
+                          round_trips: float, kernel_s: float = 0.0
+                          ) -> float:
+        """Steady-state per-morsel cost with the async device pipeline
+        (round 17) overlapping the transfer legs with neighbor morsels'
+        compute: the bottleneck stage sets throughput, so the effective
+        cost is the slower of (wire time, kernel time) plus one RTT for
+        the dispatch tail — never more than the serial chain.  The
+        serial model charged full upload+download+RTT per morsel, which
+        made the strategy ladder under-dispatch to the device exactly
+        when overlap would hide the transfer."""
+        link_s = bytes_up / self.up_bps + bytes_down / self.down_bps
+        serial = link_s + round_trips * self.rtt_s + kernel_s
+        steady = max(link_s, kernel_s) + self.rtt_s
+        return min(serial, steady)
+
 
 _SHARED_MEMORY = LinkProfile(0.0, math.inf, math.inf)
 
@@ -303,14 +319,17 @@ _ledger_lock = threading.Lock()
 _LEDGER_RAW = ("dispatches", "rows", "bytes", "flops", "seconds")
 #: strategy accounting (round 12): per-family hash/sort dispatch counts
 #: plus the summed hash-table load factor — the per-query stats block
-#: derives `strategy` and the mean `load_factor` from these
-_LEDGER_STRATEGY = ("strategy_hash", "strategy_sort", "lf_sum")
+#: derives `strategy` and the mean `load_factor` from these.  ``serial_s``
+#: (round 17) is the serial-equivalent stage seconds the async pipeline
+#: measured against its pipelined wall — the overlap evidence.
+_LEDGER_STRATEGY = ("strategy_hash", "strategy_sort", "lf_sum", "serial_s")
 
 
 def ledger_record(kind: str, *, rows: int = 0, nbytes: float = 0.0,
                   flops: float = 0.0, seconds: float = 0.0,
                   dispatches: int = 1, strategy: Optional[str] = None,
-                  load_factor: Optional[float] = None) -> None:
+                  load_factor: Optional[float] = None,
+                  serial_seconds: Optional[float] = None) -> None:
     """Record one real dispatch's achieved work.
 
     ``seconds`` is wall time from dispatch to host-visible result — on a
@@ -327,6 +346,8 @@ def ledger_record(kind: str, *, rows: int = 0, nbytes: float = 0.0,
         fields.append((f"strategy_{strategy}", dispatches))
     if load_factor is not None:
         fields.append(("lf_sum", float(load_factor) * dispatches))
+    if serial_seconds is not None:
+        fields.append(("serial_s", float(serial_seconds)))
     with _ledger_lock:
         d = kernel_ledger.setdefault(
             kind, {k: 0 if k in ("dispatches", "rows") else 0.0
@@ -370,9 +391,10 @@ def _derive(d: dict) -> dict:
     out = {k: (round(v, 6) if isinstance(v, float) else v)
            for k, v in d.items() if k not in _LEDGER_STRATEGY}
     s = d.get("seconds", 0.0)
-    if s > 0:
+    if s > 0 and d.get("bytes"):
         out["achieved_gbps"] = round(d["bytes"] / s / 1e9, 3)
         out["roofline_pct"] = round(100.0 * d["bytes"] / s / hbm_bps(), 4)
+    if s > 0:
         if d.get("flops"):
             out["achieved_tflops"] = round(d["flops"] / s / 1e12, 4)
             out["mfu_pct"] = round(100.0 * d["flops"] / s / peak_flops(), 4)
@@ -386,6 +408,13 @@ def _derive(d: dict) -> dict:
             out["strategy_sort"] = ns
     if nh and d.get("lf_sum"):
         out["load_factor"] = round(d["lf_sum"] / nh, 3)
+    ser = d.get("serial_s", 0.0)
+    if ser and s > 0:
+        # round 17 overlap evidence: serial-equivalent stage seconds vs
+        # the pipelined wall — >1.0 means the async window really hid
+        # host encode/decode + transfer behind device compute
+        out["serial_equiv_s"] = round(ser, 6)
+        out["overlap_x"] = round(ser / s, 3)
     return out
 
 
@@ -549,7 +578,7 @@ def argsort_wins(n_rows: int, key_bytes: float, n_keys: int) -> bool:
 def agg_upload_wins(bytes_up: float, bytes_down: float,
                     cacheable: bool, round_trips: float = 2.0,
                     host_bytes: Optional[float] = None,
-                    strategy: str = "sort") -> bool:
+                    strategy: str = "sort", window: int = 1) -> bool:
     """Aggregation whose inputs are NOT already device-resident.
 
     ``bytes_up`` is the WIRE cost (encoded device bytes: f64 rides f32,
@@ -587,7 +616,14 @@ def agg_upload_wins(bytes_up: float, bytes_down: float,
     # data once where the sort strategy pays ≥2 passes per packed plane
     bps = DEV_AGG_HASH_BPS if strategy == "hash" else DEV_AGG_BPS
     kernel_s = DEV_DISPATCH_S + bytes_up / bps
-    dev_s = lp.device_seconds(bytes_up, bytes_down, round_trips, kernel_s)
+    # round 17: with the async pipeline active (window ≥ 2 in-flight
+    # morsel slots) the transfer legs overlap neighbor morsels' compute,
+    # so the dispatch is priced at the steady-state bottleneck instead
+    # of the full serial chain — the serial price under-dispatched to
+    # the device exactly when overlap would have hidden the transfer
+    dev_s = lp.pipelined_seconds(bytes_up, bytes_down, round_trips,
+                                 kernel_s) if window >= 2 else \
+        lp.device_seconds(bytes_up, bytes_down, round_trips, kernel_s)
     from ..analysis import knobs
     if cacheable and knobs.env_bool("DAFT_TPU_CACHE_INVEST"):
         # invest only when residency PAYS: a resident rerun (no upload,
@@ -597,8 +633,9 @@ def agg_upload_wins(bytes_up: float, bytes_down: float,
         # matter how many times the query repeats (r4: TPC-H Q22's tiny
         # per-task aggregates burned 10.9s vs 2.1s host at SF10). The
         # ratio bound additionally rejects pathological fill costs.
-        resident_s = lp.device_seconds(0.0, bytes_down, round_trips,
-                                       kernel_s)
+        resident_s = lp.pipelined_seconds(0.0, bytes_down, round_trips,
+                                          kernel_s) if window >= 2 else \
+            lp.device_seconds(0.0, bytes_down, round_trips, kernel_s)
         win = resident_s < host_s and dev_s < INVEST_MAX_RATIO * host_s
         _log("agg_upload_invest", win, host_s, dev_s,
              resident_s=resident_s, bytes_up=bytes_up,
@@ -664,7 +701,7 @@ def shuffle_combine_wins(rows: Optional[int], groups: Optional[int],
 
 
 def join_wins(n_left: int, n_right: int, bytes_up: float,
-              bytes_down: float) -> bool:
+              bytes_down: float, window: int = 1) -> bool:
     """Equi-join as one fused device program (hash build/probe when the
     strategy model picks it, else sort/searchsorted/expand): output is
     one packed index matrix; host cost is a hash build+probe. ONE
@@ -682,8 +719,12 @@ def join_wins(n_left: int, n_right: int, bytes_up: float,
         if _join_strategy(n_left, n_right) == "hash" \
         else DEV_JOIN_ROWS_PER_S
     kernel_s = DEV_DISPATCH_S + n / rate
-    dev_s = link_profile().device_seconds(bytes_up, bytes_down, 2.0,
-                                          kernel_s)
+    lp = link_profile()
+    # round 17: overlap pricing when the async pipeline is active (the
+    # join's upload/download legs hide behind neighbor dispatches)
+    dev_s = lp.pipelined_seconds(bytes_up, bytes_down, 2.0, kernel_s) \
+        if window >= 2 else \
+        lp.device_seconds(bytes_up, bytes_down, 2.0, kernel_s)
     _log("join", dev_s < host_s, host_s, dev_s,
          n_left=n_left, n_right=n_right, bytes_up=bytes_up)
     return dev_s < host_s

@@ -206,11 +206,22 @@ def run_fused_agg(prog: FusedAggProgram, batch, group_exprs, agg_exprs,
                   out_schema: Schema, groups: Optional[float] = None):
     """Execute the fused program on one RecordBatch; returns a RecordBatch of
     partial groups (or None → caller falls back to the host chain)."""
+    tok = submit_fused_agg(prog, batch, group_exprs, agg_exprs, out_schema,
+                           groups=groups)
+    return None if tok is None else drain_fused_agg_table(tok)
+
+
+def submit_fused_agg(prog: FusedAggProgram, batch, group_exprs, agg_exprs,
+                     out_schema: Schema, groups: Optional[float] = None):
+    """Pipeline submit half of :func:`run_fused_agg`: host encode +
+    asynchronous dispatch of the first ladder rung, NO blocking fetch.
+    Returns an in-flight token for :func:`drain_fused_agg_table`, or
+    None → host fallback (pyobject inputs)."""
     for nm in prog.compiled.needs_cols:
         if batch.get_column(nm).is_pyobject():
             return None
     dt = dcol.encode_batch(batch, prog.compiled.needs_cols)
-    return run_fused_agg_table(
+    return submit_fused_agg_table(
         prog, dt, batch.schema, group_exprs, agg_exprs, out_schema,
         groups=groups,
         # the donating fast path invalidates the input planes; an overflow
@@ -374,6 +385,155 @@ def _ledger_grouped(prog: FusedAggProgram, rows: int, cap: int,
                             load_factor=load_factor or None)
 
 
+class InflightFusedAgg:
+    """One in-flight fused-agg dispatch: the device-side packed result
+    plus the ladder state a drain needs to finish (overflow re-dispatch,
+    per-strategy ledger accounting)."""
+
+    __slots__ = ("prog", "dt", "group_exprs", "key_fields", "agg_fields",
+                 "groups", "reencode", "cap_limit", "out_cap", "donate",
+                 "strategy", "lf", "packed", "t0", "submitted_s", "acct")
+
+    def __init__(self, prog, dt, group_exprs, key_fields, agg_fields,
+                 groups, reencode):
+        import time as _time
+        self.prog = prog
+        self.dt = dt
+        self.group_exprs = group_exprs
+        self.key_fields = key_fields
+        self.agg_fields = agg_fields
+        self.groups = groups
+        self.reencode = reencode
+        self.cap_limit = 0
+        self.out_cap = _OUT_CAP0
+        self.donate = False
+        self.strategy: Optional[str] = None
+        self.lf = 0.0
+        self.packed = None
+        self.t0 = _time.perf_counter()
+        #: submit-stage wall (dispatch only) — the ledger charges
+        #: submitted_s + drain wall, NOT t0→drain-end, which under the
+        #: async window would include time the token sat undrained and
+        #: deflate the achieved-GB/s evidence
+        self.submitted_s = 0.0
+        self.acct: Dict[str, list] = {}  # strategy → [dispatches, lf, cap]
+
+
+def _ladder_dispatch(tok: InflightFusedAgg) -> None:
+    """Dispatch the current ladder rung asynchronously (no fetch),
+    handling the hash width-gate fallback and decision logging."""
+    from . import costmodel
+    while True:
+        if tok.strategy is None:
+            tok.strategy, tok.lf = strategy_for(tok.prog, tok.dt,
+                                                tok.out_cap, tok.groups)
+        try:
+            tok.packed = _dispatch_packed(tok.prog, tok.dt, tok.out_cap,
+                                          tok.strategy, tok.donate)
+        except pallas_kernels.HashKeyWidthError:
+            # key set packs wider than the hash-table key budget — the
+            # kernel's trace is the exact check; remember and re-dispatch
+            # on the sort path (donation untouched: the trace failed
+            # before any executable could consume the buffers). Any
+            # OTHER error propagates — it is a real defect, not a
+            # routing signal.
+            tok.prog.hash_unfit = True
+            tok.strategy, tok.lf = "sort", 0.0
+            continue
+        tok.acct[tok.strategy] = [
+            tok.acct.get(tok.strategy, [0])[0] + 1, tok.lf, tok.out_cap]
+        # the decision that actually dispatched (post width-gate fallback)
+        costmodel.log_strategy_decision(
+            "groupby_strategy", tok.strategy, rows=tok.dt.row_count,
+            out_cap=tok.out_cap, load_factor=tok.lf)
+        return
+
+
+def submit_fused_agg_table(prog: FusedAggProgram, dt: dcol.DeviceTable,
+                           in_schema: Schema, group_exprs, agg_exprs,
+                           out_schema: Schema,
+                           start_out_cap: int = _OUT_CAP0,
+                           groups: Optional[float] = None, reencode=None
+                           ) -> InflightFusedAgg:
+    """Async submit half of :func:`run_fused_agg_table`: dispatch the
+    first ladder rung and return without blocking on the result — the
+    device computes while the caller encodes the next morsel."""
+    key_fields = [e.to_field(in_schema) for e in group_exprs]
+    agg_fields = [out_schema[e.name()] for e in agg_exprs]
+    import time as _time
+    tok = InflightFusedAgg(prog, dt, group_exprs, key_fields, agg_fields,
+                           groups, reencode)
+    if prog.nk == 0:
+        tok.packed = _dispatch_packed(prog, dt, _OUT_CAP0)
+        tok.submitted_s = _time.perf_counter() - tok.t0
+        return tok
+    tok.cap_limit = _max_out_cap(prog, dt)
+    tok.out_cap = min(start_out_cap, tok.cap_limit)
+    tok.donate = reencode is not None and _donation_ok(dt)
+    _ladder_dispatch(tok)
+    tok.submitted_s = _time.perf_counter() - tok.t0
+    return tok
+
+
+def drain_fused_agg_table(tok: InflightFusedAgg):
+    """Blocking drain half: ONE batched fetch of the packed result, then
+    decode — continuing the overflow ladder synchronously if the group
+    count outgrew the bucket (rare; each retry is dispatch+fetch).
+    Returns None → host fallback when groups exceed the link-budgeted
+    ceiling."""
+    import time as _time
+
+    from . import pipeline
+    prog, dt = tok.prog, tok.dt
+    t_drain0 = _time.perf_counter()
+    if prog.nk == 0:
+        packed = np.asarray(pipeline.fetch_host(tok.packed))
+        return _decode_packed_global(prog, packed, tok.agg_fields)
+    while True:
+        packed = np.asarray(pipeline.fetch_host(tok.packed))
+        out = _decode_packed_grouped(prog, packed, tok.dt, tok.group_exprs,
+                                     tok.key_fields, tok.agg_fields)
+        if out is not None:
+            # per-strategy accounting: an overflow ladder can MIX
+            # strategies (hash saturation falls back to sort), and each
+            # family row must count its own dispatches and byte model.
+            # The row count and whole-ladder wall go to the completing
+            # strategy's record. Submit wall + drain wall — NOT
+            # t0→now, which under the async window would charge time
+            # the token sat undrained behind its predecessors.
+            secs = tok.submitted_s + (_time.perf_counter() - t_drain0)
+            for s_, (cnt, l_, oc) in tok.acct.items():
+                final = s_ == tok.strategy
+                _ledger_grouped(prog, tok.dt.row_count if final else 0,
+                                tok.dt.capacity, oc,
+                                secs if final else 0.0, cnt, s_, l_)
+            return out
+        # the packed header carries the group count — TRUE for the sort
+        # strategy; the hash strategy saturates at the table size, so a
+        # saturated count is only a LOWER bound on the real NDV
+        g = int(packed[0, 0])
+        if g > tok.cap_limit:
+            return None
+        if tok.donate:
+            tok.dt = tok.reencode()
+        saturated = tok.strategy == "hash" \
+            and g >= pallas_kernels.table_capacity(tok.out_cap)
+        tok.out_cap = min(dcol.bucket_capacity(max(g, _OUT_CAP0)),
+                          tok.cap_limit)
+        if saturated:
+            # a completely full table means the true count is unknown
+            # and high — re-dispatch on the sort path, whose header is
+            # exact, instead of geometrically doubling the hash bucket
+            # one full row pass (and, when donating, one re-encode) at
+            # a time; NDV this high is sort's territory anyway
+            tok.strategy, tok.lf = "sort", 0.0
+        else:
+            # the bucket changed: re-ask the strategy model (a grown
+            # group budget can push the table past the slot ceiling)
+            tok.strategy = None
+        _ladder_dispatch(tok)
+
+
 def run_fused_agg_table(prog: FusedAggProgram, dt: dcol.DeviceTable,
                         in_schema: Schema, group_exprs, agg_exprs,
                         out_schema: Schema, start_out_cap: int = _OUT_CAP0,
@@ -383,125 +543,90 @@ def run_fused_agg_table(prog: FusedAggProgram, dt: dcol.DeviceTable,
     link-budgeted packed-output ceiling. With ``reencode`` (a thunk
     rebuilding the DeviceTable from host data), one-shot tables DONATE
     their input planes to the fused program on real chips — an overflow
-    re-dispatch then re-encodes instead of reusing dead buffers."""
+    re-dispatch then re-encodes instead of reusing dead buffers.
+    (Single-sourced as submit + drain so the async pipeline and the
+    synchronous chaos-degradation path run the same ladder.)"""
+    return drain_fused_agg_table(submit_fused_agg_table(
+        prog, dt, in_schema, group_exprs, agg_exprs, out_schema,
+        start_out_cap=start_out_cap, groups=groups, reencode=reencode))
+
+
+class InflightFusedAggBatch:
+    """A window's worth of in-flight fused-agg dispatches (one per
+    DeviceTable) awaiting ONE batched pytree fetch."""
+
+    __slots__ = ("prog", "tables", "in_schema", "group_exprs", "agg_exprs",
+                 "out_schema", "groups", "key_fields", "agg_fields",
+                 "strategy", "lf", "packs", "t0", "submitted_s", "failed")
+
+    def __init__(self, prog, tables, in_schema, group_exprs, agg_exprs,
+                 out_schema, groups):
+        import time as _time
+        self.prog = prog
+        self.tables = tables
+        self.in_schema = in_schema
+        self.group_exprs = group_exprs
+        self.agg_exprs = agg_exprs
+        self.out_schema = out_schema
+        self.groups = groups
+        self.key_fields = [e.to_field(in_schema) for e in group_exprs]
+        self.agg_fields = [out_schema[e.name()] for e in agg_exprs]
+        self.strategy = "sort"
+        self.lf = 0.0
+        self.packs: list = []
+        self.t0 = _time.perf_counter()
+        self.submitted_s = 0.0   # dispatch wall (see InflightFusedAgg)
+        self.failed = False
+
+
+def submit_fused_agg_tables(prog: FusedAggProgram, tables,
+                            in_schema: Schema, group_exprs, agg_exprs,
+                            out_schema: Schema,
+                            groups: Optional[float] = None
+                            ) -> InflightFusedAggBatch:
+    """Async submit half of :func:`run_fused_agg_tables`: dispatch every
+    table's fused program (no fetch).  Dispatch failures mark the token
+    failed → the drain falls back per-table."""
     import time as _time
-
-    from . import costmodel
-    key_fields = [e.to_field(in_schema) for e in group_exprs]
-    agg_fields = [out_schema[e.name()] for e in agg_exprs]
-    if prog.nk == 0:
-        packed = np.asarray(jax.device_get(
-            _dispatch_packed(prog, dt, _OUT_CAP0)))
-        return _decode_packed_global(prog, packed, agg_fields)
-    cap_limit = _max_out_cap(prog, dt)
-    out_cap = min(start_out_cap, cap_limit)
-    donate = reencode is not None and _donation_ok(dt)
-    t0 = _time.perf_counter()
-    acct: Dict[str, list] = {}  # strategy → [dispatches, lf, out_cap]
-    strategy = lf = None
-    while True:
-        if strategy is None:
-            strategy, lf = strategy_for(prog, dt, out_cap, groups)
-        try:
-            packed = np.asarray(jax.device_get(
-                _dispatch_packed(prog, dt, out_cap, strategy, donate)))
-        except pallas_kernels.HashKeyWidthError:
-            # key set packs wider than the hash-table key budget — the
-            # kernel's trace is the exact check; remember and re-dispatch
-            # on the sort path (donation untouched: the trace failed
-            # before any executable could consume the buffers). Any
-            # OTHER error propagates — it is a real defect, not a
-            # routing signal.
-            prog.hash_unfit = True
-            strategy, lf = "sort", 0.0
-            continue
-        acct[strategy] = [acct.get(strategy, [0])[0] + 1, lf, out_cap]
-        # the decision that actually dispatched (post width-gate fallback)
-        costmodel.log_strategy_decision(
-            "groupby_strategy", strategy, rows=dt.row_count,
-            out_cap=out_cap, load_factor=lf)
-        out = _decode_packed_grouped(prog, packed, dt, group_exprs,
-                                     key_fields, agg_fields)
-        if out is not None:
-            # per-strategy accounting: an overflow ladder can MIX
-            # strategies (hash saturation falls back to sort), and each
-            # family row must count its own dispatches and byte model.
-            # The row count and whole-ladder wall go to the completing
-            # strategy's record.
-            secs = _time.perf_counter() - t0
-            for s_, (cnt, l_, oc) in acct.items():
-                final = s_ == strategy
-                _ledger_grouped(prog, dt.row_count if final else 0,
-                                dt.capacity, oc, secs if final else 0.0,
-                                cnt, s_, l_)
-            return out
-        # the packed header carries the group count — TRUE for the sort
-        # strategy; the hash strategy saturates at the table size, so a
-        # saturated count is only a LOWER bound on the real NDV
-        g = int(packed[0, 0])
-        if g > cap_limit:
-            return None
-        if donate:
-            dt = reencode()
-        saturated = strategy == "hash" \
-            and g >= pallas_kernels.table_capacity(out_cap)
-        out_cap = min(dcol.bucket_capacity(max(g, _OUT_CAP0)), cap_limit)
-        if saturated:
-            # a completely full table means the true count is unknown
-            # and high — re-dispatch on the sort path, whose header is
-            # exact, instead of geometrically doubling the hash bucket
-            # one full row pass (and, when donating, one re-encode) at
-            # a time; NDV this high is sort's territory anyway
-            strategy, lf = "sort", 0.0
-        else:
-            # the bucket changed: re-ask the strategy model (a grown
-            # group budget can push the table past the slot ceiling)
-            strategy = None
-
-
-_stack_cache: Dict[int, object] = {}
-
-
-def _stack(packs):
-    from ..analysis import retrace_sanitizer
-    n = len(packs)
-    fn = _stack_cache.get(n)
-    if fn is None:
-        fn = jax.jit(lambda *xs: jnp.stack(xs))
-        _stack_cache[n] = fn
-    # one trace per pack count (+ the packed matrix shapes the jit cache
-    # also keys on — out_cap buckets, so bounded)
-    with retrace_sanitizer.dispatch_scope(
-            "fragment.stack", (n, tuple(p.shape for p in packs))):
-        return fn(*packs)
-
-
-def run_fused_agg_tables(prog: FusedAggProgram, tables, in_schema: Schema,
-                         group_exprs, agg_exprs, out_schema: Schema,
-                         groups: Optional[float] = None):
-    """Batched execution over many DeviceTables: dispatch every fused
-    program asynchronously, then fetch ALL packed results in a single
-    device→host transfer (one RTT for the whole scan instead of one per
-    task). Returns a list parallel to ``tables`` (None → caller falls back
-    per-table). Inputs are never donated here: the batched overflow retry
-    re-dispatches over the same tables, and cache-resident tables share
-    their buffers with the HBM column cache anyway."""
-    import time as _time
+    tok = InflightFusedAggBatch(prog, tables, in_schema, group_exprs,
+                                agg_exprs, out_schema, groups)
     if not tables:
-        return []
-    key_fields = [e.to_field(in_schema) for e in group_exprs]
-    agg_fields = [out_schema[e.name()] for e in agg_exprs]
-    strategy, lf = strategy_for(prog, tables[0], _OUT_CAP0, groups)
-    t0 = _time.perf_counter()
+        return tok
+    tok.strategy, tok.lf = strategy_for(prog, tables[0], _OUT_CAP0, groups)
     try:
-        packs = [_dispatch_packed(prog, dt, _OUT_CAP0, strategy)
-                 for dt in tables]
-        stacked = np.asarray(jax.device_get(_stack(packs))) \
-            if len(packs) > 1 else [np.asarray(jax.device_get(packs[0]))]
+        tok.packs = [_dispatch_packed(prog, dt, _OUT_CAP0, tok.strategy)
+                     for dt in tables]
     except pallas_kernels.HashKeyWidthError:
         prog.hash_unfit = True
-        return run_fused_agg_tables(prog, tables, in_schema, group_exprs,
-                                    agg_exprs, out_schema, groups)
+        return submit_fused_agg_tables(prog, tables, in_schema,
+                                       group_exprs, agg_exprs, out_schema,
+                                       groups)
+    except Exception:
+        tok.failed = True
+    tok.submitted_s = _time.perf_counter() - tok.t0
+    return tok
+
+
+def drain_fused_agg_tables(tok: InflightFusedAggBatch):
+    """Blocking drain half: ALL packed results come back in a single
+    pytree ``device_get`` (one batched transfer for the whole window —
+    per-task gets would serialize ~40 ms each on the tunnel), then
+    decode; overflowed tables re-dispatch as one batch."""
+    import time as _time
+
+    from . import pipeline
+    prog, tables = tok.prog, tok.tables
+    if not tables:
+        return []
+    if tok.failed:
+        return [None] * len(tables)
+    in_schema, group_exprs = tok.in_schema, tok.group_exprs
+    agg_exprs, out_schema, groups = tok.agg_exprs, tok.out_schema, tok.groups
+    key_fields, agg_fields = tok.key_fields, tok.agg_fields
+    strategy, lf = tok.strategy, tok.lf
+    t_drain0 = _time.perf_counter()
+    try:
+        stacked = [np.asarray(m) for m in pipeline.fetch_host(tok.packs)]
     except Exception:
         return [None] * len(tables)
     if prog.nk:
@@ -511,10 +636,14 @@ def run_fused_agg_tables(prog: FusedAggProgram, tables, in_schema: Schema,
         costmodel.log_strategy_decision(
             "groupby_strategy", strategy,
             rows=sum(dt.row_count for dt in tables), out_cap=_OUT_CAP0,
-            load_factor=lf, tables=len(packs))
+            load_factor=lf, tables=len(tok.packs))
+        # submit wall + fetch wall, excluding any in-window queue wait
+        # between them (see InflightFusedAgg.submitted_s)
         _ledger_grouped(prog, sum(dt.row_count for dt in tables),
                         max(dt.capacity for dt in tables), _OUT_CAP0,
-                        _time.perf_counter() - t0, len(packs), strategy, lf)
+                        tok.submitted_s
+                        + (_time.perf_counter() - t_drain0),
+                        len(tok.packs), strategy, lf)
     results: list = [None] * len(tables)
     retry: list = []  # (index, out_cap) — re-dispatched as ONE batch, not
     # per-table (each serial round trip costs ~0.1 s on the tunnel)
@@ -543,7 +672,7 @@ def run_fused_agg_tables(prog: FusedAggProgram, tables, in_schema: Schema,
         try:
             packs2 = [_dispatch_packed(prog, tables[i], cap, s)
                       for (i, cap), (s, _l) in zip(retry, retry_strats)]
-            mats = [np.asarray(m) for m in jax.device_get(packs2)]
+            mats = [np.asarray(m) for m in pipeline.fetch_host(packs2)]
             from . import costmodel
             for (i, cap), (s, l_) in zip(retry, retry_strats):
                 costmodel.log_strategy_decision(
@@ -561,3 +690,20 @@ def run_fused_agg_tables(prog: FusedAggProgram, tables, in_schema: Schema,
             except Exception:
                 results[i] = None
     return results
+
+
+def run_fused_agg_tables(prog: FusedAggProgram, tables, in_schema: Schema,
+                         group_exprs, agg_exprs, out_schema: Schema,
+                         groups: Optional[float] = None):
+    """Batched execution over many DeviceTables: dispatch every fused
+    program asynchronously, then fetch ALL packed results in a single
+    batched device→host transfer (one round of transfers for the whole
+    scan instead of one per task). Returns a list parallel to ``tables``
+    (None → caller falls back per-table). Inputs are never donated here:
+    the batched overflow retry re-dispatches over the same tables, and
+    cache-resident tables share their buffers with the HBM column cache
+    anyway.  (Single-sourced as submit + drain so the async pipeline
+    overlaps window N+1's submit with window N's drain.)"""
+    return drain_fused_agg_tables(submit_fused_agg_tables(
+        prog, tables, in_schema, group_exprs, agg_exprs, out_schema,
+        groups))

@@ -244,13 +244,17 @@ def try_eval_projection(batch, exprs: List[Expression]):
     t0 = _time.perf_counter()
     dt, outs = _run_compiled(c, batch, exprs)
     n = len(batch)
-    cols = []
+    named = []
     for e, f, (val, valid) in zip(exprs, out_fields, outs):
         dictionary = None
         if f.dtype.is_string() or f.dtype.is_binary():
             dictionary = dt.columns[_string_out_source(e)].dictionary
-        dc = dcol.DeviceColumn(val, valid, f.dtype, dictionary)
-        cols.append(dcol.decode_column(f.name, dc, n))
+        named.append((f.name,
+                      dcol.DeviceColumn(val, valid, f.dtype, dictionary)))
+    # ONE batched transfer for every output plane (round 17) — and each
+    # decoded column registers for device-resident hand-off, so a device
+    # consumer (argsort/topk, grouped agg) skips the re-upload
+    cols = dcol.decode_columns(named, n)
     costmodel.ledger_record(
         "projection", rows=n,
         nbytes=dcol.encoded_nbytes(batch, c.needs_cols)
@@ -300,7 +304,10 @@ def try_argsort(key_series: List[Series], descending: List[bool],
             return None
     cap = dcol.bucket_capacity(n)
     try:
-        cols = [dcol.encode_series(s, cap) for s in key_series]
+        # allow_resident: a key column decoded off a device projection
+        # re-enters without re-uploading (argsort never donates planes)
+        cols = [dcol.encode_series(s, cap, allow_resident=True)
+                for s in key_series]
     except (ValueError, pa.ArrowInvalid):
         return None
     mask = np.zeros(cap, dtype=np.bool_)
@@ -417,10 +424,15 @@ def try_agg(batch, to_agg: List[Expression], group_by: List[Expression]):
                  dt.capacity)):
             results = kernels.global_agg_kernel(tuple(vals), tuple(valids),
                                                 dt.row_mask, ops)
+        # ONE batched transfer for all scalar results (round 17: the
+        # per-scalar get pair cost 2 RTTs per aggregate)
+        from . import pipeline as dpipe
+        host_results = dpipe.fetch_host(results)
         cols = []
-        for (op, child, name, params), f, (rv, rm) in zip(specs, out_fields, results):
-            v = np.asarray(jax.device_get(rv)).reshape(1)
-            m = np.asarray(jax.device_get(rm)).reshape(1)
+        for (op, child, name, params), f, (rv, rm) in zip(
+                specs, out_fields, host_results):
+            v = np.asarray(rv).reshape(1)
+            m = np.asarray(rm).reshape(1)
             cols.append(_decode_scalar(name, f.dtype, v, m))
         return RecordBatch.from_series(cols)
 
@@ -459,7 +471,12 @@ def try_agg(batch, to_agg: List[Expression], group_by: List[Expression]):
     costmodel.log_strategy_decision("groupby_strategy", strategy,
                                     rows=len(batch), out_cap=cap,
                                     load_factor=load_factor)
-    g = int(jax.device_get(gcount))
+    # ONE batched transfer for the group count and every output plane
+    # (round 17: this path issued 1 + 2×(nk+nvals) sequential gets)
+    from . import pipeline as dpipe
+    g, out_keys, out_kvalids, out_vals, out_valids = dpipe.fetch_host(
+        (gcount, out_keys, out_kvalids, out_vals, out_valids))
+    g = int(g)
     # both formulations are bytes-bound: no MXU flops to claim
     if strategy == "hash":
         words = pk.hash_pack_words([v.dtype for v, _ in keys_b]) or 2

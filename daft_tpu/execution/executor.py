@@ -577,7 +577,11 @@ class LocalExecutor:
             return MicroPartition.from_recordbatch(
                 rb.agg(node.aggs, node.group_by).cast_to_schema(node.schema()))
 
-        def device_agg(rb: RecordBatch) -> Optional[MicroPartition]:
+        def morsel_gate(rb: RecordBatch, window: int = 0):
+            """Cost-gate one morsel: the fused program when the device
+            should take it, None → host. No device work happens here.
+            ``window`` ≥ 2 prices the transfer at the pipeline's
+            steady-state overlap instead of the full serial chain."""
             from ..device import costmodel
             if not (drt.device_enabled()
                     and len(rb) >= max(drt._min_rows(), 1)):
@@ -597,18 +601,37 @@ class LocalExecutor:
                     host_bytes=drt._batch_cols_nbytes(
                         rb, prog.compiled.needs_cols),
                     strategy=fragment.gate_strategy(
-                        prog, len(rb), getattr(node, "group_ndv", None))):
+                        prog, len(rb), getattr(node, "group_ndv", None)),
+                    window=window):
                 return None
+            return prog
+
+        def submit_morsel(prog, rb: RecordBatch):
+            """Encode + async dispatch (no blocking fetch); None → the
+            device declined at submit (pyobject / lowering failure)."""
             try:
-                out = fragment.run_fused_agg(
+                return fragment.submit_fused_agg(
                     prog, rb, node.group_by, agg_cols, node.schema(),
                     groups=getattr(node, "group_ndv", None))
             except Exception:  # device OOM / lowering failure → host tier
+                return None
+
+        def drain_device_agg(tok) -> Optional[MicroPartition]:
+            try:
+                out = fragment.drain_fused_agg_table(tok)
+            except Exception:  # device failure mid-flight → host tier
                 return None
             if out is None:
                 return None
             return MicroPartition.from_recordbatch(
                 out.cast_to_schema(node.schema()))
+
+        def device_agg(rb: RecordBatch) -> Optional[MicroPartition]:
+            prog = morsel_gate(rb)
+            if prog is None:
+                return None
+            tok = submit_morsel(prog, rb)
+            return None if tok is None else drain_device_agg(tok)
 
         src = node.children[0]
         if isinstance(src, pp.ScanSource) and src.tasks \
@@ -626,13 +649,74 @@ class LocalExecutor:
                     node, prog, src, agg_cols, host_agg)
                 return
 
+        child = self._exec(node.children[0])
+        from ..device import pipeline as dpipe
+        window = dpipe.inflight_window()
+        if window > 0 and drt.device_enabled():
+            # round 17: async pipeline — morsel N+1's encode+upload runs
+            # on the submit pool while morsel N computes on device and
+            # morsel N−1 downloads/decodes here
+            yield from self._pipelined_fragment_morsels(
+                child, morsel_gate, submit_morsel, drain_device_agg,
+                host_agg, window)
+            return
+
+        # synchronous per-morsel chain, kept verbatim as the
+        # DAFT_TPU_CHAOS_SERIALIZE / active-fault-plan degradation so
+        # chaos replay stays bit-identical
         def run(p: MicroPartition) -> MicroPartition:
             rb = p.combined()
             out = device_agg(rb)
             return out if out is not None else host_agg(rb)
 
-        child = self._exec(node.children[0])
         yield from _ordered_parallel(child, run)
+
+    def _pipelined_fragment_morsels(self, child, morsel_gate,
+                                    submit_morsel, drain_device_agg,
+                                    host_agg, window: int):
+        """Bounded-window async device pipeline over the morsel stream
+        (device/pipeline.py). Each device morsel's slot admits its
+        encoded host+HBM footprint on submit (before the dispatch) and
+        releases on drain; host-routed morsels bypass the window so a
+        host-heavy stream keeps full pool parallelism. Ordering is
+        preserved."""
+        import time as _time
+
+        from ..device import column as dcol, pipeline as dpipe
+
+        def submit(p, seq, gate):
+            rb = p.combined()
+            prog = morsel_gate(rb, window=window)
+            if prog is None:
+                return host_agg(rb)
+            est = dcol.encoded_nbytes(rb, prog.compiled.needs_cols)
+            slot = dpipe.acquire_slot(gate, seq, self.mem, est)
+            try:
+                t0 = _time.perf_counter()
+                with dpipe.upload_span(seq, window):
+                    tok = submit_morsel(prog, rb)
+                sub_s = _time.perf_counter() - t0
+            except BaseException:
+                dpipe.release_slot(slot)
+                raise
+            if tok is None:
+                dpipe.release_slot(slot)
+                return host_agg(rb)
+            return dpipe.InflightItem(slot, (tok, rb), sub_s=sub_s,
+                                      t_dispatched_us=dpipe.now_us())
+
+        def drain(ret, seq):
+            if not isinstance(ret, dpipe.InflightItem):
+                return ret  # host result, already computed on the pool
+            tok, rb = ret.token
+            dpipe.note_compute_span(seq, window, ret.t_dispatched_us)
+            with dpipe.download_span(seq, window):
+                out = drain_device_agg(tok)
+            return out if out is not None else host_agg(rb)
+
+        yield from dpipe.run_pipelined(child, submit, drain,
+                                       window=window,
+                                       poll=self._poll_cancel)
 
     def _fragment_scan_tasks(self, node, prog, src, agg_cols, host_agg):
         """Windowed streaming over scan tasks: resolve each task in the
@@ -700,7 +784,10 @@ class LocalExecutor:
                     host_bytes=drt._batch_cols_nbytes(
                         rb, prog.compiled.needs_cols),
                     strategy=dfrag.gate_strategy(
-                        prog, len(rb), getattr(node, "group_ndv", None))):
+                        prog, len(rb), getattr(node, "group_ndv", None)),
+                    # overlap pricing when the windows really pipeline
+                    # (pwin is assigned before any window resolves)
+                    window=pwin):
                 return ("host", rb, t)
             try:
                 dt = dcol.encode_batch(rb, prog.compiled.needs_cols)
@@ -715,23 +802,37 @@ class LocalExecutor:
             return ("dev", dt, t)
 
         width = max((os.cpu_count() or 4), 4) * 2
-        it = iter(src.tasks)
-        while True:
-            window = list(itertools.islice(it, width))
-            if not window:
-                return
-            classified = list(_ordered_parallel(iter(window), classify))
+        groups_ndv = getattr(node, "group_ndv", None)
+        from ..device import pipeline as dpipe
+        pwin = dpipe.inflight_window()
+        if pwin > 0:
+            # the async pipeline needs windows to overlap: one giant
+            # window over a small scan starves it (fetches stay batched
+            # per window either way). Aim for window+1 windows — enough
+            # to fill the in-flight ladder without multiplying the
+            # per-window fetch round-trips an RTT-bound query pays
+            width = max(1, min(width, -(-n_tasks // max(pwin + 1, 1))))
+
+        def windows():
+            it = iter(src.tasks)
+            while True:
+                w = list(itertools.islice(it, width))
+                if not w:
+                    return
+                yield w
+
+        def resolve(window_tasks):
+            classified = list(_ordered_parallel(iter(window_tasks),
+                                                classify))
             n_sharing = sum(1 for c in classified if c[0] != "host")
             gated = _ordered_parallel(
                 iter([c for c in classified if c[0] == "cand"]),
                 lambda c: gate(c, n_sharing))
             gated_it = iter(list(gated))
-            resolved = [c if c[0] != "cand" else next(gated_it)
-                        for c in classified]
-            outs = fragment.run_fused_agg_tables(
-                prog, [dt for kind, dt, _ in resolved if kind == "dev"],
-                src.schema(), node.group_by, agg_cols, node.schema(),
-                groups=getattr(node, "group_ndv", None))
+            return [c if c[0] != "cand" else next(gated_it)
+                    for c in classified]
+
+        def emit(resolved, outs):
             di = 0
             for kind, val, t in resolved:
                 if kind == "dev":
@@ -744,6 +845,67 @@ class LocalExecutor:
                             out.cast_to_schema(node.schema()))
                 else:
                     yield host_agg(val)
+
+        if pwin <= 0:
+            # synchronous window loop, kept verbatim as the chaos /
+            # fault-plan degradation: window N+1's loads wait for
+            # window N's fetch, exactly the pre-pipeline event order
+            for w in windows():
+                resolved = resolve(w)
+                outs = fragment.run_fused_agg_tables(
+                    prog,
+                    [dt for kind, dt, _ in resolved if kind == "dev"],
+                    src.schema(), node.group_by, agg_cols, node.schema(),
+                    groups=groups_ndv)
+                yield from emit(resolved, outs)
+            return
+
+        # round 17 async pipeline over windows: window N+1's classify /
+        # load / encode / dispatch runs on the submit pool while window
+        # N's packed results download and decode here. Each in-flight
+        # window's slot admits the encoded HBM footprint it keeps
+        # resident until its drain (the transient load bytes are
+        # separately admitted inside load()).
+        import time as _time
+
+        def p_submit(window_tasks, seq, wgate):
+            t0 = _time.perf_counter()
+            resolved = resolve(window_tasks)
+            tables = [dt for kind, dt, _ in resolved if kind == "dev"]
+            est = sum(
+                int(c.data.nbytes) + int(c.validity.nbytes)
+                for dt in tables for c in dt.columns.values())
+            pre_s = _time.perf_counter() - t0
+            slot = dpipe.acquire_slot(wgate, seq, self.mem, est)
+            try:
+                t1 = _time.perf_counter()
+                with dpipe.upload_span(seq, pwin):
+                    tok = fragment.submit_fused_agg_tables(
+                        prog, tables, src.schema(), node.group_by,
+                        agg_cols, node.schema(), groups=groups_ndv)
+                sub_s = pre_s + (_time.perf_counter() - t1)
+            except BaseException:
+                dpipe.release_slot(slot)
+                raise
+            return dpipe.InflightItem(slot, (resolved, tok), sub_s=sub_s,
+                                      t_dispatched_us=dpipe.now_us())
+
+        def p_drain(ret, seq):
+            resolved, tok = ret.token
+            dpipe.note_compute_span(seq, pwin, ret.t_dispatched_us)
+            with dpipe.download_span(seq, pwin):
+                outs = fragment.drain_fused_agg_tables(tok)
+            # release BEFORE emitting: a device-failure fallback re-reads
+            # its task through load()'s own admission, which must not
+            # wait on this very slot's bytes (release_slot is idempotent
+            # — the driver's release after drain becomes a no-op)
+            dpipe.release_slot(ret.slot)
+            return list(emit(resolved, outs))
+
+        for outs in dpipe.run_pipelined(windows(), p_submit, p_drain,
+                                        window=pwin, width=pwin + 1,
+                                        poll=self._poll_cancel):
+            yield from outs
 
     def _exec_DeviceExchangeAgg(self, node: pp.DeviceExchangeAgg):
         """Shuffle+final-merge as ONE mesh program: shard the partial group

@@ -124,6 +124,11 @@ def match_indices(l_gids: np.ndarray, r_gids: np.ndarray,
         n_l, n_r = len(l_gids), len(r_gids)
         # output estimate: FK-join shaped — about one match per probe row
         est_out = 2 * 8 * max(n_l, n_r)
+        # priced SERIAL on purpose: the join dispatch runs inline on its
+        # calling thread, not inside the r17 in-flight window, so there
+        # are no neighbor dispatches to hide its transfer behind —
+        # join_wins(window=) waits for the join path to ride the
+        # pipeline before claiming the overlap discount
         use_device = (drt.device_enabled()
                       and n_l + n_r >= 8192
                       and costmodel.join_wins(
@@ -183,7 +188,6 @@ def _device_match_indices(l_gids, r_gids, l_valid, r_valid):
         return None
     import time as _time
 
-    import jax
     import jax.numpy as jnp
 
     from .device import costmodel, kernels as K, mfu
@@ -215,8 +219,9 @@ def _device_match_indices(l_gids, r_gids, l_valid, r_valid):
         # declared trace signature: build/probe capacity classes + the
         # out-capacity bucket; the same signature must re-enter the jit
         # cache, never re-trace
+        from .device import pipeline as dpipe
         with retrace_sanitizer.dispatch_scope(site, (c_l, c_r, cap)):
-            return np.asarray(jax.device_get(kernel(
+            return np.asarray(dpipe.fetch_host(kernel(
                 jnp.asarray(pad(l_gids.astype(np.int64), c_l)),
                 jnp.asarray(pad(l_valid, c_l)), jnp.asarray(lmask),
                 jnp.asarray(pad(r_gids.astype(np.int64), c_r)),

@@ -470,6 +470,10 @@ class RuntimeStatsContext:
                     extra += f" strategy={d['strategy']}"
                     if "load_factor" in d:
                         extra += f" load={d['load_factor']}"
+                if "overlap_x" in d:
+                    # r17 async pipeline: serial-equivalent stage seconds
+                    # vs pipelined wall (>1 = overlap really hid work)
+                    extra += f" overlap={d['overlap_x']}x"
                 lines.append(
                     f"  {kind}: dispatches={d['dispatches']} "
                     f"rows={d['rows']} time={d['seconds']:.3f}s{extra}")

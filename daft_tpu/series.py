@@ -36,7 +36,10 @@ def _combine(arr: Union[pa.Array, pa.ChunkedArray]) -> pa.Array:
 class Series:
     """A named, typed, immutable column of values."""
 
-    __slots__ = ("_name", "_dtype", "_arrow", "_pyobjs")
+    # __weakref__: the device residency registry (device/pipeline.py)
+    # keys decoded-output device planes weakly by the host Series, so a
+    # fragment output consumed by another device op skips the re-upload
+    __slots__ = ("_name", "_dtype", "_arrow", "_pyobjs", "__weakref__")
 
     def __init__(self, name: str, dtype: DataType,
                  arrow: Optional[pa.Array] = None,

@@ -434,7 +434,7 @@ def context_from_headers(headers) -> Optional[SpanContext]:
 #: lane order for the chrome export's tid assignment: driver layers
 #: first, then device, then workers in first-seen order
 _LANE_PRIORITY = ("driver", "serving", "planner", "pipeline", "scan",
-                  "device")
+                  "device", "dev:upload", "dev:compute", "dev:download")
 
 
 def chrome_trace_events(rec: SpanRecorder) -> List[dict]:

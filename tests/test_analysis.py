@@ -814,6 +814,53 @@ def test_shuffle_cache_leak_on_drain_failure_flagged(tmp_path):
     assert "shuffle-cache-leak" in _rules_of(rule_resources.check(srcs))
 
 
+def test_device_slot_transfer_or_release_is_clean(tmp_path):
+    # the r17 pipeline submit shape: release on every decline/error
+    # path, hand the slot off whole (InflightItem) on success
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def submit(gate, seq, mem, rb):\n"
+        "    slot = acquire_slot(gate, seq, mem, 100)\n"
+        "    try:\n"
+        "        tok = dispatch(rb)\n"
+        "    except BaseException:\n"
+        "        release_slot(slot)\n"
+        "        raise\n"
+        "    if tok is None:\n"
+        "        release_slot(slot)\n"
+        "        return host(rb)\n"
+        "    return InflightItem(slot, tok)\n")
+    assert "device-slot-leak" not in _rules_of(rule_resources.check(srcs))
+
+
+def test_device_slot_leak_on_decline_path_flagged(tmp_path):
+    # the decline path drops the slot on the floor: window occupancy and
+    # admission bytes leak until process exit
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def submit(gate, seq, mem, rb):\n"
+        "    slot = acquire_slot(gate, seq, mem, 100)\n"
+        "    tok = dispatch(rb)\n"
+        "    if tok is None:\n"
+        "        return host(rb)\n"
+        "    return InflightItem(slot, tok)\n")
+    assert "device-slot-leak" in _rules_of(rule_resources.check(srcs))
+
+
+def test_device_slot_pragma_suppresses(tmp_path):
+    code = (
+        "def submit(gate, seq, mem, rb):\n"
+        "    " + PRAGMA + "allow(device-slot-leak) -- fixture reason\n"
+        "    slot = acquire_slot(gate, seq, mem, 100)\n"
+        "    return dispatch(rb)\n")
+    p = tmp_path / "daft_tpu" / "foo.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(code)
+    findings = run_analysis(str(tmp_path), subdirs=("daft_tpu",),
+                            contracts=False, readme=False, baseline=[])
+    assert "device-slot-leak" not in _rules_of(findings)
+
+
 def test_trace_recorder_exception_path_needs_abort(tmp_path):
     bad = _sources_from(
         tmp_path, "daft_tpu/foo.py",
@@ -1282,11 +1329,7 @@ def test_registered_site_is_clean(tmp_path):
         "    return prog\n"
         "def donate_fn(self):\n"
         "    self._d = jax.jit(self.run)\n"
-        "    return self._d\n"
-        "def _stack(packs):\n"
-        "    fn = jax.jit(len)\n"
-        "    _fused_cache[len(packs)] = fn\n"
-        "    return fn\n")
+        "    return self._d\n")
     assert "dispatch-site-unregistered" not in _rules_of(
         rule_shapes.check_registry(srcs))
 
@@ -1312,7 +1355,7 @@ def test_jit_not_memoized_flagged(tmp_path):
 
 
 def test_jit_memo_store_patterns_are_clean(tmp_path):
-    # the sanctioned _stack_cache shapes: dict store (direct and via a
+    # the sanctioned memo-store (pipeline._mask_cache) shapes: dict store (direct and via a
     # wrapping constructor), attribute store, declared-global store
     srcs = _sources_from(
         tmp_path, "daft_tpu/device/newmod.py",

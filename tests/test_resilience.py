@@ -201,11 +201,15 @@ def test_fast_path_layers_do_not_perturb_chaos_replay(monkeypatch,
                          "DAFT_TPU_SHUFFLE_COMPRESSION": "none",
                          "DAFT_TPU_SHUFFLE_FETCH_PARALLELISM": "1",
                          "DAFT_TPU_SCAN_PREFETCH": "0",
+                         "DAFT_TPU_DEVICE_INFLIGHT": "0",
                          "DAFT_TPU_IO_PLANNED_READS": "0"})
     out2, ev2 = one_run({"DAFT_TPU_SHUFFLE_COMBINE": "1",
                          "DAFT_TPU_SHUFFLE_COMPRESSION": "lz4",
                          "DAFT_TPU_SHUFFLE_FETCH_PARALLELISM": "8",
                          "DAFT_TPU_SCAN_PREFETCH": "8",
+                         # r17 async device pipeline: serialize mode must
+                         # override a raised in-flight window too
+                         "DAFT_TPU_DEVICE_INFLIGHT": "8",
                          "DAFT_TPU_IO_PLANNED_READS": "1"})
     assert ev1, "the fixed spec/seed injected nothing — tune the seed"
     assert ev1 == ev2

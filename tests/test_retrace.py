@@ -140,18 +140,18 @@ def test_enable_hooks_real_jax_traces():
     # books already carry every earlier test's dispatches
     with _armed() as san:
         t0 = san.summary()["traces"]
-        s0 = san.summary()["site_traces"].get("fragment.stack", 0)
+        s0 = san.summary()["site_traces"].get("pipeline.mask", 0)
         v0 = len(san.summary()["violations"])
         fn = jax.jit(lambda x: x + 1)
-        with rs.dispatch_scope("fragment.stack", ("t", 16)):
+        with rs.dispatch_scope("pipeline.mask", ("t", 16)):
             fn(jnp.zeros(16))
         mid = san.summary()
         assert mid["traces"] > t0
-        assert mid["site_traces"].get("fragment.stack", 0) == s0 + 1
+        assert mid["site_traces"].get("pipeline.mask", 0) == s0 + 1
         # same shapes again: jit cache hit, NO new trace events
-        with rs.dispatch_scope("fragment.stack", ("t", 16)):
+        with rs.dispatch_scope("pipeline.mask", ("t", 16)):
             fn(jnp.zeros(16))
-        assert san.summary()["site_traces"]["fragment.stack"] == s0 + 1
+        assert san.summary()["site_traces"]["pipeline.mask"] == s0 + 1
         assert len(san.summary()["violations"]) == v0
 
 
