@@ -481,6 +481,155 @@ def run_shuffle_bench():
     return res
 
 
+def _mesh_exchange_child():
+    """``--mesh-exchange-child``: one cold process (the parent sets
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` so the
+    virtual pod mesh exists) driving ONE hash-repartition boundary
+    through the distributed stage runner on the exchange path named by
+    ``DAFT_TPU_EXCHANGE_PATH``. Prints one JSON line: warm elapsed,
+    rows/s, the shuffle-plane counter delta (bytes per link: ici vs
+    wire, stream counts, path decisions), and an order-insensitive
+    row-set checksum for the parity gate."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import daft_tpu as dt
+    import daft_tpu.context as dctx
+    from daft_tpu import col
+    from daft_tpu.distributed import shuffle_service as ss
+    from daft_tpu.runners.distributed_runner import DistributedRunner
+
+    n = int(os.environ.get("BENCH_MESH_ROWS", "400000"))
+    nparts = 8  # == the virtual pod's mesh width
+    nfiles = 8  # one scan task per file → map tasks shard over workers
+    rng = np.random.default_rng(17)
+    root = tempfile.mkdtemp(prefix="daft_tpu_meshbench_")
+    per = n // nfiles
+    for i in range(nfiles):
+        pq.write_table(pa.table({
+            "k": pa.array(rng.integers(0, 1 << 20, per)),
+            "v": pa.array(rng.integers(0, 1 << 30, per)),
+            "w": pa.array(rng.integers(0, 1 << 30, per)),
+        }), os.path.join(root, f"part-{i}.parquet"))
+
+    def q():
+        df = dt.read_parquet(os.path.join(root, "*.parquet"))
+        return df.repartition(nparts, col("k")).to_arrow()
+
+    def checksum(t: "pa.Table"):
+        arr = np.stack([t.column(c).to_numpy().astype(np.int64)
+                        for c in ("k", "v", "w")], axis=1)
+        arr = arr[np.lexsort(arr.T[::-1])]
+        return hashlib.sha256(np.ascontiguousarray(arr).tobytes()) \
+            .hexdigest()
+
+    runner = DistributedRunner(num_workers=4)
+    old = dctx.get_context()._runner
+    dctx.get_context().set_runner(runner)
+    try:
+        q()  # warm-up: compiles, server boot, page cache, trace cache
+        before = ss.shuffle_counters_snapshot()
+        t0 = time.time()
+        out = q()
+        elapsed = time.time() - t0
+        delta = ss.shuffle_counters_delta(before)
+    finally:
+        dctx.get_context().set_runner(old)
+        if runner._manager is not None:
+            runner._manager.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+    counters = {k: int(v) for k, v in sorted(delta.items())
+                if k in ("ici_bytes", "ici_rows", "ici_exchanges",
+                         "bytes_written", "bytes_fetched", "fetches",
+                         "streams_registered", "hierarchical_streams",
+                         "rows_pushed")
+                or k.startswith("exchange_path_")}
+    print(json.dumps({
+        "path": os.environ.get("DAFT_TPU_EXCHANGE_PATH", "auto"),
+        "rows": n,
+        "partitions": nparts,
+        "elapsed_s": round(elapsed, 4),
+        "rows_per_s": round(n / elapsed, 1),
+        "counters": counters,
+        "checksum": checksum(out),
+    }))
+
+
+def run_mesh_exchange_bench():
+    """``--shuffle`` family 2: the pod-native exchange ladder on a
+    simulated multi-device pod (8 virtual CPU devices). One identical
+    hash boundary (400k rows × 24 B into 8 partitions, 4 workers) runs
+    per rung in a cold child process:
+
+    - ``flight``       — per-worker map streams over the socket (today);
+    - ``collective``   — the boundary rides the mesh all_to_all, zero
+      Flight streams (admission forced so the virtual mesh is used);
+    - ``hierarchical`` — workers split across two simulated pods; each
+      pod exchanges intra-mesh and serves ONE stream per mesh.
+
+    The artifact carries rows/s per rung, bytes per LINK (ici vs wire),
+    stream counts (the hierarchical claim: streams == meshes, not
+    workers), and the bit-parity verdict from the row-set checksums."""
+    mesh_flags = "--xla_force_host_platform_device_count=8"
+
+    def child(path, extra):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": mesh_flags,
+               # one scan task per file: map tasks really shard across
+               # the 4 workers (flight registers one stream per task)
+               "DAFT_SCAN_TASKS_MIN_SIZE_BYTES": "1",
+               "DAFT_TPU_EXCHANGE_PATH": path, **extra}
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--mesh-exchange-child"],
+            capture_output=True, text=True, timeout=420, cwd=REPO,
+            env=env)
+        merged = _merge_lines(proc.stdout or "")
+        if merged is None:
+            raise RuntimeError(
+                f"mesh-exchange child ({path}) rc={proc.returncode}: "
+                f"{(proc.stderr or '')[-500:]}")
+        return merged
+
+    flight = child("flight", {"DAFT_TPU_DEVICE": "0"})
+    collective = child("collective", {"DAFT_TPU_DEVICE": "1",
+                                      "DAFT_TPU_MESH_MIN_ROWS": "0"})
+    hier = child("hierarchical", {
+        "DAFT_TPU_DEVICE": "1", "DAFT_TPU_MESH_MIN_ROWS": "0",
+        "DAFT_TPU_WORKER_TOPOLOGY":
+            "podA=worker-0,worker-1;podB=worker-2,worker-3"})
+    out = {"flight": flight, "collective": collective,
+           "hierarchical": hier}
+    out["parity"] = {
+        "collective": collective["checksum"] == flight["checksum"],
+        "hierarchical": hier["checksum"] == flight["checksum"]}
+    out["collective_speedup_vs_flight"] = round(
+        flight["elapsed_s"] / max(collective["elapsed_s"], 1e-9), 2)
+    out["hierarchical_speedup_vs_flight"] = round(
+        flight["elapsed_s"] / max(hier["elapsed_s"], 1e-9), 2)
+    # the stream-count claim: flight registers one stream per map task,
+    # hierarchical one per mesh
+    out["streams"] = {
+        "flight": flight["counters"].get("streams_registered", 0),
+        "hierarchical": hier["counters"].get("streams_registered", 0),
+        "meshes": 2}
+    # bytes per link: what rode ICI instead of the wire
+    out["bytes_per_link"] = {
+        "flight_wire": flight["counters"].get("bytes_written", 0),
+        "collective_ici": collective["counters"].get("ici_bytes", 0),
+        "collective_wire": collective["counters"].get("bytes_written", 0),
+        "hierarchical_ici": hier["counters"].get("ici_bytes", 0),
+        "hierarchical_wire": hier["counters"].get("bytes_written", 0)}
+    return out
+
+
 def run_scan_bench():
     """``--scan``: microbench of the scan-side IO plane against a
     latency-injected local HTTP object store (every request pays a fixed
@@ -1736,6 +1885,13 @@ def main():
         r = section("shuffle", run_shuffle_bench, min_needed=40.0)
         if r is not None:
             detail["shuffle_bench"] = r
+        # pod-native exchange ladder: flight vs collective vs hierarchical
+        # on the simulated 8-device pod (cold children), rows/s +
+        # bytes-per-link + stream counts + parity
+        r = section("mesh_exchange", run_mesh_exchange_bench,
+                    min_needed=60.0)
+        if r is not None:
+            detail["mesh_exchange_bench"] = r
 
     if "--scan" in sys.argv:
         # scan-side IO plane microbench: GET coalescing + parallel fetch +
@@ -1831,7 +1987,7 @@ def main():
 
     results_dir = os.path.join(REPO, "benchmarking", "results")
     os.makedirs(results_dir, exist_ok=True)
-    artifact = os.path.join(results_dir, "r17_bench_driver.json")
+    artifact = os.path.join(results_dir, "r18_bench_driver.json")
     with open(artifact, "w") as f:
         json.dump(full, f, indent=1)
     # progress/bulk lines first (NOT last): full detail for humans reading
@@ -1896,6 +2052,13 @@ def main():
             "wire_saved": sb.get("wire_bytes_saved_ratio"),
             "combine_x": sb["fast_path"].get("combine_reduction"),
             "fetch_speedup": sb.get("fetch_overlap", {}).get("speedup")}
+    me = detail.get("mesh_exchange_bench")
+    if isinstance(me, dict) and "error" not in me:
+        compact["mesh"] = {
+            "coll_x": me.get("collective_speedup_vs_flight"),
+            "hier_x": me.get("hierarchical_speedup_vs_flight"),
+            "parity": all(me.get("parity", {}).values()),
+            "hier_streams": me.get("streams", {}).get("hierarchical")}
     sc = detail.get("scan_bench")
     if isinstance(sc, dict) and "error" not in sc:
         compact["scan"] = {
@@ -1930,8 +2093,8 @@ def main():
     if errors:
         compact["n_errors"] = len(errors)
     # hard cap: drop optional keys until the line fits the driver's window
-    for drop in ("obs", "kernels", "serve", "scan", "shuffle", "chaos",
-                 "ledger_dispatches",
+    for drop in ("obs", "kernels", "serve", "scan", "shuffle", "mesh",
+                 "chaos", "ledger_dispatches",
                  "mfu", "families", "q1_winner", "backend"):
         if len(json.dumps(compact)) <= 1500:
             break
@@ -1946,6 +2109,8 @@ if __name__ == "__main__":
         _device_child()
     elif "--device-pipeline-child" in sys.argv:
         _device_pipeline_child()
+    elif "--mesh-exchange-child" in sys.argv:
+        _mesh_exchange_child()
     elif "--warmup-child" in sys.argv:
         _warmup_child()
     elif "--serve-smoke" in sys.argv:

@@ -82,9 +82,11 @@ _KNOBS: List[Knob] = [
     _k("DAFT_TPU_MESH_DEVICES", "int", None, "daft_tpu/parallel/mesh.py",
        "core", "caps the device-mesh axis length (default: all visible "
        "devices)", default_str="all"),
-    _k("DAFT_TPU_MESH_MIN_ROWS", "int", 64 * 1024, "daft_tpu/parallel/mesh.py",
-       "core", "row floor for mesh (multi-chip collective) execution "
-       "(64Ki); `0` forces the mesh path"),
+    _k("DAFT_TPU_MESH_MIN_ROWS", "int", None, "daft_tpu/parallel/mesh.py",
+       "core", "force-override for mesh (multi-chip collective) admission: "
+       "`0` forces the mesh path, `N` requires ≥N rows; unset lets the "
+       "cost model price the collective from the calibrated ICI link rate "
+       "(`DAFT_TPU_ICI_MBPS`)", default_str="cost model"),
     _k("DAFT_TPU_REAL_DEVICE", "bool", False, "tests/conftest.py",
        "core", "`1` runs the test suite against the real accelerator "
        "backend (no CPU forcing, no virtual mesh)"),
@@ -185,8 +187,27 @@ _KNOBS: List[Knob] = [
        "escape hatch"),
     _k("DAFT_TPU_SHUFFLE_WIRE_MBPS", "float", 1000.0,
        "daft_tpu/device/costmodel.py", "shuffle",
-       "wire bandwidth the combine cost model assumes (set to the pod's "
-       "real DCN number)"),
+       "wire bandwidth the combine and exchange-path cost models assume "
+       "(set to the pod's real DCN number)"),
+    _k("DAFT_TPU_ICI_MBPS", "float", None,
+       "daft_tpu/device/costmodel.py", "shuffle",
+       "override the measured intra-mesh (ICI) collective bandwidth "
+       "(MB/s) the mesh-admission and exchange-path cost models price "
+       "against", default_str="measured"),
+    _k("DAFT_TPU_WORKER_TOPOLOGY", "str", None,
+       "daft_tpu/distributed/topology.py", "shuffle",
+       "mesh-group spec `name=w0,w1;name2=w2` naming which workers share "
+       "a device mesh (pod/host); unset autodetects — all in-process "
+       "workers share the process mesh when one is up, else every worker "
+       "is its own group (Flight-only)",
+       config_field="tpu_worker_topology", default_str="autodetect"),
+    _k("DAFT_TPU_EXCHANGE_PATH", "str", "auto",
+       "daft_tpu/distributed/topology.py", "shuffle",
+       "hash-boundary exchange path: `collective` (intra-mesh ICI "
+       "all_to_all), `hierarchical` (intra-mesh collective + one Flight "
+       "stream per mesh), `flight` (per-worker streams), or `auto` "
+       "(topology + cost model decide; chaos serialize forces `flight`)",
+       config_field="tpu_exchange_path"),
     _k("DAFT_TPU_SHUFFLE_TIMEOUT", "float", 600.0,
        "daft_tpu/distributed/shuffle_service.py", "shuffle",
        "seconds a partition fetch may take before it fails as retryable"),

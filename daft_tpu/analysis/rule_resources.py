@@ -128,6 +128,15 @@ CONTRACTS: Tuple[Contract, ...] = (
              "use `with ThreadPoolExecutor(...)`, or store it on the "
              "owner that shuts it down",
     ),
+    Contract(
+        rule="collective-lease-leak", style="event", mode="all",
+        acquire=("acquire_collective",), release=("release_collective",),
+        defining=("daft_tpu/distributed/topology.py",),
+        hint="pair topology.acquire_collective(key) with "
+             "release_collective in try/finally — a leaked lease makes a "
+             "finished collective exchange group look forever in-flight "
+             "(the /metrics gauge) and shadows its group key",
+    ),
 )
 
 #: context installers that only uninstall via __exit__

@@ -80,6 +80,12 @@ class ExecutionConfig:
     tpu_io_range_parallelism: int = 8        # concurrent range GETs / source
     tpu_io_planned_reads: bool = True        # 0 → naive per-chunk ranged GETs
     tpu_scan_prefetch: int = 2               # ScanTasks resolved ahead
+    # pod-native shuffle (distributed/topology.py): which workers share a
+    # device mesh, and the hash-boundary exchange path. Field names spell
+    # the documented knobs (DAFT_TPU_WORKER_TOPOLOGY /
+    # DAFT_TPU_EXCHANGE_PATH); the env var is the per-process override.
+    tpu_worker_topology: str = ""            # "" → autodetect
+    tpu_exchange_path: str = "auto"          # collective|hierarchical|flight
     # serving plane (serving/scheduler.py); env spellings match the
     # documented serve knobs (DAFT_TPU_SERVE_CONCURRENCY, …)
     tpu_serve_concurrency: int = 4           # scheduler worker slots

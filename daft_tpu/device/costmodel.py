@@ -285,9 +285,11 @@ def link_profile() -> LinkProfile:
 
 
 def reset_for_tests() -> None:
-    global _profile
+    global _profile, _ici
     with _lock:
         _profile = None
+    with _ici_lock:
+        _ici = None
     decision_counts.clear()
     ledger_reset()
 
@@ -659,6 +661,139 @@ SHUFFLE_SER_BPS = 2.0e9   # arrow IPC write/read, per side, per byte
 def shuffle_wire_bps() -> float:
     from ..analysis import knobs
     return knobs.env_float("DAFT_TPU_SHUFFLE_WIRE_MBPS") * 1e6
+
+
+# ----------------------------------------------- ICI (mesh) link model
+# The third link tier: intra-mesh collective bandwidth (ICI on a pod,
+# shared memory on the virtual CPU mesh). MEASURED like the host↔device
+# link: one warm timed all_to_all repartition over the process mesh, once
+# per process, memoized — the effective rate includes the collective
+# kernel's own bucketing work, which is exactly what an exchanged byte
+# pays. DAFT_TPU_ICI_MBPS skips measurement (ops / tests / real pod
+# numbers); measurement failure falls back to a conservative constant.
+
+MESH_DISPATCH_S = 3e-3     # collective dispatch + amortized per-size-class
+#                            compile (programs are memoized per shape
+#                            bucket, so the trace cost spreads across
+#                            every same-class exchange)
+HOST_EXCHANGE_BPS = 6.0e8  # host hash-partition pass (hash + scatter),
+#                            per byte — between the vector and agg rates
+_ICI_FALLBACK_BPS = 2.0e9  # can't measure → assume a modest link
+_ICI_PROBE_ROWS = 1 << 14  # per-shard probe rows (i64 planes)
+
+_ici_lock = threading.Lock()
+_ici: Optional[float] = None
+
+
+def _measure_ici() -> float:
+    """MARGINAL collective-exchange bandwidth: two warm timed
+    ``sharded_hash_repartition`` probes (the very program the collective
+    exchange path dispatches) at 1× and 4× the probe size; the rate comes
+    from the byte and time DIFFERENCES, so the fixed dispatch overhead —
+    which ``MESH_DISPATCH_S`` models separately — doesn't masquerade as
+    link slowness (a single-size probe on the CPU mesh under-reported the
+    link ~10× because one small dispatch is overhead-dominated)."""
+    import jax
+
+    from ..parallel import exchange, mesh as pmesh
+    mesh = pmesh.get_mesh()
+    n = pmesh.mesh_size()
+    if mesh is None or n < 2:
+        raise RuntimeError("no multi-device mesh to calibrate against")
+
+    def timed(rows_per_shard: int):
+        total = n * rows_per_shard
+        plane = np.arange(total, dtype=np.int64)
+        valid = np.ones(total, dtype=bool)
+        pid = (np.arange(total) % n).astype(np.int32)
+
+        def run():
+            sb = lambda a: exchange.shard_blocks(mesh, a)
+            out = exchange.sharded_hash_repartition(
+                mesh, (sb(plane),), (sb(valid),), sb(valid), sb(pid))
+            jax.block_until_ready(out)
+
+        run()  # warm-up: compile + stage paid here, not in the timed pass
+        t0 = time.perf_counter()
+        run()
+        # full exchanged payload: value plane + valid + row mask + pid
+        return time.perf_counter() - t0, total * (8 + 1 + 1 + 4)
+
+    t1, b1 = timed(_ICI_PROBE_ROWS)
+    t2, b2 = timed(4 * _ICI_PROBE_ROWS)
+    if t2 > t1:
+        return (b2 - b1) / (t2 - t1)
+    return b2 / max(t2, 1e-7)  # noisy clock: effective rate of the big probe
+
+
+def ici_bps() -> float:
+    """The calibrated (or overridden) intra-mesh collective bandwidth,
+    bytes/s."""
+    global _ici
+    if _ici is not None:
+        return _ici
+    with _ici_lock:
+        if _ici is not None:
+            return _ici
+        from ..analysis import knobs
+        env = knobs.env_float("DAFT_TPU_ICI_MBPS", default=None)
+        if env is not None:
+            _ici = env * 1e6
+            return _ici
+        try:
+            # daft-lint: allow(blocking-under-lock) -- intentional: one
+            # calibration per process; concurrent deciders wait for it
+            # instead of racing duplicate mesh probes
+            _ici = _measure_ici()
+        except Exception:
+            _ici = _ICI_FALLBACK_BPS
+        return _ici
+
+
+def mesh_exchange_wins(rows: Optional[int], row_bytes: float = 32.0,
+                       n_shards: int = 2) -> bool:
+    """Admission for a LOCAL mesh collective (DeviceExchangeAgg, the
+    in-process hash repartition): price the collective — dispatch +
+    amortized compile + the bytes over the calibrated ICI rate — against
+    one host hash-partition pass over the same bytes. Replaces the static
+    64Ki-row gate, which measured rows and ignored row width: a 50k-row
+    200-byte-row exchange was wrongly declined while a 100k-row 8-byte
+    one was wrongly accepted on a slow mesh. Unknown ``rows`` keeps the
+    old optimistic behavior (the structural gates already vetted the
+    plan). ``DAFT_TPU_MESH_MIN_ROWS`` (when set) force-overrides in
+    ``parallel/mesh.py`` before this is consulted."""
+    if rows is None:
+        return True
+    if rows <= 0:
+        return False
+    nbytes = rows * max(row_bytes, 1.0)
+    host_s = nbytes / HOST_EXCHANGE_BPS
+    dev_s = MESH_DISPATCH_S + nbytes / ici_bps()
+    _log("mesh_exchange", dev_s < host_s, host_s, dev_s,
+         rows=rows, row_bytes=row_bytes, n_shards=n_shards)
+    return dev_s < host_s
+
+
+def exchange_collective_wins(rows: Optional[int],
+                             row_bytes: float = 32.0) -> bool:
+    """Price a DISTRIBUTED hash boundary's collective path against the
+    Flight wire: the collective pays one mesh dispatch plus the bytes
+    over ICI; the Flight trip pays IPC serialize + wire + deserialize per
+    byte. With no cardinality evidence the collective wins by default —
+    an intra-mesh boundary riding the wire is the pathology this decision
+    exists to stop, and the runtime admission gate
+    (``mesh.mesh_admits``) re-checks with exact rows before dispatching
+    the program. Logged under ``exchange_path`` ("device" = collective
+    family)."""
+    if not rows:
+        _log("exchange_path", True, 0.0, 0.0, rows=rows or 0)
+        return True
+    nbytes = rows * max(row_bytes, 1.0)
+    wire_s = nbytes * (2.0 / SHUFFLE_SER_BPS + 1.0 / shuffle_wire_bps())
+    coll_s = MESH_DISPATCH_S + nbytes / ici_bps()
+    _log("exchange_path", coll_s < wire_s, wire_s, coll_s,
+         rows=rows, row_bytes=row_bytes)
+    return coll_s < wire_s
 
 
 def shuffle_combine_wins(rows: Optional[int], groups: Optional[int],

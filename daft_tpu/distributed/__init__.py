@@ -9,6 +9,8 @@ between stages ride the mesh collectives or the driver's host exchange.
 """
 
 from .stages import Stage, StagePlan
+from .topology import (CollectiveExchangeGroup, MeshGroup, WorkerTopology,
+                       plan_exchange_path)
 from .worker import Worker, InProcessWorker, WorkerManager, StageTask
 from .resilience import (FaultPlan, RetryPolicy, ResilienceContext,
                          TaskSupervisor, InjectedFault, ShuffleFetchError,
@@ -20,4 +22,6 @@ __all__ = ["Stage", "StagePlan", "Worker", "InProcessWorker",
            "WorkerManager", "StageTask", "Scheduler", "RoundRobinScheduler",
            "LeastLoadedScheduler", "StageRunner", "FaultPlan", "RetryPolicy",
            "ResilienceContext", "TaskSupervisor", "InjectedFault",
-           "ShuffleFetchError", "FailFastError", "TaskTimeout"]
+           "ShuffleFetchError", "FailFastError", "TaskTimeout",
+           "WorkerTopology", "MeshGroup", "CollectiveExchangeGroup",
+           "plan_exchange_path"]

@@ -29,7 +29,11 @@ re-execution, not in the transport):
    translation of the old one, and redispatches the reduce task with
    translated fetch specs. Recovery composes recursively (a recomputed
    map task whose own inputs were cleaned up recovers them the same way),
-   depth-bounded.
+   depth-bounded. Collective (pod-native) exchange stages are
+   ALL-OR-NOTHING lineage units: a per-mesh stream's registered producer
+   is the whole exchange group (``topology.CollectiveExchangeGroup``),
+   so losing it re-executes every member map task plus the intra-mesh
+   collective as one unit — never a single map task.
 
 4. **Speculative execution** — when a task's runtime exceeds a multiple
    of the median of its completed siblings, the supervisor launches a
@@ -797,6 +801,19 @@ class TaskSupervisor:
                               attrs={"task": map_task.fault_key}):
                     child = TaskSupervisor(self.ctx, self.manager,
                                            self.scheduler)
+                    group = getattr(map_task, "group_tasks", None)
+                    if group is not None:
+                        # collective stage: ALL-OR-NOTHING. The lost
+                        # stream is one fused artifact of every member
+                        # map task plus the intra-mesh collective — no
+                        # per-map-task receipt exists to recover, so the
+                        # whole exchange group re-executes and the merged
+                        # receipt is rebuilt
+                        # (topology.CollectiveExchangeGroup)
+                        outs = child.run(list(group), speculate=False)
+                        receipt = map_task.rebuild(outs)
+                        count("collective_group_recoveries")
+                        return receipt
                     return child.run([map_task], speculate=False)[0]
             finally:
                 self.ctx.depth -= 1

@@ -18,6 +18,7 @@ from ..physical import plan as pp
 from .resilience import (FetchRetryState, ResilienceContext, RetryPolicy,
                          ShuffleFetchError, TaskSupervisor, count)
 from .stages import Boundary, Stage, StagePlan
+from .topology import WorkerTopology
 from .worker import (FetchSpec, ShuffleOutSpec, StageTask, WorkerManager,
                      WorkerState)
 
@@ -76,16 +77,28 @@ class LeastLoadedScheduler(Scheduler):
 class StageRunner:
     """Drives a StagePlan: dispatches each stage's tasks through the
     scheduler, feeds results downstream. Hash boundaries whose consumer
-    fragment is partition-local execute through the SHUFFLE SERVICE — map
-    tasks spill hash-partitioned output into their worker's cache, reduce
-    tasks fan out one-per-partition and fetch their slice from every map
-    worker (the reference's flight-shuffle map/serve/fetch pipeline);
-    every other boundary materializes through the driver. Failures route
+    fragment is partition-local are planned by the PLACEMENT LAYER
+    (``topology.WorkerTopology`` + the exchange-path decision ladder):
+
+    - ``collective`` — producer and consumer live on one device mesh;
+      the boundary repartitions through the ICI all_to_all programs
+      (``parallel/exchange.py``) and never touches the Flight wire;
+    - ``hierarchical`` — across meshes; each mesh's map outputs exchange
+      intra-mesh, then ONE Flight stream per mesh (not per worker)
+      crosses the wire; per-mesh streams are all-or-nothing lineage
+      units recomputed as whole exchange groups;
+    - ``flight`` — today's per-worker shuffle service: map tasks spill
+      hash-partitioned output into their worker's cache, reduce tasks
+      fan out one-per-partition and fetch their slice from every map
+      worker (the reference's flight-shuffle map/serve/fetch pipeline).
+
+    Every other boundary materializes through the driver. Failures route
     through the resilience plane (``resilience.py``): bounded retries
     with backoff on other workers, per-worker quarantine, lineage
     recomputation of lost shuffle partitions, and speculative backups
     for stragglers. ``DAFT_TPU_DISTRIBUTED_SHUFFLE=driver`` forces the
-    materializing path."""
+    materializing path; ``DAFT_TPU_CHAOS_SERIALIZE=1`` degrades every
+    boundary to the verbatim flight path for bit-identical replay."""
 
     def __init__(self, manager: WorkerManager,
                  scheduler: Optional[Scheduler] = None,
@@ -121,59 +134,100 @@ class StageRunner:
             for b in s.boundaries:
                 consumer[b.upstream] = (s, b)
         outputs: Dict[int, list] = {}
-        shuffled: Dict[int, bool] = {}
+        #: producer output mode per stage: "mat" (partition list),
+        #: "shuffled" (map receipts — per-worker OR per-mesh streams),
+        #: "collective" (per-partition lists from an intra-mesh exchange)
+        out_mode: Dict[int, str] = {}
         use_shuffle = self._shuffle_enabled()
+        topo = WorkerTopology.detect(self.manager.worker_ids) \
+            if use_shuffle else None
         for stage in stage_plan.stages:
-            # this stage's output mode: shuffle out when its consumer can
-            # fan out over the hash partitions
+            # this stage's output mode: the placement layer picks the
+            # exchange path for its consumer boundary (collective /
+            # hierarchical / flight), flight shuffles out when the
+            # consumer can fan out over the hash partitions
             shuffle_out = None
+            exch_path = None
             cons = consumer.get(stage.id)
             if use_shuffle and cons is not None:
                 cstage, b = cons
                 if b.num_partitions > 1 and b.kind == "hash" \
                         and all(ob.kind in ("hash", "gather")
-                                for ob in cstage.boundaries) \
-                        and (stage_plan.fanout_safe(cstage, b)
-                             or stage_plan.split_for_fanout(cstage, b)
-                             is not None):
-                    shuffle_out = ShuffleOutSpec(b.num_partitions,
-                                                 tuple(b.by))
-                    combo = self._plan_combine(stage_plan, cstage, b, stage)
-                    if combo is not None:
-                        shuffle_out.combine_aggs, \
-                            shuffle_out.combine_by = combo
+                                for ob in cstage.boundaries):
+                    inputs_mat = all(
+                        out_mode.get(ob.upstream, "mat") == "mat"
+                        for ob in stage.boundaries)
+                    if stage_plan.collective_safe(cstage, b):
+                        exch_path = self._plan_exchange_path(
+                            topo, stage, b, inputs_mat)
+                    if exch_path in (None, "flight") and (
+                            stage_plan.fanout_safe(cstage, b)
+                            or stage_plan.split_for_fanout(cstage, b)
+                            is not None):
+                        exch_path = "flight"
+                        shuffle_out = ShuffleOutSpec(b.num_partitions,
+                                                     tuple(b.by))
+                        combo = self._plan_combine(stage_plan, cstage, b,
+                                                   stage)
+                        if combo is not None:
+                            shuffle_out.combine_aggs, \
+                                shuffle_out.combine_by = combo
             fetch_srcs: Dict[int, list] = {}
             fetch_n: Dict[int, int] = {}
+            coll_inputs: Dict[int, list] = {}
             mat_inputs: Dict[int, List[MicroPartition]] = {}
-            first_shuffled: Optional[Boundary] = None
+            first_exchanged: Optional[Boundary] = None
             for b in stage.boundaries:
                 up_out = outputs.pop(b.upstream)
-                if shuffled.get(b.upstream):
+                mode = out_mode.get(b.upstream, "mat")
+                if mode == "shuffled":
                     fetch_srcs[b.upstream] = [(r.address, r.shuffle_id)
                                               for r in up_out]
                     fetch_n[b.upstream] = b.num_partitions
-                    first_shuffled = first_shuffled or b
+                    first_exchanged = first_exchanged or b
+                elif mode == "collective":
+                    coll_inputs[b.upstream] = up_out
+                    first_exchanged = first_exchanged or b
                 else:
                     mat_inputs[b.upstream] = self._apply_exchange(b, up_out)
-            if fetch_srcs:
-                if len(set(fetch_n.values())) > 1:
+            if exch_path == "hierarchical":
+                # two-level exchange replaces the stage run entirely: the
+                # producer's map tasks execute per mesh group, each
+                # group's output repartitions intra-mesh and serves as
+                # ONE stream (decision gated on all-materialized inputs)
+                outputs[stage.id] = self._run_hierarchical_producer(
+                    stage, mat_inputs, cons[1], topo)
+                out_mode[stage.id] = "shuffled"
+                continue
+            if fetch_srcs or coll_inputs:
+                ns = set(fetch_n.values()) \
+                    | {len(pl) for pl in coll_inputs.values()}
+                if len(ns) > 1:
                     # boundaries disagree on partition count — no shared
                     # fan-out exists; materialize driver-side instead
                     for up, srcs in fetch_srcs.items():
                         mat_inputs[up] = self._driver_fetch_resilient(
                             srcs, fetch_n[up], up)
-                    outputs[stage.id] = self._run_stage(stage, mat_inputs,
-                                                        shuffle_out)
+                    for up, plists in coll_inputs.items():
+                        mat_inputs[up] = [p for pl in plists for p in pl]
+                    result = self._run_stage(stage, mat_inputs,
+                                             shuffle_out)
                 else:
-                    outputs[stage.id] = self._run_shuffled_stage(
-                        stage_plan, stage, fetch_srcs, mat_inputs,
-                        next(iter(fetch_n.values())), first_shuffled,
+                    result = self._run_shuffled_stage(
+                        stage_plan, stage, fetch_srcs, coll_inputs,
+                        mat_inputs, next(iter(ns)), first_exchanged,
                         shuffle_out)
                 self._cleanup_shuffles(fetch_srcs)
             else:
-                outputs[stage.id] = self._run_stage(stage, mat_inputs,
-                                                    shuffle_out)
-            shuffled[stage.id] = shuffle_out is not None
+                result = self._run_stage(stage, mat_inputs, shuffle_out)
+            if exch_path == "collective":
+                outputs[stage.id] = self._collective_repartition(
+                    stage, result, cons[1])
+                out_mode[stage.id] = "collective"
+            else:
+                outputs[stage.id] = result
+                out_mode[stage.id] = "shuffled" \
+                    if shuffle_out is not None else "mat"
         yield from outputs[stage_plan.root.id]
 
     def _plan_combine(self, stage_plan: StagePlan, cstage: Stage,
@@ -203,6 +257,203 @@ class StageRunner:
                     n_cols=len(combine_aggs) + len(combine_by)):
                 return None
         return combine_aggs, combine_by
+
+    # ---------------------------------------- pod-native exchange paths
+    def _plan_exchange_path(self, topo: WorkerTopology, stage: Stage,
+                            b: Boundary, inputs_mat: bool) -> str:
+        """Placement decision for one structurally-eligible hash
+        boundary (consumer whole-stage fanout-safe): collective /
+        hierarchical / flight per the topology decision ladder
+        (``topology.plan_exchange_path``). Hierarchical additionally
+        requires the producer's own inputs to be driver-materialized —
+        its map tasks re-dispatch per mesh group, which the shuffled
+        input bindings don't survive. Every decision is counted in the
+        shuffle data plane (``exchange_path_*``)."""
+        from . import topology as tp
+        from .shuffle_service import shuffle_count
+        path = tp.plan_exchange_path(topo, b.num_partitions)
+        if path == "hierarchical" and not inputs_mat:
+            path = "flight"
+        shuffle_count(f"exchange_path_{path}")
+        return path
+
+    def _collective_repartition(self, stage: Stage, parts: list,
+                                b: Boundary) -> list:
+        """Execute one hash boundary as an intra-mesh collective: the
+        stage's output repartitions through the device mesh
+        (``sharded_hash_repartition`` — memoized, shape-bucketed) with a
+        host hash fanout as the admission fallback, and NEVER touches
+        the Flight wire. Returns per-partition partition lists the
+        consumer's reduce tasks bind directly."""
+        from . import topology as tp
+        from .. import tracing
+        key = stage.task_key(0, "cx")
+        lease = tp.acquire_collective(key)
+        try:
+            with tracing.span("exchange:collective",
+                              key=f"exchange:{key}",
+                              attrs={"partitions": b.num_partitions},
+                              lane="shuffle") as sp:
+                return self._intra_mesh_repartition(
+                    parts, list(b.by), b.num_partitions, sp)
+        finally:
+            tp.release_collective(lease)
+
+    def _intra_mesh_repartition(self, parts: list, by: list, n: int,
+                                sp=None) -> list:
+        """One hash repartition that stays inside the mesh: the ICI
+        collective program when the admission gate prices it in
+        (``mesh.mesh_admits`` over the exact bytes), else a host hash
+        fanout of the same pid chain — both agree with
+        ``partition_by_hash``, so every path is bit-co-partitioned.
+        → n bucket lists."""
+        from ..execution.executor import LocalExecutor
+        parts = [p for p in parts if len(p)]
+        rows = sum(len(p) for p in parts)
+        mesh_out = None
+        if parts:
+            try:
+                mesh_out = LocalExecutor()._mesh_hash_repartition(
+                    list(parts), list(by), n)
+            except Exception:
+                mesh_out = None  # host fallback below is always sound
+        if sp is not None:
+            from ..device import costmodel
+            sp.set("rows", rows)
+            sp.set("bytes", sum(p.size_bytes() for p in parts))
+            sp.set("ici", mesh_out is not None)
+            if mesh_out is not None:
+                sp.set("ici_bps", int(costmodel.ici_bps()))
+        if mesh_out is not None:
+            return [[p] for p in mesh_out]
+        buckets: List[list] = [[] for _ in range(n)]
+        for mp in parts:
+            for i, piece in enumerate(mp.partition_by_hash(list(by), n)):
+                if len(piece):
+                    buckets[i].append(piece)
+        # one combined morsel per bucket — the binding a reduce task
+        # receives must look exactly like a fetched+concatenated flight
+        # partition (a multi-piece binding would execute the consumer
+        # fragment per piece, not per partition)
+        return [[b0[0].concat(b0[1:])] if len(b0) > 1 else b0
+                for b0 in buckets]
+
+    def _run_hierarchical_producer(self, stage: Stage,
+                                   stage_inputs: Dict[int, list],
+                                   b: Boundary, topo: WorkerTopology
+                                   ) -> list:
+        """Two-level hierarchical exchange, map side: the stage's tasks
+        split across mesh groups; each group's outputs repartition
+        intra-mesh (the collective leg) and register as ONE shuffle
+        stream per mesh — the wire carries one stream per mesh instead
+        of one per worker. Each per-mesh stream is an ALL-OR-NOTHING
+        lineage unit: its producer is the whole exchange group
+        (``topology.CollectiveExchangeGroup``), so losing the stream
+        recomputes every member map task plus the collective, never one
+        map task."""
+        import concurrent.futures as cf
+        import dataclasses as dc
+
+        from . import topology as tp
+        from .. import tracing
+        from .resilience import active_fault_plan
+        from .shuffle_service import shuffle_count
+        tasks = self._make_tasks(stage, stage_inputs, None)
+        groups = topo.groups
+        lineage = self._resilience().lineage
+        work = []  # (gi, group, its tasks) — deterministic split
+        for gi, g in enumerate(groups):
+            # round-robin tasks over groups; WITHIN a group spread over
+            # its workers by group-local position (indexing by the raw
+            # task_idx would alias with the group split whenever g.size
+            # divides the group count, pinning a whole mesh to one
+            # worker)
+            gtasks = [dc.replace(
+                t, preferred_worker=g.workers[
+                    (t.task_idx // len(groups)) % g.size])
+                for t in tasks if t.task_idx % len(groups) == gi]
+            if gtasks:
+                work.append((gi, g, gtasks))
+        # meshes exchange CONCURRENTLY (the flight path dispatches every
+        # map task at once — serializing per mesh would cost sum-of-mesh
+        # walls instead of the max); fault-plan runs stay sequential so
+        # injected-fault attempt counters advance in one total order
+        if len(work) > 1 and active_fault_plan() is None:
+            tctx = tracing.current()
+            with cf.ThreadPoolExecutor(
+                    max_workers=len(work),
+                    thread_name_prefix="daft-tpu-meshgrp") as pool:
+                futs = [pool.submit(tracing.run_attached, tctx,
+                                    self._run_one_mesh_group, stage, b,
+                                    gi, g, gtasks)
+                        for gi, g, gtasks in work]
+                done = [f.result() for f in futs]  # group order
+        else:
+            done = [self._run_one_mesh_group(stage, b, gi, g, gtasks)
+                    for gi, g, gtasks in work]
+        receipts = []
+        for (gi, g, gtasks), (receipt, rebuild) in zip(work, done):
+            lineage.register(receipt, tp.CollectiveExchangeGroup(
+                fault_key=stage.task_key(gi, "g"),
+                group_tasks=list(gtasks), rebuild=rebuild))
+            receipts.append(receipt)
+        shuffle_count("hierarchical_streams", len(receipts))
+        return receipts
+
+    def _run_one_mesh_group(self, stage: Stage, b: Boundary, gi: int,
+                            g, gtasks: list):
+        """Run ONE mesh group's map tasks and build its merged per-mesh
+        stream → (receipt, rebuild). The group lease spans the whole
+        exchange; the rebuild closure is the lineage recovery recipe."""
+        from . import topology as tp
+        from .. import tracing
+        gkey = stage.task_key(gi, "g")
+        rebuild = self._group_receipt_builder(b, gkey)
+        lease = tp.acquire_collective(gkey)
+        try:
+            with tracing.span("exchange:collective",
+                              key=f"exchange:{gkey}",
+                              attrs={"mesh": g.name,
+                                     "tasks": len(gtasks),
+                                     "partitions": b.num_partitions},
+                              lane="shuffle"):
+                outs = self._collect(gtasks)
+                return rebuild(outs), rebuild
+        finally:
+            tp.release_collective(lease)
+
+    def _group_receipt_builder(self, b: Boundary, gkey: str):
+        """→ rebuild(task outputs) → per-mesh ShuffleResult. A closure so
+        lineage recovery re-derives the receipt the same deterministic
+        way the first run did (same boundary keys, same partition
+        count)."""
+        by = list(b.by)
+        n = b.num_partitions
+
+        def rebuild(outs: list):
+            from .shuffle_service import (ShuffleCache,
+                                          get_local_shuffle_server)
+            from .worker import ShuffleResult
+            parts: List[MicroPartition] = []
+            for res in outs:
+                parts.extend(res if isinstance(res, list) else [res])
+            buckets = self._intra_mesh_repartition(parts, by, n)
+            cache = ShuffleCache()
+            rows = 0
+            try:
+                for i, plist in enumerate(buckets):
+                    for p in plist:
+                        rows += len(p)
+                        cache.push(i, p.combined().to_arrow_table())
+                server = get_local_shuffle_server()
+                server.register(cache)
+            except BaseException:
+                cache.cleanup()
+                raise
+            return ShuffleResult(server.address, cache.shuffle_id, n,
+                                 rows)
+
+        return rebuild
 
     def _cleanup_shuffles(self, fetch_srcs: Dict[int, list]) -> None:
         """Best-effort release of consumed map outputs when the consuming
@@ -256,13 +507,15 @@ class StageRunner:
 
     def _run_shuffled_stage(self, stage_plan: StagePlan, stage: Stage,
                             fetch_srcs: Dict[int, list],
+                            coll_inputs: Dict[int, list],
                             mat_inputs: Dict[int, List[MicroPartition]],
                             n: int, b: Boundary,
                             shuffle_out: Optional[ShuffleOutSpec]) -> list:
-        """Stage with shuffle-backed inputs: fan the whole fragment out
-        when it is partition-local; otherwise fan out its safe frontier
-        (e.g. the merge-agg under a Sort) and run the global remainder as
-        one task; if neither applies, fetch partitions onto the driver."""
+        """Stage with shuffle- or collective-backed inputs: fan the whole
+        fragment out when it is partition-local; otherwise fan out its
+        safe frontier (e.g. the merge-agg under a Sort) and run the
+        global remainder as one task; if neither applies, fetch
+        partitions onto the driver."""
         # replicating a driver-materialized input to every reduce task is
         # only sound for GATHER boundaries (broadcast-by-design, join-type
         # gated at translate time). A materialized hash/range/split input
@@ -271,11 +524,22 @@ class StageRunner:
         replication_ok = all(
             ob.kind == "gather" for ob in stage.boundaries
             if ob.upstream in mat_inputs)
+        exchanged = set(fetch_srcs) | set(coll_inputs)
         if replication_ok and stage_plan.fanout_safe(stage, b) and all(
                 stage_plan.fanout_safe(stage, ob)
-                for ob in stage.boundaries if ob.upstream in fetch_srcs):
+                for ob in stage.boundaries if ob.upstream in exchanged):
             return self._run_reduce_fanout(stage, fetch_srcs, mat_inputs,
-                                           n, shuffle_out)
+                                           n, shuffle_out, coll_inputs)
+        if coll_inputs:
+            # defensive: a collective input reaching a fanout-unsafe
+            # consumer materializes EVERYTHING driver-side — a
+            # hash-partitioned input must never replicate beside a
+            # partitioned sibling (same rule as mat hash inputs above)
+            for up, plists in coll_inputs.items():
+                mat_inputs[up] = [p for pl in plists for p in pl]
+            for up, srcs in fetch_srcs.items():
+                mat_inputs[up] = self._driver_fetch_resilient(srcs, n, up)
+            return self._run_stage(stage, mat_inputs, shuffle_out)
         split = stage_plan.split_for_fanout(stage, b) if replication_ok \
             else None
         if split is not None:
@@ -429,13 +693,16 @@ class StageRunner:
 
     def _run_reduce_fanout(self, stage: Stage, fetch_srcs: Dict[int, list],
                            mat_inputs: Dict[int, List[MicroPartition]],
-                           n: int, shuffle_out: Optional[ShuffleOutSpec]
+                           n: int, shuffle_out: Optional[ShuffleOutSpec],
+                           coll_inputs: Optional[Dict[int, list]] = None
                            ) -> list:
         """One reduce task per hash partition: task i binds each shuffled
-        input to FetchSpec(partition=i); driver-materialized bindings
-        (broadcast/gather sides) replicate to every task. Fetch sources
-        carry stable ``s<upstream>.m<map_idx>`` keys so injected faults
-        replay identically across runs (the shuffle uuid does not)."""
+        input to FetchSpec(partition=i) and each collective input to its
+        already-exchanged partition-i bucket; driver-materialized
+        bindings (broadcast/gather sides) replicate to every task. Fetch
+        sources carry stable ``s<upstream>.m<map_idx>`` keys so injected
+        faults replay identically across runs (the shuffle uuid does
+        not)."""
         tasks = []
         for i in range(n):
             si: Dict[int, object] = {
@@ -443,6 +710,8 @@ class StageRunner:
                               keys=[f"s{up}.m{j}"
                                     for j in range(len(srcs))])
                 for up, srcs in fetch_srcs.items()}
+            for up, plists in (coll_inputs or {}).items():
+                si[up] = list(plists[i])
             si.update(mat_inputs)
             tasks.append(StageTask(stage.id, stage.plan, si, task_idx=i,
                                    shuffle_out=shuffle_out,

@@ -337,6 +337,10 @@ class ShuffleServer:
         cache.close()  # seal files before serving
         with self._lock:
             self._caches[cache.shuffle_id] = cache
+        # one served stream source per registered map output — the
+        # stream-count evidence behind the hierarchical exchange (one
+        # stream per MESH instead of one per worker)
+        shuffle_count("streams_registered")
 
     def unregister(self, shuffle_id: str) -> None:
         with self._lock:
@@ -437,6 +441,9 @@ class FlightShuffleServer:
         cache.close()  # seal files before serving
         with self._lock:
             self._caches[cache.shuffle_id] = cache
+        # stream-count evidence, same as the HTTP server (hierarchical
+        # exchanges register one stream per mesh, flight one per worker)
+        shuffle_count("streams_registered")
 
     def unregister(self, shuffle_id: str) -> None:
         with self._lock:

@@ -198,6 +198,21 @@ class StagePlan:
             return False
         return self._subtree_safe(stage.plan, b)
 
+    def collective_safe(self, stage: Stage, b: Boundary) -> bool:
+        """Structural eligibility of one hash boundary for the
+        collective / hierarchical exchange family (the placement layer's
+        precondition, topology- and cost-blind): the consumer fragment
+        must be partition-local END TO END over this boundary — a
+        collective exchange hands each reduce task one already-exchanged
+        bucket, so there is no safe-frontier split to fall back on — and
+        every sibling input must be hash (co-partitioned: the mesh pid
+        chain and ``partition_by_hash`` agree by construction) or gather
+        (replicated)."""
+        return (b.kind == "hash" and b.num_partitions > 1
+                and all(ob.kind in ("hash", "gather")
+                        for ob in stage.boundaries)
+                and self.fanout_safe(stage, b))
+
     def split_for_fanout(self, stage: Stage, b: Boundary):
         """Cut the consumer fragment at its SAFE FRONTIER: the highest node
         on the StageInput's path whose subtree is partition-local. →

@@ -960,7 +960,10 @@ class LocalExecutor:
         out_names = [s[2] for s in specs]
         n = pmesh.mesh_size()
         total = len(rb)
-        C = (total + n - 1) // n
+        # per-shard capacity padded to a size class so literal-different
+        # row counts re-enter the memoized collective program instead of
+        # tracing one program per row count (the r16 retrace budget)
+        C = dcol.bucket_capacity((total + n - 1) // n)
         cap = n * C
 
         encode = _np_plane_encoder(rb, cap)
@@ -981,6 +984,8 @@ class LocalExecutor:
             host = jax.device_get((fk, fkv, fv, fvv, gmask))
         except Exception:
             return None
+        _count_ici_exchange(total, list(keys) + list(vals),
+                            list(kvalids) + list(vvalids))
         fk, fkv, fv, fvv, gmask = [
             [np.asarray(a) for a in grp] if isinstance(grp, (list, tuple))
             else np.asarray(grp) for grp in host]
@@ -1007,10 +1012,12 @@ class LocalExecutor:
         mesh = pmesh.get_mesh()
         rb = RecordBatch.concat([p.combined() for p in parts]) \
             if len(parts) > 1 else parts[0].combined()
-        # tiny repartitions don't repay the collective program's per-shape
-        # compile + dispatch against the host fanout (same admission rule
-        # as the mesh exchange agg; DAFT_TPU_MESH_MIN_ROWS=0 forces)
-        if len(rb) < pmesh.mesh_min_rows():
+        # tiny repartitions don't repay the collective program's dispatch
+        # against the host fanout: the cost model prices the exact bytes
+        # against the calibrated ICI rate (DAFT_TPU_MESH_MIN_ROWS
+        # force-overrides; =0 forces the mesh)
+        if not pmesh.mesh_admits(
+                len(rb), rb.size_bytes() / max(len(rb), 1)):
             return None
         schema = rb.schema
         # pure data movement must be bit-exact: every column must round-trip
@@ -1026,7 +1033,9 @@ class LocalExecutor:
             return [MicroPartition.from_recordbatch(RecordBatch.empty(schema))
                     for _ in range(n)]
         total = len(rb)
-        C = (total + n - 1) // n
+        # size-class padded per-shard capacity: one collective program per
+        # bucket, not per literal row count (r16 retrace discipline)
+        C = dcol.bucket_capacity((total + n - 1) // n)
         cap = n * C
         # destination shard from the SAME xxh64 chain as the host exchange
         # (partition_by_hash) so co-partitioned joins agree across tiers
@@ -1055,6 +1064,7 @@ class LocalExecutor:
             host = jax.device_get((op, ov, om))
         except Exception:
             return None
+        _count_ici_exchange(total, planes, valids)
         op, ov, om = [[np.asarray(a) for a in grp]
                       if isinstance(grp, (list, tuple)) else np.asarray(grp)
                       for grp in host]
@@ -1689,6 +1699,22 @@ def _fragment_groups_affordable(node, src) -> bool:
 def _lit_true() -> Expression:
     from ..expressions.expressions import lit
     return lit(True)
+
+
+def _count_ici_exchange(rows: int, planes, valids) -> None:
+    """Account one completed mesh collective exchange in the shuffle
+    data plane: bytes that rode ICI instead of the Flight wire (the
+    encoded plane payload entering the all_to_all) — surfaced per query
+    in ``explain(analyze=True)`` and at ``/metrics``."""
+    try:
+        from ..distributed.shuffle_service import shuffle_count
+        nbytes = sum(int(p.nbytes) for p in planes) \
+            + sum(int(v.nbytes) for v in valids)
+        shuffle_count("ici_exchanges")
+        shuffle_count("ici_rows", rows)
+        shuffle_count("ici_bytes", nbytes)
+    except Exception:
+        pass  # accounting must never take the exchange down
 
 
 def _encode_plane_lists(encode, names):

@@ -151,6 +151,18 @@ def _scan_io_raw() -> Dict[str, float]:
         return {}
 
 
+def _exchange_raw() -> Dict[str, float]:
+    """Raw snapshot of the collective-exchange program-cache counters
+    (hit/miss/uncacheable traces of the memoized mesh programs,
+    ``parallel/exchange.py``) — never raises, like the device ledger."""
+    try:
+        from .parallel import exchange
+        c = exchange.exchange_cache_counters()
+        return {k: float(v) for k, v in c.items() if k != "entries"}
+    except Exception:
+        return {}
+
+
 def _sanitizer_raw() -> Dict[str, float]:
     """Raw snapshot of the lock-order sanitizer counters (acquisitions,
     contended acquisitions, blocking-while-held events) — empty unless
@@ -282,6 +294,11 @@ class RuntimeStatsContext:
         # bytes fetched vs used, prefetch overlap)
         self._io0 = _scan_io_raw()
         self.io: Dict[str, float] = {}
+        # …and the collective-exchange program cache (hit/miss/
+        # uncacheable): the evidence that same-shape mesh exchanges
+        # re-enter one trace instead of re-tracing per call
+        self._exchange0 = _exchange_raw()
+        self.exchange: Dict[str, float] = {}
         # …and for the lock-order sanitizer (DAFT_TPU_SANITIZE=1):
         # per-query acquisition/contention deltas + current graph size
         self._sanitizer0 = _sanitizer_raw()
@@ -380,6 +397,13 @@ class RuntimeStatsContext:
                     self._io0, _scan_io_raw())
             except Exception:
                 self.io = {}
+        # process-wide diff regardless of attribution: the program cache
+        # is shared engine state (like the sanitizers), not per-thread
+        # traffic — concurrent queries legitimately share its hits
+        after_ex = _exchange_raw()
+        self.exchange = {k: v - self._exchange0.get(k, 0)
+                         for k, v in after_ex.items()
+                         if v - self._exchange0.get(k, 0)}
         try:
             from .analysis import lock_sanitizer
             self.sanitizer = lock_sanitizer.counters_delta(
@@ -482,6 +506,7 @@ class RuntimeStatsContext:
             for k, v in sorted(self.recovery.items()):
                 lines.append(f"  {k}: {v}")
         lines.extend(render_shuffle_block(self.shuffle))
+        lines.extend(render_exchange_block(self.exchange))
         lines.extend(render_io_block(self.io))
         lines.extend(render_sanitizer_block(self.sanitizer))
         lines.extend(render_retrace_block(self.retrace))
@@ -547,6 +572,34 @@ def render_shuffle_block(sh: Dict[str, float]) -> List[str]:
                   f", serial {serial:.3f}s"
         lines.append(f"  fetched: {_fmt_bytes(fetched)} in "
                      f"{int(sh.get('fetches', 0))} fetches{overlap}")
+    paths = {p: int(sh.get(f"exchange_path_{p}", 0))
+             for p in ("collective", "hierarchical", "flight")}
+    if any(paths.values()):
+        lines.append("  exchange paths: " + ", ".join(
+            f"{p}={n}" for p, n in paths.items() if n))
+    if sh.get("ici_exchanges"):
+        lines.append(
+            f"  ici: {_fmt_bytes(sh.get('ici_bytes', 0))} in "
+            f"{int(sh.get('ici_exchanges', 0))} collective exchanges "
+            f"({int(sh.get('ici_rows', 0))} rows over the mesh, "
+            f"not the wire)")
+    if sh.get("hierarchical_streams"):
+        lines.append(f"  hierarchical: "
+                     f"{int(sh.get('hierarchical_streams', 0))} "
+                     f"per-mesh stream(s)")
+    return lines
+
+
+def render_exchange_block(ex: Dict[str, float]) -> List[str]:
+    """Human lines for one query's collective-exchange program-cache
+    delta (shared by ``explain(analyze=True)`` and the dashboard): the
+    evidence that repeated same-shape mesh exchanges re-entered one
+    memoized trace instead of re-tracing per call."""
+    if not ex:
+        return []
+    lines = ["exchange programs (collective cache):"]
+    lines.append("  " + ", ".join(
+        f"{k}={int(v)}" for k, v in sorted(ex.items())))
     return lines
 
 
@@ -831,8 +884,8 @@ def flight_entry(ctx: RuntimeStatsContext) -> dict:
                      and wall_us / 1e3 > slow_ms),
         "operators": ctx.as_dict(),
     }
-    for block in ("recovery", "shuffle", "io", "device_kernels",
-                  "serving", "sanitizer", "retrace"):
+    for block in ("recovery", "shuffle", "exchange", "io",
+                  "device_kernels", "serving", "sanitizer", "retrace"):
         v = getattr(ctx, block, None)
         if v:
             entry[block] = dict(v)

@@ -41,10 +41,11 @@ def mesh_size() -> int:
         return n
 
 
-#: below this many rows a mesh collective (exchange agg, hash
-#: repartition) is not worth its per-shape compile + collective dispatch
-#: against the host path; ``DAFT_TPU_MESH_MIN_ROWS=0`` forces the mesh
-#: (the knob the mesh-correctness tests and the multichip dryrun set)
+#: legacy static admission floor, now only the FALLBACK when the cost
+#: model cannot price a collective (no calibrated rates at all);
+#: ``DAFT_TPU_MESH_MIN_ROWS`` (when set) force-overrides the cost model
+#: entirely — ``0`` forces the mesh (the knob the mesh-correctness tests
+#: and the multichip dryrun set), ``N`` requires at least N rows
 _MESH_MIN_ROWS = 65536
 
 
@@ -52,6 +53,27 @@ def mesh_min_rows() -> int:
     from ..analysis import knobs
     v = knobs.env_int("DAFT_TPU_MESH_MIN_ROWS", default=None)
     return v if v is not None else _MESH_MIN_ROWS
+
+
+def mesh_admits(rows: Optional[int], row_bytes: float = 32.0) -> bool:
+    """Admission for a mesh collective (exchange agg, hash repartition).
+
+    ``DAFT_TPU_MESH_MIN_ROWS`` set → force-override: the static row floor
+    decides exactly as before (``0`` forces the mesh). Unset → the cost
+    model prices the collective (dispatch + amortized compile + bytes
+    over the calibrated ICI rate, ``costmodel.ici_bps``) against one
+    host hash-partition pass — so tiny aggs stop paying collective
+    compile+dispatch while medium, wide-row ones stop being wrongly
+    declined by a width-blind row count."""
+    from ..analysis import knobs
+    v = knobs.env_int("DAFT_TPU_MESH_MIN_ROWS", default=None)
+    if v is not None:
+        return rows is None or rows >= v
+    try:
+        from ..device import costmodel
+        return costmodel.mesh_exchange_wins(rows, row_bytes, mesh_size())
+    except Exception:
+        return rows is None or rows >= _MESH_MIN_ROWS
 
 
 def get_mesh():

@@ -315,7 +315,12 @@ def _try_mesh_exchange_agg(p1, final_aggs, gb2, f_schema: Schema,
         return None  # global aggs gather a handful of scalars — host wins
     if not drt.device_enabled() or pmesh.mesh_size() < 2:
         return None
-    if est_rows is not None and est_rows < pmesh.mesh_min_rows():
+    # admission is priced, not thresholded: the cost model compares the
+    # collective (dispatch + bytes over the calibrated ICI rate) against
+    # a host exchange pass over the estimated bytes; DAFT_TPU_MESH_MIN_ROWS
+    # (when set) force-overrides with the old static row floor
+    row_bytes = 8.0 * max(len(gb2) + len(final_aggs), 1)
+    if not pmesh.mesh_admits(est_rows, row_bytes):
         return None
     def _exchangeable(dtype) -> bool:
         # bit-exact round trip, or string/binary riding shared dictionary

@@ -633,6 +633,22 @@ def prometheus_text() -> str:
     except Exception:
         pass
     try:
+        from .parallel import exchange
+        ex = exchange.exchange_cache_counters()
+        emit("daft_tpu_exchange_programs", ex.pop("entries", 0), "gauge",
+             "memoized collective exchange programs resident")
+        plane("exchange", ex,
+              "collective exchange program-cache counter")
+    except Exception:
+        pass
+    try:
+        from .distributed import topology
+        emit("daft_tpu_exchange_collective_inflight",
+             topology.collective_inflight(), "gauge",
+             "collective exchange groups currently in flight")
+    except Exception:
+        pass
+    try:
         from . import observability as obs
         plane("obs", obs.obs_counters_snapshot(),
               "observability export counter")

@@ -814,6 +814,30 @@ def test_shuffle_cache_leak_on_drain_failure_flagged(tmp_path):
     assert "shuffle-cache-leak" in _rules_of(rule_resources.check(srcs))
 
 
+def test_collective_lease_leak_flagged(tmp_path):
+    # the exchange body can raise — the lease must release on that path
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def run(stage):\n"
+        "    lease = topology.acquire_collective(stage)\n"
+        "    do_exchange(stage)\n"
+        "    topology.release_collective(lease)\n")
+    assert "collective-lease-leak" in _rules_of(rule_resources.check(srcs))
+
+
+def test_collective_lease_finally_release_clean(tmp_path):
+    srcs = _sources_from(
+        tmp_path, "daft_tpu/foo.py",
+        "def run(stage):\n"
+        "    lease = topology.acquire_collective(stage)\n"
+        "    try:\n"
+        "        do_exchange(stage)\n"
+        "    finally:\n"
+        "        topology.release_collective(lease)\n")
+    assert "collective-lease-leak" not in _rules_of(
+        rule_resources.check(srcs))
+
+
 def test_device_slot_transfer_or_release_is_clean(tmp_path):
     # the r17 pipeline submit shape: release on every decline/error
     # path, hand the slot off whole (InflightItem) on success

@@ -1,0 +1,317 @@
+"""Pod-native hierarchical shuffle: the topology-aware exchange planner.
+
+Bit-parity of the three exchange paths (collective / hierarchical /
+flight) on identical data over grouped-agg and hash-join boundaries,
+the ``DAFT_TPU_CHAOS_SERIALIZE=1`` degradation to the verbatim Flight
+path, and the ALL-OR-NOTHING lineage recovery of a collective exchange
+group when one participant's served stream dies
+(``distributed/topology.py`` + the StageRunner placement layer).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import daft_tpu
+import daft_tpu.context as dctx
+from daft_tpu import col
+from daft_tpu.context import execution_config_ctx
+from daft_tpu.distributed import resilience as rz
+from daft_tpu.distributed import shuffle_service as ss
+from daft_tpu.distributed import topology as tp
+from daft_tpu.runners.distributed_runner import DistributedRunner
+
+PATH_ENVS = ("flight", "collective", "hierarchical")
+TOPOLOGY_2MESH = "podA=worker-0,worker-1;podB=worker-2"
+
+
+def _run_distributed(q, monkeypatch, path=None, topology=None,
+                     num_workers=3, **env):
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    if path is not None:
+        monkeypatch.setenv("DAFT_TPU_EXCHANGE_PATH", path)
+    if topology is not None:
+        monkeypatch.setenv("DAFT_TPU_WORKER_TOPOLOGY", topology)
+    runner = DistributedRunner(num_workers=num_workers)
+    old = dctx.get_context()._runner
+    dctx.get_context().set_runner(runner)
+    before = ss.shuffle_counters_snapshot()
+    try:
+        out = q()
+    finally:
+        dctx.get_context().set_runner(old)
+        if runner._manager is not None:
+            runner._manager.shutdown()
+    return out, ss.shuffle_counters_delta(before)
+
+
+def _canon(d, float_cols=()):
+    cols = sorted(d)
+    rows = []
+    for row in zip(*(d[c] for c in cols)):
+        rows.append(tuple(round(v, 6) if c in float_cols else v
+                          for c, v in zip(cols, row)))
+    return sorted(rows)
+
+
+# ------------------------------------------------------------- topology
+
+def test_topology_spec_parsing():
+    topo = tp.WorkerTopology.from_spec(
+        "podA=w0,w1;podB=w2", ["w0", "w1", "w2", "w3"])
+    assert topo.n_groups == 3  # podA, podB, singleton w3
+    assert topo.group_of("w0").name == "podA"
+    assert topo.group_of("w3").workers == ("w3",)
+    assert topo.multi_worker_groups() == 1
+
+
+def test_topology_spec_rejects_duplicates():
+    with pytest.raises(ValueError):
+        tp.WorkerTopology.from_spec("a=w0;b=w0", ["w0"])
+
+
+def test_topology_autodetect_single_mesh(monkeypatch):
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "1")
+    topo = tp.WorkerTopology.detect(["w0", "w1"])
+    assert topo.single_mesh()  # in-process workers share the CPU mesh
+
+
+def test_chaos_serialize_forces_flight_path(monkeypatch):
+    monkeypatch.setenv("DAFT_TPU_CHAOS_SERIALIZE", "1")
+    monkeypatch.setenv("DAFT_TPU_EXCHANGE_PATH", "collective")
+    topo = tp.WorkerTopology.detect(["w0", "w1"])
+    # chaos wins over the force: replay must ride the verbatim path
+    assert tp.plan_exchange_path(topo, 4) == "flight"
+
+
+def test_invalid_exchange_path_raises(monkeypatch):
+    monkeypatch.setenv("DAFT_TPU_EXCHANGE_PATH", "collectve")  # typo
+    topo = tp.WorkerTopology.detect(["w0"])
+    with pytest.raises(ValueError, match="unknown exchange path"):
+        tp.plan_exchange_path(topo, 4)
+
+
+def test_active_fault_plan_degrades_auto_to_flight(monkeypatch):
+    """Recorded fault keys live on the flight path's task/fetch sites:
+    an active fault plan pins the AUTO ladder to flight; an explicit
+    force still wins (the fetch-parallelism contract)."""
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "1")
+    monkeypatch.setenv("DAFT_TPU_FAULT_SPEC", "fetch:0.1")
+    rz.reset_for_tests()
+    try:
+        topo = tp.WorkerTopology.detect(["w0", "w1"])
+        assert tp.plan_exchange_path(topo, 4) == "flight"
+        monkeypatch.setenv("DAFT_TPU_EXCHANGE_PATH", "hierarchical")
+        assert tp.plan_exchange_path(topo, 4) == "hierarchical"
+    finally:
+        rz.reset_for_tests()
+
+
+def test_config_field_mirrors_apply(monkeypatch):
+    """The registry's config_field contract: with the env vars unset,
+    the per-query ExecutionConfig fields drive topology and path."""
+    monkeypatch.delenv("DAFT_TPU_EXCHANGE_PATH", raising=False)
+    monkeypatch.delenv("DAFT_TPU_WORKER_TOPOLOGY", raising=False)
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "1")
+    with execution_config_ctx(tpu_exchange_path="flight",
+                              tpu_worker_topology="pod=w0,w1"):
+        topo = tp.WorkerTopology.detect(["w0", "w1", "w2"])
+        assert topo.group_of("w0").name == "pod"
+        assert topo.group_of("w2").workers == ("w2",)
+        assert tp.plan_exchange_path(topo, 4) == "flight"
+
+
+def test_collective_lease_gauge_balances():
+    k = tp.acquire_collective("t.lease")
+    assert tp.collective_inflight() >= 1
+    tp.release_collective(k)
+    assert tp.collective_inflight() == 0
+
+
+# ------------------------------------------------- grouped-agg parity
+
+def _groupby_query(data):
+    def q():
+        df = daft_tpu.from_pydict(data).into_partitions(4)
+        return df.groupby("k").agg(col("v").sum().alias("s")).to_pydict()
+    return q
+
+
+def test_exchange_paths_bit_parity_grouped_agg(monkeypatch):
+    """The same grouped aggregation through all three exchange paths —
+    and the driver-materializing oracle — must agree bit-exactly."""
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")  # keep a host hash boundary
+    rng = np.random.default_rng(7)
+    data = {"k": rng.integers(0, 11, 6000).tolist(),
+            "v": rng.integers(0, 1000, 6000).tolist()}
+    q = _groupby_query(data)
+    oracle = _canon(q())
+    got = {}
+    for path in PATH_ENVS:
+        topo = TOPOLOGY_2MESH if path == "hierarchical" else None
+        out, delta = _run_distributed(q, monkeypatch, path=path,
+                                      topology=topo)
+        got[path] = _canon(out)
+        assert delta.get(f"exchange_path_{path}", 0) >= 1, \
+            (path, delta)
+        if path == "hierarchical":
+            # ONE stream per mesh (2 meshes host map tasks), not one
+            # per worker
+            assert 1 <= delta.get("hierarchical_streams", 0) <= 2
+    for path, rows in got.items():
+        assert rows == oracle, f"{path} diverged from the oracle"
+
+
+def test_collective_path_rides_ici_on_device_mesh(monkeypatch):
+    """With the device mesh up and admission forced, a collective
+    repartition boundary moves its bytes over the mesh all_to_all —
+    counted as ici_bytes, zero Flight fetches — and stays bit-exact."""
+    from daft_tpu.parallel import mesh as pmesh
+    if pmesh.mesh_size() < 2:
+        pytest.skip("no multi-device mesh")
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "1")
+    monkeypatch.setenv("DAFT_TPU_MESH_MIN_ROWS", "0")
+    n = pmesh.mesh_size()
+    rng = np.random.default_rng(5)
+    data = {"k": rng.integers(0, 1000, 4096).tolist(),
+            "v": rng.integers(0, 10 ** 6, 4096).tolist()}
+
+    def q():
+        df = daft_tpu.from_pydict(data).into_partitions(4)
+        return df.repartition(n, col("k")).to_pydict()
+
+    oracle = _canon(q())
+    out, delta = _run_distributed(q, monkeypatch, path="collective")
+    assert _canon(out) == oracle
+    assert delta.get("ici_exchanges", 0) >= 1, delta
+    assert delta.get("ici_bytes", 0) > 0
+    assert delta.get("fetches", 0) == 0  # nothing crossed the wire
+
+
+# --------------------------------------------------- hash-join parity
+
+def test_exchange_paths_bit_parity_hash_join(monkeypatch):
+    """A hash join's co-partitioning boundaries (two hash inputs into one
+    consumer stage) through every path: the mesh pid chain and
+    ``partition_by_hash`` share the engine xxh64 chain, so mixed-path
+    sides still co-partition and results stay identical."""
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+    rng = np.random.default_rng(13)
+    n = 4000
+    left = {"k": rng.integers(0, 40, n).tolist(),
+            "lv": rng.integers(0, 100, n).tolist()}
+    right = {"k": list(range(40)),
+             "rv": rng.integers(0, 9, 40).tolist()}
+
+    def q():
+        with execution_config_ctx(broadcast_join_size_bytes_threshold=1):
+            lf = daft_tpu.from_pydict(left).into_partitions(3)
+            rf = daft_tpu.from_pydict(right).into_partitions(2)
+            return lf.join(rf, on="k").to_pydict()
+
+    oracle = _canon(q())
+    for path in PATH_ENVS:
+        topo = TOPOLOGY_2MESH if path == "hierarchical" else None
+        out, delta = _run_distributed(q, monkeypatch, path=path,
+                                      topology=topo)
+        assert _canon(out) == oracle, f"{path} diverged on the join"
+
+
+# ------------------------------------------- chaos-serialize degradation
+
+def test_chaos_replay_bit_identical_with_topology(monkeypatch):
+    """Under DAFT_TPU_CHAOS_SERIALIZE=1 every boundary degrades to the
+    verbatim Flight path, so the injected-fault event log and the answer
+    replay bit-identically — with or without a forced topology/path."""
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+    monkeypatch.setenv("DAFT_TPU_CHAOS_SERIALIZE", "1")
+    monkeypatch.setenv("DAFT_TPU_FAULT_SPEC", "fetch:0.3")
+    monkeypatch.setenv("DAFT_TPU_FAULT_SEED", "7")
+    monkeypatch.setenv("DAFT_TPU_RETRY_BACKOFF", "0.01")
+    rng = np.random.default_rng(23)
+    data = {"k": rng.integers(0, 7, 3000).tolist(),
+            "v": rng.integers(0, 100, 3000).tolist()}
+    q = _groupby_query(data)
+
+    def chaos_run(path, topology):
+        rz.reset_for_tests()
+        out, delta = _run_distributed(q, monkeypatch, path=path,
+                                      topology=topology)
+        events = rz.fault_events()
+        rz.reset_for_tests()
+        return _canon(out), events, delta
+
+    base_rows, base_events, base_delta = chaos_run(None, None)
+    coll_rows, coll_events, coll_delta = chaos_run(
+        "collective", TOPOLOGY_2MESH)
+    assert coll_rows == base_rows
+    assert coll_events == base_events, \
+        "chaos replay diverged when a topology was configured"
+    # the degradation really took the flight rungs, not collective ones
+    assert coll_delta.get("exchange_path_collective", 0) == 0
+    assert coll_delta.get("ici_exchanges", 0) == 0
+
+
+# ---------------------------------------- all-or-nothing group recovery
+
+def test_collective_group_recovery_is_all_or_nothing(monkeypatch):
+    """Kill one collective participant's served stream (crash fault
+    destroys the per-mesh data): lineage recovery must re-execute the
+    WHOLE exchange group — every member map task plus the intra-mesh
+    collective — and the query must still answer exactly."""
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
+    monkeypatch.setenv("DAFT_TPU_RETRY_BACKOFF", "0.01")
+    rng = np.random.default_rng(31)
+    data = {"k": rng.integers(0, 9, 4000).tolist(),
+            "v": rng.integers(0, 1000, 4000).tolist()}
+    q = _groupby_query(data)
+    oracle = _canon(q())
+
+    rz.reset_for_tests()
+    monkeypatch.setenv("DAFT_TPU_FAULT_SPEC", "crash:1:1")
+    monkeypatch.setenv("DAFT_TPU_FAULT_SEED", "3")
+    try:
+        out, delta = _run_distributed(q, monkeypatch, path="hierarchical",
+                                      topology=TOPOLOGY_2MESH)
+        counters = rz.counters_snapshot()
+    finally:
+        rz.reset_for_tests()
+    assert _canon(out) == oracle
+    assert counters.get("injected_crash", 0) >= 1, counters
+    assert counters.get("collective_group_recoveries", 0) >= 1, \
+        "the lost per-mesh stream was not recovered as a whole group"
+    # in-flight gauge drained: recovery re-acquired and released leases
+    assert tp.collective_inflight() == 0
+
+
+# ------------------------------------------------- counters surfacing
+
+def test_exchange_counters_surface_in_stats_and_metrics(monkeypatch):
+    """Satellite: exchange_cache_counters() + the collective counters
+    show up in RuntimeStatsContext / explain(analyze=True) renders and
+    the Prometheus /metrics text."""
+    from daft_tpu import observability as obs
+    from daft_tpu import tracing
+    from daft_tpu.parallel import exchange, mesh as pmesh
+    if pmesh.mesh_size() < 2:
+        pytest.skip("no multi-device mesh")
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "1")
+    monkeypatch.setenv("DAFT_TPU_MESH_MIN_ROWS", "0")
+    ctx = obs.RuntimeStatsContext()
+    df = daft_tpu.from_pydict(
+        {"k": list(range(2048)), "v": list(range(2048))})
+    df.groupby("k").agg(col("v").sum().alias("s")).to_pydict()
+    ctx.finish()
+    # the mesh exchange traced or re-entered at least one program
+    cache = exchange.exchange_cache_counters()
+    assert cache["entries"] >= 1
+    rendered = ctx.render()
+    assert "exchange programs (collective cache):" in rendered \
+        or ctx.exchange == {}  # another test may have warmed every program
+    text = tracing.prometheus_text()
+    assert "daft_tpu_exchange_programs" in text
+    assert "daft_tpu_exchange_collective_inflight" in text
+    # strict-parse clean (the obs-smoke scrape gate)
+    tracing.parse_prometheus_text(text)
