@@ -263,6 +263,31 @@ _KNOBS: List[Knob] = [
        "daft_tpu/runners/distributed_runner.py", "resilience",
        "distributed-runner worker count (`0` = auto from cpu count)",
        default_str="auto"),
+    # --------------------------------------------------------- spill
+    _k("DAFT_TPU_SPILL_JOIN", "str", "auto",
+       "daft_tpu/execution/out_of_core.py", "spill",
+       "grace hash join gate: `auto` (cost-model priced via "
+       "`spill_plan_wins`), `1` forces partitioned execution, `0` "
+       "restores the legacy materialize-then-refan join (no recursion)",
+       config_field="tpu_spill_join"),
+    _k("DAFT_TPU_SPILL_AGG", "str", "auto",
+       "daft_tpu/execution/out_of_core.py", "spill",
+       "spill-partitioned aggregation gate: `auto` spills the fused "
+       "reducer's group state only when the budget can't hold it, `1` "
+       "forces the spilling reducer, `0` declines the fusion for "
+       "over-budget states (legacy exchange plan)",
+       config_field="tpu_spill_agg"),
+    _k("DAFT_TPU_SPILL_PARTITIONS", "int", 0,
+       "daft_tpu/execution/out_of_core.py", "spill",
+       "forces the first-level radix fanout of grace joins and spilling "
+       "reducers; `0` lets planner size/NDV evidence pick the count",
+       config_field="tpu_spill_partitions", default_str="evidence"),
+    _k("DAFT_TPU_SPILL_MAX_DEPTH", "int", 3,
+       "daft_tpu/execution/out_of_core.py", "spill",
+       "rotated-radix recursion bound for a bucket that still exceeds "
+       "its budget; exhaustion (an unsplittable all-duplicate key) falls "
+       "through to an in-memory merge, counted in `depth_exhausted`",
+       config_field="tpu_spill_max_depth"),
     # ------------------------------------------------------- io-scan
     _k("DAFT_TPU_IO_COALESCE_GAP", "bytes", 1 << 20,
        "daft_tpu/io/read_planner.py", "io-scan",

@@ -129,6 +129,18 @@ CONTRACTS: Tuple[Contract, ...] = (
              "owner that shuts it down",
     ),
     Contract(
+        rule="spill-store-leak", style="object", mode="all",
+        acquire=("SpillBuffer", "PartitionedSpillStore",
+                 "SplitSpillBuffer", "materialize", "drain_to_store"),
+        release=("close",),
+        defining=("daft_tpu/execution/memory.py",
+                  "daft_tpu/execution/out_of_core.py"),
+        hint="close() the spill buffer/store on every exit path "
+             "(try/finally or `with`), or transfer ownership by "
+             "returning/storing it — a leaked store strands its spill "
+             "directory until GC",
+    ),
+    Contract(
         rule="collective-lease-leak", style="event", mode="all",
         acquire=("acquire_collective",), release=("release_collective",),
         defining=("daft_tpu/distributed/topology.py",),

@@ -86,6 +86,14 @@ class ExecutionConfig:
     # DAFT_TPU_EXCHANGE_PATH); the env var is the per-process override.
     tpu_worker_topology: str = ""            # "" → autodetect
     tpu_exchange_path: str = "auto"          # collective|hierarchical|flight
+    # out-of-core execution (execution/out_of_core.py): grace hash join
+    # and spill-partitioned aggregation gates. Field names spell the
+    # documented knobs (DAFT_TPU_SPILL_JOIN, …); env is the per-process
+    # override.
+    tpu_spill_join: str = "auto"             # auto|1 (force)|0 (legacy)
+    tpu_spill_agg: str = "auto"              # auto|1 (force)|0 (decline)
+    tpu_spill_partitions: int = 0            # 0 → planner evidence decides
+    tpu_spill_max_depth: int = 3             # rotated-radix recursion bound
     # serving plane (serving/scheduler.py); env spellings match the
     # documented serve knobs (DAFT_TPU_SERVE_CONCURRENCY, …)
     tpu_serve_concurrency: int = 4           # scheduler worker slots

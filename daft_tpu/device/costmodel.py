@@ -835,6 +835,41 @@ def shuffle_combine_wins(rows: Optional[int], groups: Optional[int],
     return saved_s > extra_s
 
 
+# --------------------------------------------- out-of-core spill pricing
+
+SPILL_DISK_BPS = 1.5e9   # spill-tier IPC write/read rate, per byte per
+#                          direction (local NVMe with lz4 buffer
+#                          compression; coarse like the host constants —
+#                          the decision only needs the ratio of one extra
+#                          disk round trip to an in-memory pass)
+
+
+def spill_plan_wins(nbytes: float, resident_budget: float) -> bool:
+    """Price a spill-partitioned plan (grace join pairwise phase /
+    spill-partitioned agg) against the in-memory single-unit plan for
+    ``nbytes`` of materialized input with ``resident_budget`` bytes
+    allowed resident.
+
+    A spilled partition is a price, not a failure (HiFrames): past the
+    resident budget the in-memory plan is INFEASIBLE (an OOM has
+    infinite cost) and the partitioned plan wins outright; under it the
+    partitioned plan pays one extra IPC write+read of the overflow it
+    would have spilled — zero when everything stayed resident — so small
+    inputs keep the whole-input single join/merge. Logged under
+    ``spill_plan`` ("device" = partitioned plan chosen)."""
+    agg_s = nbytes / HOST_AGG_BPS
+    if nbytes > resident_budget:
+        part_s = agg_s + 2.0 * (nbytes - resident_budget) / SPILL_DISK_BPS
+        _log("spill_plan", True, 1e12, part_s,
+             nbytes=nbytes, budget=resident_budget)
+        return True
+    # everything fits resident: the partitioned plan would spill nothing
+    # but still forfeits the whole-input kernel pass — in-memory wins
+    _log("spill_plan", False, agg_s, agg_s,
+         nbytes=nbytes, budget=resident_budget)
+    return False
+
+
 def join_wins(n_left: int, n_right: int, bytes_up: float,
               bytes_down: float, window: int = 1) -> bool:
     """Equi-join as one fused device program (hash build/probe when the

@@ -464,17 +464,26 @@ def _hash_shuffle_combined(stream, cache, spec: ShuffleOutSpec,
     schema (``stages.combine_for_boundary``), so the pushed schema is
     byte-identical to the uncombined path and the reduce side needs no
     changes. Buffers merge LSM-style (only once the buffer rivals the
-    state) so re-aggregation stays O(log n) passes; peak residency is ~2×
-    this task's per-partition group cardinality — the state the reduce
-    side would otherwise hold anyway."""
+    state) so re-aggregation stays O(log n) passes; peak residency is
+    BUDGET-BOUNDED (round 19): when the summed partition states outgrow
+    the breaker budget, the largest state flushes to the (always-on-disk)
+    ShuffleCache mid-stream and restarts — pushing a partition's state in
+    several pieces is exactly what the uncombined path does with raw
+    rows, so the reduce side's merge agg is unchanged and a map task
+    over an unbounded-NDV boundary composes with the exchange paths
+    instead of holding its whole group state."""
+    from ..execution.memory import breaker_budget_bytes, spill_count
     from .shuffle_service import shuffle_count
     n = spec.num_partitions
+    budget = breaker_budget_bytes()
     caggs = list(spec.combine_aggs)
     cby = list(spec.combine_by)
     state: List[Optional[MicroPartition]] = [None] * n
+    sbytes = [0] * n
     buf: List[List[MicroPartition]] = [[] for _ in range(n)]
     bufrows = [0] * n
     rows = 0
+    pushed = 0
     wire_schema = None
 
     def merge(i: int) -> None:
@@ -486,7 +495,15 @@ def _hash_shuffle_combined(stream, cache, spec: ShuffleOutSpec,
         out = merged.agg(caggs, cby)
         state[i] = out.cast_to_schema(wire_schema) \
             if wire_schema is not None else out
+        sbytes[i] = int(state[i].size_bytes() or 0)
         buf[i], bufrows[i] = [], 0
+
+    def flush(i: int) -> None:
+        nonlocal pushed
+        if state[i] is not None and len(state[i]):
+            pushed += len(state[i])
+            cache.push(i, state[i].combined().to_arrow_table())
+        state[i], sbytes[i] = None, 0
 
     for mp in stream:
         rows += len(mp)
@@ -500,12 +517,15 @@ def _hash_shuffle_combined(stream, cache, spec: ShuffleOutSpec,
                         _COMBINE_REAGG_ROWS,
                         0 if state[i] is None else len(state[i])):
                     merge(i)
-    pushed = 0
+                    while sum(sbytes) > budget:
+                        j = max(range(n), key=lambda x: sbytes[x])
+                        if sbytes[j] == 0:
+                            break
+                        spill_count("combine_state_flushes")
+                        flush(j)
     for i in range(n):
         merge(i)
-        if state[i] is not None and len(state[i]):
-            pushed += len(state[i])
-            cache.push(i, state[i].combined().to_arrow_table())
+        flush(i)
     shuffle_count("combine_rows_in", rows)
     shuffle_count("combine_rows_out", pushed)
     return rows

@@ -1130,13 +1130,17 @@ class LocalExecutor:
         k = self.cfg.sample_size_for_sort
         buf = memory.SpillBuffer(memory.breaker_budget_bytes())
         samples: List[RecordBatch] = []
-        for p in stream:
-            self._poll_cancel()
-            rb = p.combined()
-            if len(rb):
-                s = rb.sample(size=min(k, len(rb)))
-                samples.append(s.eval_expression_list(by))
-            buf.append(p)
+        try:
+            for p in stream:
+                self._poll_cancel()
+                rb = p.combined()
+                if len(rb):
+                    s = rb.sample(size=min(k, len(rb)))
+                    samples.append(s.eval_expression_list(by))
+                buf.append(p)
+        except BaseException:
+            buf.close()  # a failed drain must not leak the spill files
+            raise
         return buf, samples
 
     def _breaker_fanout(self, total_bytes: int) -> int:
@@ -1258,12 +1262,17 @@ class LocalExecutor:
             # back to the streaming store with the same (spill-bounded)
             # buffer when it declines
             parts = memory.materialize(child, memory.breaker_budget_bytes())
-            mesh_out = self._mesh_hash_repartition(list(parts), by, n)
-            if mesh_out is not None:
+            try:
+                mesh_out = self._mesh_hash_repartition(list(parts), by, n)
+                if mesh_out is not None:
+                    yield from mesh_out
+                    return
+                yield from self._fan_exchange_streaming(
+                    node, n, lambda mp, i: mp.partition_by_hash(by, n),
+                    stream=iter(parts))
+            finally:
                 parts.close()
-                yield from mesh_out
-                return
-            child = iter(parts)
+            return
         yield from self._fan_exchange_streaming(
             node, n, lambda mp, i: mp.partition_by_hash(by, n),
             stream=child)
@@ -1463,6 +1472,7 @@ class LocalExecutor:
                   == [e._key() for e in node.left_on]
                   and [e._key() for e in rnode.by]
                   == [e._key() for e in node.right_on])
+        from . import out_of_core as ooc
         if copart:
             # both exchanges emit exactly n partitions in index order and
             # partition on the join keys — zip the two streams and join
@@ -1470,21 +1480,31 @@ class LocalExecutor:
             # so at most one partition PAIR (plus the stores' bounded
             # buffers) is resident; neither side materializes as a list
             # (reference: hash_join.rs build-then-stream-probe, with the
-            # build side's state held by the exchange sink)
-            yield from _ordered_parallel(
-                zip(self._exec(lnode), self._exec(rnode)),
-                lambda lr: lr[0].hash_join(lr[1], node.left_on,
-                                           node.right_on, how))
+            # build side's state held by the exchange sink). A skewed
+            # pair past the pair budget re-partitions with the rotated
+            # radix instead of joining whole (out_of_core).
+            for outs in _ordered_parallel(
+                    zip(self._exec(lnode), self._exec(rnode)),
+                    lambda lr: ooc.join_copartitioned_pair(
+                        self, lr[0], lr[1], node, lnode.schema(),
+                        rnode.schema())):
+                yield from outs
             return
         # no static co-partitioning evidence: index pairing would join
-        # unrelated partitions — spill-partition BOTH sides by key hash
-        # (same xxh64 chain → co-partitioned buckets), then join pairwise;
-        # peak memory is one bucket pair, not both children
-        lbuf = memory.materialize(self._exec(lnode),
-                                  memory.breaker_budget_bytes())
-        rbuf = memory.materialize(self._exec(rnode),
-                                  memory.breaker_budget_bytes())
-        try:
+        # unrelated partitions — grace hash join: stream BOTH sides into
+        # rotated-radix spill stores (same xxh64 chain at depth 0 →
+        # co-partitioned buckets), then join bucket pairs one at a time,
+        # recursing on any pair that still exceeds the pair budget; peak
+        # memory is one bucket pair, not both children
+        if ooc.spill_join_mode(self.cfg) != "0":
+            yield from ooc.grace_hash_join(self, node)
+            return
+        # DAFT_TPU_SPILL_JOIN=0: the legacy materialize-then-refan path
+        # (no recursion; an oversized bucket pair loads whole)
+        with memory.materialize(self._exec(lnode),
+                                memory.breaker_budget_bytes()) as lbuf, \
+                memory.materialize(self._exec(rnode),
+                                   memory.breaker_budget_bytes()) as rbuf:
             # fanout sized from BOTH sides (a tiny left must not gather an
             # arbitrarily large right into RAM); both buffers are
             # spill-bounded, so sizing them first costs disk, not memory
@@ -1501,8 +1521,12 @@ class LocalExecutor:
             lstore = self._key_bucket_store(iter(lbuf),
                                             list(node.left_on), n)
             lbuf.close()
-            rstore = self._key_bucket_store(iter(rbuf),
-                                            list(node.right_on), n)
+            try:
+                rstore = self._key_bucket_store(iter(rbuf),
+                                                list(node.right_on), n)
+            except BaseException:
+                lstore.close()
+                raise
             rbuf.close()
             try:
                 yield from _ordered_parallel(
@@ -1513,20 +1537,23 @@ class LocalExecutor:
             finally:
                 lstore.close()
                 rstore.close()
-        finally:
-            lbuf.close()
-            rbuf.close()
 
     def _key_bucket_store(self, stream, by, n: int):
-        """Drain a stream into an n-bucket store hashed on ``by``."""
+        """Drain a stream into an n-bucket store hashed on ``by``. The
+        store closes itself when the drain fails; the caller owns it
+        once it is returned whole."""
         from . import memory
         store = memory.PartitionedSpillStore(n)
-        for mp in stream:
-            self._poll_cancel()
-            for j, piece in enumerate(mp.partition_by_hash(by, n)):
-                if len(piece):
-                    store.push(j, piece.combined())
-        store.finalize()
+        try:
+            for mp in stream:
+                self._poll_cancel()
+                for j, piece in enumerate(mp.partition_by_hash(by, n)):
+                    if len(piece):
+                        store.push(j, piece.combined())
+            store.finalize()
+        except BaseException:
+            store.close()
+            raise
         return store
 
     def _adaptive_hash_join(self, node: pp.HashJoin, li, ri):
@@ -1539,37 +1566,41 @@ class LocalExecutor:
         from . import memory
         how = node.how
         threshold = self.cfg.broadcast_join_size_bytes_threshold
-        lparts = memory.materialize(self._exec(li),
-                                    memory.breaker_budget_bytes())
-        if lparts.total_bytes <= threshold and how in ("inner", "right"):
-            self._aqe().record_join("hash→broadcast_left",
-                                    lparts.total_bytes)
-            left = _gather_all(iter(lparts))
-            lparts.close()
-            yield from _ordered_parallel(
-                self._exec(ri), lambda p: left.hash_join(
-                    p, node.left_on, node.right_on, how))
-            return
-        rparts = memory.materialize(self._exec(ri),
-                                    memory.breaker_budget_bytes())
-        if rparts.total_bytes <= threshold and how in ("inner", "left",
-                                                       "semi", "anti"):
-            self._aqe().record_join("hash→broadcast_right",
-                                    rparts.total_bytes)
-            right = _gather_all(iter(rparts))
-            rparts.close()
-            yield from _ordered_parallel(
-                iter(lparts), lambda p: p.hash_join(
-                    right, node.left_on, node.right_on, how))
-            return
-        n = node.children[0].num_partitions
-        self._aqe().record_join("hash",
-                                lparts.total_bytes + rparts.total_bytes)
-        yield from _ordered_parallel(
-            zip(self._refan(lparts, list(node.left_on), n, li.schema()),
-                self._refan(rparts, list(node.right_on), n, ri.schema())),
-            lambda lr: lr[0].hash_join(lr[1], node.left_on, node.right_on,
-                                       how))
+        with memory.materialize(self._exec(li),
+                                memory.breaker_budget_bytes()) as lparts:
+            if lparts.total_bytes <= threshold and how in ("inner",
+                                                           "right"):
+                self._aqe().record_join("hash→broadcast_left",
+                                        lparts.total_bytes)
+                left = _gather_all(iter(lparts))
+                lparts.close()
+                yield from _ordered_parallel(
+                    self._exec(ri), lambda p: left.hash_join(
+                        p, node.left_on, node.right_on, how))
+                return
+            with memory.materialize(
+                    self._exec(ri),
+                    memory.breaker_budget_bytes()) as rparts:
+                if rparts.total_bytes <= threshold \
+                        and how in ("inner", "left", "semi", "anti"):
+                    self._aqe().record_join("hash→broadcast_right",
+                                            rparts.total_bytes)
+                    right = _gather_all(iter(rparts))
+                    rparts.close()
+                    yield from _ordered_parallel(
+                        iter(lparts), lambda p: p.hash_join(
+                            right, node.left_on, node.right_on, how))
+                    return
+                n = node.children[0].num_partitions
+                self._aqe().record_join(
+                    "hash", lparts.total_bytes + rparts.total_bytes)
+                yield from _ordered_parallel(
+                    zip(self._refan(lparts, list(node.left_on), n,
+                                    li.schema()),
+                        self._refan(rparts, list(node.right_on), n,
+                                    ri.schema())),
+                    lambda lr: lr[0].hash_join(lr[1], node.left_on,
+                                               node.right_on, how))
 
     def _refan(self, parts, by: List[Expression], n: int, schema):
         """Key-hash a (possibly spilled) partition buffer into n buckets

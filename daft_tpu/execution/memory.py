@@ -19,10 +19,73 @@ import os
 import tempfile
 import threading
 import uuid
-from typing import Iterable, Iterator, List, Optional, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 import pyarrow as pa
 import pyarrow.ipc as paipc
+
+# --------------------------------------------------------- spill counters
+# Process-wide spill-tier accounting, mirroring the shuffle data-plane
+# counters: ``RuntimeStatsContext`` snapshots at query start and diffs at
+# finish() for the per-query ``spill`` block (bytes written/read,
+# partitions spilled, grace-join recursions, per-store peak residency).
+
+_spill_counters_lock = threading.Lock()
+_spill_counters: Dict[str, float] = {}
+
+
+def spill_count(name: str, n: float = 1) -> None:
+    with _spill_counters_lock:
+        _spill_counters[name] = _spill_counters.get(name, 0) + n
+    # context-local attribution for the serving plane (overlapping
+    # queries each see only their own spill traffic)
+    from .. import observability as obs
+    obs.bump_plane("spill", name, n)
+
+
+def spill_counters_snapshot() -> Dict[str, float]:
+    with _spill_counters_lock:
+        return dict(_spill_counters)
+
+
+def spill_counters_delta(before: Dict[str, float],
+                         after: Optional[Dict[str, float]] = None
+                         ) -> Dict[str, float]:
+    if after is None:
+        after = spill_counters_snapshot()
+    out = {}
+    for k, v in after.items():
+        d = v - before.get(k, 0)
+        if d:
+            out[k] = d
+    return out
+
+
+# ------------------------------------------------------ spill compression
+# Spill IPC writers honor the same knob (and the same auto-fallback) as
+# the shuffle tier: Arrow IPC *buffer* compression is self-describing, so
+# every reader (SpillBuffer reload, bucket reads) needs no configuration.
+
+_spill_ipc_cache: Dict[str, Optional[object]] = {}
+
+
+def spill_ipc_options() -> Optional["paipc.IpcWriteOptions"]:
+    """IPC write options for spill files per
+    ``DAFT_TPU_SHUFFLE_COMPRESSION`` (``lz4`` default) — out-of-core runs
+    pay roughly half the disk bytes; falls back to uncompressed when the
+    codec is missing from this pyarrow build."""
+    from ..analysis import knobs
+    pref = (knobs.env_str("DAFT_TPU_SHUFFLE_COMPRESSION") or "lz4").lower()
+    if pref in ("none", "off", "0", ""):
+        return None
+    if pref in _spill_ipc_cache:
+        return _spill_ipc_cache[pref]
+    try:
+        opts = paipc.IpcWriteOptions(compression=pref)
+    except Exception:
+        opts = None  # unknown codec / not built in → uncompressed
+    _spill_ipc_cache[pref] = opts
+    return opts
 
 
 def parse_bytes(v: str) -> int:
@@ -140,17 +203,21 @@ class SpillBuffer:
     """Multi-pass materialized partition buffer with a byte budget.
 
     Append partitions; once in-memory bytes exceed the budget, further
-    partitions are written to Arrow IPC files. Iteration re-yields all
-    partitions in append order (disk ones re-loaded lazily). ``close()``
-    (or GC) deletes spill files.
+    partitions are written to Arrow IPC files (compressed per
+    ``spill_ipc_options``). Iteration re-yields all partitions in append
+    order (disk ones re-loaded lazily). ``close()`` (deterministic —
+    breaker sites own it via try/finally or ``with``; ``__del__`` is only
+    the last-resort GC net) deletes spill files.
     """
 
     def __init__(self, budget: Optional[int] = None):
         self.budget = budget if budget is not None else memory_limit_bytes()
         self._entries: List[Tuple[str, object]] = []  # ("mem", mp)|("disk", path)
         self._mem_bytes = 0
+        self.peak_mem_bytes = 0
         self.bytes_spilled = 0
         self.total_rows = 0
+        self._accounted = False
 
     def append(self, mp) -> None:
         self.total_rows += len(mp)
@@ -159,14 +226,18 @@ class SpillBuffer:
             path = self._write_ipc(mp)
             self._entries.append(("disk", path))
             self.bytes_spilled += sz
+            spill_count("bytes_written", sz)
+            spill_count("partitions_spilled")
         else:
             self._entries.append(("mem", mp))
             self._mem_bytes += sz
+            self.peak_mem_bytes = max(self.peak_mem_bytes, self._mem_bytes)
 
     def _write_ipc(self, mp) -> str:
         path = os.path.join(spill_dir(), f"{uuid.uuid4().hex}.arrow")
         table = mp.combined().to_arrow_table()
-        with paipc.new_stream(path, table.schema) as w:
+        with paipc.new_stream(path, table.schema,
+                              options=spill_ipc_options()) as w:
             w.write_table(table)
         return path
 
@@ -176,6 +247,7 @@ class SpillBuffer:
         from ..recordbatch import RecordBatch
         with paipc.open_stream(path) as r:
             table = r.read_all()
+        spill_count("bytes_read", table.nbytes)
         return MicroPartition.from_recordbatch(
             RecordBatch.from_arrow_table(table))
 
@@ -198,6 +270,13 @@ class SpillBuffer:
         return v if kind == "mem" else self._read_ipc(v)
 
     def close(self):
+        # only stores that really hit disk count toward the spill block:
+        # a resident-only buffer is ordinary breaker memory, not spill
+        # evidence (and would make zero-spill queries render the block)
+        if not self._accounted and self.bytes_spilled:
+            self._accounted = True
+            spill_count("stores")
+            spill_count("store_peak_bytes", self.peak_mem_bytes)
         for kind, v in self._entries:
             if kind == "disk":
                 try:
@@ -207,6 +286,13 @@ class SpillBuffer:
         self._entries = []
         self._mem_bytes = 0
 
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
     def __del__(self):
         try:
             self.close()
@@ -215,10 +301,16 @@ class SpillBuffer:
 
 
 def materialize(parts: Iterable, budget: Optional[int] = None) -> SpillBuffer:
-    """Drain a partition stream into a (possibly spilling) buffer."""
+    """Drain a partition stream into a (possibly spilling) buffer. The
+    buffer closes itself if the DRAIN fails — the caller only owns it
+    once it is returned whole."""
     buf = SpillBuffer(budget)
-    for p in parts:
-        buf.append(p)
+    try:
+        for p in parts:
+            buf.append(p)
+    except BaseException:
+        buf.close()
+        raise
     return buf
 
 
@@ -256,6 +348,7 @@ class PartitionedSpillStore:
         self._mem: List[List] = [[] for _ in range(n)]  # pa.Table lists
         self._mem_bytes_per = [0] * n
         self._mem_bytes = 0
+        self.peak_mem_bytes = 0
         self._writers: List[Optional[Tuple[object, object]]] = [None] * n
         self._spilled = [False] * n
         self.rows = [0] * n
@@ -265,6 +358,7 @@ class PartitionedSpillStore:
                                   f"pstore_{_uuid.uuid4().hex}")
         self._lock = threading.Lock()
         self._sealed = False
+        self._accounted = False
 
     def _path(self, i: int) -> str:
         return os.path.join(self._root, f"bucket-{i}.arrow")
@@ -274,7 +368,8 @@ class PartitionedSpillStore:
         if w is None:
             os.makedirs(self._root, exist_ok=True)
             f = open(self._path(i), "ab")
-            w = (paipc.new_stream(f, schema), f)
+            w = (paipc.new_stream(f, schema, options=spill_ipc_options()),
+                 f)
             self._writers[i] = w
         return w[0]
 
@@ -293,10 +388,12 @@ class PartitionedSpillStore:
                 # splitting needs per-bucket locks (tracked as follow-up)
                 self._writer(i, t.schema).write_table(t)
                 self.bytes_spilled += nb
+                spill_count("bytes_written", nb)
                 return
             self._mem[i].append(batch)
             self._mem_bytes_per[i] += nb
             self._mem_bytes += nb
+            self.peak_mem_bytes = max(self.peak_mem_bytes, self._mem_bytes)
             while self._mem_bytes > self.budget:
                 j = max(range(self.n), key=lambda x: self._mem_bytes_per[x])
                 if self._mem_bytes_per[j] == 0:
@@ -308,6 +405,8 @@ class PartitionedSpillStore:
             t = b.to_arrow_table()
             self._writer(j, t.schema).write_table(t)
         self.bytes_spilled += self._mem_bytes_per[j]
+        spill_count("bytes_written", self._mem_bytes_per[j])
+        spill_count("partitions_spilled")
         self._mem_bytes -= self._mem_bytes_per[j]
         self._mem_bytes_per[j] = 0
         self._mem[j] = []
@@ -329,17 +428,27 @@ class PartitionedSpillStore:
         assert self._sealed, "finalize() before reading buckets"
         out = []
         if self._spilled[i] and os.path.exists(self._path(i)):
+            read = 0
             with open(self._path(i), "rb") as f:
                 while True:
                     try:
                         r = paipc.open_stream(f)
                     except Exception:
                         break
-                    out.append(RecordBatch.from_arrow_table(r.read_all()))
+                    t = r.read_all()
+                    read += t.nbytes
+                    out.append(RecordBatch.from_arrow_table(t))
+            if read:
+                spill_count("bytes_read", read)
         out.extend(self._mem[i])
         return out
 
     def close(self) -> None:
+        # spilling stores only — see SpillBuffer.close
+        if not self._accounted and self.bytes_spilled:
+            self._accounted = True
+            spill_count("stores")
+            spill_count("store_peak_bytes", self.peak_mem_bytes)
         with self._lock:
             for w in self._writers:
                 if w is not None:
@@ -357,6 +466,13 @@ class PartitionedSpillStore:
             shutil.rmtree(self._root, ignore_errors=True)
         except Exception:
             pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     def __del__(self):
         try:
@@ -389,3 +505,10 @@ class SplitSpillBuffer:
 
     def close(self):
         self._buf.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -252,21 +252,41 @@ def _fused_groups_admissible(node) -> bool:
     from .memory import memory_limit_bytes
     budget = memory_limit_bytes()
     if budget is not None:
-        width = max(1 + len(getattr(node, "group_by", ())
-                            ) + len(getattr(node, "aggs", ())), 2)
-        if ndv * width * _FUSE_BYTES_PER_GROUP > budget:
+        est = _est_state_bytes(node)
+        if est is not None and est > budget:
             return False
     return True
 
 
-def _partitioned_agg_info(node):
+def _est_state_bytes(node):
+    """Predicted resident group-state bytes for this final agg (the
+    fused reducer's working set): NDV evidence × row width × the coarse
+    per-group cost — None without evidence."""
+    ndv = getattr(node, "group_ndv", None)
+    if ndv is None:
+        ndv = getattr(node, "group_rows_est", None)
+    if ndv is None:
+        return None
+    width = max(1 + len(getattr(node, "group_by", ())
+                        ) + len(getattr(node, "aggs", ())), 2)
+    return float(ndv) * width * _FUSE_BYTES_PER_GROUP
+
+
+def _partitioned_agg_info(node, cfg=None):
     """When ``node`` is a final grouped Aggregate over an engine-inserted
     hash Exchange whose final aggs are associative self-merges, return
-    (exchange_child, key_exprs, merge_aggs) for the fused partitioned-agg
-    stage; else None. ``merge_aggs`` re-merge two batches of FINAL-schema
-    state: for a final agg ``op(col(p)).alias(out)``, the merge is
-    ``op(col(out)).alias(out)``."""
+    (exchange_child, key_exprs, merge_aggs, spill, est_state_bytes) for
+    the fused partitioned-agg stage; else None. ``merge_aggs`` re-merge
+    two batches of FINAL-schema state: for a final agg
+    ``op(col(p)).alias(out)``, the merge is ``op(col(out)).alias(out)``.
+
+    ``spill`` selects the spill-partitioned reducer (round 19): a group
+    state the budget can't hold streams through a rotated-radix spill
+    store and merges per bucket on read (``AGG_DECOMPOSITION`` self-merge
+    semantics) — peak RSS ≈ budget + one bucket — instead of declining
+    the fusion (``DAFT_TPU_SPILL_AGG=0`` restores the decline)."""
     from ..aggs import merge_exprs_for
+    from . import out_of_core as ooc
     if not (isinstance(node, pp.Aggregate) and node.mode == "final"
             and node.group_by):
         return None
@@ -274,8 +294,16 @@ def _partitioned_agg_info(node):
     if not (isinstance(ch, pp.Exchange) and ch.kind == "hash"
             and ch.engine_inserted):
         return None
-    if not _fused_groups_admissible(node):
-        return None
+    mode = ooc.spill_agg_mode(cfg)
+    est_state = _est_state_bytes(node)
+    if _fused_groups_admissible(node):
+        spill = mode == "1"
+    elif mode == "0":
+        return None  # legacy decline → the spill-bounded exchange plan
+    else:
+        # the in-memory reducer's state would not fit (or NDV evidence
+        # is past the fuse ceiling): spill-partitioned reducer
+        spill = True
     # shared subplans stream through the executor's shared buffer — the
     # fusion would bypass it
     if getattr(ch, "shared_consumers", 1) > 1 \
@@ -284,7 +312,7 @@ def _partitioned_agg_info(node):
     merge = merge_exprs_for(node.aggs, alias_to="out")
     if merge is None:
         return None
-    return ch.children[0], list(ch.by), merge
+    return ch.children[0], list(ch.by), merge, spill, est_state
 
 
 class PushExecutor(LocalExecutor):
@@ -354,7 +382,7 @@ class PushExecutor(LocalExecutor):
     # _exec (inherited) routes multi-consumer nodes through the shared
     # buffer; everything else lands here and becomes a stage
     def _exec_node(self, node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
-        pagg = _partitioned_agg_info(node)
+        pagg = _partitioned_agg_info(node, self.cfg)
         if pagg is not None:
             out = self._partitioned_agg_stage(node, *pagg)
         elif isinstance(node, pp.Aggregate) \
@@ -402,7 +430,8 @@ class PushExecutor(LocalExecutor):
         return out
 
     def _partitioned_agg_stage(self, node, exchange_child, by,
-                               merge_aggs) -> Channel:
+                               merge_aggs, spill: bool = False,
+                               est_state=None) -> Channel:
         """Partitioned-by-hash dispatcher fused with the final grouped
         aggregation (reference ``dispatcher.rs:24-60`` Partitioned +
         ``sinks/grouped_aggregate.rs:54-151``): the dispatcher hashes each
@@ -417,14 +446,21 @@ class PushExecutor(LocalExecutor):
         ``max(_REAGG_ROWS, len(state))`` — the LSM-style amortization lets
         it grow to the current state size, so peak residency is ~2× the
         worker's group cardinality (proportional to the output this
-        reducer must materialize anyway; the spill-bounded exchange path
-        remains the interpreter tier's behavior)."""
+        reducer must materialize anyway). With ``spill`` (round 19) the
+        reducer never holds its state at all: every ``_REAGG_ROWS`` the
+        buffer collapses to FINAL-schema partial states that radix-fan
+        (rotated — the dispatcher already consumed ``h % k``) into a
+        per-reducer spill store, and each bucket self-merges ON READ via
+        the ``AGG_DECOMPOSITION`` merge expressions — an unbounded-NDV
+        group-by streams in one pass at peak RSS ≈ budget + one bucket,
+        recursing (bounded) on a bucket skew redominates."""
         k = _default_workers()
         if self.stats is not None:
             self.stats.register(node).workers = k
         if self.cfg.enable_aqe:
             self._aqe().record_replan(
-                f"fused partitioned agg: hash shuffle elided → {k} reducers")
+                f"fused partitioned agg: hash shuffle elided → {k} reducers"
+                + (" (spill-partitioned)" if spill else ""))
         child = self._exec(exchange_child)
         in_q = [Channel(self.pipe, 2) for _ in range(k)]
         out = Channel(self.pipe, self.CHANNEL_CAPACITY, producers=k)
@@ -483,9 +519,62 @@ class PushExecutor(LocalExecutor):
             finally:
                 out.close()
 
+        def spill_reducer(i):
+            from ..expressions import col as _col
+            from . import memory, out_of_core as ooc
+            skeys = [_col(g.name()) for g in node.group_by]
+            m = ooc.agg_state_fanout(est_state, k, self.cfg)
+            depth_max = ooc.spill_max_depth(self.cfg)
+            bucket_budget = max(ooc.pair_budget_bytes() // k, 16 << 10)
+            store = memory.PartitionedSpillStore(
+                m, budget=max(memory.breaker_budget_bytes() // k,
+                              16 << 10))
+            buf: List[MicroPartition] = []
+            rows = 0
+
+            def flush():
+                nonlocal buf, rows
+                if not buf:
+                    return
+                fresh = buf[0].concat(buf[1:]) if len(buf) > 1 else buf[0]
+                fresh = fresh.agg(node.aggs, node.group_by) \
+                    .cast_to_schema(node.schema())
+                for j, piece in enumerate(ooc.radix_split(
+                        fresh.combined(), skeys, m, 1)):
+                    if len(piece):
+                        store.push(j, piece)
+                buf, rows = [], 0
+
+            try:
+                for mp in in_q[i]:
+                    buf.append(mp)
+                    rows += len(mp)
+                    if rows >= _REAGG_ROWS:
+                        flush()
+                flush()
+                store.finalize()
+                for j in range(m):
+                    batches = store.bucket_batches(j)
+                    if not batches:
+                        continue
+                    for state in ooc.merge_spilled_agg_bucket(
+                            batches, merge_aggs, node.group_by,
+                            node.schema(), skeys, 1, depth_max,
+                            bucket_budget):
+                        if len(state):
+                            out.put(state)
+            except PipelineCancelled:
+                pass
+            except BaseException as exc:  # noqa: BLE001
+                self.pipe.fail(exc)
+            finally:
+                store.close()
+                out.close()
+
         self.pipe.spawn(dispatch, name=f"dsp-{name}")
+        body = spill_reducer if spill else reducer
         for i in range(k):
-            self.pipe.spawn(lambda i=i: reducer(i), name=f"red-{name}-{i}")
+            self.pipe.spawn(lambda i=i: body(i), name=f"red-{name}-{i}")
         return out
 
     def _map_stage(self, node, kernel) -> Channel:

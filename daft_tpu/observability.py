@@ -140,6 +140,17 @@ def _shuffle_raw() -> Dict[str, float]:
         return {}
 
 
+def _spill_raw() -> Dict[str, float]:
+    """Raw snapshot of the out-of-core spill-tier counters (bytes
+    written/read, partitions spilled, grace-join/agg recursions, store
+    peak residency) — never raises, like the device ledger."""
+    try:
+        from .execution import memory
+        return memory.spill_counters_snapshot()
+    except Exception:
+        return {}
+
+
 def _scan_io_raw() -> Dict[str, float]:
     """Raw snapshot of the scan-plane IO counters (object GETs, planned
     ranges vs coalesced requests, bytes fetched vs used, prefetch wall vs
@@ -294,6 +305,10 @@ class RuntimeStatsContext:
         # bytes fetched vs used, prefetch overlap)
         self._io0 = _scan_io_raw()
         self.io: Dict[str, float] = {}
+        # …and the out-of-core spill tier (bytes written/read, grace
+        # recursions, per-store peak residency)
+        self._spill0 = _spill_raw()
+        self.spill: Dict[str, float] = {}
         # …and the collective-exchange program cache (hit/miss/
         # uncacheable): the evidence that same-shape mesh exchanges
         # re-enter one trace instead of re-tracing per call
@@ -378,6 +393,7 @@ class RuntimeStatsContext:
                              for k, v in self._plane("recovery").items()}
             self.shuffle = self._plane("shuffle")
             self.io = self._plane("io")
+            self.spill = self._plane("spill")
         else:
             try:
                 from .distributed import resilience
@@ -397,6 +413,12 @@ class RuntimeStatsContext:
                     self._io0, _scan_io_raw())
             except Exception:
                 self.io = {}
+            try:
+                from .execution import memory
+                self.spill = memory.spill_counters_delta(
+                    self._spill0, _spill_raw())
+            except Exception:
+                self.spill = {}
         # process-wide diff regardless of attribution: the program cache
         # is shared engine state (like the sanitizers), not per-thread
         # traffic — concurrent queries legitimately share its hits
@@ -508,6 +530,7 @@ class RuntimeStatsContext:
         lines.extend(render_shuffle_block(self.shuffle))
         lines.extend(render_exchange_block(self.exchange))
         lines.extend(render_io_block(self.io))
+        lines.extend(render_spill_block(self.spill))
         lines.extend(render_sanitizer_block(self.sanitizer))
         lines.extend(render_retrace_block(self.retrace))
         lines.extend(render_serving_block(self.serving))
@@ -600,6 +623,49 @@ def render_exchange_block(ex: Dict[str, float]) -> List[str]:
     lines = ["exchange programs (collective cache):"]
     lines.append("  " + ", ".join(
         f"{k}={int(v)}" for k, v in sorted(ex.items())))
+    return lines
+
+
+def render_spill_block(d: Dict[str, float]) -> List[str]:
+    """Human lines for one query's out-of-core spill delta (shared by
+    ``explain(analyze=True)`` and the dashboard): disk bytes the spill
+    tier wrote/read, partitions that left RAM, grace-join/agg recursion
+    evidence (deepest rotated-radix level reached, depth-bound
+    exhaustions on unsplittable keys), and the summed per-store peak
+    residency of the stores that spilled (an upper bound on what the
+    spill tier held resident)."""
+    if not d:
+        return []
+    lines = ["spill (out-of-core tier):"]
+    written = d.get("bytes_written", 0)
+    read = d.get("bytes_read", 0)
+    if written or read:
+        lines.append(f"  disk: {_fmt_bytes(written)} written / "
+                     f"{_fmt_bytes(read)} read, "
+                     f"{int(d.get('partitions_spilled', 0))} partitions "
+                     f"spilled")
+    jp, jg = int(d.get("joins_partitioned", 0)), \
+        int(d.get("joins_gathered", 0))
+    if jp or jg:
+        lines.append(f"  grace join: {jp} partitioned, {jg} gathered")
+    rec = int(d.get("recursions", 0))
+    if rec or d.get("depth_exhausted"):
+        deepest = max((int(k.rsplit("_d", 1)[1]) for k in d
+                       if k.startswith("recursions_d")), default=0)
+        lines.append(
+            f"  recursion: {rec} re-partitions (deepest level {deepest}),"
+            f" {int(d.get('depth_exhausted', 0))} depth-bound exhaustions")
+    if d.get("agg_buckets_merged"):
+        lines.append(f"  agg: {int(d.get('agg_buckets_merged', 0))} "
+                     f"state buckets merged on read")
+    if d.get("stores"):
+        ns = int(d.get("stores", 0))
+        # summed per-store peaks: an upper bound on what the spilling
+        # stores held resident (stores are often sequential, so the true
+        # instantaneous peak is usually far lower)
+        lines.append(
+            f"  resident: ≤{_fmt_bytes(d.get('store_peak_bytes', 0))} "
+            f"summed peak across {ns} spilling store(s)")
     return lines
 
 
@@ -884,7 +950,7 @@ def flight_entry(ctx: RuntimeStatsContext) -> dict:
                      and wall_us / 1e3 > slow_ms),
         "operators": ctx.as_dict(),
     }
-    for block in ("recovery", "shuffle", "exchange", "io",
+    for block in ("recovery", "shuffle", "exchange", "io", "spill",
                   "device_kernels", "serving", "sanitizer", "retrace"):
         v = getattr(ctx, block, None)
         if v:

@@ -236,8 +236,15 @@ def _translate_join(node: lp.Join, cfg) -> pp.PhysicalPlan:
         pr = pp.Exchange(pr, "gather", 1)
     elif strategy == "broadcast_left":
         pl = pp.Exchange(pl, "gather", 1)
-    return pp.HashJoin(pl, pr, node.left_on, node.right_on, node.how,
+    join = pp.HashJoin(pl, pr, node.left_on, node.right_on, node.how,
                        node.schema(), strategy)
+    # footer-backed size evidence for the grace hash join's first-level
+    # radix fanout (execution/out_of_core.plan_partitions): enough
+    # buckets that each is EXPECTED to fit the pair budget — recursion
+    # is the safety net when the estimate is wrong, not the plan
+    join.left_bytes_est = lsize
+    join.right_bytes_est = rsize
+    return join
 
 
 def _translate_agg(node: lp.Aggregate, cfg) -> pp.PhysicalPlan:

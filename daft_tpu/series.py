@@ -716,9 +716,31 @@ def _hash_array(s: Series) -> np.ndarray:
         if rep is None:
             return np.array([np.uint64(hash(repr(v)) & 0xFFFFFFFFFFFFFFFF)
                              for v in arr.to_pylist()], dtype=np.uint64)
-        vals = (s if phys == dt else s.cast(phys)).to_numpy()
+        sp = s if phys == dt else s.cast(phys)
+        if valid.all():
+            vals = sp.to_numpy()
+        else:
+            # a null mask must not change VALID rows' hashes: numpy
+            # promotes a nullable int/bool column to float64 (or object),
+            # so `5` used to hash by its FLOAT bit pattern beside a null
+            # but by its int bits in a dense column — two join/group
+            # sides with different masks were silently NOT co-partitioned
+            # (missed matches under the spill-partitioned join). Fill
+            # nulls with a typed zero so the numpy round trip keeps the
+            # true physical dtype; the sentinel overwrite below restores
+            # the null rows.
+            a = sp.to_arrow()
+            try:
+                fill = pa.scalar(
+                    False if pa.types.is_boolean(a.type) else 0,
+                    type=a.type)
+                vals = pc.fill_null(a, fill).to_numpy(
+                    zero_copy_only=False)
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError,
+                    TypeError):
+                vals = sp.to_numpy()
         vals = np.ascontiguousarray(np.nan_to_num(vals) if vals.dtype.kind == "f" else vals)
-        if vals.dtype.kind == "O":  # mixed/null-laden → repr-hash rows
+        if vals.dtype.kind == "O":  # mixed/unfillable → repr-hash rows
             out = np.array([np.uint64(hash(repr(v)) & 0xFFFFFFFFFFFFFFFF)
                             for v in vals], dtype=np.uint64)
             out[~valid] = np.uint64(0x6E756C6C)

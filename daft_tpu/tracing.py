@@ -627,6 +627,12 @@ def prometheus_text() -> str:
     except Exception:
         pass
     try:
+        from .execution import memory
+        plane("spill", memory.spill_counters_snapshot(),
+              "out-of-core spill-tier counter")
+    except Exception:
+        pass
+    try:
         from .distributed import resilience
         plane("recovery", resilience.counters_snapshot(),
               "resilience recovery counter")

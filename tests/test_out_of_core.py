@@ -1,0 +1,419 @@
+"""Out-of-core execution tests: grace hash join + spill-partitioned
+aggregation (execution/out_of_core.py) — bit-parity of spilled vs
+in-memory answers under tiny DAFT_TPU_MEMORY_LIMIT budgets, forced
+recursion, skewed/NULL keys, admission release on cancellation, spill
+compression, deterministic lifecycle, and the spill stats block."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import daft_tpu as daft
+from daft_tpu import col
+from daft_tpu.device import costmodel
+from daft_tpu.execution import memory, out_of_core as ooc
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.recordbatch import RecordBatch
+
+
+def _sorted_pydict(d):
+    keys = list(d.keys())
+    rows = sorted(zip(*[d[k] for k in keys]),
+                  key=lambda r: tuple((v is None, v) for v in r))
+    return {k: [r[i] for r in rows] for i, k in enumerate(keys)}
+
+
+def _join_dfs(n=60_000, ndv=20_000, nulls=False):
+    k = (np.arange(n) % ndv).astype(object)
+    if nulls:
+        k = k.copy()
+        k[::97] = None
+    left = daft.from_pydict({"k": list(k), "v": list(range(n))})
+    right = daft.from_pydict({"k": list(k[: n // 2]),
+                              "w": [i * 3 for i in range(n // 2)]})
+    return left, right
+
+
+@pytest.fixture
+def tiny_budget(monkeypatch):
+    monkeypatch.setenv("DAFT_TPU_MEMORY_LIMIT", "400KB")
+    yield
+
+
+# ----------------------------------------------------------- grace join
+
+def test_grace_join_parity_vs_in_memory(tiny_budget, monkeypatch):
+    """Spilled (partitioned + recursing) join answers are bit-identical
+    to the unbounded in-memory run."""
+    left, right = _join_dfs()
+    spilled = _sorted_pydict(
+        left.join(right, on="k", strategy="hash").to_pydict())
+    monkeypatch.delenv("DAFT_TPU_MEMORY_LIMIT")
+    monkeypatch.setenv("DAFT_TPU_SPILL_JOIN", "0")  # legacy reference
+    ref = _sorted_pydict(
+        left.join(right, on="k", strategy="hash").to_pydict())
+    assert spilled == ref
+
+
+def test_grace_join_partitions_and_recurses(tiny_budget):
+    left, right = _join_dfs()
+    b0 = memory.spill_counters_snapshot()
+    left.join(right, on="k", strategy="hash").to_pydict()
+    d = memory.spill_counters_delta(b0)
+    assert d.get("joins_partitioned", 0) >= 1
+    assert d.get("bytes_written", 0) > 0
+    assert d.get("bytes_read", 0) > 0
+
+
+def test_forced_recursion_depth(tiny_budget, monkeypatch):
+    """DAFT_TPU_SPILL_PARTITIONS=2 under-partitions on purpose so the
+    first radix level leaves oversized buckets → rotated-radix
+    recursion must kick in (and the answer must not change)."""
+    monkeypatch.setenv("DAFT_TPU_SPILL_PARTITIONS", "2")
+    left, right = _join_dfs()
+    b0 = memory.spill_counters_snapshot()
+    spilled = _sorted_pydict(
+        left.join(right, on="k", strategy="hash").to_pydict())
+    d = memory.spill_counters_delta(b0)
+    assert d.get("recursions", 0) >= 1
+    assert any(k.startswith("recursions_d") for k in d)
+    monkeypatch.delenv("DAFT_TPU_MEMORY_LIMIT")
+    monkeypatch.delenv("DAFT_TPU_SPILL_PARTITIONS")
+    ref = _sorted_pydict(
+        left.join(right, on="k", strategy="hash").to_pydict())
+    assert spilled == ref
+
+
+def test_skewed_key_exhausts_depth_not_memory(tiny_budget, monkeypatch):
+    """One all-duplicate key redominates every radix level: the depth
+    bound trips (counted) and the bucket joins in memory anyway —
+    bounded recursion, correct answer."""
+    monkeypatch.setenv("DAFT_TPU_SPILL_PARTITIONS", "2")
+    monkeypatch.setenv("DAFT_TPU_SPILL_MAX_DEPTH", "1")
+    n = 40_000
+    left = daft.from_pydict({"k": [7] * n, "v": list(range(n))})
+    right = daft.from_pydict({"k": [7] * 4, "w": [1, 2, 3, 4]})
+    b0 = memory.spill_counters_snapshot()
+    out = left.join(right, on="k", strategy="hash").to_pydict()
+    d = memory.spill_counters_delta(b0)
+    assert len(out["v"]) == n * 4
+    assert d.get("depth_exhausted", 0) >= 1
+
+
+def test_null_keys_never_match_all_join_types(tiny_budget, monkeypatch):
+    left, right = _join_dfs(n=30_000, ndv=10_000, nulls=True)
+    for how in ("inner", "left", "outer", "semi", "anti"):
+        spilled = _sorted_pydict(
+            left.join(right, on="k", how=how, strategy="hash").to_pydict())
+        monkeypatch.setenv("DAFT_TPU_SPILL_JOIN", "0")
+        monkeypatch.delenv("DAFT_TPU_MEMORY_LIMIT")
+        ref = _sorted_pydict(
+            left.join(right, on="k", how=how, strategy="hash").to_pydict())
+        monkeypatch.setenv("DAFT_TPU_MEMORY_LIMIT", "400KB")
+        monkeypatch.delenv("DAFT_TPU_SPILL_JOIN")
+        assert spilled == ref, how
+
+
+def test_copartitioned_pair_skew_guard(tiny_budget):
+    """The statically co-partitioned (exchange-fed) join re-partitions
+    an oversized partition pair instead of joining it whole."""
+    n = 60_000
+    left = daft.from_pydict({"k": [i % 5 for i in range(n)],
+                             "v": list(range(n))}).repartition(4, "k")
+    right = daft.from_pydict({"k": [i % 5 for i in range(n // 4)],
+                              "w": list(range(n // 4))}).repartition(4, "k")
+    b0 = memory.spill_counters_snapshot()
+    out = left.join(right, on="k", strategy="hash").groupby("k") \
+        .agg(col("v").count()).sort("k").to_pydict()
+    d = memory.spill_counters_delta(b0)
+    assert len(out["k"]) == 5
+    assert d.get("recursions", 0) >= 1  # skewed pairs re-partitioned
+
+
+def test_small_join_gathers(monkeypatch):
+    """Without memory pressure the observed totals fit the pair budget:
+    spill_plan_wins declines partitioned execution and ONE gathered
+    join runs."""
+    monkeypatch.delenv("DAFT_TPU_MEMORY_LIMIT", raising=False)
+    left = daft.from_pydict({"k": [1, 2, 3], "v": [10, 20, 30]})
+    right = daft.from_pydict({"k": [2, 3, 4], "w": [5, 6, 7]})
+    b0 = memory.spill_counters_snapshot()
+    out = left.join(right, on="k", strategy="hash").sort("k").to_pydict()
+    d = memory.spill_counters_delta(b0)
+    assert out["k"] == [2, 3]
+    assert d.get("joins_gathered", 0) >= 1
+    assert d.get("joins_partitioned", 0) == 0
+    assert not d.get("bytes_written")
+
+
+def test_spill_plan_wins_pricing():
+    assert costmodel.spill_plan_wins(100 << 20, 1 << 20)
+    assert not costmodel.spill_plan_wins(1 << 10, 1 << 20)
+    assert "spill_plan" in costmodel.decision_counts
+
+
+# ------------------------------------------------ spill-partitioned agg
+
+def _agg_df(n=120_000, ndv=None):
+    ndv = ndv or n  # near-unique keys: unbounded-NDV shape
+    return daft.from_pydict({
+        "k": [i % ndv for i in range(n)],
+        "v": [float(i % 97) for i in range(n)],
+        "c": [i % 7 for i in range(n)],
+    })
+
+
+def test_spilled_agg_parity(tiny_budget, monkeypatch):
+    """Forced spilling reducer vs in-memory reducer: identical grouped
+    answers on a near-unique key (the shape the fused reducer used to
+    decline)."""
+    monkeypatch.setenv("DAFT_TPU_SPILL_AGG", "1")
+    df = _agg_df()
+    q = lambda d: _sorted_pydict(
+        d.groupby("k").agg(col("v").sum(), col("c").max()).to_pydict())
+    b0 = memory.spill_counters_snapshot()
+    spilled = q(df)
+    d = memory.spill_counters_delta(b0)
+    assert d.get("agg_buckets_merged", 0) > 0
+    monkeypatch.delenv("DAFT_TPU_MEMORY_LIMIT")
+    monkeypatch.setenv("DAFT_TPU_SPILL_AGG", "0")
+    assert spilled == q(df)
+
+
+def test_spilled_agg_auto_under_budget(tiny_budget):
+    """auto mode: a group state the budget can't hold takes the
+    spilling reducer instead of declining the fusion (rows-estimate
+    evidence is absent for in-memory sources, so this exercises the
+    inadmissible-by-budget path only when evidence exists — force via
+    the knob-free shape: tiny budget + near-unique keys + footerless
+    source still must produce correct answers)."""
+    df = _agg_df(n=60_000)
+    out = _sorted_pydict(
+        df.groupby("k").agg(col("v").sum()).to_pydict())
+    assert len(out["k"]) == 60_000
+
+
+def test_spilled_agg_skewed_recursion(tiny_budget, monkeypatch):
+    """Skewed group keys (one giant group + near-unique tail) with a
+    forced-small fanout: the overflowing state bucket recursively
+    re-partitions and the merged answer stays exact."""
+    monkeypatch.setenv("DAFT_TPU_SPILL_AGG", "1")
+    monkeypatch.setenv("DAFT_TPU_SPILL_PARTITIONS", "2")
+    n = 100_000
+    df = daft.from_pydict({
+        "k": [0 if i % 2 else i for i in range(n)],
+        "v": [1.0] * n,
+    })
+    spilled = _sorted_pydict(
+        df.groupby("k").agg(col("v").sum()).to_pydict())
+    monkeypatch.delenv("DAFT_TPU_MEMORY_LIMIT")
+    monkeypatch.setenv("DAFT_TPU_SPILL_AGG", "0")
+    monkeypatch.delenv("DAFT_TPU_SPILL_PARTITIONS")
+    ref = _sorted_pydict(df.groupby("k").agg(col("v").sum()).to_pydict())
+    assert spilled == ref
+
+
+def test_spilled_agg_null_keys(tiny_budget, monkeypatch):
+    monkeypatch.setenv("DAFT_TPU_SPILL_AGG", "1")
+    df = daft.from_pydict({"k": [None if i % 5 == 0 else i % 1000
+                                 for i in range(20_000)],
+                           "v": list(range(20_000))})
+    spilled = _sorted_pydict(df.groupby("k").agg(col("v").sum())
+                             .to_pydict())
+    monkeypatch.delenv("DAFT_TPU_MEMORY_LIMIT")
+    monkeypatch.setenv("DAFT_TPU_SPILL_AGG", "0")
+    ref = _sorted_pydict(df.groupby("k").agg(col("v").sum()).to_pydict())
+    assert spilled == ref
+
+
+# ---------------------------------------------- cancellation + admission
+
+def test_cancellation_mid_partition_releases_admission(tiny_budget):
+    """Cancelling a grace join mid-drain unwinds the pair loop and
+    releases every admitted byte (the r11 leak invariant)."""
+    from daft_tpu.execution import cancellation as cxl
+    from daft_tpu.execution.executor import LocalExecutor
+
+    left, right = _join_dfs(n=40_000, ndv=40_000)
+    tok = cxl.CancelToken()
+    holder = {}
+    orig = ooc._join_pair
+
+    def cancel_after_first(*args, **kwargs):
+        out = orig(*args, **kwargs)
+        tok.set("test")
+        return out
+
+    ooc._join_pair = cancel_after_first
+    try:
+        with cxl.cancel_scope(tok):
+            ex = LocalExecutor()
+            holder["ex"] = ex
+            builder = left.join(right, on="k", strategy="hash")._builder
+            opt = builder.optimize()
+            from daft_tpu.physical.translate import translate
+            plan = translate(opt._plan)
+            with pytest.raises(cxl.QueryCancelled):
+                for _ in ex.run(plan):
+                    pass
+    finally:
+        ooc._join_pair = orig
+    assert holder["ex"].mem.outstanding == 0
+
+
+# ------------------------------------------------------------ lifecycle
+
+def test_context_managers_close_deterministically(tmp_path, monkeypatch):
+    monkeypatch.setenv("DAFT_TPU_SPILL_DIR", str(tmp_path))
+    memory._spill_dir = None
+    rb = RecordBatch.from_pydict({"x": list(range(2000))})
+    with memory.PartitionedSpillStore(4, budget=1) as store:
+        store.push(0, rb)
+        store.push(1, rb)
+        store.finalize()
+        assert any(e.startswith("pstore_") for e in os.listdir(tmp_path))
+    assert not any(os.listdir(os.path.join(tmp_path, e))
+                   for e in os.listdir(tmp_path)
+                   if os.path.isdir(os.path.join(tmp_path, e)))
+    with memory.SpillBuffer(budget=1) as buf:
+        buf.append(MicroPartition.from_recordbatch(rb))
+        assert buf.bytes_spilled > 0
+    assert not any(f.endswith(".arrow") for f in os.listdir(tmp_path))
+    memory._spill_dir = None
+
+
+def test_no_spill_dirs_leak_after_query(tmp_path, monkeypatch):
+    """After a spilling grace join completes, its spill directory holds
+    no bucket files — deterministic close(), not GC."""
+    monkeypatch.setenv("DAFT_TPU_SPILL_DIR", str(tmp_path))
+    monkeypatch.setenv("DAFT_TPU_MEMORY_LIMIT", "400KB")
+    memory._spill_dir = None
+    left, right = _join_dfs(n=30_000, ndv=10_000)
+    left.join(right, on="k", strategy="hash").to_pydict()
+    leftovers = []
+    for root, _dirs, files in os.walk(tmp_path):
+        leftovers.extend(os.path.join(root, f) for f in files)
+    assert leftovers == []
+    memory._spill_dir = None
+
+
+# ---------------------------------------------------------- compression
+
+@pytest.mark.parametrize("codec", ["lz4", "zstd", "none"])
+def test_spill_codec_roundtrip(tmp_path, monkeypatch, codec):
+    monkeypatch.setenv("DAFT_TPU_SPILL_DIR", str(tmp_path))
+    monkeypatch.setenv("DAFT_TPU_SHUFFLE_COMPRESSION", codec)
+    memory._spill_dir = None
+    memory._spill_ipc_cache.clear()
+    rb = RecordBatch.from_pydict(
+        {"x": list(range(5000)), "s": ["val%d" % (i % 50)
+                                       for i in range(5000)]})
+    store = memory.PartitionedSpillStore(2, budget=1)
+    store.push(0, rb)
+    store.push(1, rb)
+    store.finalize()
+    got = store.bucket_batches(0)
+    assert sum(len(b) for b in got) == 5000
+    assert got[0].to_pydict() == rb.to_pydict()
+    store.close()
+    buf = memory.SpillBuffer(budget=1)
+    buf.append(MicroPartition.from_recordbatch(rb))
+    assert buf[0].to_pydict() == rb.to_pydict()
+    buf.close()
+    memory._spill_ipc_cache.clear()
+    memory._spill_dir = None
+
+
+def test_spill_compression_shrinks_disk_bytes(tmp_path, monkeypatch):
+    """lz4 spill files are smaller on disk than uncompressed ones for
+    compressible data (the counters track LOGICAL bytes either way)."""
+    monkeypatch.setenv("DAFT_TPU_SPILL_DIR", str(tmp_path))
+    memory._spill_dir = None
+    rb = RecordBatch.from_pydict({"x": [1] * 50_000})
+
+    def disk_bytes(codec):
+        monkeypatch.setenv("DAFT_TPU_SHUFFLE_COMPRESSION", codec)
+        memory._spill_ipc_cache.clear()
+        store = memory.PartitionedSpillStore(1, budget=1)
+        store.push(0, rb)
+        store.finalize()
+        total = sum(os.path.getsize(os.path.join(r, f))
+                    for r, _d, fs in os.walk(tmp_path) for f in fs)
+        store.close()
+        return total
+
+    try:
+        compressed = disk_bytes("lz4")
+    except Exception:
+        pytest.skip("lz4 codec not built into this pyarrow")
+    plain = disk_bytes("none")
+    assert compressed < plain
+    memory._spill_ipc_cache.clear()
+    memory._spill_dir = None
+
+
+# ------------------------------------------------- determinism + stats
+
+def test_chaos_serialize_spilled_run_deterministic(tiny_budget,
+                                                   monkeypatch):
+    """Spilled execution is deterministic by construction; under
+    DAFT_TPU_CHAOS_SERIALIZE=1 two runs are bit-identical."""
+    monkeypatch.setenv("DAFT_TPU_CHAOS_SERIALIZE", "1")
+    left, right = _join_dfs(n=20_000, ndv=5_000)
+    q = lambda: left.join(right, on="k", strategy="hash") \
+        .groupby("k").agg(col("v").sum(), col("w").sum()) \
+        .sort("k").to_pydict()
+    assert q() == q()
+
+
+def test_spill_stats_block_in_explain_analyze(tiny_budget):
+    from daft_tpu import observability as obs
+    left, right = _join_dfs(n=30_000, ndv=10_000)
+    left.join(right, on="k", strategy="hash").to_pydict()
+    stats = obs.last_query_stats_local() or obs.last_query_stats()
+    assert stats is not None and stats.spill
+    rendered = stats.render()
+    assert "spill (out-of-core tier):" in rendered
+    assert "written" in rendered
+
+
+def test_spill_counters_at_metrics_endpoint(tiny_budget):
+    from daft_tpu import tracing
+    left, right = _join_dfs(n=20_000, ndv=5_000)
+    left.join(right, on="k", strategy="hash").to_pydict()
+    text = tracing.prometheus_text()
+    assert "daft_tpu_spill_bytes_written_total" in text
+
+
+# ------------------------------------------------------------- helpers
+
+def test_rotated_radix_decorrelates():
+    """Depth-1 sub-partitioning of one depth-0 bucket must spread rows
+    across sub-buckets (the naive ``h % m`` of a ``h % n`` residue class
+    collapses when gcd(n, m) > 1)."""
+    rb = RecordBatch.from_pydict({"k": list(range(100_000))})
+    d0 = ooc.radix_split(rb, [col("k")], 8, 0)
+    bucket = d0[3]
+    d1 = ooc.radix_split(bucket, [col("k")], 8, 1)
+    sizes = [len(p) for p in d1]
+    assert sum(sizes) == len(bucket)
+    assert all(s > 0 for s in sizes)
+    lo, hi = min(sizes), max(sizes)
+    assert hi < 2 * lo  # roughly uniform
+
+
+def test_radix_depth0_matches_partition_by_hash():
+    rb = RecordBatch.from_pydict({"k": list(range(10_000))})
+    a = ooc.radix_split(rb, [col("k")], 8, 0)
+    b = rb.partition_by_hash([col("k")], 8)
+    for x, y in zip(a, b):
+        assert x.to_pydict() == y.to_pydict()
+
+
+def test_plan_partitions_from_evidence():
+    assert ooc.plan_partitions(None) == ooc._DEFAULT_PARTITIONS
+    big = ooc.plan_partitions(10 << 30, budget=1 << 30)
+    assert 2 <= big <= ooc._MAX_PARTITIONS
+    assert ooc.plan_partitions(1, budget=1 << 30) == 2

@@ -193,9 +193,11 @@ def test_partitioned_agg_incremental_merge(many_files, monkeypatch):
 
 def test_partitioned_agg_declines_on_huge_footer_ndv(many_files, monkeypatch):
     """Footer stats predicting more groups than _FUSE_MAX_GROUPS route the
-    final agg through the spill-bounded exchange path (one agg per bucket)
-    instead of the fused LSM dispatcher — the SF100 Q18 crossover. Keys
-    without footer evidence (or small ranges) keep the fused default."""
+    final agg to the SPILL-PARTITIONED fused reducer (round 19: the state
+    streams through a rotated-radix store, merged per bucket on read) —
+    and DAFT_TPU_SPILL_AGG=0 restores the legacy decline onto the
+    spill-bounded exchange path. Keys without footer evidence (or small
+    ranges) keep the in-memory fused default."""
     from daft_tpu.execution import pipeline
     from daft_tpu.physical.translate import translate
     monkeypatch.setenv("DAFT_TPU_DEVICE", "0")
@@ -218,15 +220,23 @@ def test_partitioned_agg_declines_on_huge_footer_ndv(many_files, monkeypatch):
         col("v").sum().alias("s"))
     node = final_agg_node(df_wide)
     assert node.group_ndv == pytest.approx(n)  # dense ids: range == rows
-    # n (8000) distinct ids > a forced-low threshold → fusion declined
+    # n (8000) distinct ids > a forced-low threshold → the fusion now
+    # keeps the boundary elided but switches to the spilling reducer
     monkeypatch.setattr(pipeline, "_FUSE_MAX_GROUPS", n // 2)
+    info = pipeline._partitioned_agg_info(node)
+    assert info is not None and info[3] is True  # spill=True
+    # legacy escape hatch: DAFT_TPU_SPILL_AGG=0 declines the fusion
+    monkeypatch.setenv("DAFT_TPU_SPILL_AGG", "0")
     assert pipeline._partitioned_agg_info(node) is None
-    # the small-range key keeps the fused path under the same threshold
+    monkeypatch.delenv("DAFT_TPU_SPILL_AGG")
+    # the small-range key keeps the in-memory fused path under the same
+    # threshold
     df_small = dt.read_parquet(glob).groupby("g").agg(
         col("v").sum().alias("s"))
     small = final_agg_node(df_small)
     assert small.group_ndv == pytest.approx(7)
-    assert pipeline._partitioned_agg_info(small) is not None
+    small_info = pipeline._partitioned_agg_info(small)
+    assert small_info is not None and small_info[3] is False
     # and both paths still answer correctly end-to-end: the declined
     # (exchange) path must produce every group with the right sums
     out = df_wide.sort("id").to_pydict()
