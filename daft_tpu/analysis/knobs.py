@@ -358,6 +358,45 @@ _KNOBS: List[Knob] = [
        "per-session retained-response budget across finished "
        "operations (newest kept first); `0` disables",
        default_str="64MiB"),
+    # ------------------------------------------------------ adaptive
+    _k("DAFT_TPU_ADAPTIVE", "bool", False,
+       "daft_tpu/distributed/replan.py", "adaptive",
+       "`1` enables distributed runtime re-planning: boundary actuals "
+       "(exact rows/bytes/NDV from map receipts and in-memory sources) "
+       "rewrite downstream fragment estimates and re-pick combine "
+       "gating, broadcast demotion, exchange rung and spill fanout "
+       "before each stage dispatches; chaos-serialize or an active "
+       "fault plan disables it (counted `replan_frozen`)",
+       config_field="tpu_adaptive"),
+    _k("DAFT_TPU_ADAPTIVE_HISTORY", "int", 512,
+       "daft_tpu/physical/adaptive.py", "adaptive",
+       "bound on the AdaptivePlanner decision history; appends past the "
+       "cap evict the oldest entry (counted `history_evictions`)",
+       config_field="tpu_adaptive_history"),
+    _k("DAFT_TPU_CALIBRATION", "bool", False,
+       "daft_tpu/device/calibration.py", "adaptive",
+       "`1` enables the calibrated cost-model profile: observed "
+       "`DEV_*` kernel rates, shuffle wire rate, ICI rate and the "
+       "footer-NDV ratio override the hard-coded constants once the "
+       "sample floor is met; frozen (defaults + no observations) under "
+       "chaos-serialize or an active fault plan",
+       config_field="tpu_calibration"),
+    _k("DAFT_TPU_CALIBRATION_DIR", "str", None,
+       "daft_tpu/device/calibration.py", "adaptive",
+       "directory persisting one calibration profile per backend "
+       "(`calibration_<backend>.json`, atomic rewrite); unset keeps the "
+       "profile in-memory for the process lifetime",
+       config_field="tpu_calibration_dir", default_str="in-memory"),
+    _k("DAFT_TPU_CALIBRATION_ALPHA", "float", 0.2,
+       "daft_tpu/device/calibration.py", "adaptive",
+       "EWMA weight of one calibration observation (weighted samples "
+       "collapse to one update; clamped to (0, 1])",
+       config_field="tpu_calibration_alpha"),
+    _k("DAFT_TPU_CALIBRATION_MIN_SAMPLES", "int", 8,
+       "daft_tpu/device/calibration.py", "adaptive",
+       "sample-count floor a learned constant needs before it overrides "
+       "the hard-coded default",
+       config_field="tpu_calibration_min_samples"),
     # ------------------------------------------------- observability
     _k("DAFT_TPU_XPLANE_DIR", "str", None, "daft_tpu/observability.py",
        "observability", "directory capturing a jax profiler "

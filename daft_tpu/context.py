@@ -94,6 +94,17 @@ class ExecutionConfig:
     tpu_spill_agg: str = "auto"              # auto|1 (force)|0 (decline)
     tpu_spill_partitions: int = 0            # 0 → planner evidence decides
     tpu_spill_max_depth: int = 3             # rotated-radix recursion bound
+    # self-tuning feedback loops (round 20): distributed runtime
+    # re-planning (distributed/replan.py) and the calibrated cost-model
+    # profile (device/calibration.py). Field names spell the documented
+    # knobs (DAFT_TPU_ADAPTIVE, DAFT_TPU_CALIBRATION, …); the env var is
+    # the per-process override.
+    tpu_adaptive: bool = False               # runtime re-planning
+    tpu_adaptive_history: int = 512          # AdaptivePlanner history cap
+    tpu_calibration: bool = False            # learned cost-model profile
+    tpu_calibration_dir: str = ""            # "" → in-memory only
+    tpu_calibration_alpha: float = 0.2       # EWMA observation weight
+    tpu_calibration_min_samples: int = 8     # floor before overriding
     # serving plane (serving/scheduler.py); env spellings match the
     # documented serve knobs (DAFT_TPU_SERVE_CONCURRENCY, …)
     tpu_serve_concurrency: int = 4           # scheduler worker slots

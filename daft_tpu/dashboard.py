@@ -51,6 +51,9 @@ def broadcast_query(stats) -> None:
             # incl. the hash-vs-sort strategy + table load factor (r12)
             "device_kernels": dict(
                 getattr(stats, "device_kernels", {}) or {}),
+            # self-tuning feedback plane (r20): calibration observations
+            # + runtime re-plan decisions this query made
+            "adaptive": dict(getattr(stats, "adaptive", {}) or {}),
             # lock-order sanitizer (DAFT_TPU_SANITIZE=1): graph size,
             # cycles, per-query contention/blocking events
             "sanitizer": dict(getattr(stats, "sanitizer", {}) or {}),

@@ -102,6 +102,15 @@ _lock = threading.Lock()
 _profile: Optional[LinkProfile] = None
 
 
+def _cal(name: str, default: float) -> float:
+    """Read one costmodel constant through the calibration store (round
+    20): the learned per-backend value once its sample floor is met and
+    ``DAFT_TPU_CALIBRATION`` is on; the hard-coded default otherwise
+    (and always under the chaos-determinism freeze)."""
+    from . import calibration
+    return calibration.const(name, default)
+
+
 def _env_profile() -> Optional[LinkProfile]:
     from ..analysis import knobs
     rtt = knobs.env_float("DAFT_TPU_LINK_RTT_MS", default=None)
@@ -292,6 +301,8 @@ def reset_for_tests() -> None:
         _ici = None
     decision_counts.clear()
     ledger_reset()
+    from . import calibration
+    calibration.reset_for_tests()
 
 
 # ------------------------------------------------------ silicon peak specs
@@ -363,6 +374,12 @@ def ledger_record(kind: str, *, rows: int = 0, nbytes: float = 0.0,
     for field, v in fields:
         if v:
             obs.bump_plane("device_kernels", f"{kind}\x00{field}", v)
+    # calibration chokepoint (round 20): every real dispatch's achieved
+    # rate feeds the learned cost-model profile (no-op unless
+    # DAFT_TPU_CALIBRATION is on and the chaos freeze is off)
+    from . import calibration
+    calibration.observe_dispatch(kind, strategy, rows=rows, nbytes=nbytes,
+                                 seconds=seconds, dispatches=dispatches)
     # tracing plane: one span per real dispatch, carrying the ledger's
     # roofline story onto the query timeline (guard-checked: untraced
     # queries build nothing here)
@@ -536,7 +553,8 @@ def row_output_op_wins(bytes_up: float, bytes_down: float,
         return f
     host_s = ((host_bytes if host_bytes is not None else bytes_up)
               + bytes_down) / HOST_VECTOR_BPS
-    kernel_s = DEV_DISPATCH_S + (bytes_up + bytes_down) / DEV_VECTOR_BPS
+    kernel_s = DEV_DISPATCH_S + (bytes_up + bytes_down) \
+        / _cal("DEV_VECTOR_BPS", DEV_VECTOR_BPS)
     dev_s = link_profile().device_seconds(
         bytes_up, bytes_down, round_trips, kernel_s)
     _log("row_output", dev_s < host_s, host_s, dev_s,
@@ -555,7 +573,8 @@ def image_resize_wins(bytes_up: float, bytes_down: float) -> bool:
     if f is not None:
         return f
     host_s = bytes_up / HOST_PIL_BPS
-    kernel_s = DEV_DISPATCH_S + (bytes_up + bytes_down) / DEV_VECTOR_BPS
+    kernel_s = DEV_DISPATCH_S + (bytes_up + bytes_down) \
+        / _cal("DEV_VECTOR_BPS", DEV_VECTOR_BPS)
     dev_s = link_profile().device_seconds(bytes_up, bytes_down, 2.0,
                                           kernel_s)
     _log("image_resize", dev_s < host_s, host_s, dev_s,
@@ -569,7 +588,8 @@ def argsort_wins(n_rows: int, key_bytes: float, n_keys: int) -> bool:
         return f
     host_s = n_rows * max(n_keys, 1) / HOST_SORT_ROWS_PER_S
     bytes_down = n_rows * 8  # the permutation
-    kernel_s = DEV_DISPATCH_S + n_rows * max(n_keys, 1) / DEV_SORT_ROWS_PER_S
+    kernel_s = DEV_DISPATCH_S + n_rows * max(n_keys, 1) \
+        / _cal("DEV_SORT_ROWS_PER_S", DEV_SORT_ROWS_PER_S)
     dev_s = link_profile().device_seconds(key_bytes, bytes_down, 2.0,
                                           kernel_s)
     _log("argsort", dev_s < host_s, host_s, dev_s,
@@ -616,7 +636,8 @@ def agg_upload_wins(bytes_up: float, bytes_down: float,
     # round 12: the fused-agg gate prices the kernel at the strategy the
     # dispatch would actually take — the one-pass hash kernel streams the
     # data once where the sort strategy pays ≥2 passes per packed plane
-    bps = DEV_AGG_HASH_BPS if strategy == "hash" else DEV_AGG_BPS
+    bps = _cal("DEV_AGG_HASH_BPS", DEV_AGG_HASH_BPS) \
+        if strategy == "hash" else _cal("DEV_AGG_BPS", DEV_AGG_BPS)
     kernel_s = DEV_DISPATCH_S + bytes_up / bps
     # round 17: with the async pipeline active (window ≥ 2 in-flight
     # morsel slots) the transfer legs overlap neighbor morsels' compute,
@@ -659,8 +680,15 @@ SHUFFLE_SER_BPS = 2.0e9   # arrow IPC write/read, per side, per byte
 
 
 def shuffle_wire_bps() -> float:
+    """Wire bandwidth the shuffle/exchange decisions price against. An
+    EXPLICIT env setting wins (ops know their DCN); otherwise the
+    calibrated rate — observed at every sizable shuffle fetch — beats
+    the hard-coded 1000 MB/s default once its sample floor is met."""
     from ..analysis import knobs
-    return knobs.env_float("DAFT_TPU_SHUFFLE_WIRE_MBPS") * 1e6
+    if knobs.env_raw("DAFT_TPU_SHUFFLE_WIRE_MBPS") is not None:
+        return knobs.env_float("DAFT_TPU_SHUFFLE_WIRE_MBPS") * 1e6
+    return _cal("SHUFFLE_WIRE_BPS",
+                knobs.env_float("DAFT_TPU_SHUFFLE_WIRE_MBPS") * 1e6)
 
 
 # ----------------------------------------------- ICI (mesh) link model
@@ -740,14 +768,25 @@ def ici_bps() -> float:
         if env is not None:
             _ici = env * 1e6
             return _ici
+        measured = None
         try:
             # daft-lint: allow(blocking-under-lock) -- intentional: one
             # calibration per process; concurrent deciders wait for it
             # instead of racing duplicate mesh probes
-            _ici = _measure_ici()
+            measured = _measure_ici()
+            _ici = measured
         except Exception:
-            _ici = _ICI_FALLBACK_BPS
-        return _ici
+            # can't probe this process → the calibrated (cross-process)
+            # rate beats the hard-coded fallback once it has samples
+            _ici = _cal("ICI_BPS", _ICI_FALLBACK_BPS)
+    if measured is not None:
+        # outside the probe lock: fold only a REAL measurement into the
+        # persisted per-backend profile (feeding the fallback constant
+        # back in would let it masquerade as evidence) so meshless
+        # processes start calibrated
+        from . import calibration
+        calibration.observe("ICI_BPS", measured)
+    return _ici
 
 
 def mesh_exchange_wins(rows: Optional[int], row_bytes: float = 32.0,
@@ -798,7 +837,8 @@ def exchange_collective_wins(rows: Optional[int],
 
 def shuffle_combine_wins(rows: Optional[int], groups: Optional[int],
                          num_partitions: int, n_cols: int = 4,
-                         bytes_per_col: float = 8.0) -> bool:
+                         bytes_per_col: float = 8.0,
+                         exact_groups: bool = False) -> bool:
     """Price the map-side shuffle combine for a hash boundary feeding a
     decomposable grouped aggregation (Partial Partial Aggregates).
 
@@ -824,6 +864,14 @@ def shuffle_combine_wins(rows: Optional[int], groups: Optional[int],
         _log("shuffle_combine", True, 0.0, 0.0, rows=rows or 0,
              groups=groups or 0, num_partitions=num_partitions)
         return True
+    if not exact_groups:
+        # round 20: footer NDV evidence is damped by the calibrated
+        # actual/footer ratio — parquet min/max range NDV systematically
+        # over-predicts (a sparse key set reads as near-unique), which
+        # declined combines that would have collapsed the wire. EXACT
+        # evidence (measured by the re-planner) is never damped.
+        from . import calibration
+        groups = max(groups * calibration.ndv_ratio(), 1.0)
     groups_out = min(rows, groups * max(num_partitions, 1))
     saved_rows = max(rows - groups_out, 0)
     per_byte_trip = (2.0 / SHUFFLE_SER_BPS + 1.0 / shuffle_wire_bps()
@@ -833,6 +881,25 @@ def shuffle_combine_wins(rows: Optional[int], groups: Optional[int],
     _log("shuffle_combine", saved_s > extra_s, extra_s, saved_s,
          rows=rows, groups=groups, num_partitions=num_partitions)
     return saved_s > extra_s
+
+
+def combine_wins_pure(rows: Optional[int], groups: Optional[int],
+                      num_partitions: int, n_cols: int = 4,
+                      bytes_per_col: float = 8.0) -> bool:
+    """The HARD-CODED combine decision — same math as
+    ``shuffle_combine_wins`` but with no calibration damping, no
+    logging, and no side effects. The runtime re-planner compares the
+    evidence-priced decision against this to count ``combine_flips``
+    without double-tallying ``decision_counts``."""
+    if not rows or not groups:
+        return True
+    row_bytes = max(n_cols, 1) * bytes_per_col
+    groups_out = min(rows, groups * max(num_partitions, 1))
+    saved_rows = max(rows - groups_out, 0)
+    per_byte_trip = (2.0 / SHUFFLE_SER_BPS + 1.0 / shuffle_wire_bps()
+                     + 1.0 / HOST_AGG_BPS)
+    return saved_rows * row_bytes * per_byte_trip \
+        > rows * row_bytes / HOST_AGG_BPS
 
 
 # --------------------------------------------- out-of-core spill pricing
@@ -885,9 +952,9 @@ def join_wins(n_left: int, n_right: int, bytes_up: float,
         return f
     n = n_left + n_right
     host_s = n / HOST_JOIN_ROWS_PER_S
-    rate = DEV_JOIN_HASH_ROWS_PER_S \
+    rate = _cal("DEV_JOIN_HASH_ROWS_PER_S", DEV_JOIN_HASH_ROWS_PER_S) \
         if _join_strategy(n_left, n_right) == "hash" \
-        else DEV_JOIN_ROWS_PER_S
+        else _cal("DEV_JOIN_ROWS_PER_S", DEV_JOIN_ROWS_PER_S)
     kernel_s = DEV_DISPATCH_S + n / rate
     lp = link_profile()
     # round 17: overlap pricing when the async pipeline is active (the
@@ -967,10 +1034,15 @@ def groupby_strategy(rows: int, groups: Optional[float],
     requires a packable key set). Logged under ``groupby_strategy``
     ("device" = hash)."""
     from ..analysis import knobs
+    from . import calibration
     from . import pallas_kernels as pk
     words = pk.hash_pack_words(key_dtypes) if key_dtypes else None
     table = pk.table_capacity(max(out_cap, 1))
-    ndv = groups if groups else float(out_cap)
+    # footer NDV evidence damped by the calibrated actual/footer ratio
+    # (round 20): over-predicted NDV pushed dispatches onto the sort
+    # path whose one-pass hash rival would have won
+    ndv = max(groups * calibration.ndv_ratio(), 1.0) if groups \
+        else float(out_cap)
     lf = min(ndv / table, 1.0)
     forced = (knobs.env_str("DAFT_TPU_KERNEL_GROUPBY") or "auto").lower()
     if forced == "sort" or words is None:

@@ -107,6 +107,10 @@ class StageRunner:
         self.scheduler = scheduler or LeastLoadedScheduler()
         self.max_retries = max_retries  # None → DAFT_TPU_MAX_RETRIES
         self._rctx: Optional[ResilienceContext] = None
+        # set by the distributed runner's AQE loop so the runtime
+        # re-planner folds its decisions into the SAME history the
+        # materialize-and-reoptimize rounds record into
+        self._aqe_planner = None
 
     def _resilience(self) -> ResilienceContext:
         if self._rctx is None:
@@ -125,6 +129,7 @@ class StageRunner:
         return knobs.env_str("DAFT_TPU_DISTRIBUTED_SHUFFLE") != "driver"
 
     def run(self, stage_plan: StagePlan) -> Iterator[MicroPartition]:
+        from . import replan
         # fresh resilience state per query: quarantines/lineage span
         # stages but not queries
         self._rctx = ResilienceContext(
@@ -141,7 +146,17 @@ class StageRunner:
         use_shuffle = self._shuffle_enabled()
         topo = WorkerTopology.detect(self.manager.worker_ids) \
             if use_shuffle else None
+        # runtime re-planning (round 20, DAFT_TPU_ADAPTIVE): boundary
+        # actuals fold back into not-yet-dispatched stages — estimate
+        # rewrites, combine gating, broadcast demotion, exchange rung —
+        # disabled under the chaos-determinism contract
+        rp = replan.StageReplanner(stage_plan,
+                                   planner=self._aqe_planner) \
+            if replan.adaptive_enabled() else None
         for stage in stage_plan.stages:
+            if rp is not None:
+                rp.before_stage(stage, consumer.get(stage.id), outputs,
+                                out_mode)
             # this stage's output mode: the placement layer picks the
             # exchange path for its consumer boundary (collective /
             # hierarchical / flight), flight shuffles out when the
@@ -159,7 +174,7 @@ class StageRunner:
                         for ob in stage.boundaries)
                     if stage_plan.collective_safe(cstage, b):
                         exch_path = self._plan_exchange_path(
-                            topo, stage, b, inputs_mat)
+                            topo, stage, b, inputs_mat, rp)
                     if exch_path in (None, "flight") and (
                             stage_plan.fanout_safe(cstage, b)
                             or stage_plan.split_for_fanout(cstage, b)
@@ -168,7 +183,7 @@ class StageRunner:
                         shuffle_out = ShuffleOutSpec(b.num_partitions,
                                                      tuple(b.by))
                         combo = self._plan_combine(stage_plan, cstage, b,
-                                                   stage)
+                                                   stage, rp)
                         if combo is not None:
                             shuffle_out.combine_aggs, \
                                 shuffle_out.combine_by = combo
@@ -228,18 +243,26 @@ class StageRunner:
                 outputs[stage.id] = result
                 out_mode[stage.id] = "shuffled" \
                     if shuffle_out is not None else "mat"
+            if rp is not None:
+                rp.after_stage(stage, outputs[stage.id],
+                               out_mode.get(stage.id, "mat"))
         yield from outputs[stage_plan.root.id]
 
     def _plan_combine(self, stage_plan: StagePlan, cstage: Stage,
-                      b: Boundary, up_stage: Stage):
+                      b: Boundary, up_stage: Stage, rp=None):
         """Decide the map-side combine for one hash boundary: structural
         eligibility comes from the stage planner
         (``StagePlan.combine_for_boundary`` — the boundary must feed a
         final grouped aggregation whose aggs are all self-merges), then
         the cost model prices the modeled wire savings against the extra
         map-side agg pass (``costmodel.shuffle_combine_wins`` over the
-        planner's row/NDV evidence). ``DAFT_TPU_SHUFFLE_COMBINE=1``
-        forces it, ``0`` is the escape hatch, default ``auto``."""
+        planner's row/NDV evidence). With the runtime re-planner active
+        (round 20) the pricing uses the producing stage's MEASURED rows
+        and — when affordable — the EXACT key NDV instead of footer
+        estimates; a decision the static evidence would have gotten
+        wrong is counted as a ``combine_flip``.
+        ``DAFT_TPU_SHUFFLE_COMBINE=1`` forces it, ``0`` is the escape
+        hatch, default ``auto``."""
         from ..analysis import knobs
         mode = knobs.env_str("DAFT_TPU_SHUFFLE_COMBINE").lower()
         if mode in ("0", "off", "false", "none"):
@@ -250,28 +273,78 @@ class StageRunner:
         combine_aggs, combine_by, agg_node = combo
         if mode not in ("1", "on", "force", "true"):
             from ..device import costmodel
+            from ..physical import adaptive
             rows = getattr(agg_node, "group_rows_est", None)
             groups = getattr(agg_node, "group_ndv", None)
-            if not costmodel.shuffle_combine_wins(
-                    rows, groups, b.num_partitions,
-                    n_cols=len(combine_aggs) + len(combine_by)):
+            n_cols = len(combine_aggs) + len(combine_by)
+            ev = rp.combine_evidence(up_stage) if rp is not None else None
+            e_rows, e_groups, exact = rows, groups, False
+            if ev is not None:
+                m_rows, m_ndv, m_exact = ev
+                e_rows = m_rows
+                if m_ndv is not None:
+                    e_groups, exact = m_ndv, m_exact
+            decision = costmodel.shuffle_combine_wins(
+                e_rows, e_groups, b.num_partitions, n_cols=n_cols,
+                exact_groups=exact)
+            if rp is not None and ev is not None:
+                static = costmodel.combine_wins_pure(
+                    rows, groups, b.num_partitions, n_cols=n_cols)
+                if static != decision:
+                    adaptive.count("combine_flips")
+                    rp.planner.record_replan(
+                        f"stage s{up_stage.id}: map-side combine "
+                        f"{'enabled' if decision else 'declined'} from "
+                        f"measured evidence (rows={e_rows} "
+                        f"groups={e_groups} exact={exact}; static said "
+                        f"{'combine' if static else 'no combine'})",
+                        int(e_rows or 0))
+            if not decision:
                 return None
         return combine_aggs, combine_by
 
     # ---------------------------------------- pod-native exchange paths
     def _plan_exchange_path(self, topo: WorkerTopology, stage: Stage,
-                            b: Boundary, inputs_mat: bool) -> str:
+                            b: Boundary, inputs_mat: bool,
+                            rp=None) -> str:
         """Placement decision for one structurally-eligible hash
         boundary (consumer whole-stage fanout-safe): collective /
         hierarchical / flight per the topology decision ladder
         (``topology.plan_exchange_path``). Hierarchical additionally
         requires the producer's own inputs to be driver-materialized —
         its map tasks re-dispatch per mesh group, which the shuffled
-        input bindings don't survive. Every decision is counted in the
-        shuffle data plane (``exchange_path_*``)."""
+        input bindings don't survive. With the runtime re-planner
+        active, the ladder prices from the producing stage's MEASURED
+        rows and row widths instead of the evidence-free default-accept;
+        a rung the evidence changed is counted ``exchange_repicks``.
+        Every decision is counted in the shuffle data plane
+        (``exchange_path_*``)."""
+        from ..physical import adaptive
         from . import topology as tp
         from .shuffle_service import shuffle_count
-        path = tp.plan_exchange_path(topo, b.num_partitions)
+        ev = rp.exchange_evidence(stage) if rp is not None else None
+        if ev is not None:
+            rows_est, row_bytes = ev
+            path = tp.plan_exchange_path(topo, b.num_partitions,
+                                         rows_est=rows_est,
+                                         row_bytes=row_bytes)
+            # evidence-free, the auto ladder default-accepts the
+            # collective family on structural grounds alone — a flip to
+            # flight here is the measured evidence talking
+            structural = "collective" if topo.single_mesh() else (
+                "hierarchical" if topo.multi_worker_groups() >= 1
+                else "flight")
+            forced = tp._path_setting() in tp.PATHS
+            if not forced and path != structural \
+                    and structural != "flight":
+                adaptive.count("exchange_repicks")
+                rp.planner.record_replan(
+                    f"stage s{stage.id}: exchange rung "
+                    f"{structural}→{path} from measured rows="
+                    f"{int(rows_est)} row_bytes={row_bytes:.1f}",
+                    int(rows_est))
+        else:
+            path = tp.plan_exchange_path(topo, b.num_partitions)
         if path == "hierarchical" and not inputs_mat:
             path = "flight"
         shuffle_count(f"exchange_path_{path}")
@@ -450,8 +523,9 @@ class StageRunner:
             except BaseException:
                 cache.cleanup()
                 raise
+            _, nbytes, _ = cache.stats()
             return ShuffleResult(server.address, cache.shuffle_id, n,
-                                 rows)
+                                 rows, nbytes=nbytes)
 
         return rebuild
 

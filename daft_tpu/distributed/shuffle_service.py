@@ -87,6 +87,10 @@ def shuffle_counters_reset() -> None:
 #: regardless of partition size
 _CHUNK_BYTES = 1 << 20
 
+#: fetches below this decoded size are RTT-dominated and must not feed
+#: the calibrated wire-rate profile
+_WIRE_OBS_MIN_BYTES = 256 << 10
+
 _ipc_opts_cache: Dict[str, Tuple[Optional[object], Optional[str]]] = {}
 
 
@@ -224,6 +228,16 @@ class ShuffleCache:
             return os.path.getsize(self._path(partition))
         except OSError:
             return 0
+
+    def stats(self) -> Tuple[int, int, Dict[int, int]]:
+        """(total rows pushed, total on-disk bytes, per-partition rows) —
+        the EXACT boundary cardinalities the runtime re-planner consumes
+        (they ride back to the driver on the map receipt)."""
+        with self._lock:
+            part_rows = dict(self._rows)
+        rows = sum(part_rows.values())
+        nbytes = sum(self.partition_size(p) for p in part_rows)
+        return rows, nbytes, part_rows
 
     def touch(self) -> None:
         """Refresh the spill dir's mtime: an actively-served output must
@@ -680,10 +694,17 @@ def fetch_partition(address: str, shuffle_id: str, partition: int,
             raise ShuffleFetchError(address, shuffle_id, partition,
                                     detail=f"{type(exc).__name__}: "
                                            f"{str(exc)[:200]}") from exc
+        elapsed = _time.perf_counter() - t0
         # serial-equivalent fetch time: the per-call sum the parallel
         # fetch's span is compared against in the overlap evidence
-        shuffle_count("fetch_wall_us", (_time.perf_counter() - t0) * 1e6)
+        shuffle_count("fetch_wall_us", elapsed * 1e6)
         shuffle_count("fetches")
+        # calibration chokepoint (round 20): sizable fetches feed the
+        # observed wire rate (tiny partitions measure RTT, not bandwidth)
+        if out is not None and out.nbytes >= _WIRE_OBS_MIN_BYTES \
+                and elapsed > 1e-3:
+            from ..device import calibration
+            calibration.observe("SHUFFLE_WIRE_BPS", out.nbytes / elapsed)
         sp.set("rows", out.num_rows if out is not None else 0)
         return out
 

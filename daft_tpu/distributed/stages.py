@@ -39,6 +39,10 @@ class Boundary:
     by: Tuple = ()
     descending: Tuple = ()
     engine_inserted: bool = False  # preserves the AQE-adaptability flag
+    # join-side co-partitioning exchange (translate marks the pair):
+    # strategy-adaptable — the runtime re-planner may demote it to a
+    # broadcast from measured sizes, exactly like the local AQE path
+    join_side: bool = False
 
 
 @dataclass
@@ -129,7 +133,8 @@ class StagePlan:
                 boundaries.append(Boundary(
                     sid, node.kind, node.num_partitions, tuple(node.by),
                     tuple(node.descending),
-                    getattr(node, "engine_inserted", False)))
+                    getattr(node, "engine_inserted", False),
+                    getattr(node, "join_side", False)))
                 return pp.StageInput(sid, node.schema())
             n = copy.copy(node)
             n.children = [cut(c, boundaries) for c in node.children]

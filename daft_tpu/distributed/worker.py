@@ -63,13 +63,21 @@ class ShuffleOutSpec:
 @dataclass
 class ShuffleResult:
     """Map-side receipt: where a task's shuffled output is served from
-    (flotilla: the shuffle cache registration a reduce task fetches by)."""
+    (flotilla: the shuffle cache registration a reduce task fetches by).
+
+    ``rows``/``nbytes`` are the EXACT pushed cardinality and on-disk
+    bytes of this map output, and ``state_rows`` (combine path only) the
+    pushed group-state count — an upper bound on the boundary keys' NDV
+    this task saw. The runtime re-planner (round 20) folds these actuals
+    into downstream stage decisions before dispatching them."""
 
     address: str
     shuffle_id: str
     num_partitions: int
     rows: int
     samples_ipc: Optional[bytes] = None
+    nbytes: int = 0
+    state_rows: Optional[int] = None
 
 
 @dataclass
@@ -398,6 +406,7 @@ def _run_task_body(task: StageTask) -> object:
     by = list(spec.by)
     cache = ShuffleCache()
     rows = 0
+    state_rows = None
     samples_ipc = None
     # a failure while draining the stream (task fault, fetch fault on a
     # lazily resolved input, partitioning error) must delete the cache's
@@ -409,7 +418,8 @@ def _run_task_body(task: StageTask) -> object:
     try:
         if spec.kind == "hash":
             if spec.combine_aggs:
-                rows = _hash_shuffle_combined(stream, cache, spec, by)
+                rows, state_rows = _hash_shuffle_combined(stream, cache,
+                                                          spec, by)
             else:
                 for mp in stream:
                     rows += len(mp)
@@ -451,12 +461,14 @@ def _run_task_body(task: StageTask) -> object:
     except BaseException:
         cache.cleanup()
         raise
+    _, nbytes, _ = cache.stats()  # sealed by register(): sizes are final
     return ShuffleResult(server.address, cache.shuffle_id,
-                         spec.num_partitions, rows, samples_ipc)
+                         spec.num_partitions, rows, samples_ipc,
+                         nbytes=nbytes, state_rows=state_rows)
 
 
 def _hash_shuffle_combined(stream, cache, spec: ShuffleOutSpec,
-                           by: list) -> int:
+                           by: list) -> tuple:
     """Map-side combine (Partial Partial Aggregates): hash-partition every
     morsel, but pre-aggregate each partition's buffered pieces to ONE
     group-state table before pushing — the wire carries group states, not
@@ -528,7 +540,10 @@ def _hash_shuffle_combined(stream, cache, spec: ShuffleOutSpec,
         flush(i)
     shuffle_count("combine_rows_in", rows)
     shuffle_count("combine_rows_out", pushed)
-    return rows
+    # → (input rows, pushed group-state rows): the state count rides the
+    # receipt as this task's exact boundary-key NDV bound (re-planner
+    # evidence; mid-stream budget flushes only ever over-count it)
+    return rows, pushed
 
 
 def _ipc_bytes(table) -> bytes:

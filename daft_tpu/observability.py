@@ -174,6 +174,18 @@ def _exchange_raw() -> Dict[str, float]:
         return {}
 
 
+def _adaptive_raw() -> Dict[str, float]:
+    """Raw snapshot of the self-tuning counters (calibration
+    observations, re-plan decisions: combine flips, broadcast
+    demotions, exchange re-picks, estimate rewrites) — never raises,
+    like the device ledger."""
+    try:
+        from .physical import adaptive
+        return adaptive.counters_snapshot()
+    except Exception:
+        return {}
+
+
 def _sanitizer_raw() -> Dict[str, float]:
     """Raw snapshot of the lock-order sanitizer counters (acquisitions,
     contended acquisitions, blocking-while-held events) — empty unless
@@ -314,6 +326,10 @@ class RuntimeStatsContext:
         # re-enter one trace instead of re-tracing per call
         self._exchange0 = _exchange_raw()
         self.exchange: Dict[str, float] = {}
+        # …and the self-tuning feedback plane (round 20): calibration
+        # observations + runtime re-plan decisions this query made
+        self._adaptive0 = _adaptive_raw()
+        self.adaptive: Dict[str, float] = {}
         # …and for the lock-order sanitizer (DAFT_TPU_SANITIZE=1):
         # per-query acquisition/contention deltas + current graph size
         self._sanitizer0 = _sanitizer_raw()
@@ -394,6 +410,7 @@ class RuntimeStatsContext:
             self.shuffle = self._plane("shuffle")
             self.io = self._plane("io")
             self.spill = self._plane("spill")
+            self.adaptive = self._plane("adaptive")
         else:
             try:
                 from .distributed import resilience
@@ -419,6 +436,12 @@ class RuntimeStatsContext:
                     self._spill0, _spill_raw())
             except Exception:
                 self.spill = {}
+            try:
+                from .physical import adaptive
+                self.adaptive = adaptive.counters_delta(
+                    self._adaptive0, _adaptive_raw())
+            except Exception:
+                self.adaptive = {}
         # process-wide diff regardless of attribution: the program cache
         # is shared engine state (like the sanitizers), not per-thread
         # traffic — concurrent queries legitimately share its hits
@@ -529,6 +552,7 @@ class RuntimeStatsContext:
                 lines.append(f"  {k}: {v}")
         lines.extend(render_shuffle_block(self.shuffle))
         lines.extend(render_exchange_block(self.exchange))
+        lines.extend(render_adaptive_block(self.adaptive))
         lines.extend(render_io_block(self.io))
         lines.extend(render_spill_block(self.spill))
         lines.extend(render_sanitizer_block(self.sanitizer))
@@ -623,6 +647,36 @@ def render_exchange_block(ex: Dict[str, float]) -> List[str]:
     lines = ["exchange programs (collective cache):"]
     lines.append("  " + ", ".join(
         f"{k}={int(v)}" for k, v in sorted(ex.items())))
+    return lines
+
+
+def render_adaptive_block(d: Dict[str, float]) -> List[str]:
+    """Human lines for one query's self-tuning delta (shared by
+    ``explain(analyze=True)`` and the dashboard): the re-plan decisions
+    it made, the calibration observations it fed, plus the live
+    calibrated-vs-default state of the cost-model constants (which
+    learned values are overriding the hard-coded defaults right now)."""
+    cal_names: List[str] = []
+    try:
+        from .device import calibration
+        if calibration.enabled():
+            cal_names = calibration.calibrated_names()
+    except Exception:
+        pass
+    if not d and not cal_names:
+        return []
+    lines = ["adaptive (self-tuning):"]
+    decisions = {k: int(v) for k, v in sorted(d.items())
+                 if k != "calibration_observations" and v}
+    if decisions:
+        lines.append("  re-plan: " + ", ".join(
+            f"{k}={v}" for k, v in decisions.items()))
+    obs_n = int(d.get("calibration_observations", 0))
+    if obs_n:
+        lines.append(f"  calibration: {obs_n} observations fed")
+    if cal_names:
+        lines.append("  calibrated constants (overriding defaults): "
+                     + ", ".join(cal_names))
     return lines
 
 
@@ -951,7 +1005,8 @@ def flight_entry(ctx: RuntimeStatsContext) -> dict:
         "operators": ctx.as_dict(),
     }
     for block in ("recovery", "shuffle", "exchange", "io", "spill",
-                  "device_kernels", "serving", "sanitizer", "retrace"):
+                  "adaptive", "device_kernels", "serving", "sanitizer",
+                  "retrace"):
         v = getattr(ctx, block, None)
         if v:
             entry[block] = dict(v)

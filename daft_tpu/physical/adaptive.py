@@ -35,6 +35,64 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+# ------------------------------------------------------ adaptive counters
+# Process-wide accounting for BOTH feedback loops (round 20): calibration
+# observations, distributed re-plan decisions (combine flips, broadcast
+# demotions, exchange re-picks, estimate rewrites), and history
+# evictions. Mirrors the shuffle/spill counter pattern: snapshot at query
+# start, diff at finish() → the per-query ``adaptive`` stats block; also
+# credited to the thread-attributed RuntimeStatsContext and scraped at
+# ``/metrics`` as ``daft_tpu_adaptive_*_total``.
+
+_counters_lock = threading.Lock()
+_counters: Dict[str, float] = {}
+
+
+def count(name: str, n: float = 1) -> None:
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0) + n
+    from .. import observability as obs
+    obs.bump_plane("adaptive", name, n)
+
+
+def counters_snapshot() -> Dict[str, float]:
+    with _counters_lock:
+        return dict(_counters)
+
+
+def counters_delta(before: Dict[str, float],
+                   after: Optional[Dict[str, float]] = None
+                   ) -> Dict[str, float]:
+    if after is None:
+        after = counters_snapshot()
+    out = {}
+    for k, v in after.items():
+        d = v - before.get(k, 0)
+        if d:
+            out[k] = d
+    return out
+
+
+def counters_reset() -> None:
+    with _counters_lock:
+        _counters.clear()
+
+
+def history_cap() -> int:
+    """Bound on ``AdaptivePlanner.history`` (``DAFT_TPU_ADAPTIVE_HISTORY``
+    env, else the ``ExecutionConfig.tpu_adaptive_history`` mirror): the
+    planner lives as long as its executor, and a long-lived serving
+    process re-plans forever — an unbounded decision log is a slow leak."""
+    from ..analysis import knobs
+    cap = knobs.env_int("DAFT_TPU_ADAPTIVE_HISTORY", default=None)
+    if cap is None:
+        try:
+            from ..context import get_context
+            cap = int(get_context().execution_config.tpu_adaptive_history)
+        except Exception:
+            cap = 512
+    return max(int(cap), 1)
+
 
 @dataclass
 class StageStats:
@@ -45,12 +103,26 @@ class StageStats:
 
 
 class AdaptivePlanner:
-    """Records per-boundary actuals and decides adapted partition counts."""
+    """Records per-boundary actuals and decides adapted partition counts.
+
+    ``history`` is BOUNDED (``DAFT_TPU_ADAPTIVE_HISTORY``): appends past
+    the cap evict the oldest entry, counted in ``evictions`` (and the
+    process-wide ``history_evictions`` adaptive counter) so a serving
+    process that re-plans for days holds a window, not a log."""
 
     def __init__(self, cfg):
         self.cfg = cfg
         self._lock = threading.Lock()
         self.history: List[StageStats] = []
+        self.evictions = 0
+        self._cap = history_cap()
+
+    def _append_locked(self, s: StageStats) -> None:
+        self.history.append(s)
+        while len(self.history) > self._cap:
+            self.history.pop(0)
+            self.evictions += 1
+            count("history_evictions")
 
     def adapt_partition_count(self, planned: int, total_bytes: int,
                               total_rows: int) -> int:
@@ -60,7 +132,7 @@ class AdaptivePlanner:
         by_size = max(math.ceil(total_bytes / target), 1)
         adapted = max(min(planned, by_size), 1)
         with self._lock:
-            self.history.append(StageStats(
+            self._append_locked(StageStats(
                 rows=total_rows, size_bytes=total_bytes, partitions=adapted,
                 decision=(f"shuffle {planned}→{adapted} parts "
                           f"({total_bytes} bytes materialized)")))
@@ -72,7 +144,7 @@ class AdaptivePlanner:
         stats folded back into the logical plan, and the optimizer re-run
         over the remainder (the reference's update_stats → next_stage)."""
         with self._lock:
-            self.history.append(StageStats(
+            self._append_locked(StageStats(
                 rows=rows, size_bytes=size_bytes, partitions=0,
                 decision=decision))
 
@@ -80,7 +152,7 @@ class AdaptivePlanner:
         """Join-strategy adaptation from measured input sizes (hash ↔
         broadcast demotion)."""
         with self._lock:
-            self.history.append(StageStats(
+            self._append_locked(StageStats(
                 rows=0, size_bytes=measured_bytes, partitions=0,
                 decision=f"join {decision} "
                          f"({measured_bytes} bytes measured)"))
@@ -88,6 +160,9 @@ class AdaptivePlanner:
     def explain_analyze(self) -> str:
         lines = ["== Adaptive execution =="]
         with self._lock:
+            if self.evictions:
+                lines.append(f"(history capped at {self._cap}; "
+                             f"{self.evictions} oldest entries evicted)")
             for i, s in enumerate(self.history):
                 lines.append(f"stage {i}: rows={s.rows} "
                              f"bytes={s.size_bytes} → {s.decision}")

@@ -47,20 +47,37 @@ class DistributedRunner(Runner):
                  ) -> Iterator[MicroPartition]:
         from .. import observability as obs
         from .. import tracing
+        from ..context import get_context
+        cfg = get_context().execution_config
         tctx = tracing.maybe_start_trace("distributed")
         # a planning failure strikes before the driver stats context
         # below takes ownership of the recorder — close and unregister it
         # on that path or it leaks (daft-lint: trace-recorder-leak)
         try:
             with tracing.attach(tctx):
-                with tracing.span("plan:optimize", lane="planner"):
-                    optimized = builder.optimize()
-                with tracing.span("plan:translate", lane="planner"):
-                    pplan = translate(optimized.plan)
+                aqe_planner = None
+                if cfg.enable_aqe:
+                    # the native runner's AQE loop, distributed (round
+                    # 20): join inputs materialize THROUGH the stage
+                    # runner, their actual rows/bytes replace the
+                    # subtree, and the optimizer re-runs — join ORDER
+                    # and broadcast decisions in this tier come from
+                    # measurements too
+                    with tracing.span("plan:optimize", lane="planner"):
+                        plan, aqe_planner = self._adaptive_logical(
+                            builder, cfg)
+                    with tracing.span("plan:translate", lane="planner"):
+                        pplan = translate(plan)
+                else:
+                    with tracing.span("plan:optimize", lane="planner"):
+                        optimized = builder.optimize()
+                    with tracing.span("plan:translate", lane="planner"):
+                        pplan = translate(optimized.plan)
                 stage_plan = StagePlan.from_physical(pplan)
                 runner = StageRunner(
                     self._get_manager(),
                     self._scheduler or LeastLoadedScheduler())
+                runner._aqe_planner = aqe_planner
                 # driver-level query stats: each stage task runs its own
                 # local executor (whose stats only cover that fragment);
                 # this context spans the whole query, so its
@@ -95,3 +112,46 @@ class DistributedRunner(Runner):
                 stats.finish()
             finally:
                 obs.set_last_stats(stats)
+
+    # ------------------------------------------------------------- AQE
+    def _adaptive_logical(self, builder, cfg):
+        """Distributed port of ``NativeRunner._run_adaptive``'s planning
+        loop (the reference's next_stage/update_stats): the cheapest
+        unmeasured join input materializes through the DISTRIBUTED stage
+        runner (workers, shuffle plane, resilience included), an
+        in-memory source carrying its ACTUAL rows/bytes replaces the
+        subtree, and the whole optimizer re-runs — repeated until every
+        join input is measured. → (final logical plan, the AdaptivePlanner
+        holding the re-plan history, shared with the stage runner's
+        boundary-level re-planner)."""
+        from .. import observability as obs
+        from ..logical import plan as lp
+        from ..logical.optimizer import Optimizer
+        from ..physical import adaptive
+        from .native_runner import _pick_join_input, _replace_subtree
+
+        planner = adaptive.new_planner(cfg)
+        plan = Optimizer().optimize(builder._plan)
+        for _round in range(32):  # bound the loop defensively
+            target = _pick_join_input(plan)
+            if target is None:
+                break
+            sub_runner = StageRunner(
+                self._get_manager(),
+                self._scheduler or LeastLoadedScheduler())
+            sub_runner._aqe_planner = planner
+            with obs.nested_scope():  # no per-query exports mid-loop
+                parts = [p for p in sub_runner.run(
+                    StagePlan.from_physical(translate(target)))
+                    if len(p)]
+            rows = sum(len(p) for p in parts)
+            size = sum(int(p.size_bytes() or 0) for p in parts)
+            src = lp.Source(partitions=parts, schema=target.schema(),
+                            num_partitions=max(len(parts), 1))
+            planner.record_replan(
+                f"materialized join input distributed ({rows} rows, "
+                f"{size} bytes actual) → re-optimized remainder",
+                rows, size)
+            plan = _replace_subtree(plan, target, src)
+            plan = Optimizer().optimize(plan)
+        return plan, planner

@@ -42,6 +42,44 @@ from .caches import PlanCache, ResultCache
 _DEFAULT_EST_BYTES = 64 << 20  # footprint guess when the cost model is blind
 _MIN_EST_BYTES = 1 << 20
 
+#: per-fingerprint admission-history EWMA weight and retained entries —
+#: ROADMAP 4c (minimal): when the cost model is BLIND (no source stats),
+#: repeat queries admit their OBSERVED result bytes instead of the flat
+#: 64 MiB default, seeded from this process's history and from
+#: flight-recorder records of earlier processes
+_HIST_ALPHA = 0.3
+_HIST_MAX_ENTRIES = 1024
+
+
+def _history_fingerprint(builder) -> Optional[str]:
+    """Stable per-query history key: the literal-inclusive structure
+    hash plus the source PATHS — but WITHOUT the size/mtime version
+    tokens (a repeat query over refreshed data is still the same
+    workload for admission purposes). The paths must participate: the
+    canonical structure names sources positionally, so without them a
+    same-shape query over a DIFFERENT (much larger) dataset would seed
+    its admission estimate from the small one's history and bypass the
+    memory gate. None when the plan is unfingerprintable (in-memory
+    sources, sinks)."""
+    import hashlib
+
+    from ..context import get_context
+    from ..logical.fingerprint import fingerprint
+    try:
+        fp = fingerprint(builder.plan, get_context().execution_config)
+    except Exception:
+        return None
+    if fp is None:
+        return None
+    try:
+        paths = tuple(p for (_t, vers) in fp.sources
+                      for (p, _sz, _mt) in vers)
+    except Exception:
+        return None
+    return hashlib.sha256(
+        (fp.structure + "\x00" + repr(fp.params) + "\x00" + repr(paths))
+        .encode()).hexdigest()[:16]
+
 
 class AdmissionRejected(RuntimeError):
     """Structured admission failure. ``kind`` is one of ``queue_full``,
@@ -154,6 +192,9 @@ class QueryHandle:
         self.submitted_at_us = int(time.time() * 1e6)
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        # per-fingerprint admission-history key, set only when the cost
+        # model was blind at submit (the history's trigger condition)
+        self._fp_hist_key: Optional[str] = None
         # tracing: the query's trace starts at SUBMIT so queue wait is
         # on the timeline; None when tracing is off / sampled out
         from .. import tracing
@@ -293,6 +334,17 @@ class QueryScheduler:
         self._shutdown = False
         self._counts_lock = threading.Lock()
         self._counters: Dict[str, float] = {}
+        # per-fingerprint admission history (ROADMAP 4c, minimal):
+        # key → (ewma result bytes, ewma wall us, samples); consulted
+        # only when the cost-model estimate is absent, seeded lazily
+        # from the flight recorder so it survives restarts
+        self._hist_lock = threading.Lock()
+        self._fp_hist: Dict[str, tuple] = {}
+        self._flight_seeded = False
+        # submit-thread side channel: _estimate_bytes keeps its
+        # (self, builder) signature — tests monkeypatch it — so the
+        # history key travels per-thread instead of per-call
+        self._tl_est = threading.local()
         self._threads: List[threading.Thread] = []
         for i in range(self.concurrency):
             t = threading.Thread(target=self._worker_loop,
@@ -376,6 +428,11 @@ class QueryScheduler:
         # also needs
         if est_bytes is None:
             est_bytes = self._estimate_bytes(builder)
+            # the estimator flags a blind (history-keyed) estimate on
+            # the submitting thread; adopt it onto the handle so the
+            # completion path can close the loop
+            h._fp_hist_key = getattr(self._tl_est, "hist_key", None)
+            self._tl_est.hist_key = None
         with self._cond:
             self._count("submitted")
             if self._shutdown:
@@ -420,8 +477,69 @@ class QueryScheduler:
         except Exception:
             est = None
         if est is None:
+            # cost model is blind: seed from per-fingerprint history
+            # (this process's completions, else flight-recorder records
+            # of earlier processes) before falling back to the flat
+            # default — repeat queries stop over-/under-admitting
+            key = _history_fingerprint(builder)
+            self._tl_est.hist_key = key
+            if key is not None:
+                seeded = self._history_estimate(key)
+                if seeded is not None:
+                    self._count("est_seeded_history")
+                    return seeded
             return _DEFAULT_EST_BYTES
         return max(int(est), _MIN_EST_BYTES)
+
+    # ----------------------------------------- admission history (4c)
+    def _history_estimate(self, key: str) -> Optional[int]:
+        self._seed_history_from_flight()
+        with self._hist_lock:
+            e = self._fp_hist.get(key)
+        if e is None:
+            return None
+        return max(int(e[0]), _MIN_EST_BYTES)
+
+    def _record_history(self, key: Optional[str], result_bytes: int,
+                        wall_us: int) -> None:
+        if key is None or result_bytes < 0:
+            return
+        with self._hist_lock:
+            e = self._fp_hist.get(key)
+            if e is None:
+                self._fp_hist[key] = (float(result_bytes),
+                                      float(wall_us), 1)
+            else:
+                b, w, n = e
+                self._fp_hist[key] = (
+                    b + _HIST_ALPHA * (result_bytes - b),
+                    w + _HIST_ALPHA * (wall_us - w), n + 1)
+            while len(self._fp_hist) > _HIST_MAX_ENTRIES:
+                self._fp_hist.pop(next(iter(self._fp_hist)))
+
+    def _seed_history_from_flight(self) -> None:
+        """One-time seed from flight-recorder records
+        (``DAFT_TPU_QUERY_LOG``): serving blocks of past queries carry
+        the history key + observed result bytes/latency, so a fresh
+        process admits repeat queries from evidence immediately."""
+        with self._hist_lock:
+            if self._flight_seeded:
+                return
+            self._flight_seeded = True
+        try:
+            from .. import tracing
+            entries = tracing.flight_history()
+        except Exception:
+            return
+        for entry in reversed(entries):  # oldest-first into the EWMA
+            sv = entry.get("serving")
+            if not isinstance(sv, dict):
+                continue
+            key = sv.get("fp_hist_key")
+            rb = sv.get("result_bytes")
+            if key and isinstance(rb, (int, float)):
+                self._record_history(str(key), int(rb),
+                                     int(sv.get("run_us", 0) or 0))
 
     # ----------------------------------------------------------- dispatch
     def _pick_locked(self) -> Optional[QueryHandle]:
@@ -612,6 +730,22 @@ class QueryScheduler:
                 "session": h.session, "priority": h.priority,
                 "queue_wait_us": queue_wait_us, "admitted_bytes": est,
                 "running_at_admit": running_at_admit})
+            if h._fp_hist_key is not None:
+                # close the admission loop: the OBSERVED result bytes +
+                # wall feed the per-fingerprint history (and ride the
+                # flight-recorder serving block for future processes)
+                try:
+                    result_bytes = int(ps.size_bytes()) \
+                        if ps is not None else 0
+                except Exception:
+                    result_bytes = 0
+                run_us = int((time.monotonic()
+                              - (h.started_at or h.submitted_at)) * 1e6)
+                self._record_history(h._fp_hist_key, result_bytes,
+                                     run_us)
+                info.update({"fp_hist_key": h._fp_hist_key,
+                             "result_bytes": result_bytes,
+                             "run_us": run_us})
             if stats is None:
                 # result-cache hit: no execution happened — synthesize an
                 # (attributed, hence plane-empty) context so

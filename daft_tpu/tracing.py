@@ -639,6 +639,21 @@ def prometheus_text() -> str:
     except Exception:
         pass
     try:
+        from .physical import adaptive as _adaptive
+        plane("adaptive", _adaptive.counters_snapshot(),
+              "self-tuning feedback counter")
+    except Exception:
+        pass
+    try:
+        from .device import calibration
+        if calibration.enabled():
+            emit("daft_tpu_calibration_constants_active",
+                 len(calibration.calibrated_names()), "gauge",
+                 "cost-model constants currently overridden by the "
+                 "calibrated profile")
+    except Exception:
+        pass
+    try:
         from .parallel import exchange
         ex = exchange.exchange_cache_counters()
         emit("daft_tpu_exchange_programs", ex.pop("entries", 0), "gauge",
