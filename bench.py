@@ -2033,6 +2033,208 @@ def run_device_pipeline_bench():
     return out
 
 
+def _fusion_link_micro():
+    """In-process micro: filter→project→top-k over an in-memory source,
+    device-forced, per-operator vs fused-region, with the r17 simulated
+    transfer-bound link charging every upload/download.  Per-operator
+    must ship the FULL projected planes back for the host top-k; the
+    fused region sorts in-program and transfers only the k-bucket — the
+    download the region eliminates becomes measurable wall time on a
+    CPU box the same way it would on a tunneled chip."""
+    import jax
+    import numpy as np
+
+    import daft_tpu as dt
+    import daft_tpu.device.column as dcol
+    import daft_tpu.device.pipeline as dpipe
+    from daft_tpu import col
+    delay_ms = float(os.environ.get("BENCH_FUSION_LINK_MS", "2"))
+    link_mbps = float(os.environ.get("BENCH_FUSION_LINK_MBPS", "40"))
+    real_fetch, real_encode = dpipe.fetch_host, dcol.encode_batch
+    xfer = {}
+
+    def _link_sleep(nbytes):
+        time.sleep(delay_ms / 1e3 + nbytes / (link_mbps * 1e6))
+
+    def slow_fetch(tree):
+        dev = [x for x in jax.tree_util.tree_leaves(tree)
+               if isinstance(x, jax.Array)]
+        if dev:
+            nb = sum(int(x.nbytes) for x in dev)
+            xfer["down_bytes"] = xfer.get("down_bytes", 0) + nb
+            xfer["downloads"] = xfer.get("downloads", 0) + 1
+            _link_sleep(nb)
+        return real_fetch(tree)
+
+    def slow_encode(batch, columns=None):
+        t = real_encode(batch, columns)
+        if not t.resident:   # residency hits carry nothing on a real wire
+            nb = sum(int(c.data.nbytes) + int(c.validity.nbytes)
+                     for c in t.columns.values())
+            xfer["up_bytes"] = xfer.get("up_bytes", 0) + nb
+            xfer["uploads"] = xfer.get("uploads", 0) + 1
+            _link_sleep(nb)
+        return t
+
+    rng = np.random.default_rng(21)
+    n = 1 << 21
+    data = {"a": rng.integers(0, 100, n).astype(np.int64),
+            "b": rng.normal(size=n), "c": rng.normal(size=n)}
+
+    def q():
+        df = dt.from_pydict(data)
+        return (df.where(col("a") < 95)
+                .select((col("b") * 2.0 + col("c")).alias("x"), col("a"))
+                .sort(col("x"), desc=True).limit(32)
+                .to_pydict())
+
+    saved = {k: os.environ.get(k)
+             for k in ("DAFT_TPU_FUSION", "DAFT_TPU_DEVICE_FORCE")}
+    os.environ["DAFT_TPU_DEVICE_FORCE"] = "1"
+    dpipe.fetch_host, dcol.encode_batch = slow_fetch, slow_encode
+    res = {}
+    try:
+        for mode in ("0", "1"):
+            os.environ["DAFT_TPU_FUSION"] = mode
+            q()   # warm: traces + compiles off the measured run
+            xfer.clear()
+            t0 = time.time()
+            out = q()
+            res[mode] = {"hot_s": round(time.time() - t0, 3),
+                         "rows": len(out["x"]),
+                         "answer": _canon_rows(out), **xfer}
+    finally:
+        dpipe.fetch_host, dcol.encode_batch = real_fetch, real_encode
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    parity = res["0"]["answer"] == res["1"]["answer"]
+    for m in res.values():
+        m.pop("answer")
+    fused, per_op = res["1"]["hot_s"], res["0"]["hot_s"]
+    return {"rows": n, "link_delay_ms": delay_ms, "link_mbps": link_mbps,
+            "per_operator": res["0"], "fused": res["1"],
+            "fused_over_per_op": round(fused / per_op, 3) if per_op
+            else None, "parity": parity}
+
+
+def _fusion_child():
+    """``--fusion-child``: one process, one fusion configuration (the
+    driver sets DAFT_TPU_DEVICE / DAFT_TPU_FUSION / DAFT_TPU_CALIBRATION
+    in the env).  Emits q1/q3/q6 walls + canonical answers (cross-config
+    parity evidence), the SF1 suite wall, the ``region`` ledger family,
+    and — with BENCH_FUSION_MICRO=1 — the simulated-link chain micro."""
+    budget = float(os.environ.get("BENCH_FUSION_BUDGET_S", "360"))
+    deadline = time.time() + budget * 0.92
+
+    def safe_rows(rows):
+        # date cells aren't JSON; stringified they still compare equal
+        # across children
+        return [[v if isinstance(v, (str, int, float, bool, type(None)))
+                 else str(v) for v in r] for r in rows]
+
+    for qn in ("q1", "q3", "q6"):
+        out, warm, hot = run_tpch_query(DATA, qn)
+        _emit({qn: {"warm_s": round(warm, 3), "hot_s": round(hot, 3),
+                    "answer": safe_rows(_canon_rows(out))}})
+    if os.environ.get("BENCH_FUSION_MICRO") == "1" \
+            and time.time() < deadline:
+        try:
+            _emit({"link_micro": _fusion_link_micro()})
+        except Exception as exc:
+            _emit({"link_micro": {"error": str(exc)[:200]}})
+    if time.time() < deadline:
+        _emit({"tpch_sf1_suite": run_tpch_suite(
+            DATA, budget_s=deadline - time.time())})
+    from daft_tpu.device import costmodel, fragment
+    snap = costmodel.ledger_snapshot()
+    _emit({"region_ledger": snap.get("region", {}),
+           "region_programs": len(fragment.fused_region_programs())})
+
+
+def _rows_close(a, b, rtol=1e-6, atol=1e-6):
+    """Order-insensitive row-set comparison with float tolerance: the
+    fused region and the host tier sum in different orders, so revenue
+    columns agree to ~1e-9 relative, not bitwise."""
+    if a is None or b is None or len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        if len(ra) != len(rb):
+            return False
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) or isinstance(vb, float):
+                if va is None or vb is None:
+                    if va is not vb:
+                        return False
+                elif abs(va - vb) > atol + rtol * abs(vb):
+                    return False
+            elif va != vb:
+                return False
+    return True
+
+
+def run_fusion_bench():
+    """``--fusion``: whole-query device compilation (round 21).  Three
+    cold children over identical data — host, device per-fragment
+    (DAFT_TPU_FUSION=0), device fused (DAFT_TPU_FUSION=auto) — report
+    q1/q3/q6 hot walls + the SF1 suite wall; answers must agree across
+    all three (``parity_all``).  Both device children run with the
+    runtime-calibrated cost model (round 20) — the honest device tier,
+    with observed rates routing device-losing fragments host.  The
+    fused child also runs the simulated-link chain micro: per-operator
+    vs one-program dispatch with every round-trip charged wire time."""
+    budget = float(os.environ.get("BENCH_FUSION_BUDGET_S", "360"))
+
+    def child(env):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--fusion-child"],
+            capture_output=True, text=True, timeout=budget + 60, cwd=REPO,
+            env={**os.environ, "BENCH_FUSION_BUDGET_S": str(budget),
+                 **env})
+        merged = _merge_lines(proc.stdout or "")
+        if merged is None:
+            raise RuntimeError(f"fusion child rc={proc.returncode}: "
+                               f"{(proc.stderr or '')[-500:]}")
+        return merged
+
+    host = child({"DAFT_TPU_DEVICE": "0", "DAFT_TPU_FUSION": "0"})
+    frag = child({"DAFT_TPU_DEVICE": "1", "DAFT_TPU_FUSION": "0",
+                  "DAFT_TPU_CALIBRATION": "1"})
+    fused = child({"DAFT_TPU_DEVICE": "1", "DAFT_TPU_FUSION": "auto",
+                   "DAFT_TPU_CALIBRATION": "1", "BENCH_FUSION_MICRO": "1"})
+
+    out = {"budget_s": budget}
+    parity_all = True
+    for qn in ("q1", "q3", "q6"):
+        h, f, u = host.get(qn), frag.get(qn), fused.get(qn)
+        if not (h and f and u):
+            parity_all = False
+            continue
+        parity = _rows_close(f["answer"], h["answer"]) \
+            and _rows_close(u["answer"], h["answer"])
+        parity_all &= parity
+        out[qn] = {"host_hot_s": h["hot_s"],
+                   "device_per_fragment_hot_s": f["hot_s"],
+                   "device_fused_hot_s": u["hot_s"],
+                   "parity": parity}
+    micro = fused.get("link_micro")
+    if micro is not None:
+        out["link_micro"] = micro
+        if "parity" in micro:
+            parity_all &= bool(micro["parity"])
+    for name, c in (("host", host), ("device_per_fragment", frag),
+                    ("device_fused", fused)):
+        s = c.get("tpch_sf1_suite")
+        if s is not None:
+            out[f"sf1_suite_{name}"] = s
+    out["region_ledger"] = fused.get("region_ledger", {})
+    out["region_programs"] = fused.get("region_programs", 0)
+    out["parity_all"] = parity_all
+    return out
+
+
 def _merge_lines(text: str):
     merged = {}
     for line in text.strip().splitlines():
@@ -2196,6 +2398,13 @@ def main():
                     min_needed=60.0)
         if r is not None:
             detail["device_pipeline_bench"] = r
+
+    if "--fusion" in sys.argv:
+        # whole-query compilation: host vs per-fragment vs fused-region
+        # q1/q3/q6 + SF1 suite walls, link-charged chain micro, parity
+        r = section("fusion", run_fusion_bench, min_needed=120.0)
+        if r is not None:
+            detail["fusion_bench"] = r
 
     if "--warmup" in sys.argv:
         # shape-discipline bench: cold vs AOT+persisted-cache first-query
@@ -2418,6 +2627,8 @@ if __name__ == "__main__":
         _device_pipeline_child()
     elif "--mesh-exchange-child" in sys.argv:
         _mesh_exchange_child()
+    elif "--fusion-child" in sys.argv:
+        _fusion_child()
     elif "--warmup-child" in sys.argv:
         _warmup_child()
     elif "--serve-smoke" in sys.argv:

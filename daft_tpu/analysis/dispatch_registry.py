@@ -97,6 +97,27 @@ SITES: Tuple[DispatchSite, ...] = (
        "(program, capacity class, out_cap bucket, strategy, "
        "scalar-plane shapes)",
        "donating twin of fragment.packed; same signature contract"),
+    _s("region.chain", "daft_tpu/device/fragment.py",
+       ("get_fused_region",),
+       "(program, capacity class, out-width bucket, scalar-plane shapes)",
+       "round 21 fused chain region: one trace per (region program, "
+       "size class, transfer-width bucket), never per row count"),
+    _s("region.topk", "daft_tpu/device/fragment.py",
+       ("get_fused_region",),
+       "(program, capacity class, k bucket, scalar-plane shapes)",
+       "round 21 fused top-k region: one trace per (region program, "
+       "size class, k bucket)"),
+    _s("region.join_agg", "daft_tpu/device/fragment.py",
+       ("get_fused_join_agg",),
+       "(program, probe capacity class, build capacity class, pair-width "
+       "bucket W, out_cap bucket, scalar-plane shapes)",
+       "round 21 fused join_agg region: one trace per (region program, "
+       "probe/build size classes, W bucket, group bucket)"),
+    _s("region.build", "daft_tpu/device/fragment.py",
+       ("prepare_region_build",),
+       "(build capacity class,)",
+       "join_agg build-side key sort: one trace per build size class, "
+       "reused by every probe morsel of every query"),
     _s("pipeline.mask", "daft_tpu/device/pipeline.py",
        ("_masked_validity",),
        "(validity-plane capacity class,)",

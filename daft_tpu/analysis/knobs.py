@@ -120,6 +120,15 @@ _KNOBS: List[Knob] = [
        "startup, so first queries re-enter warm programs; pairs with "
        "`DAFT_TPU_COMPILE_CACHE_DIR` to survive restarts",
        config_field="tpu_aot_warmup"),
+    _k("DAFT_TPU_FUSION", "str", "auto", "daft_tpu/physical/fusion.py",
+       "device", "whole-query fusion regions (round 21): `auto` lets the "
+       "cost model price each region (`costmodel.fusion_wins`), `1` "
+       "force-admits every planned region, `0` disables the planner pass "
+       "entirely", config_field="tpu_fusion"),
+    _k("DAFT_TPU_FUSION_MAX_OPS", "int", 8, "daft_tpu/physical/fusion.py",
+       "device", "region-size cap: the planner stops growing a fusion "
+       "region past this many fused operators (bounds trace size and "
+       "retrace surface)", config_field="tpu_fusion_max_ops"),
     _k("DAFT_TPU_HBM_CACHE_BYTES", "bytes", 8 * 1024 ** 3,
        "daft_tpu/device/cache.py", "device",
        "HBM budget for the resident-column cache (byte suffixes accepted)",

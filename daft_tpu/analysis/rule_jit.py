@@ -303,6 +303,118 @@ def hash_join_jaxpr(capacity: int = 128):
         key, ones, ones, key, ones, ones)
 
 
+FRAGMENT_PATH = "daft_tpu/device/fragment.py"
+#: round 21's whole-query compilation contract: a fusion region is ONE
+#: jit program — its fresh jaxpr carries ZERO host-callback primitives
+#: (an in-region callback would be a hidden host round-trip, the exact
+#: thing fusion exists to eliminate), every lax.sort inside stays within
+#: the ≤3-operand packed-code budget, and each region dispatch site is
+#: declared in the registry with a finite per-signature trace budget.
+REGION_SITES = ("region.chain", "region.topk", "region.join_agg",
+                "region.build")
+
+
+def _region_chain_jaxpr(topk: bool = False):
+    """Fresh jaxpr of a representative chain/topk region program."""
+    import jax
+    import numpy as np
+    from .. import col
+    from ..schema import DataType, Field, Schema
+    from ..device import fragment as F
+    schema = Schema([Field("a", DataType.int64()),
+                     Field("b", DataType.float64())])
+    exprs = [(col("b") * 2.0).alias("b2"), col("a")]
+    pred = col("a") > 10
+    if topk:
+        prog = F.get_fused_region(exprs, pred, schema,
+                                  sort_by=(col("b"),), descending=(True,),
+                                  nulls_first=(False,), limit=8,
+                                  fused_ops=("Filter", "Project", "TopN"))
+    else:
+        prog = F.get_fused_region(exprs, pred, schema,
+                                  fused_ops=("Filter", "Project"))
+    if prog is None:
+        raise RuntimeError("representative region program did not lower")
+    C = 64
+    arrays = {"a": np.arange(C, dtype=np.int64),
+              "b": np.ones(C, np.float64)}
+    valids = {"a": np.ones(C, bool), "b": np.ones(C, bool)}
+    mask = np.ones(C, bool)
+    return jax.make_jaxpr(lambda ar, va, m: prog._run_packed(
+        ar, va, m, (), out_w=32))(arrays, valids, mask)
+
+
+def _region_join_agg_jaxpr():
+    """Fresh jaxpr of a representative join_agg region program."""
+    import jax
+    import numpy as np
+    from .. import col
+    from ..schema import DataType, Field, Schema
+    from ..device import fragment as F
+    src = Schema([Field("k", DataType.int64()),
+                  Field("b", DataType.float64())])
+    build = Schema([Field("k2", DataType.int64()),
+                    Field("g", DataType.int64()),
+                    Field("w", DataType.float64())])
+    prog = F.get_fused_join_agg(
+        group_exprs=[col("g")],
+        child_exprs=[(col("b") * col("w")).alias("__v0__")],
+        ops=("sum",), probe_pred=None, post_pred=None,
+        lkey="k", rkey="k2", src_schema=src, build_schema=build,
+        fused_ops=("HashJoin", "Project", "Aggregate"))
+    if prog is None:
+        raise RuntimeError("representative join_agg program did not lower")
+    C = 64
+    p_arrays = {"k": np.arange(C, dtype=np.int64),
+                "b": np.ones(C, np.float64)}
+    p_valids = {k: np.ones(C, bool) for k in p_arrays}
+    b_arrays = {"g": np.arange(C, dtype=np.int64),
+                "w": np.ones(C, np.float64)}
+    b_valids = {k: np.ones(C, bool) for k in b_arrays}
+    mask = np.ones(C, bool)
+    b_sorted = np.arange(C, dtype=np.int64)
+    b_perm = np.arange(C, dtype=np.int32)
+    b_live = np.int32(C)
+    return jax.make_jaxpr(
+        lambda pa, pv, pm, ba, bv, bs, bp, bl: prog._run_packed(
+            pa, pv, pm, (), ba, bv, bs, bp, bl, (), W=128, out_cap=32))(
+        p_arrays, p_valids, mask, b_arrays, b_valids,
+        b_sorted, b_perm, b_live)
+
+
+def check_fusion_region_contracts() -> List[Finding]:
+    """Round 21's fusion-region contract, re-proved from fresh jaxprs."""
+    out: List[Finding] = []
+    from . import dispatch_registry as reg
+    for sid in REGION_SITES:
+        if reg.budget_for(sid) is None:
+            out.append(Finding(
+                "fusion-region-contract", FRAGMENT_PATH, 1,
+                f"region dispatch site {sid!r} is undeclared or exempt in "
+                f"the dispatch registry — fusion regions must carry a "
+                f"finite per-signature trace budget"))
+    jaxprs = (("chain region", _region_chain_jaxpr(False)),
+              ("topk region", _region_chain_jaxpr(True)),
+              ("join_agg region", _region_join_agg_jaxpr()))
+    for label, jx in jaxprs:
+        for prim in FORBIDDEN_IN_FUSED_JOIN:
+            k = count_primitive(jx.jaxpr, prim)
+            if k:
+                out.append(Finding(
+                    "fusion-region-contract", FRAGMENT_PATH, 1,
+                    f"{label} program contains {k} {prim} primitive(s) — "
+                    f"whole-query compilation forbids host round-trips "
+                    f"inside a fused region"))
+        ops = max_sort_operands(jx.jaxpr)
+        if ops > ARGSORT_MAX_SORT_OPERANDS:
+            out.append(Finding(
+                "fusion-region-contract", FRAGMENT_PATH, 1,
+                f"{label} program sorts with {ops} operands (contract: "
+                f"≤{ARGSORT_MAX_SORT_OPERANDS}) — the packed-code sort "
+                f"budget applies inside regions too"))
+    return out
+
+
 def check_dispatch_contracts() -> List[Finding]:
     """Re-prove PR 1's dispatch contracts from freshly-built jaxprs."""
     out: List[Finding] = []
@@ -337,6 +449,7 @@ def check_dispatch_contracts() -> List[Finding]:
                 f"join_fused_impl build-side sort exceeds "
                 f"{ARGSORT_MAX_SORT_OPERANDS} operands"))
         out.extend(_check_hash_contracts())
+        out.extend(check_fusion_region_contracts())
     except Exception as exc:   # can't verify ⇒ say so, don't pass silently
         out.append(Finding(
             "dispatch-contract", KERNELS_PATH, 1,

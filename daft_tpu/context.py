@@ -99,6 +99,13 @@ class ExecutionConfig:
     # profile (device/calibration.py). Field names spell the documented
     # knobs (DAFT_TPU_ADAPTIVE, DAFT_TPU_CALIBRATION, …); the env var is
     # the per-process override.
+    # whole-query fusion regions (round 21, physical/fusion.py): the
+    # planner grows maximal device-eligible operator chains into single
+    # donated-buffer XLA programs. Field names spell the documented knobs
+    # (DAFT_TPU_FUSION / DAFT_TPU_FUSION_MAX_OPS); env is the per-process
+    # override.
+    tpu_fusion: str = "auto"                 # auto|1 (force)|0 (off)
+    tpu_fusion_max_ops: int = 8              # region-size cap (fused ops)
     tpu_adaptive: bool = False               # runtime re-planning
     tpu_adaptive_history: int = 512          # AdaptivePlanner history cap
     tpu_calibration: bool = False            # learned cost-model profile

@@ -51,6 +51,9 @@ HOST_PIL_BPS = 85e6             # per-image PIL resize, input bytes/s
 DEV_VECTOR_BPS = 8.0e9      # fused elementwise XLA, per byte touched
 DEV_AGG_BPS = 4.0e9         # fused grouped-agg (sort strategy), per byte
 DEV_AGG_HASH_BPS = 8.0e9    # one-pass hash grouped-agg, per byte touched
+DEV_AGG_DENSE_BPS = 1.6e10  # direct-indexed dense grouped-agg (round 21):
+#                             pure arithmetic group ids + one scatter pass
+#                             per plane — no sort, no table
 DEV_SORT_ROWS_PER_S = 50.0e6    # XLA multi-key sort, rows/s
 DEV_JOIN_ROWS_PER_S = 40.0e6    # sort/searchsorted/expand join, rows/s
 DEV_JOIN_HASH_ROWS_PER_S = 80.0e6  # hash build/probe join, rows/s: ONE
@@ -335,14 +338,19 @@ _LEDGER_RAW = ("dispatches", "rows", "bytes", "flops", "seconds")
 #: derives `strategy` and the mean `load_factor` from these.  ``serial_s``
 #: (round 17) is the serial-equivalent stage seconds the async pipeline
 #: measured against its pipelined wall — the overlap evidence.
-_LEDGER_STRATEGY = ("strategy_hash", "strategy_sort", "lf_sum", "serial_s")
+_LEDGER_STRATEGY = ("strategy_hash", "strategy_sort", "strategy_dense",
+                    "lf_sum", "serial_s", "fused_ops", "rt_saved",
+                    "fusion_serial_s")
 
 
 def ledger_record(kind: str, *, rows: int = 0, nbytes: float = 0.0,
                   flops: float = 0.0, seconds: float = 0.0,
                   dispatches: int = 1, strategy: Optional[str] = None,
                   load_factor: Optional[float] = None,
-                  serial_seconds: Optional[float] = None) -> None:
+                  serial_seconds: Optional[float] = None,
+                  fused_ops: Optional[int] = None,
+                  round_trips_saved: Optional[int] = None,
+                  fusion_serial_seconds: Optional[float] = None) -> None:
     """Record one real dispatch's achieved work.
 
     ``seconds`` is wall time from dispatch to host-visible result — on a
@@ -350,17 +358,30 @@ def ledger_record(kind: str, *, rows: int = 0, nbytes: float = 0.0,
     LOWER bound on silicon utilization (the synthetic ``mfu.report``
     isolates the silicon with in-jit repetition). ``nbytes``/``flops``
     are the kernel's modeled HBM traffic / arithmetic, conservative.
-    ``strategy`` (``hash``/``sort``) and the hash table's achieved
-    ``load_factor`` land in the same family row for the stats block."""
+    ``strategy`` (``hash``/``sort``/``dense``) and the hash table's
+    achieved ``load_factor`` land in the same family row for the stats
+    block. The ``region`` family (round 21) additionally carries
+    ``fused_ops`` (operators compiled into the region programs),
+    ``round_trips_saved`` (host round-trips the fusion eliminated vs the
+    per-fragment chain), and ``fusion_serial_seconds`` — the modeled
+    serial per-fragment equivalent, from which the stats block derives
+    the ``fusion_x`` ratio the way ``serial_seconds`` yields
+    ``overlap_x``."""
     fields = [("dispatches", dispatches), ("rows", rows),
               ("bytes", float(nbytes)), ("flops", float(flops)),
               ("seconds", float(seconds))]
-    if strategy in ("hash", "sort"):
+    if strategy in ("hash", "sort", "dense"):
         fields.append((f"strategy_{strategy}", dispatches))
     if load_factor is not None:
         fields.append(("lf_sum", float(load_factor) * dispatches))
     if serial_seconds is not None:
         fields.append(("serial_s", float(serial_seconds)))
+    if fused_ops is not None:
+        fields.append(("fused_ops", int(fused_ops)))
+    if round_trips_saved is not None:
+        fields.append(("rt_saved", int(round_trips_saved)))
+    if fusion_serial_seconds is not None:
+        fields.append(("fusion_serial_s", float(fusion_serial_seconds)))
     with _ledger_lock:
         d = kernel_ledger.setdefault(
             kind, {k: 0 if k in ("dispatches", "rows") else 0.0
@@ -417,14 +438,15 @@ def _derive(d: dict) -> dict:
         if d.get("flops"):
             out["achieved_tflops"] = round(d["flops"] / s / 1e12, 4)
             out["mfu_pct"] = round(100.0 * d["flops"] / s / peak_flops(), 4)
-    nh = int(d.get("strategy_hash", 0))
-    ns = int(d.get("strategy_sort", 0))
-    if nh or ns:
-        out["strategy"] = "mixed" if (nh and ns) else \
-            ("hash" if nh else "sort")
-        if nh and ns:
-            out["strategy_hash"] = nh
-            out["strategy_sort"] = ns
+    counts = {nm: int(d.get(f"strategy_{nm}", 0))
+              for nm in ("hash", "sort", "dense")}
+    ran = [nm for nm, c in counts.items() if c]
+    if ran:
+        out["strategy"] = ran[0] if len(ran) == 1 else "mixed"
+        if len(ran) > 1:
+            for nm in ran:
+                out[f"strategy_{nm}"] = counts[nm]
+    nh = counts["hash"]
     if nh and d.get("lf_sum"):
         out["load_factor"] = round(d["lf_sum"] / nh, 3)
     ser = d.get("serial_s", 0.0)
@@ -434,6 +456,17 @@ def _derive(d: dict) -> dict:
         # host encode/decode + transfer behind device compute
         out["serial_equiv_s"] = round(ser, 6)
         out["overlap_x"] = round(ser / s, 3)
+    if d.get("fused_ops"):
+        out["fused_ops"] = int(d["fused_ops"])
+    if d.get("rt_saved"):
+        out["round_trips_saved"] = int(d["rt_saved"])
+    fser = d.get("fusion_serial_s", 0.0)
+    if fser and s > 0:
+        # round 21 fusion evidence: modeled serial per-fragment seconds
+        # vs the fused-region wall — >1.0 means compiling the chain into
+        # one program really beat dispatching it operator-at-a-time
+        out["fusion_serial_s"] = round(fser, 6)
+        out["fusion_x"] = round(fser / s, 3)
     return out
 
 
@@ -636,8 +669,9 @@ def agg_upload_wins(bytes_up: float, bytes_down: float,
     # round 12: the fused-agg gate prices the kernel at the strategy the
     # dispatch would actually take — the one-pass hash kernel streams the
     # data once where the sort strategy pays ≥2 passes per packed plane
-    bps = _cal("DEV_AGG_HASH_BPS", DEV_AGG_HASH_BPS) \
-        if strategy == "hash" else _cal("DEV_AGG_BPS", DEV_AGG_BPS)
+    bps = _cal("DEV_AGG_HASH_BPS", DEV_AGG_HASH_BPS) if strategy == "hash" \
+        else _cal("DEV_AGG_DENSE_BPS", DEV_AGG_DENSE_BPS) \
+        if strategy == "dense" else _cal("DEV_AGG_BPS", DEV_AGG_BPS)
     kernel_s = DEV_DISPATCH_S + bytes_up / bps
     # round 17: with the async pipeline active (window ≥ 2 in-flight
     # morsel slots) the transfer legs overlap neighbor morsels' compute,
@@ -666,6 +700,58 @@ def agg_upload_wins(bytes_up: float, bytes_down: float,
         return win
     _log("agg_upload", dev_s < host_s, host_s, dev_s,
          bytes_up=bytes_up, bytes_down=bytes_down, round_trips=round_trips)
+    return dev_s < host_s
+
+
+def fusion_serial_estimate(rows: int, n_ops: int) -> float:
+    """Modeled wall of the PER-FRAGMENT serial chain a fused region
+    replaced: each of the ``n_ops`` fused operators would have paid its
+    own dispatch + transfer legs + round trips. Recorded per dispatch
+    into the ``region`` ledger family, where ``_derive`` turns it into
+    the ``fusion_x`` ratio (modeled serial / achieved fused wall)."""
+    lp = link_profile()
+    b = max(rows, 1) * 8.0
+    per_op = lp.device_seconds(
+        b, b, 2.0,
+        DEV_DISPATCH_S + b / _cal("DEV_VECTOR_BPS", DEV_VECTOR_BPS))
+    return max(n_ops, 1) * per_op
+
+
+def fusion_wins(shape: str, rows: int, bytes_up: float, bytes_down: float,
+                n_ops: int, host_bytes: Optional[float] = None,
+                window: int = 1) -> bool:
+    """Admission gate for one FusedRegion morsel (round 21): the single
+    fused dispatch — one upload, one kernel, one packed download — against
+    the host running the region's whole operator chain. Shapes price the
+    host side differently: a chain is ``n_ops`` vectorized passes, a topk
+    adds the host sort, a join_agg is the hash join plus the aggregation
+    pass. ``DAFT_TPU_FUSION=1`` bypasses this gate entirely (the executor
+    force-admits); ``auto`` calls it per morsel."""
+    f = _forced()
+    if f is not None:
+        return f
+    lp = link_profile()
+    hb = host_bytes if host_bytes is not None else bytes_up
+    if shape == "join_agg":
+        host_s = rows / HOST_JOIN_ROWS_PER_S + hb / HOST_AGG_BPS
+        kernel_s = DEV_DISPATCH_S \
+            + rows / _cal("DEV_JOIN_ROWS_PER_S", DEV_JOIN_ROWS_PER_S) \
+            + bytes_up / _cal("DEV_AGG_BPS", DEV_AGG_BPS)
+    elif shape == "topk":
+        host_s = hb / HOST_VECTOR_BPS * max(n_ops - 1, 1) \
+            + rows / HOST_SORT_ROWS_PER_S
+        kernel_s = DEV_DISPATCH_S \
+            + rows / _cal("DEV_SORT_ROWS_PER_S", DEV_SORT_ROWS_PER_S) \
+            + bytes_up / _cal("DEV_VECTOR_BPS", DEV_VECTOR_BPS)
+    else:
+        host_s = hb / HOST_VECTOR_BPS * max(n_ops, 1)
+        kernel_s = DEV_DISPATCH_S \
+            + bytes_up / _cal("DEV_VECTOR_BPS", DEV_VECTOR_BPS)
+    dev_s = lp.pipelined_seconds(bytes_up, bytes_down, 2.0, kernel_s) \
+        if window >= 2 else \
+        lp.device_seconds(bytes_up, bytes_down, 2.0, kernel_s)
+    _log("fusion", dev_s < host_s, host_s, dev_s,
+         shape=shape, rows=rows, n_ops=n_ops)
     return dev_s < host_s
 
 

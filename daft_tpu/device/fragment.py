@@ -19,6 +19,7 @@ time to reverse the packing host-side.
 
 from __future__ import annotations
 
+import threading as _threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -91,6 +92,10 @@ class FusedAggProgram:
         #: column → device numpy dtype (set by get_fused_agg; None when
         #: an input is not device-representable) — the AOT warm-up grid
         self.in_np_dtypes = None
+        #: source column per group key when EVERY key is a string/binary
+        #: passthrough (dictionary-coded plane) — dense-strategy
+        #: eligibility; None otherwise
+        self.key_sources = None
 
     def donate_fn(self):
         """The donating twin executable (round 12 megakernel discipline):
@@ -101,7 +106,8 @@ class FusedAggProgram:
         runs never trace it."""
         if self._donate_fn is None:
             self._donate_fn = jax.jit(
-                self._run_packed, static_argnames=("out_cap", "strategy"),
+                self._run_packed,
+                static_argnames=("out_cap", "strategy", "dims"),
                 donate_argnums=(0, 1))
         return self._donate_fn
 
@@ -158,7 +164,7 @@ def get_fused_agg(group_exprs: List[Expression], child_exprs: List[Expression],
         return keys, kvalids, vals, vvalids, row_mask
 
     def run_packed(arrays, valids, row_mask, scalars, out_cap: int,
-                   strategy: str = "sort"):
+                   strategy: str = "sort", dims: Tuple[int, ...] = ()):
         keys, kvalids, vals, vvalids, row_mask = eval_inputs(
             arrays, valids, row_mask, scalars)
         if nk == 0:
@@ -168,11 +174,16 @@ def get_fused_agg(group_exprs: List[Expression], child_exprs: List[Expression],
             return jnp.stack([_pack_i64(x.reshape(())) for x in flat])
         # round 12: the whole scan→filter→project→agg chain stays ONE jit
         # program either way — `strategy` only swaps the reduction's inner
-        # loop (one-pass Pallas hash table vs radix sort + segment reduce)
-        impl = pallas_kernels.hash_grouped_agg_impl if strategy == "hash" \
-            else kernels.grouped_agg_block_impl
-        ok, okv, ov, ovv, g = impl(
-            keys, kvalids, vals, vvalids, row_mask, ops, out_cap)
+        # loop (dense direct slot indexing vs one-pass Pallas hash table
+        # vs radix sort + segment reduce)
+        if strategy == "dense":
+            ok, okv, ov, ovv, g = kernels.grouped_agg_dense_impl(
+                keys, kvalids, vals, vvalids, row_mask, ops, out_cap, dims)
+        else:
+            impl = pallas_kernels.hash_grouped_agg_impl \
+                if strategy == "hash" else kernels.grouped_agg_block_impl
+            ok, okv, ov, ovv, g = impl(
+                keys, kvalids, vals, vvalids, row_mask, ops, out_cap)
         flat = list(ok) + list(okv) + list(ov) + list(ovv)
         meta["grouped_dtypes"] = [x.dtype for x in flat]
         rows = [jnp.full((out_cap,), 0, jnp.int64).at[0]
@@ -181,8 +192,20 @@ def get_fused_agg(group_exprs: List[Expression], child_exprs: List[Expression],
         return jnp.stack(rows)
 
     prog = FusedAggProgram(
-        jax.jit(run_packed, static_argnames=("out_cap", "strategy")),
+        jax.jit(run_packed, static_argnames=("out_cap", "strategy", "dims")),
         run_packed, c, nk, ops, has_pred, meta)
+    # dense-strategy eligibility: every group key must be a plain
+    # string/binary column passthrough, so its device plane carries
+    # sorted-dictionary codes the mixed-radix group id can index directly
+    srcs = []
+    for e, f in zip(group_exprs, c.out_fields[:nk]):
+        src = runtime._string_out_source(e) \
+            if (f.dtype.is_string() or f.dtype.is_binary()) else None
+        if src is None:
+            srcs = None
+            break
+        srcs.append(src)
+    prog.key_sources = tuple(srcs) if srcs else None
     try:
         # device input dtypes per needed column — the AOT warm-up grid
         # (device/warmup.py) rebuilds abstract inputs from this
@@ -231,7 +254,7 @@ def submit_fused_agg(prog: FusedAggProgram, batch, group_exprs, agg_exprs,
 
 def _dispatch_packed(prog: FusedAggProgram, dt: dcol.DeviceTable,
                      out_cap: int, strategy: str = "sort",
-                     donate: bool = False):
+                     donate: bool = False, dims: Tuple[int, ...] = ()):
     from ..analysis import retrace_sanitizer
     arrays = {n: col.data for n, col in dt.columns.items()}
     valids = {n: col.validity for n, col in dt.columns.items()}
@@ -243,10 +266,58 @@ def _dispatch_packed(prog: FusedAggProgram, dt: dcol.DeviceTable,
     # budget violation
     with retrace_sanitizer.dispatch_scope(
             "fragment.donate" if donate else "fragment.packed",
-            (id(prog), dt.capacity, out_cap, strategy,
+            (id(prog), dt.capacity, out_cap, strategy, dims,
              tuple(s.shape for s in scalars))):
         return fn(arrays, valids, dt.row_mask, scalars, out_cap=out_cap,
-                  strategy=strategy)
+                  strategy=strategy, dims=dims)
+
+
+#: dense-strategy slot ceiling: K = prod(dim+1) static slots per dispatch;
+#: past this the slot planes outgrow the group blocks they stand in for
+#: and hash/sort territory begins anyway
+DENSE_MAX_SLOTS = 4096
+
+
+def dense_dims(prog: FusedAggProgram,
+               dt: dcol.DeviceTable) -> Optional[Tuple[int, ...]]:
+    """Pow2-bucketed dictionary width per group key, or None when this
+    table is ineligible for the dense direct-index strategy (a key is not
+    a dictionary-coded passthrough, a dictionary is missing, or the slot
+    product exceeds :data:`DENSE_MAX_SLOTS`). Bucketing to powers of two
+    bounds the static-arg space: per-morsel dictionaries drift in size,
+    but their buckets — and therefore the traced programs — do not."""
+    if not prog.key_sources:
+        return None
+    dims = []
+    K = 1
+    for src in prog.key_sources:
+        col = dt.columns.get(src)
+        if col is None or col.dictionary is None:
+            return None
+        d = len(col.dictionary)
+        d = max(1 << (max(d - 1, 0)).bit_length(), 1)  # pow2 ceiling
+        dims.append(d)
+        K *= d + 1
+        if K > DENSE_MAX_SLOTS:
+            return None
+    return tuple(dims)
+
+
+def dense_plan(prog: FusedAggProgram, dt: dcol.DeviceTable,
+               cap_limit: int) -> Optional[Tuple[Tuple[int, ...], int]]:
+    """``(dims, out_cap)`` for a dense dispatch, or None when ineligible.
+    The bucket is sized to hold every possible slot up front — dense
+    output can never overflow, so the ladder never re-dispatches."""
+    dims = dense_dims(prog, dt)
+    if dims is None:
+        return None
+    K = 1
+    for d in dims:
+        K *= d + 1
+    out_cap = dcol.bucket_capacity(max(K, _OUT_CAP0))
+    if out_cap > cap_limit:
+        return None
+    return dims, out_cap
 
 
 def _donation_ok(dt: dcol.DeviceTable) -> bool:
@@ -374,6 +445,10 @@ def _ledger_grouped(prog: FusedAggProgram, rows: int, cap: int,
         flops, nbytes = mfu.hash_agg_models(
             cap, out_cap, pallas_kernels.table_capacity(out_cap), words,
             len(prog.ops))
+    elif strategy == "dense":
+        flops, nbytes = mfu.dense_agg_models(cap, out_cap,
+                                             max(prog.nk, 1),
+                                             len(prog.ops))
     else:
         flops, nbytes = mfu.grouped_agg_models(cap, out_cap,
                                                max(prog.nk, 1),
@@ -392,7 +467,8 @@ class InflightFusedAgg:
 
     __slots__ = ("prog", "dt", "group_exprs", "key_fields", "agg_fields",
                  "groups", "reencode", "cap_limit", "out_cap", "donate",
-                 "strategy", "lf", "packed", "t0", "submitted_s", "acct")
+                 "strategy", "lf", "dims", "packed", "t0", "submitted_s",
+                 "acct")
 
     def __init__(self, prog, dt, group_exprs, key_fields, agg_fields,
                  groups, reencode):
@@ -409,6 +485,7 @@ class InflightFusedAgg:
         self.donate = False
         self.strategy: Optional[str] = None
         self.lf = 0.0
+        self.dims: Tuple[int, ...] = ()
         self.packed = None
         self.t0 = _time.perf_counter()
         #: submit-stage wall (dispatch only) — the ledger charges
@@ -425,11 +502,21 @@ def _ladder_dispatch(tok: InflightFusedAgg) -> None:
     from . import costmodel
     while True:
         if tok.strategy is None:
-            tok.strategy, tok.lf = strategy_for(tok.prog, tok.dt,
-                                                tok.out_cap, tok.groups)
+            # dense first: a direct-indexed dispatch streams the rows once
+            # with no sort and no table, so whenever the key dictionaries
+            # fit the slot budget it dominates both rivals
+            plan = dense_plan(tok.prog, tok.dt, tok.cap_limit)
+            if plan is not None:
+                tok.dims, tok.out_cap = plan
+                tok.strategy, tok.lf = "dense", 0.0
+            else:
+                tok.dims = ()
+                tok.strategy, tok.lf = strategy_for(tok.prog, tok.dt,
+                                                    tok.out_cap, tok.groups)
         try:
             tok.packed = _dispatch_packed(tok.prog, tok.dt, tok.out_cap,
-                                          tok.strategy, tok.donate)
+                                          tok.strategy, tok.donate,
+                                          tok.dims)
         except pallas_kernels.HashKeyWidthError:
             # key set packs wider than the hash-table key budget — the
             # kernel's trace is the exact check; remember and re-dispatch
@@ -592,6 +679,20 @@ def submit_fused_agg_tables(prog: FusedAggProgram, tables,
                                 agg_exprs, out_schema, groups)
     if not tables:
         return tok
+    # dense first, per table: each morsel carries its own dictionaries
+    # (pow2-bucketed, so same-scan tables share one traced program); a
+    # table that misses the slot budget rides the batch strategy instead
+    plans = [dense_plan(prog, dt, _max_out_cap(prog, dt)) for dt in tables]
+    if all(p is not None for p in plans):
+        tok.strategy, tok.lf = "dense", 0.0
+        try:
+            tok.packs = [
+                _dispatch_packed(prog, dt, p[1], "dense", dims=p[0])
+                for dt, p in zip(tables, plans)]
+            tok.submitted_s = _time.perf_counter() - tok.t0
+            return tok
+        except Exception:
+            tok.packs = []  # fall through to the hash/sort batch path
     tok.strategy, tok.lf = strategy_for(prog, tables[0], _OUT_CAP0, groups)
     try:
         tok.packs = [_dispatch_packed(prog, dt, _OUT_CAP0, tok.strategy)
@@ -707,3 +808,590 @@ def run_fused_agg_tables(prog: FusedAggProgram, tables, in_schema: Schema,
     return drain_fused_agg_tables(submit_fused_agg_tables(
         prog, tables, in_schema, group_exprs, agg_exprs, out_schema,
         groups))
+
+
+# ---------------------------------------------------------------------------
+# FusedRegion programs (round 21 whole-query compilation)
+#
+# A FusedRegion compiles a maximal operator chain — filter/project chains,
+# top-k tails, and join→project→partial-agg spines — into ONE traced
+# program whose intermediates stay device-resident; only the region's
+# packed output crosses the link. Three program families mirror the three
+# planner grammars (physical/fusion.py):
+#
+# - chain: predicate + projection + in-program compaction; the survivors
+#   transfer at a static width bucket (overflow re-dispatches grown, the
+#   grouped-agg ladder discipline).
+# - topk: a chain whose tail argsort runs in-program; only a static
+#   ``bucket_capacity(limit)`` slice transfers, never the full table.
+# - join_agg: the broadcast build side is encoded + radix-sorted ONCE and
+#   stays resident; each probe morsel runs predicate → searchsorted join →
+#   joined-plane gather → post-projection → partial grouped agg as one
+#   dispatch, with DUAL overflow ladders (join pair width W and group
+#   bucket out_cap), both read from the packed header.
+
+_region_cache: Dict[Tuple, object] = {}
+
+#: join pair-width ceiling: past this the fused join's expand planes cost
+#: more HBM than the morsel itself and the host join is the right tool
+_REGION_MAX_W = 1 << 22
+
+
+class FusedRegionProgram:
+    """One compiled fusion region (chain or topk shape)."""
+
+    def __init__(self, shape: str, packed_fn, run_packed,
+                 compiled: compiler.Compiled,
+                 nout: int, has_pred: bool, meta: dict,
+                 fused_ops: Tuple[str, ...] = (), limit: int = 0):
+        self.shape = shape              # chain | topk
+        self.packed_fn = packed_fn
+        self._run_packed = run_packed
+        self._donate_fn = None
+        self.compiled = compiled
+        self.nout = nout
+        self.has_pred = has_pred
+        self.meta = meta
+        self.fused_ops = fused_ops
+        self.limit = limit
+        self.in_np_dtypes = None
+        #: per input-capacity survivor bucket observed on the last drain:
+        #: the ladder's learned first rung (benign race: worst case one
+        #: extra overflow re-dispatch)
+        self.w_hint: Dict[int, int] = {}
+
+    def donate_fn(self):
+        """Donating twin (r12 discipline): one-shot input planes are dead
+        after the in-program compaction, so XLA reuses their HBM. Guarded
+        by ``_donation_ok`` — never for cache-resident tables, never on
+        CPU."""
+        if self._donate_fn is None:
+            self._donate_fn = jax.jit(
+                self._run_packed, static_argnames=("out_w",),
+                donate_argnums=(0, 1))
+        return self._donate_fn
+
+
+def get_fused_region(exprs, predicate, schema: Schema,
+                     sort_by=(), descending=(), nulls_first=(),
+                     limit: Optional[int] = None,
+                     fused_ops: Tuple[str, ...] = ()
+                     ) -> Optional[FusedRegionProgram]:
+    """Compile (or fetch) a chain/topk region program. None → the region
+    does not lower (caller runs the fallback subtree)."""
+    shape = "topk" if sort_by else "chain"
+    key = ("region", shape, tuple(e._key() for e in exprs),
+           predicate._key() if predicate is not None else None,
+           tuple(e._key() for e in sort_by), tuple(descending),
+           tuple(nulls_first), limit, runtime._schema_key(schema))
+    hit = _region_cache.get(key)
+    if hit is not None:
+        _fused_counters["hits"] += 1
+        return hit if isinstance(hit, FusedRegionProgram) else None
+    _fused_counters["misses"] += 1
+    proj = list(exprs) + list(sort_by) + \
+        ([predicate] if predicate is not None else [])
+    try:
+        c = compiler.compile_projection(proj, schema, jit=False)
+    except (compiler.NotCompilable, NotImplementedError, ValueError,
+            TypeError, KeyError, OverflowError):
+        _region_cache[key] = False
+        return None
+    n = len(exprs)
+    ns = len(sort_by)
+    has_pred = predicate is not None
+    desc = tuple(bool(d) for d in descending)
+    nf = tuple(bool(x) for x in nulls_first)
+    k_lim = int(limit or 0)
+    meta: dict = {}
+
+    def run_packed(arrays, valids, row_mask, scalars, out_w: int):
+        outs = c.fn(arrays, valids, row_mask, scalars)
+        if has_pred:
+            pv, pm = outs[-1]
+            row_mask = row_mask & pv.astype(jnp.bool_) & pm
+            outs = outs[:-1]
+        # encode_batch capacities are bucket_capacity outputs already;
+        # min(shape, bucket(shape)) re-asserts that through the
+        # sanctioned chokepoint without ever changing the value
+        C = min(row_mask.shape[0], dcol.bucket_capacity(row_mask.shape[0]))
+        live = jnp.sum(row_mask).astype(jnp.int32)
+        if ns:
+            skeys = tuple(v for v, _ in outs[n:])
+            svalids = tuple(m for _, m in outs[n:])
+            perm = kernels._packed_argsort(
+                kernels._sort_codes(skeys, svalids, row_mask, desc, nf), C)
+            live = jnp.minimum(live, jnp.asarray(k_lim, jnp.int32))
+            outs = outs[:n]
+        else:
+            # stable compaction: live rows to the front in source order
+            perm = lax.sort(((~row_mask).astype(jnp.int8),
+                             jnp.arange(C, dtype=jnp.int32)),
+                            num_keys=1, is_stable=True)[1]
+        w = min(out_w, C)
+        idx = perm[:w]
+        sel = jnp.arange(w, dtype=jnp.int32) < live
+        flat = [jnp.take(v, idx) for v, _ in outs] \
+            + [jnp.take(m, idx) & sel for _, m in outs]
+        meta["region_dtypes"] = [x.dtype for x in flat]
+        rows = [jnp.zeros((w,), jnp.int64).at[0].set(live.astype(jnp.int64))]
+        rows += [_pack_i64(x) for x in flat]
+        return jnp.stack(rows)
+
+    prog = FusedRegionProgram(
+        shape, jax.jit(run_packed, static_argnames=("out_w",)),
+        run_packed, c, n, has_pred, meta, fused_ops=fused_ops, limit=k_lim)
+    try:
+        prog.in_np_dtypes = {nm: dcol.device_np_dtype(schema[nm].dtype)
+                             for nm in c.needs_cols}
+    except (ValueError, KeyError):
+        prog.in_np_dtypes = None
+    _region_cache[key] = prog
+    return prog
+
+
+def region_start_w(prog: FusedRegionProgram, dt: dcol.DeviceTable) -> int:
+    """First transfer-width rung. Top-k transfers its static k bucket;
+    an unfiltered chain can never shrink, so it transfers whole; a
+    filtered chain bets on selectivity with a quarter-capacity bucket —
+    one overflow re-dispatch costs a dispatch, not a scan."""
+    if prog.shape == "topk":
+        return min(dcol.bucket_capacity(max(prog.limit, 1)), dt.capacity)
+    if not prog.has_pred:
+        return dt.capacity
+    hint = prog.w_hint.get(dt.capacity)
+    if hint is not None:
+        # learned rung: the last morsel at this capacity drained at this
+        # survivor bucket — steady-state selectivity makes it right for
+        # the next one, turning the ladder into a one-dispatch path
+        return min(hint, dt.capacity)
+    return min(dcol.bucket_capacity(
+        max(min(dt.capacity, dt.row_count) // 4, _OUT_CAP0)), dt.capacity)
+
+
+class InflightRegion:
+    """One in-flight chain/topk region dispatch awaiting its packed
+    fetch (+ the ladder state an overflow re-dispatch needs)."""
+
+    __slots__ = ("prog", "dt", "exprs", "fields", "out_w", "donate",
+                 "reencode", "packed", "t0", "submitted_s")
+
+    def __init__(self, prog, dt, exprs, fields, out_w, donate, reencode):
+        import time as _time
+        self.prog = prog
+        self.dt = dt
+        self.exprs = exprs
+        self.fields = fields
+        self.out_w = out_w
+        self.donate = donate
+        self.reencode = reencode
+        self.packed = None
+        self.t0 = _time.perf_counter()
+        self.submitted_s = 0.0
+
+
+def _dispatch_region(prog: FusedRegionProgram, dt: dcol.DeviceTable,
+                     out_w: int, donate: bool = False):
+    from ..analysis import retrace_sanitizer
+    arrays = {n: col.data for n, col in dt.columns.items()}
+    valids = {n: col.validity for n, col in dt.columns.items()}
+    scalars = runtime._prep_scalars(prog.compiled, dt)
+    fn = prog.donate_fn() if donate else prog.packed_fn
+    with retrace_sanitizer.dispatch_scope(
+            "region.topk" if prog.shape == "topk" else "region.chain",
+            (id(prog), dt.capacity, out_w,
+             tuple(s.shape for s in scalars))):
+        return fn(arrays, valids, dt.row_mask, scalars, out_w=out_w)
+
+
+def submit_region(prog: FusedRegionProgram, batch, exprs, out_schema: Schema
+                  ) -> Optional[InflightRegion]:
+    """Encode + async dispatch of one chain/topk region morsel; None →
+    host fallback (pyobject inputs / encode failure)."""
+    import time as _time
+    for nm in prog.compiled.needs_cols:
+        if batch.get_column(nm).is_pyobject():
+            return None
+    try:
+        dt = dcol.encode_batch(batch, prog.compiled.needs_cols)
+    except (ValueError, TypeError):
+        return None
+    fields = [out_schema[e.name()] for e in exprs]
+    donate = _donation_ok(dt)
+    tok = InflightRegion(prog, dt, exprs, fields,
+                         region_start_w(prog, dt), donate,
+                         lambda: dcol.encode_batch(
+                             batch, prog.compiled.needs_cols))
+    tok.packed = _dispatch_region(prog, dt, tok.out_w, donate=donate)
+    tok.submitted_s = _time.perf_counter() - tok.t0
+    return tok
+
+
+def drain_region(tok: InflightRegion):
+    """Blocking drain: one packed fetch → RecordBatch, continuing the
+    width ladder when a chain's survivor count outgrew the bucket."""
+    import time as _time
+
+    from . import costmodel, pipeline
+    prog = tok.prog
+    t_drain0 = _time.perf_counter()
+    while True:
+        packed = np.asarray(pipeline.fetch_host(tok.packed))
+        live = int(packed[0, 0])
+        w = packed.shape[1]
+        if live <= w:
+            from ..recordbatch import RecordBatch
+            dtypes = prog.meta["region_dtypes"]
+            nout = prog.nout
+            rows = packed[1:]
+            cols = []
+            for i, (e, f) in enumerate(zip(tok.exprs, tok.fields)):
+                v = _unpack_i64(rows[i][:live], dtypes[i])
+                m = _unpack_i64(rows[nout + i][:live],
+                                dtypes[nout + i]).astype(np.bool_)
+                cols.append(runtime.decode_group_key(e, f, v, m, tok.dt,
+                                                     live))
+            out = RecordBatch.from_series(cols)
+            if prog.has_pred and prog.shape != "topk":
+                prog.w_hint[tok.dt.capacity] = min(
+                    dcol.bucket_capacity(max(live, _OUT_CAP0)),
+                    tok.dt.capacity)
+            n_ops = max(len(prog.fused_ops), 2)
+            secs = tok.submitted_s + (_time.perf_counter() - t_drain0)
+            costmodel.ledger_record(
+                "region", rows=tok.dt.row_count,
+                nbytes=(1 + 2 * nout) * 8 * w, seconds=secs,
+                strategy=prog.shape, fused_ops=n_ops,
+                round_trips_saved=n_ops - 1,
+                fusion_serial_seconds=costmodel.fusion_serial_estimate(
+                    tok.dt.row_count, n_ops))
+            return out
+        if tok.donate:
+            tok.dt = tok.reencode()
+            tok.donate = False
+        tok.out_w = min(dcol.bucket_capacity(live), tok.dt.capacity)
+        tok.packed = _dispatch_region(prog, tok.dt, tok.out_w)
+
+
+class FusedJoinAggProgram:
+    """One compiled join_agg region: probe predicate → searchsorted join
+    against the pre-sorted resident build side → joined-plane gather →
+    post projection → partial grouped agg, as ONE traced program."""
+
+    def __init__(self, packed_fn, run_packed, c_pred, c_post,
+                 lkey: str, rkey: str,
+                 probe_needs, build_needs, nk: int, ops: Tuple[str, ...],
+                 has_post_pred: bool, meta: dict,
+                 fused_ops: Tuple[str, ...] = ()):
+        self.packed_fn = packed_fn
+        self._run_packed = run_packed
+        self.c_pred = c_pred            # probe-side predicate (or None)
+        self.c_post = c_post            # joined-namespace projection
+        self.lkey = lkey
+        self.rkey = rkey
+        self.probe_needs = probe_needs  # raw probe planes the gather feeds
+        self.build_needs = build_needs
+        self.nk = nk
+        self.ops = ops
+        self.has_post_pred = has_post_pred
+        self.meta = meta
+        self.fused_ops = fused_ops
+        self.in_np_dtypes = None        # probe-side planes (warm-up grid)
+        self.build_np_dtypes = None     # build-side planes (warm-up grid)
+
+
+class RegionBuild:
+    """The join_agg build side, encoded + radix-sorted once per query;
+    every probe morsel's program reuses these resident planes."""
+
+    __slots__ = ("dt", "sorted_key", "perm", "live_count")
+
+    def __init__(self, dt, sorted_key, perm, live_count):
+        self.dt = dt
+        self.sorted_key = sorted_key
+        self.perm = perm
+        self.live_count = live_count
+
+
+_join_sort_jit = None
+_join_sort_lock = _threading.Lock()
+
+
+def prepare_region_build(prog: FusedJoinAggProgram, build_rb
+                         ) -> Optional[RegionBuild]:
+    """Encode the broadcast build side and sort its join-key plane —
+    ONE dispatch for the whole query. None → region declines."""
+    global _join_sort_jit
+    from ..analysis import retrace_sanitizer
+    cols = list(dict.fromkeys([prog.rkey] + list(prog.build_needs)))
+    for nm in cols:
+        if build_rb.get_column(nm).is_pyobject():
+            return None
+    try:
+        dt = dcol.encode_batch(build_rb, cols)
+    except (ValueError, TypeError):
+        return None
+    if _join_sort_jit is None:
+        with _join_sort_lock:
+            if _join_sort_jit is None:
+                _join_sort_jit = jax.jit(kernels.join_sort_impl)
+    k = dt.columns[prog.rkey]
+    with retrace_sanitizer.dispatch_scope("region.build",
+                                          (dt.capacity,)):
+        sorted_key, perm, live = _join_sort_jit(k.data, k.validity,
+                                                dt.row_mask)
+    return RegionBuild(dt, sorted_key, perm, live)
+
+
+def get_fused_join_agg(group_exprs, child_exprs, ops: Tuple[str, ...],
+                       probe_pred, post_pred, lkey: str, rkey: str,
+                       src_schema: Schema, build_schema: Schema,
+                       fused_ops: Tuple[str, ...] = ()
+                       ) -> Optional[FusedJoinAggProgram]:
+    """Compile (or fetch) the join_agg region program. None → the region
+    does not lower."""
+    key = ("region_ja", tuple(e._key() for e in group_exprs),
+           tuple(e._key() for e in child_exprs), ops,
+           probe_pred._key() if probe_pred is not None else None,
+           post_pred._key() if post_pred is not None else None,
+           lkey, rkey, runtime._schema_key(src_schema),
+           runtime._schema_key(build_schema))
+    hit = _region_cache.get(key)
+    if hit is not None:
+        _fused_counters["hits"] += 1
+        return hit if isinstance(hit, FusedJoinAggProgram) else None
+    _fused_counters["misses"] += 1
+    from ..schema import Field
+    src_names = set(src_schema.column_names)
+    joined_schema = Schema(
+        [Field(f.name, f.dtype) for f in src_schema]
+        + [Field(f.name, f.dtype) for f in build_schema])
+    nk = len(group_exprs)
+    has_post_pred = post_pred is not None
+    proj = list(group_exprs) + list(child_exprs) + \
+        ([post_pred] if post_pred is not None else [])
+    try:
+        c_post = compiler.compile_projection(proj, joined_schema, jit=False)
+        c_pred = compiler.compile_projection([probe_pred], src_schema,
+                                             jit=False) \
+            if probe_pred is not None else None
+    except (compiler.NotCompilable, NotImplementedError, ValueError,
+            TypeError, KeyError, OverflowError):
+        _region_cache[key] = False
+        return None
+    probe_needs = tuple(nm for nm in c_post.needs_cols if nm in src_names)
+    build_needs = tuple(nm for nm in c_post.needs_cols
+                        if nm not in src_names)
+    meta: dict = {}
+
+    def run_packed(p_arrays, p_valids, p_mask, p_scalars,
+                   b_arrays, b_valids, b_sorted, b_perm, b_live,
+                   post_scalars, W: int, out_cap: int):
+        if c_pred is not None:
+            pv, pm = c_pred.fn(p_arrays, p_valids, p_mask, p_scalars)[-1]
+            p_mask = p_mask & pv.astype(jnp.bool_) & pm
+        counts, starts, total = kernels.join_count_impl(
+            p_arrays[lkey], p_valids[lkey], p_mask, b_sorted, b_live)
+        owner, ridx, pair_valid = kernels.join_expand_impl(
+            counts, starts, b_perm, W)
+        j_arrays, j_valids = {}, {}
+        for nm in probe_needs:
+            j_arrays[nm] = jnp.take(p_arrays[nm], owner)
+            j_valids[nm] = jnp.take(p_valids[nm], owner) & pair_valid
+        for nm in build_needs:
+            j_arrays[nm] = jnp.take(b_arrays[nm], ridx)
+            j_valids[nm] = jnp.take(b_valids[nm], ridx) & pair_valid
+        outs = c_post.fn(j_arrays, j_valids, pair_valid, post_scalars)
+        mask = pair_valid
+        if has_post_pred:
+            qv, qm = outs[-1]
+            mask = mask & qv.astype(jnp.bool_) & qm
+            outs = outs[:-1]
+        keys = tuple(v for v, _ in outs[:nk])
+        kvalids = tuple(m for _, m in outs[:nk])
+        vals = tuple(v for v, _ in outs[nk:])
+        vvalids = tuple(m for _, m in outs[nk:])
+        ok, okv, ov, ovv, g = kernels.grouped_agg_block_impl(
+            keys, kvalids, vals, vvalids, mask, ops, out_cap)
+        flat = list(ok) + list(okv) + list(ov) + list(ovv)
+        meta["grouped_dtypes"] = [x.dtype for x in flat]
+        head = jnp.zeros((out_cap,), jnp.int64) \
+            .at[0].set(g.astype(jnp.int64)) \
+            .at[1].set(total.astype(jnp.int64))
+        return jnp.stack([head] + [_pack_i64(x) for x in flat])
+
+    prog = FusedJoinAggProgram(
+        jax.jit(run_packed, static_argnames=("W", "out_cap")),
+        run_packed, c_pred, c_post, lkey, rkey, probe_needs, build_needs,
+        nk, ops, has_post_pred, meta, fused_ops=fused_ops)
+    try:
+        need = set(probe_needs) | {lkey} \
+            | set(c_pred.needs_cols if c_pred is not None else ())
+        prog.in_np_dtypes = {
+            nm: dcol.device_np_dtype(src_schema[nm].dtype) for nm in need}
+        bneed = set(build_needs) | {rkey}
+        prog.build_np_dtypes = {
+            nm: dcol.device_np_dtype(build_schema[nm].dtype)
+            for nm in bneed}
+    except (ValueError, KeyError):
+        prog.in_np_dtypes = None
+        prog.build_np_dtypes = None
+    _region_cache[key] = prog
+    return prog
+
+
+class InflightJoinAgg:
+    """One in-flight join_agg region dispatch (+ dual-ladder state)."""
+
+    __slots__ = ("prog", "dt", "build", "group_exprs", "key_fields",
+                 "agg_fields", "W", "out_cap", "packed", "t0",
+                 "submitted_s")
+
+    def __init__(self, prog, dt, build, group_exprs, key_fields,
+                 agg_fields, W, out_cap):
+        import time as _time
+        self.prog = prog
+        self.dt = dt
+        self.build = build
+        self.group_exprs = group_exprs
+        self.key_fields = key_fields
+        self.agg_fields = agg_fields
+        self.W = W
+        self.out_cap = out_cap
+        self.packed = None
+        self.t0 = _time.perf_counter()
+        self.submitted_s = 0.0
+
+
+def _dispatch_join_agg(prog: FusedJoinAggProgram, dt: dcol.DeviceTable,
+                       build: RegionBuild, W: int, out_cap: int):
+    from ..analysis import retrace_sanitizer
+    p_arrays = {n: col.data for n, col in dt.columns.items()}
+    p_valids = {n: col.validity for n, col in dt.columns.items()}
+    b_arrays = {n: col.data for n, col in build.dt.columns.items()}
+    b_valids = {n: col.validity for n, col in build.dt.columns.items()}
+    p_scalars = runtime._prep_scalars(prog.c_pred, dt) \
+        if prog.c_pred is not None else ()
+    post_scalars = _prep_scalars_joined(prog.c_post, dt, build.dt)
+    with retrace_sanitizer.dispatch_scope(
+            "region.join_agg",
+            (id(prog), dt.capacity, build.dt.capacity, W, out_cap,
+             tuple(s.shape for s in p_scalars),
+             tuple(s.shape for s in post_scalars))):
+        return prog.packed_fn(p_arrays, p_valids, dt.row_mask, p_scalars,
+                              b_arrays, b_valids, build.sorted_key,
+                              build.perm, build.live_count, post_scalars,
+                              W=W, out_cap=out_cap)
+
+
+def _prep_scalars_joined(c: compiler.Compiled, p_dt: dcol.DeviceTable,
+                         b_dt: dcol.DeviceTable):
+    """Scalar prep over the joined namespace: each spec's dictionary
+    comes from whichever side encoded the column."""
+    import pyarrow as pa
+    scalars = []
+    for spec in c.scalar_specs:
+        src = p_dt.columns.get(spec.col) or b_dt.columns.get(spec.col)
+        d = src.dictionary if src is not None else None
+        if d is None:
+            d = pa.array([], type=pa.large_string())
+        scalars.append(jnp.asarray(spec.fn(d)))
+    return tuple(scalars)
+
+
+def submit_join_agg(prog: FusedJoinAggProgram, batch, build: RegionBuild,
+                    group_exprs, agg_exprs, out_schema: Schema,
+                    start_out_cap: int = _OUT_CAP0
+                    ) -> Optional[InflightJoinAgg]:
+    """Encode + async dispatch of one probe morsel; None → host
+    fallback."""
+    import time as _time
+    need = list(dict.fromkeys(
+        [prog.lkey] + list(prog.probe_needs)
+        + list(prog.c_pred.needs_cols if prog.c_pred is not None else ())))
+    for nm in need:
+        if batch.get_column(nm).is_pyobject():
+            return None
+    try:
+        dt = dcol.encode_batch(batch, need)
+    except (ValueError, TypeError):
+        return None
+    key_fields = [out_schema[e.name()] for e in group_exprs]
+    agg_fields = [out_schema[e.name()] for e in agg_exprs]
+    # expected ≤1 build match per probe row (FK equi-join): start the pair
+    # bucket at the probe capacity; the header's true total grows it
+    W = dt.capacity
+    tok = InflightJoinAgg(prog, dt, build, group_exprs, key_fields,
+                          agg_fields, W,
+                          min(dcol.bucket_capacity(max(start_out_cap,
+                                                       _OUT_CAP0)),
+                              dcol.bucket_capacity(W)))
+    tok.packed = _dispatch_join_agg(prog, dt, build, tok.W, tok.out_cap)
+    tok.submitted_s = _time.perf_counter() - tok.t0
+    return tok
+
+
+def drain_join_agg(tok: InflightJoinAgg):
+    """Blocking drain: one packed fetch → partial-group RecordBatch,
+    continuing the DUAL overflow ladder (pair width W, group bucket
+    out_cap) read from the packed header. None → host fallback."""
+    import time as _time
+
+    from . import costmodel, pipeline
+    prog = tok.prog
+    t_drain0 = _time.perf_counter()
+    while True:
+        packed = np.asarray(pipeline.fetch_host(tok.packed))
+        g = int(packed[0, 0])
+        total = int(packed[0, 1])
+        grown = False
+        if total > tok.W:
+            if total > _REGION_MAX_W:
+                return None
+            tok.W = dcol.bucket_capacity(total)
+            grown = True
+        if g > tok.out_cap:
+            cap_limit = dcol.bucket_capacity(max(tok.W, tok.dt.capacity))
+            if g > cap_limit:
+                return None
+            tok.out_cap = min(dcol.bucket_capacity(g), cap_limit)
+            grown = True
+        if grown:
+            tok.packed = _dispatch_join_agg(prog, tok.dt, tok.build,
+                                            tok.W, tok.out_cap)
+            continue
+        from ..recordbatch import RecordBatch
+        dtypes = prog.meta["grouped_dtypes"]
+        nk, nv = prog.nk, len(tok.agg_fields)
+        rows = packed[1:]
+        cols = []
+        for i, (e, f) in enumerate(zip(tok.group_exprs, tok.key_fields)):
+            kv = _unpack_i64(rows[i][:g], dtypes[i])
+            km = _unpack_i64(rows[nk + i][:g],
+                             dtypes[nk + i]).astype(np.bool_)
+            dc = dcol.DeviceColumn(kv, km, f.dtype, None)
+            cols.append(dcol.decode_column(f.name, dc, g))
+        for i, f in enumerate(tok.agg_fields):
+            vv = _unpack_i64(rows[2 * nk + i][:g], dtypes[2 * nk + i])
+            vm = _unpack_i64(rows[2 * nk + nv + i][:g],
+                             dtypes[2 * nk + nv + i]).astype(np.bool_)
+            dc = dcol.DeviceColumn(vv, vm, f.dtype, None)
+            cols.append(dcol.decode_column(f.name, dc, g))
+        out = RecordBatch.from_series(cols)
+        n_ops = max(len(prog.fused_ops), 3)
+        secs = tok.submitted_s + (_time.perf_counter() - t_drain0)
+        costmodel.ledger_record(
+            "region", rows=tok.dt.row_count,
+            nbytes=(1 + 2 * (nk + nv)) * 8 * tok.out_cap, seconds=secs,
+            strategy="join_agg", fused_ops=n_ops,
+            round_trips_saved=n_ops - 1,
+            fusion_serial_seconds=costmodel.fusion_serial_estimate(
+                tok.dt.row_count, n_ops))
+        return out, g
+
+
+def fused_region_programs() -> List[object]:
+    """Every region program compiled so far — the AOT warm-up grid
+    (device/warmup.py) iterates these alongside the fused-agg library."""
+    return [p for p in _region_cache.values()
+            if isinstance(p, (FusedRegionProgram, FusedJoinAggProgram))]

@@ -482,6 +482,198 @@ def grouped_agg_block_impl(keys, key_valids, vals, val_valids, row_mask,
 
 
 # ---------------------------------------------------------------------------
+# dense direct-indexed grouped aggregation (dictionary-coded keys)
+
+def grouped_agg_dense_impl(keys, key_valids, vals, val_valids, row_mask,
+                           ops: Tuple[str, ...], out_cap: int,
+                           dims: Tuple[int, ...]):
+    """Grouped aggregation by DIRECT slot indexing — no sort, no hash table.
+
+    When every group key rides sorted-dictionary codes (string/binary
+    planes encode as dense ints < dictionary size, ``column._np_encode``),
+    a row's group id is pure arithmetic over its codes: a mixed-radix
+    number over the per-key slot widths ``dims`` (each dictionary size
+    rounded up to a power of two so the static-arg space stays bounded;
+    slot ``d`` of a key holds its nulls). Aggregation is then ONE O(C)
+    scatter pass per reduced plane over ``K = prod(d+1)`` slots — the
+    radix sort + inverse-permutation sort of the sort strategy (≥4
+    streaming passes over the packed row planes) disappears entirely.
+
+    Strides are most-significant-first over the keys with nulls at each
+    key's top slot, so occupied slots enumerate groups in ascending key
+    order with nulls last — the same group order the sort strategy emits.
+    Requires ``K <= out_cap`` (the dispatch site sizes the bucket);
+    dense output can never overflow, because group_count <= K.
+
+    Returns the [out_cap]-wide block layout of
+    :func:`grouped_agg_block_impl`.
+    """
+    C = row_mask.shape[0]
+    K = 1
+    for d in dims:
+        K *= d + 1
+    if K > out_cap:
+        raise ValueError("dense dispatch requires K <= out_cap")
+    # mixed-radix group id per ORIGINAL row (no gathers, no sort)
+    gid = jnp.zeros(C, dtype=jnp.int32)
+    for k, kv, d in zip(keys, key_valids, dims):
+        comp = jnp.where(kv & row_mask,
+                         jnp.clip(k.astype(jnp.int32), 0, d), d)
+        gid = gid * (d + 1) + comp
+    seg = jnp.where(row_mask, gid, out_cap).astype(jnp.int32)
+
+    # ONE [C, K] one-hot shared by every additive reduction below: the
+    # per-slot sums become a single stacked matmul instead of a scatter
+    # per plane. XLA CPU lowers scatter to a serial per-row update loop
+    # (the q1 profile showed it dominating the whole dispatch), while a
+    # [C, K]·[K] GEMM is multithreaded there and rides the MXU on TPU.
+    # K is the tiny static slot count (dictionary product), NOT out_cap,
+    # so the materialized one-hot stays ~C·K·8 bytes.
+    acc_dt = jnp.float64 if any(
+        v.dtype == jnp.float64 for v in vals) else jnp.float32
+    oh = jax.nn.one_hot(jnp.where(row_mask, gid, K), K, dtype=acc_dt)
+
+    def slot_pad(x):
+        """[K] slot vector → [out_cap] (slots past K are empty)."""
+        return jnp.zeros((out_cap,), x.dtype).at[:K].set(x)
+
+    # pass 1 — collect every additive plane (slot occupancy, contrib
+    # counts, float sums, squared sums) into ONE [ncols, C] matrix for a
+    # single GEMM against the shared one-hot. Integer sums keep the
+    # exact int64 scatter, and min/max/any/bool reductions scatter too
+    # (no additive form).
+    mm_cols = []
+    col_ix = {}
+
+    def want(i, tag, x, src):
+        # queries reuse planes (q1 sums l_quantity three ways over one
+        # validity mask) — identical sources collapse to one matrix row
+        shared = (tag,) + src
+        ix = col_ix.get(shared)
+        if ix is None:
+            ix = len(mm_cols)
+            mm_cols.append(x.astype(acc_dt))
+            col_ix[shared] = ix
+        col_ix[(i, tag)] = ix
+
+    want(-1, "occ", row_mask, (id(row_mask),))
+    for i, (v, vv, op) in enumerate(zip(vals, val_valids, ops)):
+        contrib = row_mask & vv
+        want(i, "cnt", contrib, (id(vv),))
+        if op in ("sum", "mean", "var", "stddev") \
+                and jnp.issubdtype(v.dtype, jnp.floating):
+            x = jnp.where(contrib, v, jnp.zeros((), v.dtype))
+            want(i, "s1", x, (id(v), id(vv)))
+            if op in ("var", "stddev"):
+                xa = x.astype(acc_dt)
+                want(i, "s2", xa * xa, (id(v), id(vv)))
+    # stack along axis 0 (each column lands contiguously) and contract
+    # the row axis directly — the axis=1/transpose formulation pays an
+    # extra interleaving copy of the whole matrix
+    M = jnp.stack(mm_cols, axis=0)
+    R = jnp.matmul(M, oh, precision=lax.Precision.HIGHEST)  # [ncols, K]
+
+    occ = R[col_ix[(-1, "occ")]]
+    occupied = slot_pad(occ > 0.0)
+    group_count = jnp.sum(occ > 0.0).astype(jnp.int32)
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    # compact occupied slots to the front: one stable [out_cap]-sized
+    # 2-operand sort (ascending slot order — the group order — survives)
+    slot_of = lax.sort((jnp.where(occupied, 0, 1).astype(jnp.int32), j),
+                       num_keys=1, is_stable=True)[1]
+    live_group = j < group_count
+
+    # each slot's key codes come back by mixed-radix decomposition —
+    # nothing is gathered from the row planes
+    strides = []
+    s = 1
+    for d in reversed(dims):
+        strides.append(s)
+        s *= d + 1
+    strides.reverse()
+    out_keys = []
+    out_kvalids = []
+    for k, d, st in zip(keys, dims, strides):
+        comp = (slot_of // st) % (d + 1)
+        out_keys.append(comp.astype(k.dtype))
+        out_kvalids.append(live_group & (comp != d))
+
+    def slot_take(r):
+        """[K] slot sums → compacted [out_cap] group order."""
+        return jnp.take(slot_pad(r), slot_of)
+
+    def red_scatter(x, fn=jax.ops.segment_sum):
+        return jnp.take(fn(x, seg, num_segments=out_cap + 1)[:out_cap],
+                        slot_of)
+
+    idx = jnp.arange(C, dtype=jnp.int32)
+    out_vals = []
+    out_vvalids = []
+    for i, (v, vv, op) in enumerate(zip(vals, val_valids, ops)):
+        contrib = row_mask & vv
+        cntf = slot_take(R[col_ix[(i, "cnt")]])  # counts exact in float
+        cnt = cntf.astype(jnp.int64)
+        has = live_group & (cnt > 0)
+        if op == "count":
+            out_vals.append(cnt)
+            out_vvalids.append(live_group)
+            continue
+        if op in ("sum", "mean", "var", "stddev"):
+            if (i, "s1") in col_ix:
+                s1 = slot_take(R[col_ix[(i, "s1")]])
+            else:  # integer/bool input: exact int64 scatter sum
+                x = jnp.where(contrib, v, jnp.zeros((), v.dtype)) \
+                    .astype(jnp.int64)
+                s1 = red_scatter(x)
+            if op == "sum":
+                out_vals.append(s1)
+                out_vvalids.append(has)
+                continue
+            # widest float the backend supports (mirrors the sort path)
+            fdt = s1.astype(jnp.float64).dtype if s1.dtype != jnp.float32 \
+                else jnp.float32
+            safe = jnp.maximum(cnt, 1).astype(fdt)
+            mean = s1.astype(fdt) / safe
+            if op == "mean":
+                out_vals.append(mean)
+                out_vvalids.append(has)
+                continue
+            if (i, "s2") in col_ix:
+                s2 = slot_take(R[col_ix[(i, "s2")]]).astype(fdt)
+            else:
+                xf = jnp.where(contrib, v,
+                               jnp.zeros((), v.dtype)).astype(fdt)
+                s2 = red_scatter(xf * xf)
+            var = jnp.maximum(s2 / safe - mean * mean, 0.0)
+            out_vals.append(jnp.sqrt(var) if op == "stddev" else var)
+            out_vvalids.append(has)
+            continue
+        if op in ("min", "max", "bool_and", "bool_or"):
+            base = v.astype(jnp.int8) if v.dtype == jnp.bool_ else v
+            red = "min" if op in ("min", "bool_and") else "max"
+            ident = _identity_for(base.dtype, red)
+            x = jnp.where(contrib, base, ident)
+            fn = jax.ops.segment_min if red == "min" else jax.ops.segment_max
+            r = red_scatter(x, fn)
+            if v.dtype == jnp.bool_:
+                r = r.astype(jnp.bool_)
+            out_vals.append(r)
+            out_vvalids.append(has)
+            continue
+        if op == "any_value":
+            fi = jax.ops.segment_min(jnp.where(contrib, idx, C - 1), seg,
+                                     num_segments=out_cap + 1)[:out_cap]
+            fi = jnp.take(jnp.clip(fi, 0, C - 1), slot_of)
+            out_vals.append(jnp.take(v, fi))
+            out_vvalids.append(has)
+            continue
+        raise ValueError(f"unsupported device agg {op}")
+
+    return tuple(out_keys), tuple(out_kvalids), tuple(out_vals), \
+        tuple(out_vvalids), group_count
+
+
+# ---------------------------------------------------------------------------
 # global aggregation
 
 def global_agg_impl(vals, val_valids, row_mask, ops: Tuple[str, ...]):

@@ -112,6 +112,19 @@ def hash_agg_models(cap: int, out_cap: int, table_cap: int, n_words: int,
     return 0.0, int(row_bytes + table_cap * slot_bytes)
 
 
+def dense_agg_models(cap: int, out_cap: int, n_keys: int, n_vals: int,
+                     val_bytes: int = 4):
+    """(flops, bytes) of one DENSE direct-indexed grouped-agg dispatch:
+    one pass over each key-code plane (the mixed-radix group id is pure
+    arithmetic), one scatter pass per reduced plane (values + the count
+    plane), and the [out_cap] slot planes. No sort, no table — the
+    lightest byte model of the three strategies, which is exactly why
+    the dispatch sites prefer it whenever the dictionaries fit."""
+    row_bytes = cap * (n_keys * 4 + 1 + (n_vals + 1) * (val_bytes + 1))
+    slot_bytes = out_cap * (n_vals + 2) * 8
+    return 0.0, int(row_bytes + slot_bytes)
+
+
 def hash_join_bytes_model(c_l: int, c_r: int, out_cap: int) -> int:
     """Modeled HBM traffic of one hash join dispatch: one pass over each
     side's key+liveness planes, the chain-link plane (written once per

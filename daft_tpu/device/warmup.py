@@ -117,13 +117,86 @@ def warmup_fragments(classes: List[int],
     return {"programs": programs, "skipped": skipped, "errors": errors}
 
 
+def warmup_regions(classes: List[int],
+                   progs: Optional[list] = None) -> Dict[str, int]:
+    """AOT-compile every fusion-region program seen so far (round 21's
+    whole-query compilation library, ``fragment.fused_region_programs()``)
+    over the size-class grid at each shape's first-dispatch width rung.
+    join_agg regions warm the probe=build diagonal of their 2-D capacity
+    grid — off-diagonal pairs compile on demand into the same cache."""
+    import jax
+
+    from ..analysis import retrace_sanitizer
+    from . import column as dcol
+    from . import fragment
+    progs = fragment.fused_region_programs() if progs is None else progs
+    programs = skipped = errors = 0
+    for prog in progs:
+        if prog.in_np_dtypes is None:
+            skipped += 1
+            continue
+        is_join = isinstance(prog, fragment.FusedJoinAggProgram)
+        if is_join:
+            if prog.build_np_dtypes is None or prog.c_post.scalar_specs \
+                    or (prog.c_pred is not None
+                        and prog.c_pred.scalar_specs):
+                skipped += 1
+                continue
+        elif prog.compiled.scalar_specs:
+            skipped += 1   # string-scalar planes are data-shaped
+            continue
+        for cap in classes:
+            arrays = {n: jax.ShapeDtypeStruct((cap,), dt)
+                      for n, dt in prog.in_np_dtypes.items()}
+            valids = {n: jax.ShapeDtypeStruct((cap,), np.bool_)
+                      for n in prog.in_np_dtypes}
+            mask = jax.ShapeDtypeStruct((cap,), np.bool_)
+            with retrace_sanitizer.dispatch_scope(
+                    "warmup.aot", ("region", id(prog), cap)):
+                try:
+                    if is_join:
+                        b_arrays = {n: jax.ShapeDtypeStruct((cap,), dt)
+                                    for n, dt
+                                    in prog.build_np_dtypes.items()}
+                        b_valids = {n: jax.ShapeDtypeStruct(
+                            (cap,), np.bool_)
+                            for n in prog.build_np_dtypes}
+                        b_sorted = jax.ShapeDtypeStruct(
+                            (cap,), prog.build_np_dtypes[prog.rkey])
+                        b_perm = jax.ShapeDtypeStruct((cap,), np.int32)
+                        b_live = jax.ShapeDtypeStruct((), np.int32)
+                        prog.packed_fn.lower(
+                            arrays, valids, mask, (), b_arrays, b_valids,
+                            b_sorted, b_perm, b_live, (), W=cap,
+                            out_cap=min(fragment._OUT_CAP0, cap)
+                        ).compile()
+                    else:
+                        if prog.shape == "topk":
+                            out_w = min(dcol.bucket_capacity(
+                                max(prog.limit, 1)), cap)
+                        elif not prog.has_pred:
+                            out_w = cap
+                        else:
+                            out_w = min(dcol.bucket_capacity(
+                                max(cap // 4, fragment._OUT_CAP0)), cap)
+                        prog.packed_fn.lower(
+                            arrays, valids, mask, (),
+                            out_w=out_w).compile()
+                    programs += 1
+                except Exception:
+                    errors += 1
+    return {"programs": programs, "skipped": skipped, "errors": errors}
+
+
 def warmup_session(max_capacity: int = _DEFAULT_MAX_CAPACITY,
                    min_capacity: int = _DEFAULT_MIN_CAPACITY,
                    kernels: bool = True,
-                   fragments: bool = True) -> Dict[str, object]:
-    """Run the full warm-up (kernel library + fragment library) over the
-    configured size-class ladder; returns a stats dict.  Callers gate on
-    ``DAFT_TPU_AOT_WARMUP`` (the serving scheduler does at startup)."""
+                   fragments: bool = True,
+                   regions: bool = True) -> Dict[str, object]:
+    """Run the full warm-up (kernel library + fragment library + fusion
+    regions) over the configured size-class ladder; returns a stats
+    dict.  Callers gate on ``DAFT_TPU_AOT_WARMUP`` (the serving
+    scheduler does at startup)."""
     from . import column as dcol
     t0 = time.perf_counter()
     classes = dcol.size_classes(max_capacity, min_capacity)
@@ -132,6 +205,8 @@ def warmup_session(max_capacity: int = _DEFAULT_MAX_CAPACITY,
         stats["kernels"] = warmup_kernels(classes)
     if fragments:
         stats["fragments"] = warmup_fragments(classes)
+    if regions:
+        stats["regions"] = warmup_regions(classes)
     stats["seconds"] = round(time.perf_counter() - t0, 3)
     return stats
 

@@ -907,6 +907,286 @@ class LocalExecutor:
                                         poll=self._poll_cancel):
             yield from outs
 
+    # fused regions (round 21 whole-query compilation) -----------------
+    def _exec_FusedRegion(self, node: pp.FusedRegion):
+        """Execute a planner-proposed fusion region: the region's whole
+        operator chain runs as ONE device program per morsel (submit =
+        encode+dispatch, drain = one packed fetch), riding the r17 async
+        pipeline. Admission is priced per morsel by ``fusion_wins``
+        (``DAFT_TPU_FUSION=1`` force-admits); every decline — cost gate,
+        pyobject/encode failure, overflow past the ladder ceiling — runs
+        the equivalent host chain per morsel, and a region whose program
+        does not lower at all runs the untouched ``fallback`` subtree."""
+        from ..device import runtime as drt
+        from ..physical import fusion as pfusion
+        mode = pfusion.fusion_mode(self.cfg)
+        if mode == "0" or not drt.device_enabled():
+            yield from self._exec(node.fallback)
+            return
+        if node.shape == "join_agg":
+            yield from self._exec_region_join_agg(node, mode)
+            return
+        yield from self._exec_region_chain(node, mode)
+
+    def _exec_region_chain(self, node: pp.FusedRegion, mode: str):
+        """chain / topk shapes: predicate + projection (+ in-program
+        argsort for topk) + compaction in one dispatch, packed survivors
+        back in one transfer."""
+        from ..device import column as dcol, costmodel, fragment
+        from ..device import pipeline as dpipe, runtime as drt
+        topk = node.shape == "topk"
+        prog = fragment.get_fused_region(
+            node.exprs, node.predicate, node.source.schema(),
+            sort_by=node.sort_by, descending=node.descending,
+            nulls_first=node.nulls_first, limit=node.limit,
+            fused_ops=node.fused_ops)
+        if prog is None:
+            yield from self._exec(node.fallback)
+            return
+        n_ops = max(len(node.fused_ops) - 1, 2)
+
+        def host_run(rb: RecordBatch) -> MicroPartition:
+            if node.predicate is not None:
+                rb = rb.filter(node.predicate)
+            rb = rb.eval_expression_list(node.exprs) \
+                .cast_to_schema(node.schema())
+            if topk:
+                # per-morsel top-k in the OUTPUT namespace (the TopN
+                # fallback's sort keys live there); merged below
+                rb = rb.top_n(node.fallback.sort_by, node.limit,
+                              node.descending, node.nulls_first)
+            return MicroPartition.from_recordbatch(rb)
+
+        def gate(rb: RecordBatch, window: int = 0) -> bool:
+            if len(rb) < max(drt._min_rows(), 1):
+                return False
+            if mode == "1":
+                return True
+            est_w = dcol.bucket_capacity(max(node.limit or 0, 1)) if topk \
+                else dcol.bucket_capacity(max(len(rb), 1))
+            return costmodel.fusion_wins(
+                node.shape, len(rb),
+                dcol.encoded_nbytes(rb, prog.compiled.needs_cols),
+                (1 + 2 * prog.nout) * 8 * est_w, n_ops,
+                host_bytes=drt._batch_cols_nbytes(
+                    rb, prog.compiled.needs_cols),
+                window=window)
+
+        def device_submit(rb: RecordBatch):
+            try:
+                return fragment.submit_region(prog, rb, node.exprs,
+                                              node.schema())
+            except Exception:
+                return None
+
+        def device_drain(tok) -> Optional[MicroPartition]:
+            try:
+                out = fragment.drain_region(tok)
+            except Exception:
+                return None
+            if out is None:
+                return None
+            out = out.cast_to_schema(node.schema())
+            return MicroPartition.from_recordbatch(out)
+
+        def emit():
+            child = self._exec(node.source)
+            window = dpipe.inflight_window()
+            if window > 0:
+                def submit(p, seq, wgate):
+                    import time as _time
+                    rb = p.combined()
+                    if not gate(rb, window=window):
+                        return host_run(rb)
+                    est = dcol.encoded_nbytes(rb, prog.compiled.needs_cols)
+                    slot = dpipe.acquire_slot(wgate, seq, self.mem, est)
+                    try:
+                        t0 = _time.perf_counter()
+                        with dpipe.upload_span(seq, window):
+                            tok = device_submit(rb)
+                        sub_s = _time.perf_counter() - t0
+                    except BaseException:
+                        dpipe.release_slot(slot)
+                        raise
+                    if tok is None:
+                        dpipe.release_slot(slot)
+                        return host_run(rb)
+                    return dpipe.InflightItem(
+                        slot, (tok, rb), sub_s=sub_s,
+                        t_dispatched_us=dpipe.now_us())
+
+                def drain(ret, seq):
+                    if not isinstance(ret, dpipe.InflightItem):
+                        return ret
+                    tok, rb = ret.token
+                    dpipe.note_compute_span(seq, window, ret.t_dispatched_us)
+                    with dpipe.download_span(seq, window):
+                        out = device_drain(tok)
+                    return out if out is not None else host_run(rb)
+
+                yield from dpipe.run_pipelined(child, submit, drain,
+                                               window=window,
+                                               poll=self._poll_cancel)
+                return
+
+            def run(p: MicroPartition) -> MicroPartition:
+                rb = p.combined()
+                if not gate(rb):
+                    return host_run(rb)
+                tok = device_submit(rb)
+                out = device_drain(tok) if tok is not None else None
+                return out if out is not None else host_run(rb)
+
+            yield from _ordered_parallel(child, run)
+
+        if not topk:
+            yield from emit()
+            return
+        # topk tail: each morsel arrives already reduced to its own top-k
+        # bucket; one final host merge produces the query's k rows
+        tops = list(emit())
+        if not tops:
+            yield MicroPartition.from_recordbatch(
+                RecordBatch.empty(node.schema()))
+            return
+        merged = tops[0].concat(tops[1:]) if len(tops) > 1 else tops[0]
+        yield MicroPartition.from_recordbatch(
+            merged.combined().top_n(node.fallback.sort_by, node.limit,
+                                    node.descending, node.nulls_first))
+
+    def _exec_region_join_agg(self, node: pp.FusedRegion, mode: str):
+        """join_agg shape: the broadcast build side materializes once
+        (host) and is encoded + key-sorted once on device; every probe
+        morsel then joins, projects, and partially aggregates in ONE
+        dispatch. Output is partial group blocks — the parent final
+        Aggregate merges them."""
+        from ..aggs import split_agg_expr
+        from ..device import column as dcol, costmodel, fragment
+        from ..device import pipeline as dpipe, runtime as drt
+        specs = [split_agg_expr(a) for a in node.aggs]
+        child_exprs = [(c if c is not None else _lit_true())
+                       .alias(f"__v{i}__")
+                       for i, (op, c, nm, pr) in enumerate(specs)]
+        ops = tuple(s[0] for s in specs)
+        agg_cols = [col(s[2]) for s in specs]
+        post_pred = getattr(node, "post_predicate", None)
+        lkey = node.left_on[0].name()
+        rkey = node.right_on[0].name()
+        prog = fragment.get_fused_join_agg(
+            node.group_by, child_exprs, ops, node.predicate, post_pred,
+            lkey, rkey, node.source.schema(), node.build.schema(),
+            fused_ops=node.fused_ops)
+        if prog is None:
+            yield from self._exec(node.fallback)
+            return
+        build_rb = _gather_all(self._exec(node.build)).combined()
+        build = fragment.prepare_region_build(prog, build_rb)
+        if build is None:
+            yield from self._exec(node.fallback)
+            return
+        n_ops = max(len(node.fused_ops), 3)
+        nk, nv = len(node.group_by), len(ops)
+        # adaptive group-bucket start: seed the next morsel's ladder from
+        # the last drained group count (q3-style high-NDV keys would pay
+        # one overflow re-dispatch per morsel otherwise)
+        g_hint = [fragment._OUT_CAP0]
+
+        def host_run(rb: RecordBatch) -> MicroPartition:
+            if node.predicate is not None:
+                rb = rb.filter(node.predicate)
+            joined = rb.hash_join(build_rb, list(node.left_on),
+                                  list(node.right_on), "inner")
+            if post_pred is not None:
+                joined = joined.filter(post_pred)
+            return MicroPartition.from_recordbatch(
+                joined.agg(list(node.aggs), list(node.group_by))
+                .cast_to_schema(node.schema()))
+
+        def gate(rb: RecordBatch, window: int = 0) -> bool:
+            if len(rb) < max(drt._min_rows(), 1):
+                return False
+            if mode == "1":
+                return True
+            need = list(dict.fromkeys(
+                [lkey] + list(prog.probe_needs)
+                + list(prog.c_pred.needs_cols
+                       if prog.c_pred is not None else ())))
+            return costmodel.fusion_wins(
+                "join_agg", len(rb), dcol.encoded_nbytes(rb, need),
+                (1 + 2 * (nk + nv)) * 8
+                * dcol.bucket_capacity(max(g_hint[0], 1)),
+                n_ops, host_bytes=drt._batch_cols_nbytes(rb, need),
+                window=window)
+
+        def device_submit(rb: RecordBatch):
+            try:
+                return fragment.submit_join_agg(
+                    prog, rb, build, node.group_by, agg_cols,
+                    node.schema(), start_out_cap=g_hint[0])
+            except Exception:
+                return None
+
+        def device_drain(tok) -> Optional[MicroPartition]:
+            try:
+                res = fragment.drain_join_agg(tok)
+            except Exception:
+                return None
+            if res is None:
+                return None
+            out, g = res
+            g_hint[0] = max(g, fragment._OUT_CAP0)
+            return MicroPartition.from_recordbatch(
+                out.cast_to_schema(node.schema()))
+
+        child = self._exec(node.source)
+        window = dpipe.inflight_window()
+        if window > 0:
+            def submit(p, seq, wgate):
+                import time as _time
+                rb = p.combined()
+                if not gate(rb, window=window):
+                    return host_run(rb)
+                need = list(dict.fromkeys([lkey] + list(prog.probe_needs)))
+                est = dcol.encoded_nbytes(rb, need)
+                slot = dpipe.acquire_slot(wgate, seq, self.mem, est)
+                try:
+                    t0 = _time.perf_counter()
+                    with dpipe.upload_span(seq, window):
+                        tok = device_submit(rb)
+                    sub_s = _time.perf_counter() - t0
+                except BaseException:
+                    dpipe.release_slot(slot)
+                    raise
+                if tok is None:
+                    dpipe.release_slot(slot)
+                    return host_run(rb)
+                return dpipe.InflightItem(slot, (tok, rb), sub_s=sub_s,
+                                          t_dispatched_us=dpipe.now_us())
+
+            def drain(ret, seq):
+                if not isinstance(ret, dpipe.InflightItem):
+                    return ret
+                tok, rb = ret.token
+                dpipe.note_compute_span(seq, window, ret.t_dispatched_us)
+                with dpipe.download_span(seq, window):
+                    out = device_drain(tok)
+                return out if out is not None else host_run(rb)
+
+            yield from dpipe.run_pipelined(child, submit, drain,
+                                           window=window,
+                                           poll=self._poll_cancel)
+            return
+
+        def run(p: MicroPartition) -> MicroPartition:
+            rb = p.combined()
+            if not gate(rb):
+                return host_run(rb)
+            tok = device_submit(rb)
+            out = device_drain(tok) if tok is not None else None
+            return out if out is not None else host_run(rb)
+
+        yield from _ordered_parallel(child, run)
+
     def _exec_DeviceExchangeAgg(self, node: pp.DeviceExchangeAgg):
         """Shuffle+final-merge as ONE mesh program: shard the partial group
         blocks over the device mesh, all_to_all by key hash over ICI, merge,

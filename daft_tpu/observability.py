@@ -543,6 +543,13 @@ class RuntimeStatsContext:
                     # r17 async pipeline: serial-equivalent stage seconds
                     # vs pipelined wall (>1 = overlap really hid work)
                     extra += f" overlap={d['overlap_x']}x"
+                if "fused_ops" in d:
+                    # r21 whole-query compilation: operators fused into
+                    # region programs + host round-trips that eliminated
+                    extra += (f" fused_ops={d['fused_ops']}"
+                              f" rt_saved={d.get('round_trips_saved', 0)}")
+                if "fusion_x" in d:
+                    extra += f" fusion={d['fusion_x']}x"
                 lines.append(
                     f"  {kind}: dispatches={d['dispatches']} "
                     f"rows={d['rows']} time={d['seconds']:.3f}s{extra}")

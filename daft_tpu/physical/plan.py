@@ -131,6 +131,59 @@ class DeviceExchangeAgg(PhysicalPlan):
         self.group_by = group_by
 
 
+class FusedRegion(PhysicalPlan):
+    """A maximal device-eligible operator chain compiled as ONE XLA program
+    (round 21 whole-query compilation, ``physical/fusion.py``). Intermediate
+    tables never materialize on host: the region's operators share device-
+    resident planes inside a single traced program, and only the region's
+    output crosses the link.
+
+    ``shape`` picks the region grammar:
+
+    - ``chain``  — row-local Filter*/Project* over a source: predicate +
+      projection eval + in-program compaction, one packed transfer of the
+      surviving rows.
+    - ``topk``   — a chain with a TopN tail: the argsort runs in-program and
+      only a static top-k bucket is transferred.
+    - ``join_agg`` — inner single-key equi-join spine feeding Project* and a
+      partial grouped aggregation: the broadcast build side is encoded once
+      and stays device-resident; each probe morsel joins, projects and
+      partially aggregates in one dispatch (dual overflow ladders: join
+      pair capacity and group bucket).
+
+    ``fallback`` keeps the original unfused subtree — the executor runs it
+    verbatim whenever the region declines (cost gate, encode failure,
+    pyobject inputs), so fusion is strictly an execution strategy, never a
+    semantics change.
+    """
+
+    def __init__(self, shape: str, source, exprs, predicate, schema,
+                 fallback, fused_ops: Tuple[str, ...] = (),
+                 sort_by=(), descending=(), nulls_first=(), limit=None,
+                 build=None, left_on=(), right_on=(),
+                 aggs=(), group_by=(), mode: str = "partial"):
+        children = [source] + ([build] if build is not None else [])
+        super().__init__(children, schema)
+        self.shape = shape            # chain | topk | join_agg
+        self.source = source          # probe-side ScanSource/InMemorySource
+        self.exprs = exprs            # outputs, substituted over source cols
+        self.predicate = predicate    # combined row-local conjuncts (or None)
+        self.fallback = fallback      # original unfused subtree root
+        self.fused_ops = fused_ops    # operator names folded into the region
+        # topk tail
+        self.sort_by = sort_by
+        self.descending = descending
+        self.nulls_first = nulls_first
+        self.limit = limit
+        # join_agg spine
+        self.build = build            # broadcast build-side subplan
+        self.left_on = left_on        # probe-side join keys
+        self.right_on = right_on      # build-side join keys
+        self.aggs = aggs              # partial aggs over joined columns
+        self.group_by = group_by      # group keys over joined columns
+        self.mode = mode
+
+
 class Dedup(PhysicalPlan):
     def __init__(self, child, on):
         super().__init__([child], child.schema())

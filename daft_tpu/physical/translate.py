@@ -43,7 +43,14 @@ def translate(plan: lp.LogicalPlan) -> pp.PhysicalPlan:
         _tl.active = True
         _tl.memo = {}
     try:
-        return _t(plan, cfg)
+        out = _t(plan, cfg)
+        if fresh:
+            # round 21: grow maximal device-eligible operator chains into
+            # FusedRegion nodes (whole-query compilation) — outermost call
+            # only, so nested stage translations rewrite exactly once
+            from . import fusion
+            out = fusion.fuse_regions(out, cfg)
+        return out
     finally:
         if fresh:
             _tl.active = False

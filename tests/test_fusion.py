@@ -1,0 +1,325 @@
+"""Round 21 whole-query device compilation (FusedRegion) tests: planner
+pattern matching, three-way bit parity (fused region vs per-operator
+device vs host), overflow ladder re-dispatch (chain width + join_agg's
+dual W/out_cap ladder), cancellation admission hygiene, the
+fusion-region contract, AOT warm-up over the region library, and the
+``region`` ledger family."""
+
+import numpy as np
+import pytest
+
+import daft_tpu as daft
+from daft_tpu import col
+from daft_tpu.device import costmodel as cm
+from daft_tpu.device import fragment
+from daft_tpu.physical import fusion as pfusion
+from daft_tpu.physical import plan as pp
+from daft_tpu.physical.translate import translate
+
+
+@pytest.fixture(autouse=True)
+def _fused(monkeypatch):
+    monkeypatch.setenv("DAFT_TPU_DEVICE", "1")
+    monkeypatch.setenv("DAFT_TPU_DEVICE_FORCE", "1")
+    monkeypatch.setenv("DAFT_TPU_FUSION", "1")
+    yield
+
+
+def _data(n=4000, seed=7, ndv=50):
+    rng = np.random.default_rng(seed)
+    return {
+        "a": rng.integers(0, 100, n).astype(np.int64),
+        "b": rng.normal(size=n),
+        "k": rng.integers(0, ndv, n).astype(np.int64),
+        "s": rng.choice(["x", "y", "z"], n).tolist(),
+    }
+
+
+def _build_df(rng, nkeys=40):
+    return daft.from_pydict({
+        "k2": np.arange(0, nkeys, dtype=np.int64),
+        "w": rng.normal(size=nkeys),
+        "g": (np.arange(nkeys, dtype=np.int64) % 5),
+    })
+
+
+def _regions(plan):
+    found = []
+    seen = set()
+
+    def walk(n):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        if isinstance(n, pp.FusedRegion):
+            found.append(n)
+        for c in n.children:
+            walk(c)
+
+    walk(plan)
+    return found
+
+
+def _chain_query(df):
+    return df.where(col("a") > 30).select(
+        (col("b") * 2.0).alias("b2"), col("a"))
+
+
+def _topk_query(df):
+    return (df.where(col("a") > 10).select(col("a"), col("b"))
+            .sort(col("b"), desc=True).limit(9))
+
+
+def _join_agg_query(probe, build):
+    j = probe.where(col("a") > 20).join(
+        build, left_on=col("k"), right_on=col("k2"), how="inner")
+    return j.groupby(col("g")).agg(
+        (col("b") * col("w")).sum().alias("rev"),
+        col("b").count().alias("n"))
+
+
+# ------------------------------------------------------------- planner
+
+
+def test_planner_fuses_filter_project_chain():
+    df = _chain_query(daft.from_pydict(_data()))
+    regions = _regions(translate(df._builder.optimize().plan))
+    assert [r.shape for r in regions] == ["chain"]
+    assert len(regions[0].fused_ops) >= 2
+
+
+def test_planner_fuses_topk_tail():
+    df = _topk_query(daft.from_pydict(_data()))
+    regions = _regions(translate(df._builder.optimize().plan))
+    assert "topk" in [r.shape for r in regions]
+    r = next(r for r in regions if r.shape == "topk")
+    assert r.limit == 9
+
+
+def test_planner_fuses_join_agg_spine():
+    rng = np.random.default_rng(1)
+    q = _join_agg_query(daft.from_pydict(_data()), _build_df(rng))
+    regions = _regions(translate(q._builder.optimize().plan))
+    assert "join_agg" in [r.shape for r in regions]
+    r = next(r for r in regions if r.shape == "join_agg")
+    assert r.mode == "partial" and r.build is not None
+
+
+def test_fusion_off_is_identity(monkeypatch):
+    monkeypatch.setenv("DAFT_TPU_FUSION", "0")
+    df = _chain_query(daft.from_pydict(_data()))
+    assert _regions(translate(df._builder.optimize().plan)) == []
+
+
+def test_planner_declines_string_group_keys():
+    df = daft.from_pydict(_data())
+    rng = np.random.default_rng(1)
+    q = df.join(_build_df(rng), left_on=col("k"), right_on=col("k2"),
+                how="inner").groupby(col("s")).agg(
+        col("b").sum().alias("sb"))
+    regions = _regions(translate(q._builder.optimize().plan))
+    assert "join_agg" not in [r.shape for r in regions]
+
+
+def test_max_region_ops_cap(monkeypatch):
+    monkeypatch.setenv("DAFT_TPU_FUSION_MAX_OPS", "2")
+    df = daft.from_pydict(_data())
+    q = (df.where(col("a") > 5).where(col("b") > -10.0)
+         .select((col("b") + 1).alias("b1"), col("a"))
+         .select((col("b1") * 2).alias("b2"), col("a")))
+    regions = _regions(translate(q._builder.optimize().plan))
+    for r in regions:
+        # the cap bounds absorbed chain OPERATORS; the trailing "scan"
+        # marker names the source, it is not an absorbed operator
+        assert len([o for o in r.fused_ops if o != "scan"]) <= 2
+
+
+# ------------------------------------------------- three-way bit parity
+
+
+def _three_way(make_query, monkeypatch):
+    """Run the query fused, per-operator device, and pure host."""
+    outs = {}
+    for name, env in (
+            ("fused", {"DAFT_TPU_FUSION": "1"}),
+            ("device", {"DAFT_TPU_FUSION": "0"}),
+            ("host", {"DAFT_TPU_FUSION": "0", "DAFT_TPU_DEVICE": "0",
+                      "DAFT_TPU_DEVICE_FORCE": "0"})):
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        outs[name] = make_query().to_pydict()
+        monkeypatch.setenv("DAFT_TPU_DEVICE", "1")
+        monkeypatch.setenv("DAFT_TPU_DEVICE_FORCE", "1")
+    return outs
+
+
+def _assert_same(a, b, sort_cols=None):
+    assert set(a.keys()) == set(b.keys())
+    if sort_cols:
+        ka = np.lexsort([np.asarray(a[c]) for c in sort_cols[::-1]])
+        kb = np.lexsort([np.asarray(b[c]) for c in sort_cols[::-1]])
+    for k in a:
+        va, vb = list(a[k]), list(b[k])
+        if sort_cols:
+            va = [va[i] for i in ka]
+            vb = [vb[i] for i in kb]
+        if va and isinstance(va[0], float):
+            np.testing.assert_allclose(np.asarray(va), np.asarray(vb),
+                                       rtol=1e-9, atol=1e-9)
+        else:
+            assert va == vb, k
+
+
+def test_chain_parity_three_ways(monkeypatch):
+    d = _data(seed=11)
+    outs = _three_way(
+        lambda: _chain_query(daft.from_pydict(d)), monkeypatch)
+    _assert_same(outs["fused"], outs["host"])
+    _assert_same(outs["device"], outs["host"])
+
+
+def test_topk_parity_three_ways(monkeypatch):
+    d = _data(seed=12)
+    outs = _three_way(
+        lambda: _topk_query(daft.from_pydict(d)), monkeypatch)
+    _assert_same(outs["fused"], outs["host"])
+    _assert_same(outs["device"], outs["host"])
+
+
+def test_join_agg_parity_three_ways(monkeypatch):
+    d = _data(seed=13)
+    rng = np.random.default_rng(13)
+    b = _build_df(rng)
+    outs = _three_way(
+        lambda: _join_agg_query(daft.from_pydict(d), b), monkeypatch)
+    _assert_same(outs["fused"], outs["host"], sort_cols=["g"])
+    _assert_same(outs["device"], outs["host"], sort_cols=["g"])
+
+
+def test_chain_parity_with_strings_and_nulls(monkeypatch):
+    n = 3000
+    rng = np.random.default_rng(5)
+    a = rng.integers(0, 100, n).astype(np.int64)
+    b = [None if i % 17 == 0 else float(x)
+         for i, x in enumerate(rng.normal(size=n))]
+    s = [None if i % 23 == 0 else v
+         for i, v in enumerate(rng.choice(["p", "q"], n).tolist())]
+    d = {"a": a, "b": b, "s": s}
+    outs = _three_way(
+        lambda: daft.from_pydict(d).where(col("a") > 40).select(
+            (col("b") + 0.5).alias("b1"), col("s"), col("a")),
+        monkeypatch)
+    _assert_same(outs["fused"], outs["host"])
+
+
+# ------------------------------------------------------ overflow ladders
+
+
+def test_chain_width_ladder_overflow(monkeypatch):
+    """A ~95%-selective predicate overflows the quarter-capacity first
+    rung; the re-dispatch must still return every survivor."""
+    d = _data(n=20000, seed=3)
+    df = daft.from_pydict(d)
+    got = df.where(col("a") >= 5).select(
+        (col("b") + 1.0).alias("b1"), col("a")).to_pydict()
+    m = d["a"] >= 5
+    np.testing.assert_allclose(np.asarray(got["b1"]), d["b"][m] + 1.0)
+    assert np.array_equal(np.asarray(got["a"]), d["a"][m])
+
+
+def test_join_agg_pair_width_ladder(monkeypatch):
+    """Build-side key duplication fans each probe row out 6x: the true
+    pair total overflows W=probe-capacity and the dual ladder regrows."""
+    d = _data(n=20000, seed=4, ndv=8)
+    rng = np.random.default_rng(4)
+    dup = 6
+    bk = np.repeat(np.arange(0, 8, dtype=np.int64), dup)
+    b = daft.from_pydict({"k2": bk, "w": rng.normal(size=len(bk)),
+                          "g": (np.arange(len(bk), dtype=np.int64) % 4)})
+    got = _join_agg_query(daft.from_pydict(d), b).to_pydict()
+
+    import pandas as pd
+    pdf = pd.DataFrame({k: v for k, v in d.items() if k != "s"})
+    bdf = pd.DataFrame({"k2": bk, "w": b.to_pydict()["w"],
+                        "g": np.arange(len(bk)) % 4})
+    ref = pdf[pdf.a > 20].merge(bdf, left_on="k", right_on="k2")
+    ref["rev"] = ref.b * ref.w
+    rg = (ref.groupby("g").agg(rev=("rev", "sum"), n=("b", "count"))
+          .reset_index().sort_values("g").reset_index(drop=True))
+    gdf = (pd.DataFrame({k: list(v) for k, v in got.items()})
+           .sort_values("g").reset_index(drop=True))
+    assert np.array_equal(gdf["g"].values, rg["g"].values)
+    np.testing.assert_allclose(gdf["rev"].values, rg["rev"].values)
+    assert np.array_equal(gdf["n"].values, rg["n"].values)
+
+
+def test_join_agg_group_bucket_ladder(monkeypatch):
+    """Near-unique group keys overflow the _OUT_CAP0 group bucket; the
+    out_cap rung of the dual ladder regrows and every group survives."""
+    n = 6000
+    rng = np.random.default_rng(9)
+    d = {"a": np.full(n, 50, dtype=np.int64),
+         "b": rng.normal(size=n),
+         "k": np.arange(n, dtype=np.int64) % 2000}
+    b = daft.from_pydict({
+        "k2": np.arange(2000, dtype=np.int64),
+        "w": np.ones(2000),
+        "g": np.arange(2000, dtype=np.int64)})  # one group per key
+    got = _join_agg_query(daft.from_pydict(d), b).to_pydict()
+    assert len(got["g"]) == 2000
+    assert sum(got["n"]) == n
+
+
+# ------------------------------------------- cancellation / admission
+
+
+def test_cancellation_mid_region_releases_admission(monkeypatch):
+    """Closing the output stream mid-query must release every in-flight
+    region slot's admission (same hygiene as the r17 fragment path)."""
+    monkeypatch.setenv("DAFT_TPU_DEVICE_INFLIGHT", "2")
+    from daft_tpu.execution.executor import LocalExecutor
+    d = _data(n=30000, seed=6)
+    df = _chain_query(daft.from_pydict(d))
+    ex = LocalExecutor()
+    gen = ex.run(translate(df._builder.optimize().plan))
+    next(gen)
+    gen.close()
+    assert ex.mem.outstanding == 0
+
+
+# --------------------------------------------------- contract + warmup
+
+
+def test_fusion_region_contract_clean():
+    from daft_tpu.analysis import rule_jit
+    assert rule_jit.check_fusion_region_contracts() == []
+
+
+def test_warmup_regions_compiles_library():
+    """Warm-start satellite: after one fused run, the region library
+    AOT-compiles over a size-class grid with zero errors."""
+    from daft_tpu.device import warmup
+    d = _data(seed=21)
+    _chain_query(daft.from_pydict(d)).to_pydict()
+    rng = np.random.default_rng(21)
+    _join_agg_query(daft.from_pydict(d), _build_df(rng)).to_pydict()
+    progs = fragment.fused_region_programs()
+    assert progs
+    stats = warmup.warmup_regions([1 << 12, 1 << 13], progs)
+    assert stats["errors"] == 0
+    assert stats["programs"] > 0
+
+
+def test_region_ledger_family(monkeypatch):
+    """Fused dispatches land in the ``region`` ledger family with the
+    fused-op count, round-trips eliminated, and a fusion_x ratio."""
+    cm.ledger_reset()
+    d = _data(seed=30)
+    _chain_query(daft.from_pydict(d)).to_pydict()
+    snap = cm.ledger_snapshot()
+    assert "region" in snap
+    fam = snap["region"]
+    assert fam["dispatches"] >= 1
+    assert fam.get("fused_ops", 0) >= 2
+    assert fam.get("round_trips_saved", 0) >= 1
+    assert fam.get("fusion_x", 0) > 0

@@ -287,12 +287,17 @@ def test_warmup_fragments_and_session(monkeypatch):
     st = warmup.warmup_fragments([128, 256])
     assert st["programs"] >= 2
     assert st["errors"] == 0
-    # knob-gated session entry: off → None, on → stats
+    # knob-gated session entry: off → None, on → stats. The r21 region
+    # grid is exercised by its own test (test_fusion); an empty region
+    # cache here keeps this session sweep from re-compiling every region
+    # program earlier test files happened to leave behind
+    monkeypatch.setattr(fragment, "_region_cache", {})
     monkeypatch.delenv("DAFT_TPU_AOT_WARMUP", raising=False)
     assert warmup.maybe_warmup_session() is None
     monkeypatch.setenv("DAFT_TPU_AOT_WARMUP", "1")
     out = warmup.maybe_warmup_session()
     assert out is not None and out["size_classes"]
+    assert out["regions"] == {"programs": 0, "skipped": 0, "errors": 0}
 
 
 def test_observability_renders_retrace_block():
