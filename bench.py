@@ -1311,6 +1311,433 @@ def run_serve_smoke() -> int:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _fleet_make_table(prefix: str, n: int = 20000):
+    """Temp parquet table for fleet traffic; returns (dir, glob)."""
+    import tempfile
+
+    import daft_tpu as dt
+    d = tempfile.mkdtemp(prefix=prefix)
+    dt.from_pydict({
+        "k": list(range(n)),
+        "g": [i % 13 for i in range(n)],
+        "v": [float(i % 97) for i in range(n)],
+    }).write_parquet(os.path.join(d, "t"))
+    return d, os.path.join(d, "t", "*.parquet")
+
+
+class _LatencyFileServer:
+    """Serves ONE local file under every requested path, with a fixed
+    per-request sleep — object-store GET latency emulation for the fleet
+    bench. Distinct object names behave like distinct partitions in a
+    bucket (path-keyed caches miss), and the sleep happens server-side
+    in a blocked thread, so on a small CI host aggregate throughput is
+    bounded by the fleet's admission slots × storage latency — the
+    serving-capacity quantity the replica count actually scales — not by
+    this host's core count."""
+
+    def __init__(self, file_path: str, latency_s: float = 0.1):
+        with open(file_path, "rb") as f:
+            self.data = f.read()
+        self.latency_s = latency_s
+        self._httpd = None
+
+    def start(self) -> str:
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _serve(self, head_only: bool):
+                time.sleep(srv.latency_s)
+                body = srv.data
+                code = 200
+                rng = self.headers.get("Range")
+                if rng and rng.startswith("bytes="):
+                    a, _, b = rng[len("bytes="):].partition("-")
+                    start = int(a or 0)
+                    end = min(int(b) + 1 if b else len(body), len(body))
+                    body, code = body[start:end], 206
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                # a stable ETag is the version signal that lets the
+                # serving caches key remote-sourced plans (fingerprint
+                # sources = size + etag, like a real object store)
+                self.send_header("ETag", f'"bench-{len(srv.data)}"')
+                self.end_headers()
+                if not head_only:
+                    self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    self._serve(head_only=False)
+                except Exception:
+                    pass
+
+            def do_HEAD(self):
+                try:
+                    self._serve(head_only=True)
+                except Exception:
+                    pass
+
+        import threading
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._httpd.daemon_threads = True
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             daemon=True)
+        t.start()
+        return f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def _fleet_shapes(source, n_rows: int = 4000, heavy: bool = False,
+                  label: str = ""):
+    """SQL traffic mix. Default (smoke): ``source`` is a local glob; two
+    repeat shapes (cacheable) + a rotating parameterized lookup whose
+    25-literal cycle wraps, so the result cache dominates. Heavy
+    (bench): ``source`` is a :class:`_LatencyFileServer` base URL; one
+    repeat shape on a fixed object + two effectively-unique windowed
+    aggregations per round, each scanning a DISTINCT object name — every
+    miss pays real object-store GET latency, which is what makes
+    aggregate QPS scale with replica count."""
+    if heavy:
+        agg = (f"SELECT g, sum(v) AS s FROM "
+               f"read_parquet('{source}/hot.parquet') "
+               "GROUP BY g ORDER BY g")
+
+        def shape(i):
+            if i % 3 == 0:
+                return "agg", agg
+            off = (i * 7919) % max(n_rows - 2000, 1)
+            return "window", (
+                f"SELECT g, sum(v) AS s, count(v) AS c FROM "
+                f"read_parquet('{source}/w{label}-{off}.parquet') "
+                f"WHERE k >= {off} AND k < {off + 2000} "
+                "GROUP BY g ORDER BY g")
+        return shape, agg
+
+    agg = (f"SELECT g, sum(v) AS s FROM read_parquet('{source}') "
+           "GROUP BY g ORDER BY g")
+    topk = (f"SELECT k, v FROM read_parquet('{source}') "
+            "ORDER BY v DESC, k LIMIT 5")
+
+    def shape(i):
+        j = i % 3
+        if j == 0:
+            return "agg", agg
+        if j == 1:
+            return "topk", topk
+        kk = (i // 3) % 25
+        return "lookup", (f"SELECT k, v FROM read_parquet('{source}') "
+                          f"WHERE k = {kk * 37} LIMIT 5")
+    return shape, agg
+
+
+def _agg_matches(data, expected) -> bool:
+    """Float-tolerant pydict comparison: group keys must match exactly,
+    sums within 1e-6 relative (partial-sum order differs per process)."""
+    try:
+        if list(data.get("g", [])) != list(expected.get("g", [])):
+            return False
+        a, b = data.get("s", []), expected.get("s", [])
+        if len(a) != len(b):
+            return False
+        return all(abs(float(x) - float(y))
+                   <= 1e-6 * max(1.0, abs(float(y)))
+                   for x, y in zip(a, b))
+    except Exception:
+        return False
+
+
+def _fleet_traffic(router, glob, duration_s, n_clients, label,
+                   expected_agg=None, n_rows: int = 4000,
+                   heavy: bool = False):
+    """Closed-loop SQL traffic through the router; returns the traffic
+    summary (qps, latency percentiles, cache-outcome mix, failures)."""
+    import threading
+    shape, _agg_sql = _fleet_shapes(glob, n_rows=n_rows, heavy=heavy,
+                                    label=label)
+    recs = []
+    failures = []
+    lock = threading.Lock()
+    t_end = time.time() + duration_s
+
+    def client(ci):
+        i = ci
+        while time.time() < t_end:
+            name, sql = shape(i)
+            i += n_clients
+            t0 = time.time()
+            try:
+                out = router.sql(sql, session=f"{label}-s{ci}",
+                                 timeout_s=120.0)
+            except Exception as exc:  # noqa: BLE001 — recorded, not fatal
+                with lock:
+                    failures.append(f"{name}: {exc!r}"[:160])
+                continue
+            lat = time.time() - t0
+            if name == "agg" and expected_agg is not None \
+                    and not _agg_matches(out.get("data") or {},
+                                         expected_agg):
+                with lock:
+                    failures.append("agg answer mismatch")
+                continue
+            with lock:
+                recs.append(
+                    (lat, (out.get("serving") or {}).get("result_cache"),
+                     name))
+
+    t0 = time.time()
+    threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+               for ci in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 150)
+    wall = time.time() - t0
+    lats = sorted(r[0] for r in recs)
+    outcomes = [r[1] for r in recs]
+    hits = sum(1 for o in outcomes if o in ("hit", "fleet_hit"))
+    misses = sum(1 for o in outcomes if o == "miss")
+    # hit rate restricted to the REPEAT shape — the apples-to-apples
+    # "does the fleet cache what one process caches" number, independent
+    # of how many unique-miss shapes the mix carries
+    hot = [o for _, o, n in recs if n == "agg"]
+    hot_hits = sum(1 for o in hot if o in ("hit", "fleet_hit"))
+    hot_misses = sum(1 for o in hot if o == "miss")
+    return {
+        "completed": len(recs),
+        "qps": round(len(recs) / max(wall, 1e-9), 2),
+        "latency_p50_ms": round(1e3 * (_pct(lats, 0.50) or 0), 2),
+        "latency_p99_ms": round(1e3 * (_pct(lats, 0.99) or 0), 2),
+        "result_cache_hit_rate": round(hits / max(hits + misses, 1), 3),
+        "hot_shape_hit_rate": round(
+            hot_hits / max(hot_hits + hot_misses, 1), 3),
+        "fleet_hits": sum(1 for o in outcomes if o == "fleet_hit"),
+        "failures": failures[:5],
+        "n_failures": len(failures),
+    }
+
+
+def run_fleet_bench():
+    """``--fleet``: 1 vs 3 subprocess driver replicas under identical
+    closed-loop SQL traffic (grpc-free control-plane path). Reports the
+    aggregate-QPS scaling factor, the fleet result-cache hit rate vs the
+    single-replica run, and the cold-replica warm-start evidence (a 4th
+    replica added after the fact answers its FIRST query from the fleet
+    cache tier and inherits the gossiped state store)."""
+    import shutil
+    import threading
+
+    from daft_tpu.fleet.cache_tier import CacheSidecar
+    from daft_tpu.fleet.router import FleetRouter, SubprocessReplica
+
+    duration_s = float(os.environ.get("BENCH_FLEET_SECONDS", "12"))
+    # closed-loop client count must exceed (fleet slots × full latency /
+    # exec latency) or the single replica never saturates its admission
+    # slots and the ratio measures client count, not capacity
+    n_clients = int(os.environ.get("BENCH_FLEET_CLIENTS", "36"))
+    n_rows = int(os.environ.get("BENCH_FLEET_ROWS", "4000"))
+    get_ms = float(os.environ.get("BENCH_FLEET_GET_MS", "150"))
+    d, local_glob = _fleet_make_table("daft_tpu_fleet_bench_", n=n_rows)
+    import glob as globmod
+    pq_file = sorted(globmod.glob(local_glob))[0]
+    store = _LatencyFileServer(pq_file, latency_s=get_ms / 1e3)
+    base = store.start()
+    out = {"duration_s": duration_s, "clients": n_clients,
+           "rows": n_rows, "emulated_get_ms": get_ms}
+    sidecar = CacheSidecar(budget_bytes=256 << 20)
+    addr = sidecar.start()
+    env = {"DAFT_TPU_FLEET_SIDECAR": addr, "DAFT_TPU_CALIBRATION": "1"}
+    _shape, agg_sql = _fleet_shapes(base, n_rows=n_rows, heavy=True)
+    try:
+        # ---- phase 1: one replica (same sidecar, same env) ----------
+        solo = SubprocessReplica.spawn("solo", env=env)
+        router1 = FleetRouter([solo])
+        _fleet_traffic(router1, base, min(3.0, duration_s), n_clients,
+                       "warm", n_rows=n_rows, heavy=True)  # jit warm-up
+        out["single"] = _fleet_traffic(router1, base, duration_s,
+                                       n_clients, "single",
+                                       n_rows=n_rows, heavy=True)
+        solo.shutdown()
+        # the sidecar keeps phase-1 results; phase 2 uses distinct
+        # sessions but identical shapes — which is exactly the fleet
+        # tier's job, so count those hits rather than hiding them
+        # ---- phase 2: three replicas + gossip -----------------------
+        reps = [SubprocessReplica.spawn(f"r{i}", env=env)
+                for i in range(3)]
+        router3 = FleetRouter(reps)
+        stop_gossip = threading.Event()
+
+        def gossip_loop():
+            while not stop_gossip.wait(1.0):
+                try:
+                    router3.gossip_round()
+                except Exception:
+                    pass
+
+        gt = threading.Thread(target=gossip_loop, daemon=True)
+        gt.start()
+        _fleet_traffic(router3, base, min(3.0, duration_s), n_clients,
+                       "fwarm", n_rows=n_rows, heavy=True)  # per-replica
+        out["fleet3"] = _fleet_traffic(router3, base, duration_s,
+                                       n_clients, "fleet",
+                                       n_rows=n_rows, heavy=True)
+        out["fleet3"]["replicas"] = 3
+        if out["single"]["qps"]:
+            out["scaling_x"] = round(
+                out["fleet3"]["qps"] / out["single"]["qps"], 2)
+        # ---- phase 3: cold replica inherits fleet state -------------
+        cold = SubprocessReplica.spawn("cold", env=env)
+        router3.add_replica(cold)
+        router3.gossip_round()  # cold pulls the union of fleet history
+        inherited = len(cold.state_snapshot().get("origins") or {}) - 1
+        t0 = time.time()
+        first = cold.sql(agg_sql, session="cold-probe", timeout_s=120.0)
+        first_ms = round(1e3 * (time.time() - t0), 2)
+        # replay one EXACT window query a warm replica already ran: same
+        # fingerprint history key, so a blind admission estimate must
+        # seed from the gossiped fleet history instead of the default
+        shape_fleet, _ = _fleet_shapes(base, n_rows=n_rows, heavy=True,
+                                       label="fleet")
+        cold.sql(shape_fleet(1)[1], session="cold-probe", timeout_s=120.0)
+        counters = cold.counters()
+        state = cold.state_snapshot().get("origins") or {}
+        out["cold_replica"] = {
+            "origins_inherited": inherited,
+            "admission_history_inherited": sum(
+                len((s or {}).get("admission") or {})
+                for o, s in state.items() if o != "cold"),
+            "calibration_inherited": sum(
+                len((s or {}).get("calib") or {})
+                for o, s in state.items() if o != "cold"),
+            "first_query_result_cache":
+                (first.get("serving") or {}).get("result_cache"),
+            "first_query_ms": first_ms,
+            "single_cold_p50_ms": out["single"]["latency_p50_ms"],
+            # admission estimates seeded from the gossiped history when
+            # the cost model is blind (the flat-default fallback path)
+            "est_seeded_fleet": counters.get("est_seeded_fleet", 0),
+            "est_seeded_history": counters.get("est_seeded_history", 0),
+            "state_gen": counters.get("state_gen", 0),
+        }
+        stop_gossip.set()
+        gt.join(timeout=5)
+        out["router_counters"] = {
+            k: v for k, v in router3.gauges().get("aggregate", {}).items()}
+        out["scale_signal"] = router3.scale_signal()
+        for r in reps + [cold]:
+            r.shutdown()
+        return out
+    finally:
+        sidecar.stop()
+        store.stop()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def run_fleet_smoke() -> int:
+    """``--fleet-smoke``: the CI gate for the serving fleet. Three REAL
+    replica subprocesses behind the router take mixed SQL traffic; one
+    replica is killed mid-run (traffic must re-route, answers must stay
+    right) and one is gracefully drained after (its sessions must be
+    released, not orphaned). Exit 1 on a wrong answer, an admission
+    leak, an orphaned session queue, zero fleet-tier hits, or any
+    lock-order sanitizer cycle inside any replica."""
+    import shutil
+    import threading
+
+    import daft_tpu as dt
+    from daft_tpu import col
+    from daft_tpu.fleet.cache_tier import CacheSidecar
+    from daft_tpu.fleet.router import FleetRouter, SubprocessReplica
+
+    d, glob = _fleet_make_table("daft_tpu_fleet_smoke_", n=4000)
+    sidecar = CacheSidecar(budget_bytes=64 << 20)
+    addr = sidecar.start()
+    problems = []
+    try:
+        expected = dt.read_parquet(glob).groupby("g") \
+            .agg(col("v").sum().alias("s")).sort("g").to_pydict()
+        reps = [SubprocessReplica.spawn(
+            f"r{i}", env={"DAFT_TPU_FLEET_SIDECAR": addr})
+            for i in range(3)]
+        router = FleetRouter(reps)
+        duration_s = float(
+            os.environ.get("BENCH_FLEET_SMOKE_SECONDS", "8"))
+        traffic = {}
+
+        def run_traffic():
+            traffic.update(_fleet_traffic(
+                router, glob, duration_s, 6, "smoke",
+                expected_agg=expected))
+
+        tt = threading.Thread(target=run_traffic, daemon=True)
+        tt.start()
+        time.sleep(duration_s * 0.4)
+        router.gossip_round()
+        victim = reps[0].name
+        router.kill(victim)   # mid-traffic crash: re-route must absorb
+        tt.join(timeout=duration_s + 160)
+        router.gossip_round()
+        if traffic.get("completed", 0) == 0:
+            problems.append("no queries completed")
+        # the kill window races in-flight requests: those surface as
+        # recorded failures; anything else (wrong answer) is fatal
+        fatal = [f for f in traffic.get("failures", [])
+                 if "mismatch" in f]
+        if fatal:
+            problems.append(f"wrong answers: {fatal}")
+        if traffic.get("fleet_hits", 0) == 0:
+            problems.append("no fleet cache-tier hits across replicas")
+        alive = [r for r in reps if r.name != victim]
+        # graceful drain: sessions must be RELEASED on the drained
+        # replica (no orphaned queues) and re-homed by the router
+        drained = alive[0]
+        router.drain(drained.name)
+        leftover = drained.sessions()
+        if leftover:
+            problems.append(
+                f"orphaned session queues on drained replica: {leftover}")
+        for r in alive:
+            g = r.gauges()
+            if g.get("admitted_bytes", 0):
+                problems.append(
+                    f"admission leak on {r.name}: {g['admitted_bytes']}")
+            c = r.counters()
+            if c.get("lock_graph_cycles", 0):
+                problems.append(
+                    f"lock-order cycles on {r.name}: "
+                    f"{c['lock_graph_cycles']}")
+            if len([o for o in (r.state_snapshot().get("origins") or {})
+                    ]) < 2:
+                problems.append(f"gossip never reached {r.name}")
+        result = {"fleet_smoke": {
+            "completed": traffic.get("completed", 0),
+            "qps": traffic.get("qps", 0),
+            "fleet_hits": traffic.get("fleet_hits", 0),
+            "result_cache_hit_rate":
+                traffic.get("result_cache_hit_rate", 0),
+            "rerouted_failures_during_kill":
+                traffic.get("n_failures", 0),
+            "killed": victim, "drained": drained.name,
+            "problems": problems[:8],
+        }}
+        print(json.dumps(result), flush=True)
+        for r in reps:
+            r.shutdown()
+        return 1 if problems else 0
+    finally:
+        sidecar.stop()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def run_obs_bench():
     """``--obs``: tracing-overhead measurement on the serve-bench mixed
     workload. Three runs of the same closed-loop traffic: tracing OFF,
@@ -2436,6 +2863,14 @@ def main():
         if r is not None:
             detail["serve_bench"] = r
 
+    if "--fleet" in sys.argv:
+        # serving fleet: 1 vs 3 subprocess driver replicas under the same
+        # closed-loop SQL traffic — aggregate-QPS scaling, shared cache-
+        # tier hit rate, cold-replica warm-start from gossiped state
+        r = section("fleet", run_fleet_bench, min_needed=90.0)
+        if r is not None:
+            detail["fleet_bench"] = r
+
     r = section("tpch_sf1_suite_host",
                 lambda: run_tpch_suite(DATA, budget_s=_remaining() - 10),
                 min_needed=20.0)
@@ -2485,7 +2920,7 @@ def main():
 
     results_dir = os.path.join(REPO, "benchmarking", "results")
     os.makedirs(results_dir, exist_ok=True)
-    artifact = os.path.join(results_dir, "r20_bench_driver.json")
+    artifact = os.path.join(results_dir, "r22_bench_driver.json")
     with open(artifact, "w") as f:
         json.dump(full, f, indent=1)
     # progress/bulk lines first (NOT last): full detail for humans reading
@@ -2596,6 +3031,15 @@ def main():
             "repeat_x": sv.get("repeat_speedup"),
             "rc_hit": sv.get("result_cache_hit_rate"),
             "leak": sv.get("admitted_bytes_outstanding_after_drain")}
+    fl = detail.get("fleet_bench")
+    if isinstance(fl, dict) and "error" not in fl:
+        compact["fleet"] = {
+            "scaling_x": fl.get("scaling_x"),
+            "qps1": fl.get("single", {}).get("qps"),
+            "qps3": fl.get("fleet3", {}).get("qps"),
+            "rc_hit": fl.get("fleet3", {}).get("result_cache_hit_rate"),
+            "cold_first": fl.get("cold_replica", {}).get(
+                "first_query_result_cache")}
     ob = detail.get("obs_bench")
     if isinstance(ob, dict) and "error" not in ob:
         compact["obs"] = {
@@ -2609,8 +3053,8 @@ def main():
     if errors:
         compact["n_errors"] = len(errors)
     # hard cap: drop optional keys until the line fits the driver's window
-    for drop in ("obs", "kernels", "serve", "scan", "adaptive", "spill",
-                 "shuffle", "mesh", "chaos", "ledger_dispatches",
+    for drop in ("obs", "fleet", "kernels", "serve", "scan", "adaptive",
+                 "spill", "shuffle", "mesh", "chaos", "ledger_dispatches",
                  "mfu", "families", "q1_winner", "backend"):
         if len(json.dumps(compact)) <= 1500:
             break
@@ -2639,5 +3083,10 @@ if __name__ == "__main__":
         # CI gate: traced local + distributed queries, chrome-trace schema
         # validation, strict /metrics parse, flight-recorder rotation
         sys.exit(run_obs_smoke())
+    elif "--fleet-smoke" in sys.argv:
+        # CI gate: 3 real replica subprocesses behind the router; mixed
+        # traffic + a mid-run kill and a graceful drain, with answer /
+        # admission-leak / orphaned-session / lock-cycle checks
+        sys.exit(run_fleet_smoke())
     else:
         main()

@@ -367,6 +367,40 @@ _KNOBS: List[Knob] = [
        "per-session retained-response budget across finished "
        "operations (newest kept first); `0` disables",
        default_str="64MiB"),
+    # -------------------------------------------------------- fleet
+    _k("DAFT_TPU_FLEET_VNODES", "int", 64,
+       "daft_tpu/fleet/router.py", "fleet",
+       "virtual nodes per replica on the consistent-hash session ring "
+       "(more vnodes = smoother session spread, larger ring)",
+       config_field="tpu_fleet_vnodes"),
+    _k("DAFT_TPU_FLEET_GOSSIP_S", "float", 2.0,
+       "daft_tpu/fleet/replica.py", "fleet",
+       "seconds between anti-entropy gossip rounds republishing this "
+       "replica's learned state (calibration profile + admission "
+       "history) to every peer; floored at `0.05`",
+       config_field="tpu_fleet_gossip_s"),
+    _k("DAFT_TPU_FLEET_DRAIN_TIMEOUT", "float", 10.0,
+       "daft_tpu/fleet/router.py", "fleet",
+       "seconds a draining replica may finish in-flight queries before "
+       "the router cancels the stragglers and re-homes its sessions",
+       config_field="tpu_fleet_drain_timeout"),
+    _k("DAFT_TPU_FLEET_SIDECAR", "str", None,
+       "daft_tpu/fleet/cache_tier.py", "fleet",
+       "`host:port` of a fleet cache sidecar (`python -m "
+       "daft_tpu.fleet.cache_tier --port N`); when set, replicas consult "
+       "it for cross-process result-cache hits", default_str="off"),
+    _k("DAFT_TPU_FLEET_SIDECAR_BYTES", "bytes", 256 << 20,
+       "daft_tpu/fleet/cache_tier.py", "fleet",
+       "LRU byte budget of the cache sidecar's blob store",
+       default_str="256MiB"),
+    _k("DAFT_TPU_FLEET_PEERS", "str", None,
+       "daft_tpu/fleet/replica.py", "fleet",
+       "comma-separated control addresses (`host:port`) of the peer "
+       "replicas this one gossips with", default_str="none"),
+    _k("DAFT_TPU_FLEET_REPLICA_ID", "str", None,
+       "daft_tpu/fleet/replica.py", "fleet",
+       "stable identity of this replica process (its gossip origin); "
+       "`--replica-id` overrides", default_str="replica-0"),
     # ------------------------------------------------------ adaptive
     _k("DAFT_TPU_ADAPTIVE", "bool", False,
        "daft_tpu/distributed/replan.py", "adaptive",

@@ -212,6 +212,35 @@ class SparkConnectServer:
     def stop(self, grace: Optional[float] = None) -> None:
         self._server.stop(grace)
 
+    def sessions(self) -> list:
+        with self._lock:
+            return list(self._sessions)
+
+    def release_session(self, session_id: str) -> bool:
+        """Fleet handoff: drop this session's server-side state NOW.
+        The 60s idle-TTL sweeps (the scheduler's session reaper and the
+        finished-operation sweep) would reclaim it eventually; on a
+        handoff the re-homed session must not leak a queue or pinned
+        response buffers on the OLD replica for even that long. Running
+        operations are interrupted (their scheduler handles cancel
+        cooperatively), buffers are dropped with the session state, and
+        the scheduler's session queue is released. True when any state
+        existed."""
+        with self._lock:
+            st = self._sessions.pop(session_id, None)
+        if st is not None:
+            for op in list(st.operations.values()):
+                op.request_cancel()
+        released = st is not None
+        try:
+            from .. import serving
+            sched = serving.shared_scheduler_if_running()
+            if sched is not None:
+                released = sched.release_session(session_id) or released
+        except Exception:
+            pass
+        return released
+
     # ------------------------------------------------------------ helpers
     def _session(self, session_id: str) -> _SessionState:
         with self._lock:
@@ -257,11 +286,28 @@ class SparkConnectServer:
 
     def _abort(self, context, exc: Exception):
         from ..execution.cancellation import QueryCancelled
+        from ..fleet.router import ReplicaUnavailable
         from ..serving import AdmissionRejected
         grpc = self._grpc
         if isinstance(exc, Unsupported):
             context.abort(grpc.StatusCode.UNIMPLEMENTED,
                           f"unsupported by daft_tpu connect: {exc}")
+        if isinstance(exc, ReplicaUnavailable):
+            # a dead-replica routed session is a RETRYABLE condition, not
+            # an internal error: structured UNAVAILABLE + retry-info (the
+            # delay rides trailing metadata AND the message, so clients
+            # without metadata plumbing still see it)
+            try:
+                context.set_trailing_metadata((
+                    ("retry-delay-ms",
+                     str(int(exc.retry_after_s * 1000))),))
+            except Exception:
+                pass
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"replica unavailable, retry in {exc.retry_after_s:.1f}s "
+                f"(retry-info: retry-delay-ms="
+                f"{int(exc.retry_after_s * 1000)}): {exc}")
         if isinstance(exc, AdmissionRejected):
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                           f"admission rejected ({exc.kind}): {exc}")
@@ -318,12 +364,15 @@ class SparkConnectServer:
             if aborting:  # context.abort's unwind exception — re-raise
                 raise
             from ..execution.cancellation import QueryCancelled
+            from ..fleet.router import ReplicaUnavailable
             from ..serving import AdmissionRejected
             code = self._grpc.StatusCode.INTERNAL
             if isinstance(exc, QueryCancelled):
                 code = self._grpc.StatusCode.CANCELLED
             elif isinstance(exc, AdmissionRejected):
                 code = self._grpc.StatusCode.RESOURCE_EXHAUSTED
+            elif isinstance(exc, ReplicaUnavailable):
+                code = self._grpc.StatusCode.UNAVAILABLE
             op.finish(error=(code, f"{type(exc).__name__}: {exc}"))
             self._abort(context, exc)
         finally:
@@ -484,9 +533,16 @@ class SparkConnectServer:
         # every Spark Connect session becomes a serving-plane session
         # (weighted fair queuing + admission control across clients), and
         # INTERRUPT cancels the RUNNING query cooperatively through the
-        # handle, not just the response stream
-        from .. import serving
-        handle = serving.shared_scheduler().submit(df, session=session_id)
+        # handle, not just the response stream. With a fleet router
+        # installed the session is consistent-hashed onto a replica
+        # instead (sticky; re-routed on replica death/drain).
+        from .. import fleet, serving
+        router = fleet.installed_router()
+        if router is not None:
+            handle = router.submit(df, session=session_id)
+        else:
+            handle = serving.shared_scheduler().submit(
+                df, session=session_id)
         if op is not None:
             op.bind_cancel(handle.cancel)
         ps = handle.result()

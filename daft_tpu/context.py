@@ -119,6 +119,11 @@ class ExecutionConfig:
     tpu_serve_queue_timeout: float = 30.0    # queue+admission wait bound (s)
     tpu_serve_plan_cache_bytes: int = 64 << 20    # compiled-plan LRU budget
     tpu_serve_result_cache_bytes: int = 64 << 20  # result LRU budget
+    # serving fleet (fleet/); env spellings match the documented fleet
+    # knobs (DAFT_TPU_FLEET_VNODES, …)
+    tpu_fleet_vnodes: int = 64               # ring vnodes per replica
+    tpu_fleet_gossip_s: float = 2.0          # gossip round interval (s)
+    tpu_fleet_drain_timeout: float = 10.0    # drain grace before cancel (s)
 
 
 def _exec_config_from_env() -> ExecutionConfig:

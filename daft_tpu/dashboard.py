@@ -90,6 +90,28 @@ def _serving_view() -> dict:
         return {}
 
 
+def _fleet_view() -> dict:
+    """Live fleet state when this process hosts the router: per-replica
+    gauges, the aggregate, the autoscaling signal and the gossiped
+    state-store generations (empty when no router is installed)."""
+    try:
+        from . import fleet
+        router = fleet.installed_router()
+        if router is None:
+            return {}
+        out = router.gauges()
+        out["scale_signal"] = router.scale_signal()
+        out["assignments"] = len(router.assignments())
+        from .fleet import state_sync
+        out["counters"] = state_sync.counters_snapshot()
+        st = state_sync.installed()
+        if st is not None:
+            out["state"] = st.view()
+        return out
+    except Exception:
+        return {}
+
+
 class _Handler(http.server.BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
@@ -123,6 +145,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+            return
+        if self.path.startswith("/api/fleet"):
+            self._reply(json.dumps(_fleet_view()).encode(),
+                        "application/json")
             return
         if self.path.startswith("/api/queries"):
             with _history_lock:

@@ -368,15 +368,93 @@ def const(name: str, default: float) -> float:
     """The calibrated value for ``name`` when the profile has one past
     the sample floor (and calibration is on and not frozen); else the
     caller's hard-coded default. This is THE read every costmodel
-    decision site routes through."""
+    decision site routes through. When the local profile is blind a
+    gossiped fleet view (sample-weighted over replica origins,
+    ``fleet/state_sync``) beats the hard-coded default — this is how a
+    cold replica's first query prices like a warm one."""
     if not enabled() or frozen():
         return default
     _ensure_loaded()
     with _lock:
         e = _load_locked().get(name)
-        if e is None or e["samples"] < min_samples():
-            return default
-        return e["value"]
+        if e is not None and e["samples"] >= min_samples():
+            return e["value"]
+    # outside _lock: the fleet store has its own lock and must not nest
+    # under the profile lock
+    fleet = _fleet_const(name)
+    return default if fleet is None else fleet
+
+
+def _fleet_const(name: str) -> Optional[float]:
+    """Merged fleet-history value for ``name`` past the sample floor, or
+    None when no fleet state store is installed / the fleet is blind."""
+    try:
+        from ..fleet import state_sync
+        st = state_sync.installed()
+        if st is None:
+            return None
+        got = st.merged_calibration(name)
+        if got is None:
+            return None
+        value, samples = got
+        if samples < min_samples():
+            return None
+        state_sync.count("calibration_fleet_reads")
+        return float(value)
+    except Exception:
+        return None
+
+
+def profile_entries() -> Dict[str, Dict[str, float]]:
+    """Copy of the learned profile ``{name: {value, samples}}`` — the
+    gossip export consumed by ``fleet/state_sync``."""
+    _ensure_loaded()
+    with _lock:
+        return {k: dict(v) for k, v in _load_locked().items()}
+
+
+def _quantize(v: float) -> str:
+    # 2 significant digits: EWMA nudges within a few percent keep the
+    # plan token (and therefore the plan cache) stable
+    try:
+        return f"{float(v):.1e}"
+    except (TypeError, ValueError):
+        return "?"
+
+
+def plan_token() -> str:
+    """Calibration-generation token folded into plan fingerprints
+    (``logical/fingerprint.py``): a quantized digest of every constant
+    ACTIVELY overriding its default right now. When a calibrated value
+    crosses the sample floor or moves materially, the token changes and
+    cached plans priced under the old constants are invalidated —
+    without it, r20's calibrated flips (combine gating, kernel strategy,
+    fusion pricing) kept serving stale pre-calibration plans. Empty when
+    calibration is off/frozen or nothing is active, so the common path
+    leaves fingerprints untouched."""
+    if not enabled() or frozen():
+        return ""
+    floor = min_samples()
+    _ensure_loaded()
+    with _lock:
+        prof = {k: dict(v) for k, v in _load_locked().items()}
+    active = {n: _quantize(e["value"]) for n, e in prof.items()
+              if e["samples"] >= floor}
+    # fleet-inherited constants flip the same decisions local ones do
+    try:
+        from ..fleet import state_sync
+        st = state_sync.installed()
+    except Exception:
+        st = None
+    if st is not None:
+        for n, (v, samples) in st.merged_calibration_all().items():
+            if n not in active and samples >= floor:
+                active[n] = _quantize(v)
+    if not active:
+        return ""
+    import hashlib
+    blob = ",".join(f"{n}={active[n]}" for n in sorted(active))
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
 
 
 def ndv_ratio() -> float:

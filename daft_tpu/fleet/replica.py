@@ -1,0 +1,328 @@
+"""Replica subprocess entrypoint: one driver process of the fleet.
+
+``python -m daft_tpu.fleet.replica --replica-id r0`` boots:
+
+- the process-shared :class:`~daft_tpu.serving.QueryScheduler` wired to
+  this replica's :class:`~daft_tpu.fleet.state_sync.StateStore` (its
+  gossip origin) and, when ``DAFT_TPU_FLEET_SIDECAR`` names a store, the
+  sidecar cache tier;
+- the embedded Spark Connect server (query traffic; skipped cleanly
+  when grpc is unavailable — the control plane still runs);
+- a control HTTP plane the router drives: ``/health``, ``/gauges``,
+  ``/counters``, ``/sessions``, ``/fleet/state`` (GET = export, POST =
+  anti-entropy exchange: ingest the peer's snapshots, answer with ours),
+  ``/drain``, ``/release_session``, ``/metrics`` (prometheus text);
+- a gossip loop (``DAFT_TPU_FLEET_GOSSIP_S``) that republishes this
+  replica's learned state and exchanges with every peer in
+  ``DAFT_TPU_FLEET_PEERS`` (comma-separated control addresses).
+
+On readiness it prints ``FLEET_REPLICA_READY control=<addr>
+connect=<addr>`` on stdout — the line :meth:`SubprocessReplica.spawn`
+waits for. SIGTERM triggers a graceful drain before exit.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import List, Optional
+
+from . import cache_tier, state_sync
+
+
+def _gossip_interval_s() -> float:
+    from ..analysis import knobs
+    v = knobs.env_float("DAFT_TPU_FLEET_GOSSIP_S", default=None)
+    if v is None:
+        try:
+            from ..context import get_context
+            v = get_context().execution_config.tpu_fleet_gossip_s
+        except Exception:
+            v = 2.0
+    return max(float(v), 0.05)
+
+
+def _peers() -> List[str]:
+    from ..analysis import knobs
+    raw = knobs.env_str("DAFT_TPU_FLEET_PEERS") or ""
+    return [p.strip() for p in raw.split(",") if p.strip()]
+
+
+class ReplicaProcess:
+    """The in-process composition of one fleet replica (also usable
+    from tests without a subprocess)."""
+
+    def __init__(self, replica_id: str, control_port: int = 0,
+                 connect_port: int = 0, with_connect: bool = True):
+        from .. import serving
+        self.replica_id = replica_id
+        self.store = state_sync.StateStore(origin=replica_id)
+        state_sync.install(self.store)
+        tier = cache_tier.tier_from_env()
+        if tier is not None:
+            cache_tier.install(tier)
+        self.scheduler = serving.shared_scheduler()
+        self.connect_server = None
+        if with_connect:
+            try:
+                from ..connect import start_server
+                self.connect_server = start_server(port=connect_port)
+            except Exception:
+                self.connect_server = None
+        self._httpd = None
+        self._control_port = control_port
+        self._stop = threading.Event()
+        self._gossip_thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- control
+    @property
+    def control_address(self) -> str:
+        return f"127.0.0.1:{self._control_port}"
+
+    @property
+    def connect_address(self) -> str:
+        if self.connect_server is None:
+            return ""
+        return f"127.0.0.1:{self.connect_server.port}"
+
+    def start_control(self) -> str:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        replica = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, obj, code: int = 200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                raw = self.rfile.read(n) if n else b""
+                try:
+                    return json.loads(raw.decode()) if raw else {}
+                except ValueError:
+                    return {}
+
+            def do_GET(self):
+                try:
+                    if self.path == "/health":
+                        self._json(replica.health())
+                    elif self.path == "/gauges":
+                        self._json(replica.scheduler.gauges())
+                    elif self.path == "/counters":
+                        self._json(replica.counters())
+                    elif self.path == "/sessions":
+                        self._json({"sessions": replica.sessions()})
+                    elif self.path == "/fleet/state":
+                        replica.store.publish_from_engine(
+                            replica.scheduler)
+                        self._json(replica.store.snapshot_all())
+                    elif self.path == "/metrics":
+                        from .. import tracing
+                        body = tracing.prometheus_text().encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/plain; version=0.0.4")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self._json({"error": "not found"}, 404)
+                except Exception as exc:  # control plane must not die
+                    try:
+                        self._json({"error": str(exc)}, 500)
+                    except Exception:
+                        pass
+
+            def do_POST(self):
+                try:
+                    body = self._body()
+                    if self.path == "/sql":
+                        # grpc-free query path: SQL in, pydict out. The
+                        # fleet smoke/bench drive subprocess replicas
+                        # through this on runners without grpcio.
+                        from ..serving import AdmissionRejected
+                        try:
+                            self._json(replica.run_sql(
+                                str(body.get("sql", "")),
+                                session=str(body.get("session", "http")),
+                                timeout_s=float(
+                                    body.get("timeout_s", 120.0))))
+                        except AdmissionRejected as exc:
+                            self._json({"rejected": exc.kind,
+                                        "error": str(exc)}, 503)
+                    elif self.path == "/fleet/state":
+                        applied = replica.store.ingest_all(body)
+                        replica.store.publish_from_engine(
+                            replica.scheduler)
+                        out = replica.store.snapshot_all()
+                        out["applied"] = applied
+                        self._json(out)
+                    elif self.path == "/drain":
+                        stats = replica.scheduler.drain(
+                            float(body.get("timeout_s", 10.0)))
+                        self._json(stats)
+                    elif self.path == "/release_session":
+                        self._json({"released": replica.release_session(
+                            str(body.get("session", "")))})
+                    else:
+                        self._json({"error": "not found"}, 404)
+                except Exception as exc:
+                    try:
+                        self._json({"error": str(exc)}, 500)
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer(
+            ("127.0.0.1", self._control_port), Handler)
+        self._httpd.daemon_threads = True
+        self._control_port = self._httpd.server_address[1]
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             name=f"daft-tpu-fleet-ctl-{self.replica_id}",
+                             daemon=True)
+        t.start()
+        return self.control_address
+
+    # ------------------------------------------------------------- views
+    def health(self) -> dict:
+        return {"ok": True, "replica": self.replica_id,
+                "draining": self.scheduler.draining}
+
+    def sessions(self) -> List[str]:
+        out = set()
+        with self.scheduler._cond:
+            out.update(self.scheduler._sessions)
+        if self.connect_server is not None:
+            out.update(self.connect_server.sessions())
+        return sorted(out)
+
+    def counters(self) -> dict:
+        out = dict(self.scheduler.counters_snapshot())
+        out["session_count"] = len(self.sessions())
+        out["state_gen"] = self.store.generation()
+        try:
+            from ..analysis import lock_sanitizer
+            out["lock_graph_cycles"] = \
+                lock_sanitizer.counters_snapshot().get("graph_cycles", 0)
+        except Exception:
+            pass
+        for k, v in state_sync.counters_snapshot().items():
+            out[f"fleet_{k}"] = v
+        return out
+
+    def run_sql(self, sql: str, session: str = "http",
+                timeout_s: float = 120.0) -> dict:
+        """Plan + schedule one SQL statement through this replica's
+        scheduler; returns the materialized result as a pydict plus the
+        serving block (cache outcomes, admitted bytes)."""
+        import daft_tpu as dt
+        df = dt.sql(sql)
+        h = self.scheduler.submit(df, session=session)
+        ps = h.result(timeout=timeout_s)
+        out = {"data": ps.to_recordbatch().to_pydict()}
+        serving = getattr(h.stats, "serving", None) if h.stats else None
+        if serving:
+            out["serving"] = {
+                k: serving[k] for k in
+                ("plan_cache", "result_cache", "admitted_bytes")
+                if k in serving}
+        return out
+
+    def release_session(self, session: str) -> bool:
+        released = False
+        if self.connect_server is not None:
+            # also releases the scheduler's session queue via the
+            # process-shared scheduler
+            released = self.connect_server.release_session(session)
+        else:
+            released = self.scheduler.release_session(session)
+        return released
+
+    # ------------------------------------------------------------ gossip
+    def start_gossip(self) -> None:
+        peers = _peers()
+        if not peers:
+            return
+        interval = _gossip_interval_s()
+
+        def loop():
+            import urllib.request
+            while not self._stop.wait(interval):
+                self.store.publish_from_engine(self.scheduler)
+                own = self.store.snapshot_all()
+                data = json.dumps(own).encode()
+                for peer in peers:
+                    if peer == self.control_address:
+                        continue
+                    try:
+                        req = urllib.request.Request(
+                            f"http://{peer}/fleet/state", data=data,
+                            method="POST",
+                            headers={"Content-Type": "application/json"})
+                        with urllib.request.urlopen(req, timeout=2.0) as r:
+                            theirs = json.loads(r.read().decode())
+                        self.store.ingest_all(theirs)
+                    except Exception:
+                        state_sync.count("gossip_errors")
+                state_sync.count("gossip_rounds")
+
+        self._gossip_thread = threading.Thread(
+            target=loop, name=f"daft-tpu-fleet-gossip-{self.replica_id}",
+            daemon=True)
+        self._gossip_thread.start()
+
+    # ---------------------------------------------------------- lifecycle
+    def stop(self, drain_timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        try:
+            self.scheduler.drain(drain_timeout_s)
+        except Exception:
+            pass
+        if self.connect_server is not None:
+            try:
+                self.connect_server.stop(grace=1.0)
+            except Exception:
+                pass
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def _main() -> int:
+    import argparse
+    import signal
+
+    from ..analysis import knobs
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replica-id",
+                    default=knobs.env_str("DAFT_TPU_FLEET_REPLICA_ID")
+                    or "replica-0")
+    ap.add_argument("--control-port", type=int, default=0)
+    ap.add_argument("--connect-port", type=int, default=0)
+    ap.add_argument("--no-connect", action="store_true")
+    args = ap.parse_args()
+
+    rp = ReplicaProcess(args.replica_id, control_port=args.control_port,
+                        connect_port=args.connect_port,
+                        with_connect=not args.no_connect)
+    rp.start_control()
+    rp.start_gossip()
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: done.set())
+    signal.signal(signal.SIGINT, lambda *a: done.set())
+    print(f"FLEET_REPLICA_READY control={rp.control_address} "
+          f"connect={rp.connect_address}", flush=True)
+    done.wait()
+    rp.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

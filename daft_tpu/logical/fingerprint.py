@@ -10,10 +10,12 @@ A fingerprint canonicalizes a logical plan into three parts:
 - ``params`` — the bound-parameter vector: the stripped literal values
   in tree order, canonicalized with ``repr``;
 - ``sources`` — one version token per ``Source`` leaf: the scan's file
-  set with per-file ``(size, mtime_ns)`` from ``os.stat``. Any file
-  appearing, disappearing, or changing its stat busts both caches; a
-  non-statable (remote) file has no observable version at all, which
-  makes the whole plan uncacheable.
+  set with per-file ``(size, mtime_ns)`` from ``os.stat`` for local
+  files, or the object store's version token (size + etag /
+  last-modified via ``ObjectSource.version``) for remote ones. Any file
+  appearing, disappearing, or changing its version busts both caches; a
+  remote object whose store exposes NO version signal keeps the whole
+  plan uncacheable (fail-safe — it could change unobservably).
 
 Invalidation rules (documented in the README "Serving plane" section):
 
@@ -24,7 +26,14 @@ Invalidation rules (documented in the README "Serving plane" section):
   over identical source versions;
 - any ``ExecutionConfig`` change busts both (the config repr is hashed
   into ``structure``); process-env ``DAFT_TPU_*`` knob changes do NOT
-  (they are read at execution time, not plan time).
+  (they are read at execution time, not plan time);
+- the calibration generation busts both: ``structure`` folds in
+  ``device/calibration.plan_token()`` (a quantized digest of every
+  actively-overriding learned constant), so plans priced under stale
+  constants stop being served once self-tuning flips a decision. The
+  separate ``history_structure`` field deliberately EXCLUDES the token —
+  admission/latency history keys must stay stable across calibration
+  generations and across fleet replicas with different profiles.
 
 Plans are *uncacheable* (→ ``fingerprint()`` returns None, caches
 bypassed) when they contain: an in-memory source (caching would pin the
@@ -52,6 +61,11 @@ class PlanFingerprint:
     structure: str                 # sha256 hex of the literal-stripped tree
     params: Tuple[str, ...]        # bound literal vector (repr-canonical)
     sources: Tuple[Tuple, ...]     # per-source version tokens
+    # ``structure`` WITHOUT the calibration token: admission/latency
+    # history keys must survive calibration-generation flips (and match
+    # across fleet replicas whose learned profiles differ), unlike
+    # cached plans which bake the calibrated decisions in
+    history_structure: str = ""
 
     @property
     def key(self) -> Tuple:
@@ -128,13 +142,24 @@ def _source_version(node: lp.Source) -> Tuple:
         try:
             st = os.stat(p)
             versions.append((p, int(st.st_size), int(st.st_mtime_ns)))
+            continue
         except OSError:
-            # a non-statable (remote) object can change without any
-            # observable version — a cached plan would keep stale baked
-            # row-group ranges and a cached result would serve stale
-            # rows, so remote-sourced plans bypass both caches until a
-            # real version signal (etag/snapshot id) exists
-            raise _Uncacheable(f"source {p!r} has no stat version")
+            pass
+        # non-statable (remote) object: ask its store for a version
+        # token (size + etag / last-modified). A store exposing none
+        # leaves the plan uncacheable — a cached plan would keep stale
+        # baked row-group ranges and a cached result would serve stale
+        # rows if the object changed unobservably.
+        ver = None
+        if "://" in str(p):
+            try:
+                from ..io.object_io import get_io_client
+                ver = get_io_client().version(str(p))
+            except Exception:
+                ver = None
+        if ver is None:
+            raise _Uncacheable(f"source {p!r} has no version signal")
+        versions.append((str(p),) + tuple(ver))
     return (type(op).__name__, tuple(versions))
 
 
@@ -179,6 +204,22 @@ def fingerprint(plan: lp.LogicalPlan,
             cfg = repr(dataclasses.asdict(exec_config))
         except Exception:
             cfg = repr(exec_config)
-    structure = hashlib.sha256(
-        (tree + "\x00" + cfg).encode()).hexdigest()
-    return PlanFingerprint(structure, tuple(params), tuple(sources))
+    base = tree + "\x00" + cfg
+    history_structure = hashlib.sha256(base.encode()).hexdigest()
+    # the calibration-generation token: a plan cached under one set of
+    # calibrated constants (combine gating, kernel strategy, fusion
+    # pricing all price through calibration.const) must not serve after
+    # those constants flip the decision — the token changes, the old
+    # entry is simply never hit again and ages out of the LRU
+    try:
+        from ..device import calibration
+        calib = calibration.plan_token()
+    except Exception:
+        calib = ""
+    if calib:
+        structure = hashlib.sha256(
+            (base + "\x00" + calib).encode()).hexdigest()
+    else:
+        structure = history_structure
+    return PlanFingerprint(structure, tuple(params), tuple(sources),
+                           history_structure)

@@ -7,6 +7,12 @@ result caches keyed by logical-plan fingerprints, and cooperative
 cancellation threaded into the executor pipelines. The Spark Connect
 server routes every ``ExecutePlan`` through the process-shared scheduler;
 ``bench.py --serve`` drives it with sustained mixed traffic.
+
+Horizontal scale-out lives in ``daft_tpu.fleet``: N replica processes
+each host one shared scheduler like this one; the scheduler transparently
+consults the process-installed fleet state store (gossiped calibration +
+admission history) and cache tier when present, and grows ``drain`` /
+``release_session`` lifecycle hooks for the router.
 """
 
 from __future__ import annotations

@@ -69,21 +69,33 @@ def _history_fingerprint(builder) -> Optional[str]:
         fp = fingerprint(builder.plan, get_context().execution_config)
     except Exception:
         return None
+    return _history_key_from_fp(fp)
+
+
+def _history_key_from_fp(fp) -> Optional[str]:
+    import hashlib
     if fp is None:
         return None
     try:
-        paths = tuple(p for (_t, vers) in fp.sources
-                      for (p, _sz, _mt) in vers)
+        # version tuples are (path, *token) — local stat and remote
+        # etag tokens have different arities, only the path matters here
+        paths = tuple(v[0] for (_t, vers) in fp.sources for v in vers)
     except Exception:
         return None
+    # history_structure, NOT structure: the calibration-generation token
+    # must not fragment admission history across self-tuning flips or
+    # across fleet replicas with different learned profiles
+    structure = fp.history_structure or fp.structure
     return hashlib.sha256(
-        (fp.structure + "\x00" + repr(fp.params) + "\x00" + repr(paths))
+        (structure + "\x00" + repr(fp.params) + "\x00" + repr(paths))
         .encode()).hexdigest()[:16]
 
 
 class AdmissionRejected(RuntimeError):
     """Structured admission failure. ``kind`` is one of ``queue_full``,
-    ``queue_timeout``, ``memory``, ``shutdown``."""
+    ``queue_timeout``, ``memory``, ``shutdown``, ``draining`` (the fleet
+    router treats the last two as re-routable: the replica is leaving,
+    the query belongs on a peer)."""
 
     def __init__(self, kind: str, message: str,
                  est_bytes: Optional[int] = None,
@@ -305,7 +317,16 @@ class QueryScheduler:
                  queue_timeout_s: Optional[float] = None,
                  memory_budget: Optional[int] = None,
                  plan_cache_bytes: Optional[int] = None,
-                 result_cache_bytes: Optional[int] = None):
+                 result_cache_bytes: Optional[int] = None,
+                 fleet_state=None, cache_tier=None,
+                 name: Optional[str] = None):
+        # fleet wiring (both optional): ``fleet_state`` is this replica's
+        # fleet/state_sync.StateStore (falls back to the process-installed
+        # one), ``cache_tier`` the cross-replica cache layer
+        # (fleet/cache_tier); a bare scheduler never touches either
+        self.fleet_state = fleet_state
+        self.cache_tier = cache_tier
+        self.name = name or "driver"
         self.concurrency = concurrency or serve_concurrency()
         self.queue_depth = queue_depth or serve_queue_depth()
         self.queue_timeout_s = queue_timeout_s \
@@ -331,7 +352,9 @@ class QueryScheduler:
         self._builders: Dict[QueryHandle, object] = {}
         self._n_queued = 0
         self._n_running = 0
+        self._running: set = set()   # running handles (drain/kill target)
         self._shutdown = False
+        self._draining = False
         self._counts_lock = threading.Lock()
         self._counters: Dict[str, float] = {}
         # per-fingerprint admission history (ROADMAP 4c, minimal):
@@ -404,9 +427,121 @@ class QueryScheduler:
         return {"queued": queued, "running": running,
                 "concurrency": self.concurrency,
                 "sessions": sessions,
+                "draining": self._draining,
                 "admitted_bytes": self.admission.outstanding,
                 "admission_budget": self.admission.budget,
                 "counters": self.counters_snapshot()}
+
+    def gauges(self) -> Dict[str, float]:
+        """Per-replica scale-signal gauges the fleet router aggregates
+        (queue depth / admitted bytes are the autoscaling inputs)."""
+        with self._cond:
+            queued, running = self._n_queued, self._n_running
+            sessions = len(self._sessions)
+            draining = self._draining
+        return {"queued": float(queued), "running": float(running),
+                "concurrency": float(self.concurrency),
+                "sessions": float(sessions),
+                "admitted_bytes": float(self.admission.outstanding),
+                "draining": 1.0 if draining else 0.0}
+
+    # --------------------------------------------------------------- fleet
+    def _fleet_store(self):
+        if self.fleet_state is not None:
+            return self.fleet_state
+        try:
+            from ..fleet import state_sync
+            return state_sync.installed()
+        except Exception:
+            return None
+
+    def _fleet_cache_tier(self):
+        if self.cache_tier is not None:
+            return self.cache_tier
+        try:
+            from ..fleet import cache_tier as _ct
+            return _ct.installed()
+        except Exception:
+            return None
+
+    def admission_history_snapshot(self) -> Dict[str, tuple]:
+        """Copy of the per-fingerprint admission history — the gossip
+        export consumed by ``fleet/state_sync`` (key → (ewma bytes,
+        ewma wall us, samples))."""
+        with self._hist_lock:
+            return dict(self._fp_hist)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, timeout_s: float = 10.0,
+              cancel: bool = True) -> Dict[str, object]:
+        """Graceful drain: stop admitting NOW, let queued+running work
+        finish within ``timeout_s``, then cooperatively cancel the
+        stragglers via their CancelTokens. The scheduler object stays
+        alive (caches, counters, gossip exports keep serving) — only
+        admission is closed; the fleet router hands the sessions off."""
+        deadline = time.monotonic() + max(float(timeout_s), 0.0)
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            while self._n_queued or self._n_running:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(min(left, 0.1))
+            finished_in_time = not (self._n_queued or self._n_running)
+            stragglers: List[QueryHandle] = []
+            if cancel and not finished_in_time:
+                stragglers = list(self._running)
+                stragglers += [h for s in self._sessions.values()
+                               for dq in s.queues.values() for h in dq]
+        for h in stragglers:  # outside the condition: cancel() re-takes it
+            h.cancel("replica draining")
+        if stragglers:
+            with self._cond:
+                grace = time.monotonic() + 5.0
+                while self._n_running and time.monotonic() < grace:
+                    self._cond.wait(0.1)
+        with self._cond:
+            remaining = self._n_queued + self._n_running
+        self._count("drained")
+        return {"finished_in_time": finished_in_time,
+                "cancelled": len(stragglers), "remaining": remaining}
+
+    def cancel_all(self, reason: str = "replica killed") -> int:
+        """Cooperatively cancel every queued and running query (the
+        replica-kill path). Returns the number of handles signalled."""
+        with self._cond:
+            handles = [h for s in self._sessions.values()
+                       for dq in s.queues.values() for h in dq]
+            handles += list(self._running)
+        for h in handles:
+            h.cancel(reason)
+        return len(handles)
+
+    def release_session(self, session: str) -> bool:
+        """Drop a session's scheduler state NOW (fleet handoff): the
+        idle-TTL sweep that would reclaim it after 60s fires immediately
+        for the re-homed session, so it can't leak a queue on the old
+        replica. Still-queued queries (possible on a hard kill, none
+        after a graceful drain) are cancelled. True when it existed."""
+        with self._cond:
+            s = self._sessions.pop(session, None)
+            if s is None:
+                return False
+            for dq in s.queues.values():
+                for h in dq:
+                    h._finish("cancelled")
+                    self._count("cancelled")
+                    self._cleanup(h)
+                dq.clear()
+            self._n_queued = sum(t.depth()
+                                 for t in self._sessions.values())
+            self._count("sessions_released")
+            self._cond.notify_all()
+        return True
 
     # -------------------------------------------------------------- submit
     def submit(self, query, session: str = "default", priority: int = 0,
@@ -440,6 +575,13 @@ class QueryScheduler:
                     "shutdown", "scheduler is shut down"))
                 self._count("rejected_shutdown")
                 return h
+            if self._draining:
+                # the router treats this as re-routable: the session
+                # belongs on a peer replica now
+                h._finish("rejected", error=AdmissionRejected(
+                    "draining", "replica is draining"))
+                self._count("rejected_draining")
+                return h
             if self._n_queued >= self.queue_depth:
                 h._finish("rejected", error=AdmissionRejected(
                     "queue_full",
@@ -471,23 +613,29 @@ class QueryScheduler:
         return h
 
     def _estimate_bytes(self, builder) -> int:
+        # observed history outranks the heuristic model: for a repeat
+        # query (same structure + params + source paths) the recorded
+        # result bytes of past executions — this process's completions,
+        # the flight recorder's, or the fleet's gossiped history on a
+        # cold replica — are strictly better information than a
+        # selectivity guess, so repeats stop over-/under-admitting
+        key = _history_fingerprint(builder)
+        self._tl_est.hist_key = key
+        if key is not None:
+            seeded = self._history_estimate(key)
+            if seeded is not None:
+                self._count("est_seeded_history")
+                return seeded
+            seeded = self._fleet_history_estimate(key)
+            if seeded is not None:
+                self._count("est_seeded_fleet")
+                return seeded
         try:
             from ..logical import stats as lstats
             est = lstats.estimate(builder.plan).size_bytes
         except Exception:
             est = None
         if est is None:
-            # cost model is blind: seed from per-fingerprint history
-            # (this process's completions, else flight-recorder records
-            # of earlier processes) before falling back to the flat
-            # default — repeat queries stop over-/under-admitting
-            key = _history_fingerprint(builder)
-            self._tl_est.hist_key = key
-            if key is not None:
-                seeded = self._history_estimate(key)
-                if seeded is not None:
-                    self._count("est_seeded_history")
-                    return seeded
             return _DEFAULT_EST_BYTES
         return max(int(est), _MIN_EST_BYTES)
 
@@ -496,6 +644,22 @@ class QueryScheduler:
         self._seed_history_from_flight()
         with self._hist_lock:
             e = self._fp_hist.get(key)
+        if e is None:
+            return None
+        return max(int(e[0]), _MIN_EST_BYTES)
+
+    def _fleet_history_estimate(self, key: str) -> Optional[int]:
+        """Gossiped fleet admission history for ``key`` (sample-weighted
+        over replica origins) — a cold replica's first repeat query
+        admits from the fleet's observations instead of the flat
+        default. None when no fleet store is installed or it is blind."""
+        st = self._fleet_store()
+        if st is None:
+            return None
+        try:
+            e = st.merged_admission(key)
+        except Exception:
+            return None
         if e is None:
             return None
         return max(int(e[0]), _MIN_EST_BYTES)
@@ -704,6 +868,7 @@ class QueryScheduler:
         try:
             with self._cond:
                 self._n_running += 1
+                self._running.add(h)
                 running_at_admit = self._n_running
             running = True
             h._mark_running()
@@ -792,6 +957,7 @@ class QueryScheduler:
             with self._cond:
                 if running:
                     self._n_running -= 1
+                self._running.discard(h)
                 self._cond.notify_all()
 
     # ------------------------------------------------------------- execute
@@ -813,6 +979,7 @@ class QueryScheduler:
             and not cfg.enable_aqe
         with tracing.span("plan:fingerprint", lane="planner"):
             fp = fingerprint(builder.plan, cfg) if cacheable else None
+        tier = self._fleet_cache_tier()
         if fp is not None and self.result_cache.enabled:
             ps = self.result_cache.get_result(fp)
             if ps is not None:
@@ -820,6 +987,23 @@ class QueryScheduler:
                 info["plan_cache"] = "skipped"
                 tracing.event("cache:result_hit", lane="planner")
                 return ps, None, info
+            if tier is not None:
+                # local miss → the fleet tier: a repeat query that last
+                # ran on a peer replica still hits warm state. The tier
+                # degrades to a miss on any failure; a hit is promoted
+                # into the local cache so the next repeat is local.
+                try:
+                    ps = tier.get_result(fp)
+                except Exception:
+                    ps = None
+                if ps is not None:
+                    info["result_cache"] = "fleet_hit"
+                    info["plan_cache"] = "skipped"
+                    self._count("result_cache_fleet_hits")
+                    tracing.event("cache:result_fleet_hit", lane="planner")
+                    self.result_cache.put_result(fp, ps)
+                    return ps, None, info
+                self._count("result_cache_fleet_misses")
             info["result_cache"] = "miss"
         if not cacheable:
             # AQE / distributed runner: the scheduler still provides
@@ -834,6 +1018,14 @@ class QueryScheduler:
                 parts.append(p)
             return (PartitionSet(parts, builder.schema()),
                     obs.last_query_stats_local(), info)
+        if fp is not None and h._fp_hist_key is None:
+            # every EXECUTED cacheable query feeds the per-fingerprint
+            # admission history, not just blind-estimate ones (cache
+            # hits returned above — their ~0 wall would pollute the
+            # EWMA): warm replicas publish observed bytes/wall to the
+            # fleet store, which is what a cold replica's blind
+            # estimates seed from
+            h._fp_hist_key = _history_key_from_fp(fp)
         hit = self.plan_cache.get_plan(fp) if self.plan_cache.enabled \
             else None
         if hit is not None:
@@ -841,19 +1033,43 @@ class QueryScheduler:
             info["plan_cache"] = "hit"
             tracing.event("cache:plan_hit", lane="planner")
         else:
-            with tracing.span("plan:optimize", lane="planner"):
-                optimized = builder.optimize()
-            with tracing.span("plan:translate", lane="planner"):
-                pplan = translate(optimized.plan)
-            if fp is not None and self.plan_cache.enabled:
-                self.plan_cache.put_plan(fp, optimized.plan, pplan)
-                info["plan_cache"] = "miss"
+            tiered = None
+            if fp is not None and self.plan_cache.enabled \
+                    and tier is not None:
+                try:
+                    tiered = tier.get_plan(fp)
+                except Exception:
+                    tiered = None
+            if tiered is not None:
+                optimized_plan, pplan = tiered
+                info["plan_cache"] = "fleet_hit"
+                self._count("plan_cache_fleet_hits")
+                tracing.event("cache:plan_fleet_hit", lane="planner")
+                self.plan_cache.put_plan(fp, optimized_plan, pplan)
+            else:
+                with tracing.span("plan:optimize", lane="planner"):
+                    optimized = builder.optimize()
+                with tracing.span("plan:translate", lane="planner"):
+                    pplan = translate(optimized.plan)
+                if fp is not None and self.plan_cache.enabled:
+                    self.plan_cache.put_plan(fp, optimized.plan, pplan)
+                    if tier is not None:
+                        try:
+                            tier.put_plan(fp, optimized.plan, pplan)
+                        except Exception:
+                            pass
+                    info["plan_cache"] = "miss"
         executor = make_local_executor(cfg)
         parts = list(executor.run(pplan))
         stats = obs.last_query_stats_local()
         ps = PartitionSet(parts, builder.schema())
         if fp is not None and self.result_cache.enabled:
             self.result_cache.put_result(fp, ps)
+            if tier is not None:
+                try:
+                    tier.put_result(fp, ps)
+                except Exception:
+                    pass
         return ps, stats, info
 
     # ------------------------------------------------------------ shutdown

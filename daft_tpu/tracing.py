@@ -676,6 +676,14 @@ def prometheus_text() -> str:
     except Exception:
         pass
     try:
+        from .fleet import state_sync
+        fleet_counters = state_sync.counters_snapshot()
+        if fleet_counters:
+            plane("fleet", fleet_counters,
+                  "serving-fleet counter (routing, gossip, cache tier)")
+    except Exception:
+        pass
+    try:
         from .analysis import retrace_sanitizer
         plane("retrace", retrace_sanitizer.counters_snapshot(),
               "retrace sanitizer counter")

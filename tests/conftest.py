@@ -40,9 +40,23 @@ import jax
 if not _REAL:
     jax.config.update("jax_platforms", "cpu")
 
+import gc
+
 import numpy as np
 import pyarrow as pa
 import pytest
+
+# The full suite accumulates several GB of long-lived engine state
+# (compile caches, result caches, answer tables) — with the default
+# gen2 threshold (10) CPython walks that entire live set every ~70k
+# allocations, which makes the tail of a 1200-test serial run ~2x
+# slower than the same tests in isolation. Suppress full collections
+# for the run (gen0/gen1 still reclaim short-lived cycles; long-lived
+# garbage just stays resident, which a test box can afford) and move
+# the import-time baseline to the permanent generation so even
+# explicit gc.collect() calls in tests don't re-walk it.
+gc.set_threshold(700, 10, 100_000)
+gc.freeze()
 
 # importing daft_tpu ALSO arms the runtime lock-order sanitizer when
 # DAFT_TPU_SANITIZE=1 (daft_tpu/__init__.py patches the lock factories

@@ -1,0 +1,438 @@
+"""Fleet plane: consistent-hash session affinity, replica kill/drain
+lifecycle with re-routing, gossiped learned state (idempotent +
+commutative merges, cold-replica inheritance), the cross-replica cache
+tier, and the calibration-generation token in plan fingerprints."""
+
+import threading
+
+import pyarrow as pa
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import DataType, col, udf
+from daft_tpu.execution.cancellation import QueryCancelled
+from daft_tpu.device import calibration as cal
+from daft_tpu.fleet import cache_tier, state_sync
+from daft_tpu.fleet.router import (FleetRouter, InProcessReplica,
+                                   ReplicaUnavailable)
+from daft_tpu.logical.fingerprint import fingerprint
+from daft_tpu.serving import AdmissionRejected, QueryScheduler
+
+
+def mkdf(d):
+    return dt.from_pydict(d)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_state():
+    state_sync.reset_for_tests()
+    cache_tier.install(None)
+    cal.reset_for_tests()
+    yield
+    state_sync.reset_for_tests()
+    cache_tier.install(None)
+    cal.reset_for_tests()
+
+
+@pytest.fixture
+def parquet_table(tmp_path):
+    root = tmp_path / "t"
+    mkdf({"k": list(range(2000)),
+          "g": [i % 7 for i in range(2000)],
+          "v": [float(i % 31) for i in range(2000)]}) \
+        .write_parquet(str(root))
+    return str(root / "*.parquet")
+
+
+def _agg_query(glob):
+    return dt.read_parquet(glob).groupby("g") \
+        .agg(col("v").sum().alias("s")).sort("g")
+
+
+def _gated_query(gate: threading.Event, started: threading.Event = None):
+    @udf(return_dtype=DataType.int64())
+    def block(s):
+        if started is not None:
+            started.set()
+        gate.wait(30)
+        return s.to_pylist()
+
+    return mkdf({"a": [1]}).select(block(col("a")))
+
+
+@pytest.fixture
+def fleet():
+    hub = cache_tier.InProcessCacheTier()
+    reps = [InProcessReplica(f"r{i}", cache_tier=hub) for i in range(3)]
+    router = FleetRouter(reps)
+    yield router, reps
+    router.shutdown()
+
+
+# ---------------------------------------------------------------- routing
+
+def test_session_affinity_and_spread(fleet, parquet_table):
+    """Same session → same replica every time; many sessions spread over
+    >1 of the 3 replicas; results stay correct through the router."""
+    router, _ = fleet
+    expected = _agg_query(parquet_table).to_pydict()
+    owners = set()
+    for _ in range(5):
+        h = router.submit(_agg_query(parquet_table), session="sticky")
+        assert h.result(60).to_recordbatch().to_pydict() == expected
+        owners.add(router.route("sticky").name)
+    assert len(owners) == 1
+    spread = {router.route(f"s-{i}").name for i in range(24)}
+    assert len(spread) >= 2
+
+
+def test_kill_reroutes_and_cancels_inflight(fleet, parquet_table):
+    """Replica death: its in-flight query is cooperatively cancelled and
+    the session's next submit lands on (and succeeds at) a live peer."""
+    router, reps = fleet
+    gate, started = threading.Event(), threading.Event()
+    h = router.submit(_gated_query(gate, started), session="doomed")
+    assert started.wait(20)
+    owner = router.route("doomed").name
+    router.kill(owner)
+    gate.set()  # morsel finishes; executor sees the cancel token next
+    with pytest.raises(QueryCancelled):
+        h.result(60)
+    assert h.state == "cancelled"
+    h2 = router.submit(_agg_query(parquet_table), session="doomed")
+    h2.result(60)
+    assert router.route("doomed").name != owner
+    assert state_sync.counters_snapshot().get("kill") == 1
+    # dead replica rejects direct submits with a routable error
+    dead = next(r for r in reps if r.name == owner)
+    with pytest.raises(ReplicaUnavailable):
+        dead.submit(_agg_query(parquet_table), session="x")
+
+
+def test_drain_rehomes_sessions_and_rejects_draining(fleet, parquet_table):
+    """Graceful drain: in-flight queries finish inside the grace window,
+    the drained replica's sessions are released and re-homed, and a
+    direct submit to it is rejected ``draining`` (which the router
+    treats as re-routable)."""
+    router, reps = fleet
+    h = router.submit(_agg_query(parquet_table), session="moving")
+    h.result(60)
+    owner = router.route("moving").name
+    stats = router.drain(owner)
+    assert stats["finished_in_time"] is True
+    rep = next(r for r in reps if r.name == owner)
+    assert rep.scheduler.draining
+    direct = rep.scheduler.submit(_agg_query(parquet_table), session="x")
+    with pytest.raises(AdmissionRejected) as ei:
+        direct.result(10)
+    assert ei.value.kind == "draining"
+    # the session re-routes through the front door and still works
+    h2 = router.submit(_agg_query(parquet_table), session="moving")
+    h2.result(60)
+    assert router.route("moving").name != owner
+    assert rep.scheduler.counters_snapshot().get("sessions_released", 0) >= 1
+
+
+# ------------------------------------------------------------ state sync
+
+def _snap(origin, gen, calib=None, admission=None):
+    return {"origin": origin, "gen": gen, "calib": calib or {},
+            "admission": admission or {}}
+
+
+def test_gossip_merge_idempotent_and_commutative():
+    """Re-delivery is a no-op; ingest order cannot change the merged
+    view; a replica's own slot never regresses from an echoed snapshot."""
+    a1 = _snap("a", 1, {"DEV_AGG_BPS": {"value": 1e9, "samples": 10}})
+    a2 = _snap("a", 2, {"DEV_AGG_BPS": {"value": 2e9, "samples": 30}})
+    b1 = _snap("b", 1, {"DEV_AGG_BPS": {"value": 6e9, "samples": 10}})
+    x, y = state_sync.StateStore("x"), state_sync.StateStore("y")
+    for s in (a1, a2, b1):
+        assert x.ingest(dict(s))
+    # reversed delivery order, with the stale a1 arriving last
+    assert y.ingest(dict(b1)) and y.ingest(dict(a2))
+    assert not y.ingest(dict(a1))           # stale gen: rejected
+    assert not x.ingest(dict(a2))           # re-delivery: idempotent
+    assert x.merged_calibration("DEV_AGG_BPS") == \
+        y.merged_calibration("DEV_AGG_BPS")
+    v, n = x.merged_calibration("DEV_AGG_BPS")
+    assert n == 40 and v == pytest.approx(3e9)  # 30/40·2e9 + 10/40·6e9
+    # echo of x's own (empty) slot must not apply
+    x.publish_local({}, {})
+    assert not x.ingest(_snap("x", 99))
+    assert x.generation("x") == 1
+
+
+def test_sample_weighted_admission_merge():
+    x = state_sync.StateStore("x")
+    x.ingest(_snap("a", 1, admission={
+        "k": {"bytes": 4e6, "wall_us": 900.0, "samples": 3}}))
+    x.ingest(_snap("b", 1, admission={"k": (8e6, 1300.0, 1.0)}))
+    b, w, n = x.merged_admission("k")
+    assert n == 4 and b == pytest.approx(5e6) and w == pytest.approx(1000.0)
+    assert x.merged_admission("unknown") is None
+
+
+def test_cold_replica_inherits_calibration(monkeypatch):
+    """Satellite: a cold replica's ``calibration.const`` prices from the
+    gossiped fleet view (≠ the hard-coded default) before it has any
+    local observations."""
+    monkeypatch.setenv("DAFT_TPU_CALIBRATION", "1")
+    monkeypatch.setenv("DAFT_TPU_CALIBRATION_MIN_SAMPLES", "3")
+    store = state_sync.StateStore("cold")
+    store.ingest(_snap("warm", 5, {
+        "DEV_AGG_BPS": {"value": 1.5e9, "samples": 40}}))
+    state_sync.install(store)
+    assert cal.const("DEV_AGG_BPS", 4e9) == pytest.approx(1.5e9)
+    assert state_sync.counters_snapshot().get("calibration_fleet_reads") == 1
+    # below the fleet's own sample floor the default still wins
+    store2 = state_sync.StateStore("cold2")
+    store2.ingest(_snap("warm", 6, {
+        "DEV_SORT_ROWS_PER_S": {"value": 9e6, "samples": 2}}))
+    state_sync.install(store2)
+    assert cal.const("DEV_SORT_ROWS_PER_S", 50e6) == 50e6
+
+
+def test_cold_replica_admission_seeded_from_fleet(monkeypatch,
+                                                 parquet_table):
+    """A cold scheduler with a blind cost model prices a repeat workload
+    from gossiped admission history (counter ``est_seeded_fleet``), not
+    the flat 64 MiB default."""
+    from daft_tpu.logical import stats as lstats
+    from daft_tpu.serving import scheduler as sched_mod
+    monkeypatch.setattr(lstats, "estimate",
+                        lambda plan: lstats.Stats(None, None))
+    warm_store = state_sync.StateStore("warm")
+    warm = QueryScheduler(concurrency=1, result_cache_bytes=0,
+                          fleet_state=warm_store, name="warm")
+    cold_store = state_sync.StateStore("cold")
+    cold = QueryScheduler(concurrency=1, result_cache_bytes=0,
+                          fleet_state=cold_store, name="cold")
+    try:
+        h1 = warm.submit(_agg_query(parquet_table))
+        h1.result(60)
+        assert h1._fp_hist_key is not None
+        warm_store.publish_from_engine(warm)
+        assert cold_store.ingest_all(warm_store.snapshot_all()) == 1
+        h2 = cold.submit(_agg_query(parquet_table))
+        h2.result(60)
+        assert cold.counters_snapshot().get("est_seeded_fleet") == 1
+        est = h2.stats.serving["admitted_bytes"]
+        assert 0 < est < sched_mod._DEFAULT_EST_BYTES
+    finally:
+        warm.shutdown()
+        cold.shutdown()
+
+
+# ------------------------------------------------------------ cache tier
+
+def test_fleet_result_cache_hit_across_replicas(fleet, parquet_table):
+    """A repeat query landing on a DIFFERENT replica than its first run
+    hits the shared tier (``result_cache: fleet_hit``) and promotes the
+    result into the landing replica's local cache."""
+    router, reps = fleet
+    expected = _agg_query(parquet_table).to_pydict()
+    h1 = router.submit(_agg_query(parquet_table), session="first")
+    h1.result(60)
+    first = router.route("first").name
+    other = next(f"o-{i}" for i in range(200)
+                 if router.route(f"o-{i}").name != first)
+    h2 = router.submit(_agg_query(parquet_table), session=other)
+    assert h2.result(60).to_recordbatch().to_pydict() == expected
+    assert h2.stats.serving["result_cache"] == "fleet_hit"
+    landing = next(r for r in reps
+                   if r.name == router.route(other).name)
+    assert landing.scheduler.counters_snapshot() \
+        .get("result_cache_fleet_hits") == 1
+    # promoted: the SAME replica's next repeat is a plain local hit
+    h3 = router.submit(_agg_query(parquet_table), session=other)
+    h3.result(60)
+    assert h3.stats.serving["result_cache"] == "hit"
+
+
+def test_sidecar_cache_tier_roundtrip():
+    """Arrow-IPC result round-trip through a live sidecar store; misses
+    and hits count; a dead sidecar degrades to a miss, never raises."""
+    from daft_tpu.logical.fingerprint import PlanFingerprint
+    from daft_tpu.micropartition import MicroPartition
+    from daft_tpu.runners.runner import PartitionSet
+    from daft_tpu.schema import Schema
+    t = pa.table({"g": [0, 1, 2], "s": [10.0, 11.0, 12.0]})
+    ps = PartitionSet([MicroPartition.from_arrow_table(t)],
+                      Schema.from_arrow(t.schema))
+    fp = PlanFingerprint("deadbeef", ("p",), ("src",), "deadbeef")
+    sc = cache_tier.CacheSidecar(budget_bytes=8 << 20)
+    addr = sc.start()
+    try:
+        tier = cache_tier.SidecarCacheTier(addr)
+        assert tier.get_result(fp) is None          # cold: miss
+        tier.put_result(fp, ps)
+        got = tier.get_result(fp)
+        assert got is not None
+        assert got.to_recordbatch().to_pydict() == \
+            ps.to_recordbatch().to_pydict()
+        assert tier.get_plan(fp) is None            # plans never cross
+        c = state_sync.counters_snapshot()
+        assert c.get("cache_tier_misses") == 1
+        assert c.get("cache_tier_puts") == 1
+        assert c.get("cache_tier_hits") == 1
+    finally:
+        sc.stop()
+    dead = cache_tier.SidecarCacheTier(addr, timeout_s=0.2)
+    assert dead.get_result(fp) is None
+    dead.put_result(fp, ps)  # must not raise
+    assert state_sync.counters_snapshot().get("cache_tier_errors", 0) >= 1
+
+
+# ------------------------------------------- fingerprint calibration token
+
+def test_fingerprint_calibration_token_invalidates_plans(monkeypatch,
+                                                         parquet_table):
+    """Satellite regression: a calibrated constant crossing the sample
+    floor changes the plan-cache key (stale pre-calibration plans die)
+    but NOT the admission-history key (history survives the flip and
+    matches across differently-calibrated replicas)."""
+    from daft_tpu.context import get_context
+    cfg = get_context().execution_config
+    plan = _agg_query(parquet_table)._builder.plan
+    f_off = fingerprint(plan, cfg)
+    assert f_off.structure == f_off.history_structure  # common path
+    monkeypatch.setenv("DAFT_TPU_CALIBRATION", "1")
+    monkeypatch.setenv("DAFT_TPU_CALIBRATION_MIN_SAMPLES", "2")
+    f_cold = fingerprint(plan, cfg)
+    assert f_cold.key == f_off.key        # nothing active yet: no churn
+    cal.observe("DEV_AGG_BPS", 1e9)
+    cal.observe("DEV_AGG_BPS", 1e9)       # crosses the floor
+    assert cal.plan_token() != ""
+    f_warm = fingerprint(plan, cfg)
+    assert f_warm.structure != f_off.structure
+    assert f_warm.key != f_off.key
+    assert f_warm.history_structure == f_off.history_structure
+    # EWMA nudges within quantization don't churn the token
+    cal.observe("DEV_AGG_BPS", 1.001e9)
+    assert fingerprint(plan, cfg).structure == f_warm.structure
+    # fleet-inherited constants flip the token the same way local ones do
+    cal.reset_for_tests()
+    store = state_sync.StateStore("me")
+    store.ingest(_snap("peer", 3, {
+        "DEV_AGG_BPS": {"value": 1e9, "samples": 40}}))
+    state_sync.install(store)
+    f_fleet = fingerprint(plan, cfg)
+    assert f_fleet.structure == f_warm.structure  # same quantized value
+    assert f_fleet.history_structure == f_off.history_structure
+
+
+def test_fingerprint_remote_source_version_token(parquet_table):
+    """Satellite regression: a remote (http) source is cacheable iff the
+    store exposes a version signal. The ETag rides in the fingerprint's
+    source token (so an object change busts the key without changing the
+    structure); a store with no ETag/Last-Modified leaves the plan
+    uncacheable (fail-safe)."""
+    import glob as globmod
+    import http.server
+
+    from daft_tpu.context import get_context
+
+    pq = sorted(globmod.glob(parquet_table))[0]
+    with open(pq, "rb") as f:
+        data = f.read()
+    etag = {"value": '"v1"', "send": True}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _serve(self, head_only):
+            body, code = data, 200
+            rng = self.headers.get("Range")
+            if rng and rng.startswith("bytes="):
+                a, _, b = rng[len("bytes="):].partition("-")
+                start = int(a or 0)
+                end = min(int(b) + 1 if b else len(data), len(data))
+                body, code = data[start:end], 206
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            if etag["send"]:
+                self.send_header("ETag", etag["value"])
+            self.end_headers()
+            if not head_only:
+                self.wfile.write(body)
+
+        def do_GET(self):
+            self._serve(False)
+
+        def do_HEAD(self):
+            self._serve(True)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}/t.parquet"
+    cfg = get_context().execution_config
+    try:
+        f1 = fingerprint(dt.read_parquet(url)._builder.plan, cfg)
+        assert f1 is not None
+        tokens = [v for (_op, vers) in f1.sources for v in vers]
+        assert tokens == [(url, "http", len(data), '"v1"')]
+        # stable across identical plan builds
+        f2 = fingerprint(dt.read_parquet(url)._builder.plan, cfg)
+        assert f2.key == f1.key
+        # object changed server-side (new ETag): key busts, shape doesn't
+        etag["value"] = '"v2"'
+        f3 = fingerprint(dt.read_parquet(url)._builder.plan, cfg)
+        assert f3.key != f1.key
+        assert f3.structure == f1.structure
+        # the admission-history key ignores version tokens entirely
+        from daft_tpu.serving.scheduler import _history_key_from_fp
+        assert _history_key_from_fp(f3) == _history_key_from_fp(f1)
+        # no version signal at all → uncacheable, caches bypassed
+        etag["send"] = False
+        assert fingerprint(dt.read_parquet(url)._builder.plan, cfg) is None
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ------------------------------------------------------------- aggregate
+
+def test_gauges_scale_signal_and_gossip_round(fleet, parquet_table):
+    router, reps = fleet
+    h = router.submit(_agg_query(parquet_table), session="g")
+    h.result(60)
+    g = router.gauges()
+    agg = g["aggregate"]
+    assert agg["replicas"] == 3 and agg["replicas_admitting"] == 3
+    assert agg["concurrency"] == sum(
+        r.gauges()["concurrency"] for r in reps)
+    sig = router.scale_signal()
+    assert 1 <= sig["desired_replicas"] <= 4
+    # pull-merge-push: every replica ends up holding every origin
+    router.gossip_round()
+    for r in reps:
+        assert set(r.store.origins()) == {"r0", "r1", "r2"}
+    from daft_tpu.analysis import lock_sanitizer
+    if lock_sanitizer.is_enabled():
+        assert int(lock_sanitizer.counters_snapshot()
+                   .get("graph_cycles", 0)) == 0
+
+
+def test_scheduler_release_session_cancels_queued():
+    """Router handoff path: releasing a session finishes its queued
+    handles as cancelled and drops the session queue."""
+    sched = QueryScheduler(concurrency=1, queue_timeout_s=60.0)
+    try:
+        gate, started = threading.Event(), threading.Event()
+        blocker = sched.submit(_gated_query(gate, started), session="keep")
+        assert started.wait(20)
+        queued = sched.submit(mkdf({"a": [1]}).select(col("a")),
+                              session="gone")
+        assert sched.release_session("gone") is True
+        with pytest.raises(QueryCancelled):
+            queued.result(10)
+        assert queued.state == "cancelled"
+        assert sched.release_session("gone") is False  # already gone
+        gate.set()
+        blocker.result(60)
+        assert sched.admission.outstanding == 0
+    finally:
+        sched.shutdown()

@@ -119,11 +119,14 @@ def test_null_keys_never_match_all_join_types(tiny_budget, monkeypatch):
 def test_copartitioned_pair_skew_guard(tiny_budget):
     """The statically co-partitioned (exchange-fed) join re-partitions
     an oversized partition pair instead of joining it whole."""
+    # the LEFT side alone trips the pair budget (5 hot keys x 12k rows
+    # per key >> 100KB); the right stays tiny so the joined output is
+    # 600k rows, not 180M — the guard keys on pair INPUT bytes
     n = 60_000
     left = daft.from_pydict({"k": [i % 5 for i in range(n)],
                              "v": list(range(n))}).repartition(4, "k")
-    right = daft.from_pydict({"k": [i % 5 for i in range(n // 4)],
-                              "w": list(range(n // 4))}).repartition(4, "k")
+    right = daft.from_pydict({"k": [i % 5 for i in range(50)],
+                              "w": list(range(50))}).repartition(4, "k")
     b0 = memory.spill_counters_snapshot()
     out = left.join(right, on="k", strategy="hash").groupby("k") \
         .agg(col("v").count()).sort("k").to_pydict()

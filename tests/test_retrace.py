@@ -278,6 +278,11 @@ def test_warmup_kernels_compiles_grid():
 def test_warmup_fragments_and_session(monkeypatch):
     from daft_tpu.device import fragment, warmup
     monkeypatch.setenv("DAFT_TPU_DEVICE_FORCE", "1")
+    # fresh fragment library: mid-suite, the shared cache holds every
+    # fused program earlier test files compiled, and the session sweep
+    # below would AOT-recompile ALL of them x size classes x strategies
+    # (minutes of XLA time that tests nothing this test doesn't)
+    monkeypatch.setattr(fragment, "_fused_cache", {})
     # populate the fragment library with one program
     data = {"wu_k": [j % 3 for j in range(50)],
             "wu_v": [float(j) for j in range(50)]}
