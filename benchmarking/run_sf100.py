@@ -6,21 +6,60 @@ single pass, stated as such in the artifact). Results append to the
 output JSON after EVERY query so a crash or timeout still leaves a
 usable partial record.
 
+r23: memory-governed. ``DAFT_TPU_MEMORY_LIMIT`` arms the process-wide
+governor (execution/governor.py) — RSS watermarks back-pressure scan
+prefetch and shrink spill fanout before the OS OOMs — and every query's
+record carries its spill bytes (logical + post-codec disk), recursion
+depth, governor actions, replan count, strategy picks, and peak RSS.
+Skips are itemized, never silent: a query is recorded as
+``{"skipped": "budget", ...}`` when the wall-clock budget ran out or
+``{"skipped": "missing_table", ...}`` when its input isn't generated,
+so partial-coverage runs state exactly what they didn't cover.
+
 Usage:
     DAFT_TPU_MEMORY_LIMIT=64GB python -m benchmarking.run_sf100 \
-        [--data .cache/tpch_sf100.0_v2] [--out benchmarking/results/...]
+        [--data .cache/tpch_sf100.0_v2] [--out benchmarking/results/...] \
+        [--budget-s 7200]
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
-import resource
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+#: input tables per TPC-H query — the missing-table itemizer's map
+QUERY_TABLES = {
+    "q1": ["lineitem"],
+    "q2": ["part", "supplier", "partsupp", "nation", "region"],
+    "q3": ["customer", "orders", "lineitem"],
+    "q4": ["orders", "lineitem"],
+    "q5": ["customer", "orders", "lineitem", "supplier", "nation",
+           "region"],
+    "q6": ["lineitem"],
+    "q7": ["supplier", "lineitem", "orders", "customer", "nation"],
+    "q8": ["part", "supplier", "lineitem", "orders", "customer",
+           "nation", "region"],
+    "q9": ["part", "supplier", "lineitem", "partsupp", "orders",
+           "nation"],
+    "q10": ["customer", "orders", "lineitem", "nation"],
+    "q11": ["partsupp", "supplier", "nation"],
+    "q12": ["orders", "lineitem"],
+    "q13": ["customer", "orders"],
+    "q14": ["lineitem", "part"],
+    "q15": ["supplier", "lineitem"],
+    "q16": ["partsupp", "part", "supplier"],
+    "q17": ["lineitem", "part"],
+    "q18": ["customer", "orders", "lineitem"],
+    "q19": ["lineitem", "part"],
+    "q20": ["supplier", "nation", "partsupp", "part", "lineitem"],
+    "q21": ["supplier", "lineitem", "orders", "nation"],
+    "q22": ["customer", "orders"],
+}
 
 
 def main():
@@ -28,9 +67,12 @@ def main():
     ap.add_argument("--data", default=os.path.join(
         REPO, ".cache", "tpch_sf100.0_v2"))
     ap.add_argument("--out", default=os.path.join(
-        REPO, "benchmarking", "results", "r4_sf100_host.json"))
+        REPO, "benchmarking", "results", "r23_sf100_host.json"))
     ap.add_argument("--queries", default=",".join(
         f"q{i}" for i in range(1, 23)))
+    ap.add_argument("--budget-s", type=float, default=0.0,
+                    help="wall-clock budget; 0 = unbounded. Queries past "
+                         "it are itemized as skipped, not dropped.")
     ap.add_argument("--note", default="")
     args = ap.parse_args()
 
@@ -43,8 +85,11 @@ def main():
     import jax
     if os.environ.get("DAFT_TPU_DEVICE") == "0":
         jax.config.update("jax_platforms", "cpu")
-    from benchmarking.tpch import queries as Q
     import daft_tpu as dt
+    from benchmarking.tpch import queries as Q
+
+    import bench as _bench
+    from daft_tpu.execution import governor as gov
 
     def get_df(name):
         return dt.read_parquet(os.path.join(args.data, name, "*.parquet"))
@@ -54,27 +99,61 @@ def main():
         "note": args.note or (
             "single box, host tier, push executor, cold single-pass per "
             "query (no hot rerun at this scale); chunked spec-conformant "
-            "datagen v2"),
+            "datagen v2; memory-governed (r23): spill fast path + "
+            "RSS-watermark backpressure"),
         "memory_limit": os.environ.get("DAFT_TPU_MEMORY_LIMIT"),
+        "governor": {"enabled": gov.enabled(),
+                     "watermarks": list(gov.watermarks())},
         "scale_factor": 100,
+        "budget_s": args.budget_s or None,
         "per_query_s": {},
+        "per_query": {},
         "total_s": 0.0,
     }
 
+    present = {t for t in set(sum(QUERY_TABLES.values(), []))
+               if os.path.isdir(os.path.join(args.data, t))}
+    t_start = time.time()
+    maxrss = 0
     for qn in args.queries.split(","):
+        missing = [t for t in QUERY_TABLES.get(qn, []) if t not in present]
+        if missing:
+            doc["per_query_s"][qn] = {"skipped": "missing_table",
+                                      "tables": missing}
+            print(f"{qn}: SKIP missing {missing}", file=sys.stderr,
+                  flush=True)
+            continue
+        if args.budget_s:
+            remaining = args.budget_s - (time.time() - t_start)
+            if remaining < 0:
+                doc["per_query_s"][qn] = {
+                    "skipped": "budget",
+                    "remaining_s": round(remaining, 1)}
+                print(f"{qn}: SKIP budget", file=sys.stderr, flush=True)
+                continue
+        s0 = _bench._rich_counters_start()
         t0 = time.time()
         try:
             out = getattr(Q, qn)(get_df).to_pydict()
             dt_s = round(time.time() - t0, 3)
+            rec = _bench._rich_counters_finish(s0)
+            rec["wall_s"] = dt_s
             doc["per_query_s"][qn] = dt_s
+            doc["per_query"][qn] = rec
             doc["total_s"] = round(doc["total_s"] + dt_s, 3)
             rows = len(next(iter(out.values()))) if out else 0
-            print(f"{qn}: {dt_s}s rows={rows}", file=sys.stderr, flush=True)
+            print(f"{qn}: {dt_s}s rows={rows} "
+                  f"rss_peak={rec['rss_peak_bytes'] >> 20}MB",
+                  file=sys.stderr, flush=True)
         except Exception as exc:
             doc["per_query_s"][qn] = {"error": str(exc)[:300]}
             print(f"{qn}: FAIL {exc}", file=sys.stderr, flush=True)
-        doc["maxrss_gb"] = round(
-            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2)
+        # the per-query bookends reset the peak, so the run-wide max is
+        # accumulated here, not read once at the end
+        maxrss = max(maxrss, gov.peak_rss_bytes())
+        doc["maxrss_gb"] = round(maxrss / 1e9, 2)
+        doc["governor_totals"] = {
+            k: int(v) for k, v in sorted(gov.counters_snapshot().items())}
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=1)
     print(json.dumps({"total_s": doc["total_s"],

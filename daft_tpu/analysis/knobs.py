@@ -297,6 +297,34 @@ _KNOBS: List[Knob] = [
        "its budget; exhaustion (an unsplittable all-duplicate key) falls "
        "through to an in-memory merge, counted in `depth_exhausted`",
        config_field="tpu_spill_max_depth"),
+    _k("DAFT_TPU_SPILL_COMPRESSION", "str", None,
+       "daft_tpu/execution/memory.py", "spill",
+       "spill-file Arrow IPC buffer codec: `lz4` | `zstd` | `none`; "
+       "unset inherits the shuffle plane's "
+       "`DAFT_TPU_SHUFFLE_COMPRESSION` (default `lz4`); readers are "
+       "self-describing, so mixed-codec spill dirs always read back",
+       config_field="tpu_spill_compression", default_str="inherit"),
+    _k("DAFT_TPU_SPILL_IO_PARALLELISM", "int", 4,
+       "daft_tpu/execution/spill_io.py", "spill",
+       "concurrent spill write/read tasks on the bounded spill-IO pool "
+       "(writes chain per bucket, so push order is preserved); `0` "
+       "restores the serial r19 path, which chaos serialize / an active "
+       "fault plan also force", config_field="tpu_spill_io_parallelism"),
+    _k("DAFT_TPU_GOVERNOR", "bool", True,
+       "daft_tpu/execution/governor.py", "spill",
+       "`0` disables the memory governor (RSS-watermark backpressure: "
+       "budget/prefetch shrinks + bounded throttles); inert anyway "
+       "without `DAFT_TPU_MEMORY_LIMIT` or under the chaos freeze"),
+    _k("DAFT_TPU_GOVERNOR_HIGH", "float", 0.85,
+       "daft_tpu/execution/governor.py", "spill",
+       "RSS fraction of the memory limit that enters the pressured "
+       "state (governor actions engage)",
+       config_field="tpu_governor_high"),
+    _k("DAFT_TPU_GOVERNOR_LOW", "float", 0.70,
+       "daft_tpu/execution/governor.py", "spill",
+       "RSS fraction of the memory limit that clears the pressured "
+       "state — the hysteresis floor, clamped below the high watermark",
+       config_field="tpu_governor_low"),
     # ------------------------------------------------------- io-scan
     _k("DAFT_TPU_IO_COALESCE_GAP", "bytes", 1 << 20,
        "daft_tpu/io/read_planner.py", "io-scan",

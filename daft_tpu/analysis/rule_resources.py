@@ -141,6 +141,17 @@ CONTRACTS: Tuple[Contract, ...] = (
              "directory until GC",
     ),
     Contract(
+        rule="spill-writer-pool-leak", style="object", mode="all",
+        acquire=("SpillWriterGroup",),
+        release=("drain", "close"),
+        defining=("daft_tpu/execution/spill_io.py",
+                  "daft_tpu/execution/memory.py"),
+        hint="drain() (raising — finalize paths) or close() (no-raise "
+             "cleanup) the writer group on every exit path, or store it "
+             "on the spill store that closes it — an abandoned group "
+             "leaves chained writes racing the store's file deletion",
+    ),
+    Contract(
         rule="collective-lease-leak", style="event", mode="all",
         acquire=("acquire_collective",), release=("release_collective",),
         defining=("daft_tpu/distributed/topology.py",),

@@ -94,6 +94,14 @@ class ExecutionConfig:
     tpu_spill_agg: str = "auto"              # auto|1 (force)|0 (decline)
     tpu_spill_partitions: int = 0            # 0 → planner evidence decides
     tpu_spill_max_depth: int = 3             # rotated-radix recursion bound
+    # spill-plane fast path + memory governor (round 23,
+    # execution/spill_io.py / execution/governor.py). Field names spell
+    # the documented knobs (DAFT_TPU_SPILL_COMPRESSION, …); env is the
+    # per-process override.
+    tpu_spill_compression: str = ""          # ""→inherit shuffle codec
+    tpu_spill_io_parallelism: int = 4        # 0 → serial r19 write path
+    tpu_governor_high: float = 0.85          # pressured above this × limit
+    tpu_governor_low: float = 0.70           # …until RSS falls below this
     # self-tuning feedback loops (round 20): distributed runtime
     # re-planning (distributed/replan.py) and the calibrated cost-model
     # profile (device/calibration.py). Field names spell the documented

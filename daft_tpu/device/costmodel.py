@@ -1009,7 +1009,20 @@ def spill_plan_wins(nbytes: float, resident_budget: float) -> bool:
     partitioned plan pays one extra IPC write+read of the overflow it
     would have spilled — zero when everything stayed resident — so small
     inputs keep the whole-input single join/merge. Logged under
-    ``spill_plan`` ("device" = partitioned plan chosen)."""
+    ``spill_plan`` ("device" = partitioned plan chosen).
+
+    Pressure-aware (r23): under governor memory pressure the resident
+    budget this decision prices against halves — a gather that fits on
+    paper is still the wrong plan when the PROCESS is already at its
+    high watermark, so borderline inputs flip to the partitioned plan
+    early. Inert when the governor is (no limit / chaos freeze)."""
+    try:
+        from ..execution import governor
+        scale = governor.budget_scale()
+        if scale != 1.0:
+            resident_budget = resident_budget * scale
+    except Exception:
+        pass
     agg_s = nbytes / HOST_AGG_BPS
     if nbytes > resident_budget:
         part_s = agg_s + 2.0 * (nbytes - resident_budget) / SPILL_DISK_BPS

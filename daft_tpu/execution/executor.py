@@ -322,8 +322,14 @@ class LocalExecutor:
             if st.dead.is_set():  # consumer gone before we even started
                 return
             from .. import tracing
+            from . import governor
             t0 = _time.perf_counter()
             est = task.size_bytes() or 0
+            # governor backpressure BEFORE admission: a bounded throttle
+            # (never a gate — it times out) that slows the producers down
+            # while process RSS sits above the high watermark, so
+            # prefetched bytes stop arriving before the OS OOMs
+            governor.throttle("scan_prefetch")
             # producer span keyed by the deterministic task index; the
             # producer thread carries the query's span context through
             # the same attribution the io counters ride
@@ -384,9 +390,17 @@ class LocalExecutor:
             rp.scan_count("prefetch_tasks")
             return True
 
-        for _ in range(window + 1):
-            if not submit():
-                break
+        from . import governor
+
+        def refill():
+            # fill to the governor's CURRENT window (≤ the configured
+            # one): under memory pressure in-flight prefetch narrows to
+            # one task ahead, and widens back out once RSS recovers
+            while len(inflight) < governor.prefetch_window(window) + 1:
+                if not submit():
+                    return
+
+        refill()
         current = None
         try:
             while inflight:
@@ -400,7 +414,7 @@ class LocalExecutor:
                     else:
                         break
                 current = None
-                submit()
+                refill()
         finally:
             # an abandoned consumer (early limit, downstream error) must
             # unblock every producer — including the one being drained

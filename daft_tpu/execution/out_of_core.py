@@ -105,8 +105,14 @@ def pair_budget_bytes(budget: Optional[int] = None) -> int:
     bucket) may occupy: a quarter of the breaker budget — both sides plus
     the join output must coexist with the stores' own buffers. The floor
     is deliberately tiny so forced-small test budgets exercise real
-    recursion."""
+    recursion. Under governor memory pressure the budget halves
+    (``budget_scale``): smaller resident work units are exactly how the
+    spill tier gives RSS back."""
+    from . import governor
     b = budget if budget is not None else memory.breaker_budget_bytes()
+    scale = governor.budget_scale()
+    if scale != 1.0:
+        b = int(b * scale)
     return max(b // 4, 16 << 10)
 
 
@@ -279,13 +285,23 @@ def grace_hash_join(ex, node) -> Iterator[MicroPartition]:
             return
         memory.spill_count("joins_partitioned")
 
-        def pairs():
-            for i in range(n):
-                yield (lstore.bucket_batches(i), rstore.bucket_batches(i))
+        # prefetch-pipelined bucket reads (r23): pair i+1's IPC decode
+        # resolves on the spill pool while pair i joins — the read-side
+        # half of the spill fast path; window 0 (chaos / serial knob)
+        # degrades to in-line reads verbatim
+        from . import spill_io
+
+        def read_pair(i):
+            return lambda: (lstore.bucket_batches(i),
+                            rstore.bucket_batches(i))
+
+        pairs = spill_io.prefetch_ordered(
+            (read_pair(i) for i in range(n)),
+            spill_io.read_prefetch_window(cfg))
 
         from .executor import _ordered_parallel
         for outs in _ordered_parallel(
-                pairs(),
+                pairs,
                 lambda lr: _join_pair(ex.mem, lr[0], lr[1], node,
                                       lnode.schema(), rnode.schema(),
                                       0, depth_max, pair_b,

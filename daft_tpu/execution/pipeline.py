@@ -521,7 +521,7 @@ class PushExecutor(LocalExecutor):
 
         def spill_reducer(i):
             from ..expressions import col as _col
-            from . import memory, out_of_core as ooc
+            from . import memory, out_of_core as ooc, spill_io
             skeys = [_col(g.name()) for g in node.group_by]
             m = ooc.agg_state_fanout(est_state, k, self.cfg)
             depth_max = ooc.spill_max_depth(self.cfg)
@@ -553,8 +553,12 @@ class PushExecutor(LocalExecutor):
                         flush()
                 flush()
                 store.finalize()
-                for j in range(m):
-                    batches = store.bucket_batches(j)
+                # bucket reads prefetch-pipelined like the grace join's
+                # pair reads: bucket j+1 decodes while j merges
+                for batches in spill_io.prefetch_ordered(
+                        (lambda j=j: store.bucket_batches(j)
+                         for j in range(m)),
+                        spill_io.read_prefetch_window(self.cfg)):
                     if not batches:
                         continue
                     for state in ooc.merge_spilled_agg_bucket(

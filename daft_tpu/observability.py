@@ -186,6 +186,17 @@ def _adaptive_raw() -> Dict[str, float]:
         return {}
 
 
+def _governor_raw() -> Dict[str, float]:
+    """Raw snapshot of the memory-governor action counters (pressure
+    episodes, throttle waits, budget/prefetch shrinks, gc collections)
+    — never raises, like the device ledger."""
+    try:
+        from .execution import governor
+        return governor.counters_snapshot()
+    except Exception:
+        return {}
+
+
 def _sanitizer_raw() -> Dict[str, float]:
     """Raw snapshot of the lock-order sanitizer counters (acquisitions,
     contended acquisitions, blocking-while-held events) — empty unless
@@ -330,6 +341,11 @@ class RuntimeStatsContext:
         # observations + runtime re-plan decisions this query made
         self._adaptive0 = _adaptive_raw()
         self.adaptive: Dict[str, float] = {}
+        # …and the memory governor (round 23): pressure actions taken
+        # while this query ran, plus the process peak RSS at finish —
+        # the bounded-RSS evidence the scale bench commits per query
+        self._governor0 = _governor_raw()
+        self.governor: Dict[str, float] = {}
         # …and for the lock-order sanitizer (DAFT_TPU_SANITIZE=1):
         # per-query acquisition/contention deltas + current graph size
         self._sanitizer0 = _sanitizer_raw()
@@ -411,6 +427,7 @@ class RuntimeStatsContext:
             self.io = self._plane("io")
             self.spill = self._plane("spill")
             self.adaptive = self._plane("adaptive")
+            self.governor = self._plane("governor")
         else:
             try:
                 from .distributed import resilience
@@ -442,6 +459,24 @@ class RuntimeStatsContext:
                     self._adaptive0, _adaptive_raw())
             except Exception:
                 self.adaptive = {}
+            try:
+                from .execution import governor
+                self.governor = governor.counters_delta(
+                    self._governor0, _governor_raw())
+            except Exception:
+                self.governor = {}
+        # RSS gauges ride the governor block regardless of attribution:
+        # peak RSS is process state (like the sanitizers), not traffic —
+        # the scale bench's bounded-RSS gate reads it per query
+        try:
+            from .execution import governor
+            self.governor["rss_peak_bytes"] = float(
+                governor.peak_rss_bytes())
+            lim = governor.limit_bytes()
+            if lim:
+                self.governor["rss_limit_bytes"] = float(lim)
+        except Exception:
+            pass
         # process-wide diff regardless of attribution: the program cache
         # is shared engine state (like the sanitizers), not per-thread
         # traffic — concurrent queries legitimately share its hits
@@ -562,6 +597,7 @@ class RuntimeStatsContext:
         lines.extend(render_adaptive_block(self.adaptive))
         lines.extend(render_io_block(self.io))
         lines.extend(render_spill_block(self.spill))
+        lines.extend(render_governor_block(self.governor))
         lines.extend(render_sanitizer_block(self.sanitizer))
         lines.extend(render_retrace_block(self.retrace))
         lines.extend(render_serving_block(self.serving))
@@ -727,6 +763,44 @@ def render_spill_block(d: Dict[str, float]) -> List[str]:
         lines.append(
             f"  resident: ≤{_fmt_bytes(d.get('store_peak_bytes', 0))} "
             f"summed peak across {ns} spilling store(s)")
+    disk_w = d.get("disk_bytes_written", 0)
+    if disk_w and written:
+        # post-codec file bytes vs logical bytes: the spill codec's
+        # measured on-disk win (r23 fast path)
+        lines.append(
+            f"  codec: {_fmt_bytes(disk_w)} on disk "
+            f"({written / disk_w:.2f}x compression)")
+    return lines
+
+
+def render_governor_block(d: Dict[str, float]) -> List[str]:
+    """Human lines for one query's memory-governor delta (shared by
+    ``explain(analyze=True)`` and the dashboard): the backpressure
+    actions taken while the query ran (pressure episodes, bounded
+    throttle waits, budget/prefetch shrinks, gc passes) and the process
+    peak RSS against the configured limit — the bounded-RSS evidence
+    the scale bench commits per query."""
+    peak = d.get("rss_peak_bytes", 0)
+    lim = d.get("rss_limit_bytes", 0)
+    actions = {k: v for k, v in d.items()
+               if k not in ("rss_peak_bytes", "rss_limit_bytes") and v}
+    if not actions and not (peak and lim):
+        return []
+    lines = ["memory governor:"]
+    if peak:
+        vs = f" vs limit {_fmt_bytes(lim)}" if lim else ""
+        lines.append(f"  rss: peak {_fmt_bytes(peak)}{vs}")
+    if actions:
+        waits = int(actions.pop("throttle_waits", 0))
+        wait_us = actions.pop("throttle_wait_us", 0)
+        if waits:
+            lines.append(f"  throttle: {waits} bounded wait(s), "
+                         f"{wait_us / 1e6:.2f}s total")
+        rest = {k: int(v) for k, v in sorted(actions.items())
+                if not k.startswith("throttle_")}
+        if rest:
+            lines.append("  actions: " + ", ".join(
+                f"{k}={v}" for k, v in rest.items()))
     return lines
 
 
@@ -1012,8 +1086,8 @@ def flight_entry(ctx: RuntimeStatsContext) -> dict:
         "operators": ctx.as_dict(),
     }
     for block in ("recovery", "shuffle", "exchange", "io", "spill",
-                  "adaptive", "device_kernels", "serving", "sanitizer",
-                  "retrace"):
+                  "governor", "adaptive", "device_kernels", "serving",
+                  "sanitizer", "retrace"):
         v = getattr(ctx, block, None)
         if v:
             entry[block] = dict(v)

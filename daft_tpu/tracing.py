@@ -633,6 +633,22 @@ def prometheus_text() -> str:
     except Exception:
         pass
     try:
+        from .execution import governor
+        plane("governor", governor.counters_snapshot(),
+              "memory-governor backpressure action counter")
+        snap = governor.snapshot()
+        emit("daft_tpu_rss_bytes", snap["rss_bytes"], "gauge",
+             "current process resident set size")
+        emit("daft_tpu_rss_peak_bytes", snap["rss_peak_bytes"], "gauge",
+             "peak process resident set size since start/reset")
+        if snap["limit_bytes"]:
+            emit("daft_tpu_memory_limit_bytes", snap["limit_bytes"],
+                 "gauge", "configured DAFT_TPU_MEMORY_LIMIT budget")
+        emit("daft_tpu_governor_pressured", snap["pressured"], "gauge",
+             "1 while RSS sits inside the governor's hysteresis band")
+    except Exception:
+        pass
+    try:
         from .distributed import resilience
         plane("recovery", resilience.counters_snapshot(),
               "resilience recovery counter")
