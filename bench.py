@@ -536,6 +536,38 @@ def _canon_rows(d: dict):
                   for row in zip(*(d[c] for c in cols)))
 
 
+def run_fuzz_smoke() -> int:
+    """``--fuzz-smoke``: the plan-discipline CI gate. Runs the
+    differential plan fuzzer (seeded random queries; every engine mode
+    matrix — optimized / fused / spilled / replanned / combined — must
+    answer bit-identically to the unoptimized reference) with the plan
+    sanitizer armed, and emits seeds-run / mismatch / sanitizer-
+    violation counts. Exit 1 on any mismatch, error, or contract
+    violation."""
+    os.environ.setdefault("DAFT_TPU_SANITIZE_PLAN", "1")
+    from daft_tpu.analysis import plan_fuzzer, plan_sanitizer
+    if plan_sanitizer.enabled_by_env() and not plan_sanitizer.is_enabled():
+        plan_sanitizer.enable()
+    res = plan_fuzzer.run_fuzz(log=print)
+    s = res.summary()
+    detail = dict(s)
+    detail["modes"] = list(plan_fuzzer.MODES)
+    for m in res.mismatches:
+        print("plan fuzzer MISMATCH\n" + m.repro())
+    for e in res.errors:
+        print(f"plan fuzzer error: {e}")
+    if plan_sanitizer.is_enabled():
+        print(plan_sanitizer.report())
+    print(json.dumps({"fuzz_smoke": detail}), flush=True)
+    ok = not (res.mismatches or res.errors or res.sanitizer_violations)
+    print(f"fuzz smoke: {s['seeds_run']} seeds, "
+          f"{s['cases_compared']} comparisons, "
+          f"{s['mismatches']} mismatches, "
+          f"{s['sanitizer_violations']} sanitizer violations -> "
+          + ("OK" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
 def run_scale_smoke() -> int:
     """``--scale-smoke``: the out-of-core CI gate. The FULL 22-query
     TPC-H suite at a small SF under a forced-tiny memory limit (every
@@ -3328,6 +3360,11 @@ if __name__ == "__main__":
         _fusion_child()
     elif "--warmup-child" in sys.argv:
         _warmup_child()
+    elif "--fuzz-smoke" in sys.argv:
+        # CI gate: differential plan fuzzer across all engine mode
+        # matrices with the plan sanitizer armed — any mismatch vs the
+        # unoptimized reference or plan-contract violation exits 1
+        sys.exit(run_fuzz_smoke())
     elif "--scale-smoke" in sys.argv:
         # CI gate: forced-spill full 22-query suite at a small SF under
         # the sanitizer — wrong answers, RSS past the ceiling, leaked

@@ -17,6 +17,12 @@ if _knobs.env_bool("DAFT_TPU_SANITIZE"):
     from .analysis import retrace_sanitizer as _retrace_sanitizer
     if _retrace_sanitizer.enabled_by_env():
         _retrace_sanitizer.enable()
+# the plan sanitizer hooks the optimizer loop and executor node streams
+# (no factory patching), so it arms on its own knob independent of the
+# DAFT_TPU_SANITIZE umbrella
+from .analysis import plan_sanitizer as _plan_sanitizer
+if _plan_sanitizer.enabled_by_env():
+    _plan_sanitizer.enable()
 
 from .datatype import DataType, ImageFormat, ImageMode, TimeUnit
 from .expressions import (

@@ -39,6 +39,16 @@ def main(argv=None) -> int:
                     help="print the generated knob tables and exit")
     ap.add_argument("--write", action="store_true",
                     help="with --knob-docs: rewrite README generated blocks")
+    ap.add_argument("--fuzz", action="store_true",
+                    help="run the differential plan fuzzer instead of the "
+                         "static rules: seeded random queries, every "
+                         "engine mode matrix vs the unoptimized reference")
+    ap.add_argument("--seeds", type=int, default=None, metavar="N",
+                    help="with --fuzz: number of seeds (default "
+                         "DAFT_TPU_FUZZ_COUNT)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="with --fuzz: base seed (default "
+                         "DAFT_TPU_FUZZ_SEED)")
     args = ap.parse_args(argv)
 
     # the dispatch-contract checks trace jaxprs; never touch a real TPU
@@ -48,6 +58,28 @@ def main(argv=None) -> int:
     from .framework import DEFAULT_SUBDIRS, repo_root, run_analysis
 
     root = repo_root()
+
+    if args.fuzz:
+        from . import plan_fuzzer
+        res = plan_fuzzer.run_fuzz(count=args.seeds, seed=args.seed,
+                                   log=print)
+        s = res.summary()
+        if args.json:
+            print(json.dumps({**s, "mismatches_detail": [
+                {"seed": m.seed, "mode": m.mode, "ops": [list(o) for o in
+                 m.ops], "detail": m.detail} for m in res.mismatches]},
+                indent=2))
+        else:
+            for m in res.mismatches:
+                print("plan fuzzer MISMATCH\n" + m.repro())
+            for e in res.errors:
+                print(f"plan fuzzer error: {e}")
+            print(f"plan fuzzer: {s['seeds_run']} seeds, "
+                  f"{s['cases_compared']} comparisons, "
+                  f"{s['mismatches']} mismatches, {s['errors']} errors, "
+                  f"{s['sanitizer_violations']} sanitizer violations")
+        return 1 if (res.mismatches or res.errors
+                     or res.sanitizer_violations) else 0
 
     if args.knob_docs:
         if args.write:

@@ -67,7 +67,7 @@ def known_rules() -> Dict[str, Tuple[str, str]]:
     name a live rule), `--rule` filtering, and the JSON `family`/`hint`
     fields. New rule modules contribute via their ``RULE_IDS`` dict."""
     from . import (rule_attribution, rule_cancellation, rule_donation,
-                   rule_resources, rule_shapes)
+                   rule_plans, rule_resources, rule_shapes)
     out: Dict[str, Tuple[str, str]] = {
         # r10 families, single-sourced here (their modules predate the
         # registry); hints stay one line by policy
@@ -119,6 +119,7 @@ def known_rules() -> Dict[str, Tuple[str, str]]:
     out.update(rule_cancellation.RULE_IDS)
     out.update(rule_attribution.RULE_IDS)
     out.update(rule_shapes.RULE_IDS)
+    out.update(rule_plans.RULE_IDS)
     return out
 
 
@@ -265,7 +266,7 @@ def run_analysis(root: Optional[str] = None,
     analyzed, per-family finding counts)."""
     from . import (rule_attribution, rule_cancellation, rule_determinism,
                    rule_donation, rule_jit, rule_knobs, rule_locks,
-                   rule_resources, rule_shapes)
+                   rule_plans, rule_resources, rule_shapes)
 
     root = root or repo_root()
     sources = walk_sources(root, subdirs)
@@ -288,6 +289,9 @@ def run_analysis(root: Optional[str] = None,
     findings.extend(rule_cancellation.check(sources))
     findings.extend(rule_attribution.check(sources))
     findings.extend(rule_shapes.check(sources))
+    findings.extend(rule_plans.check(sources))
+    if contracts:
+        findings.extend(rule_plans.check_fusion_contracts())
 
     # pragma suppression (a pragma never suppresses the pragma rules)
     by_path = {sf.path: sf for sf in sources}

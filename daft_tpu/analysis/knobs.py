@@ -493,6 +493,33 @@ _KNOBS: List[Knob] = [
        "site's per-signature budget x this multiplier; budget "
        "violations fail the pytest session; `0` = off (no listener, "
        "allocation-free scopes)"),
+    _k("DAFT_TPU_SANITIZE_PLAN", "bool", False,
+       "daft_tpu/analysis/plan_sanitizer.py", "observability",
+       "`1` arms the runtime plan sanitizer: root-schema equality after "
+       "every optimizer rule application, sampled hash-partition "
+       "membership re-verification at exchange/spill boundaries, sort-"
+       "order checks after Sort/TopN, and row-count conservation where "
+       "the plan-contract registry declares it; violations fail the "
+       "pytest session and surface in `explain(analyze=True)`, the "
+       "flight recorder, and `/metrics`",
+       config_field="tpu_sanitize_plan"),
+    _k("DAFT_TPU_SANITIZE_PLAN_SAMPLE", "int", 64,
+       "daft_tpu/analysis/plan_sanitizer.py", "observability",
+       "rows sampled per boundary partition for the plan sanitizer's "
+       "membership/order re-verification (higher = stronger checks, "
+       "more re-hash work)",
+       config_field="tpu_sanitize_plan_sample"),
+    _k("DAFT_TPU_FUZZ_SEED", "int", 0,
+       "daft_tpu/analysis/plan_fuzzer.py", "observability",
+       "base seed of the differential plan fuzzer (`python -m "
+       "daft_tpu.analysis --fuzz`); seed i of a run derives "
+       "deterministically from it",
+       config_field="tpu_fuzz_seed"),
+    _k("DAFT_TPU_FUZZ_COUNT", "int", 50,
+       "daft_tpu/analysis/plan_fuzzer.py", "observability",
+       "how many fuzzer seeds a `--fuzz` run executes (each seed runs "
+       "the full engine-mode matrix and compares answers bit-for-bit)",
+       config_field="tpu_fuzz_count"),
     _k("DAFT_TPU_TRACE", "bool", False, "daft_tpu/tracing.py",
        "observability", "`1` enables the query-wide tracing plane: one "
        "span tree per query across scheduler/planner/device/pipeline/"

@@ -1,0 +1,461 @@
+"""daft-lint differential plan fuzzer (``python -m daft_tpu.analysis
+--fuzz``).
+
+Seeded, fully deterministic: each seed expands to a random relational
+program (join / filter / project / group-agg / distinct / sort / top-n)
+over a nullable multi-dtype schema, which is then executed through a
+matrix of engine modes and compared — bit-identical — against the
+*unoptimized* reference (the raw logical plan translated and run on the
+pull interpreter, no optimizer rules, no fusion, no spill planning):
+
+- ``optimized``   — the full optimizer + default native runner
+- ``fused``       — whole-region device compilation (``tpu_fusion=1``)
+- ``spilled``     — forced grace/spill join planning (``tpu_spill_join=1``)
+- ``replanned``   — the AQE loop + runtime replanning (``enable_aqe``,
+  ``tpu_adaptive``) instead of the static plan
+- ``combined``    — the distributed runner with map-side shuffle
+  combine forced on (``DAFT_TPU_SHUFFLE_COMBINE=1``)
+
+Result rows are canonicalized (row-sorted on a total normalization of
+every cell) before comparison, so legal row-order differences between
+modes never count as mismatches — value differences always do. Float
+aggregation is restricted to order-independent reductions (min/max;
+sums only over ints) so "bit-identical" is a sound oracle under
+re-partitioned addition orders.
+
+On mismatch the failing op chain is greedily minimized (drop ops while
+the mismatch persists) and reported with its seed — the repro is just
+``seed + ops`` because the tables regenerate deterministically.
+
+Knobs: ``DAFT_TPU_FUZZ_SEED`` (base seed), ``DAFT_TPU_FUZZ_COUNT``
+(seeds per run), both mirrored on ``ExecutionConfig``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import random
+from typing import Dict, List, Optional, Tuple
+
+from . import knobs
+
+MODES = ("optimized", "fused", "spilled", "replanned", "combined")
+
+_STRINGS = ("ant", "bee", "cat", "dog", "elk", "fox", None)
+
+
+def fuzz_seed_base() -> int:
+    v = knobs.env_int("DAFT_TPU_FUZZ_SEED", None)
+    if v is not None:
+        return int(v)
+    try:
+        from ..context import get_context
+        return int(get_context().execution_config.tpu_fuzz_seed)
+    except Exception:
+        return 0
+
+
+def fuzz_count() -> int:
+    v = knobs.env_int("DAFT_TPU_FUZZ_COUNT", None)
+    if v is not None:
+        return int(v)
+    try:
+        from ..context import get_context
+        return int(get_context().execution_config.tpu_fuzz_count)
+    except Exception:
+        return 50
+
+
+# ------------------------------------------------------------------ data
+
+
+def _gen_tables(rng: random.Random) -> Dict[str, Dict[str, list]]:
+    """Two deterministic base tables with disjoint column names (so any
+    join grammar is legal), every column nullable, keys low-cardinality
+    (so joins and group-bys actually collide)."""
+    nl = rng.randint(30, 120)
+    nr = rng.randint(10, 60)
+    left = {
+        "id": list(range(nl)),  # unique: total-order tiebreaker
+        "k": [rng.choice((None, 0, 1, 2, 3, 4, 5, 6, 7)) for _ in range(nl)],
+        "v": [rng.choice((None, rng.randint(-50, 50))) for _ in range(nl)],
+        "f": [rng.choice((None, round(rng.uniform(-5.0, 5.0), 3)))
+              for _ in range(nl)],
+        "s": [rng.choice(_STRINGS) for _ in range(nl)],
+        "b": [rng.choice((None, True, False)) for _ in range(nl)],
+    }
+    right = {
+        "rk": [rng.choice((None, 0, 1, 2, 3, 4, 5, 6, 7))
+               for _ in range(nr)],
+        "w": [rng.choice((None, rng.randint(0, 20))) for _ in range(nr)],
+        "g": [rng.choice((None, round(rng.uniform(0.0, 9.0), 3)))
+              for _ in range(nr)],
+    }
+    return {"left": left, "right": right}
+
+
+# ------------------------------------------------------------- op algebra
+
+
+def _apply_op(df, right_df, op):
+    """Replay one concrete op spec onto a DataFrame. Specs are plain
+    tuples (picklable, printable) so a repro is ``seed + ops``."""
+    from .. import col
+    kind = op[0]
+    if kind == "join":
+        return df.join(right_df, left_on="k", right_on="rk", how=op[1])
+    if kind == "filter":
+        _, name, cmp, const = op
+        e = col(name)
+        e = {"gt": e > const, "lt": e < const, "ge": e >= const,
+             "le": e <= const, "eq": e == const}[cmp]
+        return df.where(e)
+    if kind == "filter_null":
+        _, name, keep_null = op
+        e = col(name).is_null()
+        return df.where(e if keep_null else ~e)
+    if kind == "project":
+        _, names, computed = op
+        exprs = [col(n) for n in names]
+        if computed is not None:
+            exprs.append((col(computed) * 2 + 1).alias(computed + "_x2"))
+        return df.select(*exprs)
+    if kind == "groupby":
+        _, keys, aggs = op
+        exprs = []
+        for fn, name in aggs:
+            e = col(name)
+            e = {"sum": e.sum, "min": e.min, "max": e.max,
+                 "count": e.count}[fn]()
+            exprs.append(e.alias(f"{fn}_{name}"))
+        return df.groupby(*keys).agg(*exprs)
+    if kind == "distinct":
+        return df.distinct()
+    if kind == "sort":
+        _, names, descs = op
+        return df.sort(list(names), desc=list(descs))
+    if kind == "topn":
+        _, n, names, descs = op
+        return df.sort(list(names), desc=list(descs)).limit(n)
+    raise ValueError(f"unknown op {op!r}")
+
+
+def build_df(tables: Dict[str, Dict[str, list]], ops: List[tuple]):
+    import daft_tpu as dt
+    df = dt.from_pydict(tables["left"])
+    right = dt.from_pydict(tables["right"])
+    for op in ops:
+        df = _apply_op(df, right, op)
+    return df
+
+
+def _cols_by_kind(df) -> Dict[str, List[str]]:
+    out: Dict[str, List[str]] = {"i": [], "f": [], "s": [], "b": []}
+    for field in df.schema():
+        t = str(field.dtype).lower()
+        if "bool" in t:
+            out["b"].append(field.name)
+        elif "int" in t:
+            out["i"].append(field.name)
+        elif "float" in t or "double" in t:
+            out["f"].append(field.name)
+        elif "utf8" in t or "str" in t:
+            out["s"].append(field.name)
+    return out
+
+
+def gen_case(seed: int) -> Tuple[Dict[str, Dict[str, list]], List[tuple]]:
+    """Expand one seed into (tables, op chain). Every candidate op is
+    validated against the live schema as it is appended — an op the
+    schema can't host is simply skipped, keeping generation total."""
+    rng = random.Random(seed * 2654435761 % (2 ** 31))
+    tables = _gen_tables(rng)
+    ops: List[tuple] = []
+
+    def try_push(op, df):
+        try:
+            nxt = _apply_op(df, _right, op)
+            nxt.schema()  # force plan-time validation
+        except Exception:
+            return df
+        ops.append(op)
+        return nxt
+
+    import daft_tpu as dt
+    df = dt.from_pydict(tables["left"])
+    _right = dt.from_pydict(tables["right"])
+
+    if rng.random() < 0.65:
+        df = try_push(("join",
+                       rng.choice(("inner", "left", "semi", "anti"))), df)
+
+    for _ in range(rng.randint(0, 3)):
+        kinds = _cols_by_kind(df)
+        num = kinds["i"] + kinds["f"]
+        if num and rng.random() < 0.8:
+            name = rng.choice(num)
+            cmp = rng.choice(("gt", "lt", "ge", "le", "eq"))
+            const = (rng.randint(-10, 10) if name in kinds["i"]
+                     else round(rng.uniform(-5.0, 5.0), 2))
+            df = try_push(("filter", name, cmp, const), df)
+        else:
+            anyc = [c for v in kinds.values() for c in v]
+            if anyc:
+                df = try_push(("filter_null", rng.choice(anyc),
+                               rng.random() < 0.3), df)
+
+    if rng.random() < 0.5:
+        kinds = _cols_by_kind(df)
+        anyc = [c for v in kinds.values() for c in v]
+        if len(anyc) >= 2:
+            keep = rng.sample(anyc, rng.randint(1, len(anyc) - 1))
+            num = [c for c in kinds["i"] + kinds["f"] if c not in keep]
+            computed = rng.choice(num) if num and rng.random() < 0.6 \
+                else None
+            df = try_push(("project", sorted(keep), computed), df)
+
+    roll = rng.random()
+    if roll < 0.4:
+        kinds = _cols_by_kind(df)
+        keyable = kinds["i"] + kinds["s"] + kinds["b"]
+        if keyable:
+            keys = rng.sample(keyable, min(len(keyable),
+                                           rng.randint(1, 2)))
+            aggs = []
+            for c in kinds["i"]:
+                if c not in keys and rng.random() < 0.7:
+                    aggs.append((rng.choice(("sum", "min", "max",
+                                             "count")), c))
+            for c in kinds["f"]:
+                # floats: order-independent reductions only, so the
+                # bit-identical oracle survives re-partitioned addition
+                if c not in keys and rng.random() < 0.7:
+                    aggs.append((rng.choice(("min", "max", "count")), c))
+            if aggs:
+                df = try_push(("groupby", sorted(keys), aggs), df)
+    elif roll < 0.55:
+        df = try_push(("distinct",), df)
+
+    kinds = _cols_by_kind(df)
+    anyc = sorted(c for v in kinds.values() for c in v)
+    if anyc and rng.random() < 0.6:
+        if rng.random() < 0.5:
+            by = rng.sample(anyc, min(len(anyc), rng.randint(1, 2)))
+            df = try_push(("sort", by,
+                           [rng.random() < 0.5 for _ in by]), df)
+        else:
+            # top-n must follow a TOTAL order or the cut itself is
+            # nondeterministic across modes: sort by every column
+            df = try_push(("topn", rng.randint(1, 12), anyc,
+                           [rng.random() < 0.5 for _ in anyc]), df)
+    return tables, ops
+
+
+# ------------------------------------------------------- oracle & modes
+
+
+def _norm(v):
+    if v is None:
+        return ("n",)
+    if isinstance(v, bool):
+        return ("b", v)
+    if isinstance(v, float):
+        return ("f", repr(v))  # exact: bit-identical, NaN-stable
+    if isinstance(v, int):
+        return ("i", v)
+    return ("s", str(v))
+
+
+def canonical_rows(pydict: Dict[str, list]) -> List[tuple]:
+    cols = sorted(pydict)
+    rows = list(zip(*(pydict[c] for c in cols))) if cols else []
+    return sorted((tuple(_norm(v) for v in r) for r in rows))
+
+
+def _concat_pydict(parts, schema) -> Dict[str, list]:
+    out: Dict[str, list] = {name: [] for name in schema.column_names}
+    for p in parts:
+        d = p.to_pydict()
+        for name in out:
+            out[name].extend(d.get(name, []))
+    return out
+
+
+def run_reference(df) -> Dict[str, list]:
+    """The differential oracle: translate the RAW logical plan (no
+    optimizer) and run it on the pull interpreter — no fusion, no spill
+    planning, no AQE, single partition stream."""
+    from ..execution.executor import LocalExecutor
+    from ..physical.translate import translate
+    plan = translate(df._builder._plan)
+    return _concat_pydict(list(LocalExecutor().run(plan)), df.schema())
+
+
+@contextlib.contextmanager
+def _mode_ctx(mode: str):
+    from ..context import execution_config_ctx, get_context
+    if mode == "optimized":
+        with execution_config_ctx():
+            yield
+    elif mode == "fused":
+        with execution_config_ctx(tpu_fusion="1"):
+            yield
+    elif mode == "spilled":
+        with execution_config_ctx(tpu_spill_join="1"):
+            yield
+    elif mode == "replanned":
+        with execution_config_ctx(enable_aqe=True, tpu_adaptive=True):
+            yield
+    elif mode == "combined":
+        ctx = get_context()
+        with ctx._lock:
+            old_runner = ctx._runner
+        from ..runners.distributed_runner import DistributedRunner
+        # daft-lint: allow(knob-direct-read) -- save/restore of the raw
+        # env value around the forced-combine run, not a parse site
+        prev = os.environ.get("DAFT_TPU_SHUFFLE_COMBINE")
+        os.environ["DAFT_TPU_SHUFFLE_COMBINE"] = "1"
+        try:
+            ctx.set_runner(DistributedRunner(num_workers=2))
+            with execution_config_ctx():
+                yield
+        finally:
+            if prev is None:
+                os.environ.pop("DAFT_TPU_SHUFFLE_COMBINE", None)
+            else:
+                os.environ["DAFT_TPU_SHUFFLE_COMBINE"] = prev
+            ctx.set_runner(old_runner)
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+
+
+def run_mode(tables, ops, mode: str) -> Dict[str, list]:
+    with _mode_ctx(mode):
+        return build_df(tables, ops).to_pydict()
+
+
+# ------------------------------------------------------------- the loop
+
+
+@dataclasses.dataclass
+class Mismatch:
+    seed: int
+    mode: str
+    ops: List[tuple]
+    detail: str
+
+    def repro(self) -> str:
+        lines = [f"seed={self.seed} mode={self.mode}",
+                 "minimized ops:"]
+        lines.extend(f"  {op!r}" for op in self.ops)
+        lines.append(f"detail: {self.detail}")
+        lines.append("replay: from daft_tpu.analysis import plan_fuzzer; "
+                     f"plan_fuzzer.replay({self.seed}, {self.mode!r})")
+        return "\n".join(lines)
+
+
+@dataclasses.dataclass
+class FuzzResult:
+    seeds_run: int = 0
+    cases_compared: int = 0
+    mismatches: List[Mismatch] = dataclasses.field(default_factory=list)
+    errors: List[str] = dataclasses.field(default_factory=list)
+    sanitizer_violations: int = 0
+
+    def summary(self) -> Dict[str, int]:
+        return {"seeds_run": self.seeds_run,
+                "cases_compared": self.cases_compared,
+                "mismatches": len(self.mismatches),
+                "errors": len(self.errors),
+                "sanitizer_violations": self.sanitizer_violations}
+
+
+def _diff_detail(ref_rows, got_rows) -> str:
+    if len(ref_rows) != len(got_rows):
+        return (f"row count: reference {len(ref_rows)} vs mode "
+                f"{len(got_rows)}")
+    for i, (a, b) in enumerate(zip(ref_rows, got_rows)):
+        if a != b:
+            return f"first differing canonical row {i}: {a!r} vs {b!r}"
+    return "rows differ"
+
+
+def _compare(tables, ops, mode: str) -> Optional[str]:
+    """None if mode agrees with the reference, else a human detail."""
+    ref = canonical_rows(run_reference(build_df(tables, ops)))
+    got = canonical_rows(run_mode(tables, ops, mode))
+    if ref == got:
+        return None
+    return _diff_detail(ref, got)
+
+
+def _minimize(tables, ops: List[tuple], mode: str) -> List[tuple]:
+    """Greedy delta-debug: drop ops one at a time while the mismatch
+    persists; the survivor is the minimal failing chain."""
+    ops = list(ops)
+    shrunk = True
+    while shrunk and len(ops) > 1:
+        shrunk = False
+        for i in range(len(ops)):
+            trial = ops[:i] + ops[i + 1:]
+            try:
+                if _compare(tables, trial, mode) is not None:
+                    ops = trial
+                    shrunk = True
+                    break
+            except Exception:
+                continue  # dropping this op broke the plan: keep it
+    return ops
+
+
+def replay(seed: int, mode: str) -> Optional[str]:
+    """Re-run one seed against one mode; returns the mismatch detail or
+    None. The entry point mismatch repros print."""
+    tables, ops = gen_case(seed)
+    return _compare(tables, ops, mode)
+
+
+def run_fuzz(count: Optional[int] = None, seed: Optional[int] = None,
+             modes: Optional[Tuple[str, ...]] = None,
+             log=None) -> FuzzResult:
+    base = fuzz_seed_base() if seed is None else seed
+    n = fuzz_count() if count is None else count
+    modes = modes or MODES
+    res = FuzzResult()
+
+    from . import plan_sanitizer
+    viol0 = len(plan_sanitizer.summary().get("violations", [])) \
+        if plan_sanitizer.is_enabled() else 0
+
+    for i in range(n):
+        s = base + i
+        try:
+            tables, ops = gen_case(s)
+            ref = canonical_rows(run_reference(build_df(tables, ops)))
+        except Exception as e:  # a generation/reference bug, not a diff
+            res.errors.append(f"seed {s}: reference failed: {e!r}")
+            continue
+        res.seeds_run += 1
+        for mode in modes:
+            try:
+                got = canonical_rows(run_mode(tables, ops, mode))
+            except Exception as e:
+                res.mismatches.append(Mismatch(
+                    s, mode, ops, f"mode raised: {e!r}"))
+                continue
+            res.cases_compared += 1
+            if got != ref:
+                small = _minimize(tables, ops, mode)
+                detail = _compare(tables, small, mode) \
+                    or _diff_detail(ref, got)
+                res.mismatches.append(Mismatch(s, mode, small, detail))
+        if log is not None and (i + 1) % 10 == 0:
+            log(f"plan fuzzer: {i + 1}/{n} seeds, "
+                f"{len(res.mismatches)} mismatches")
+
+    if plan_sanitizer.is_enabled():
+        res.sanitizer_violations = \
+            len(plan_sanitizer.summary().get("violations", [])) - viol0
+    return res

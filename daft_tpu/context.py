@@ -132,6 +132,13 @@ class ExecutionConfig:
     tpu_fleet_vnodes: int = 64               # ring vnodes per replica
     tpu_fleet_gossip_s: float = 2.0          # gossip round interval (s)
     tpu_fleet_drain_timeout: float = 10.0    # drain grace before cancel (s)
+    # plan discipline (round 22, analysis/plan_sanitizer.py /
+    # analysis/plan_fuzzer.py); env spellings match the documented knobs
+    # (DAFT_TPU_SANITIZE_PLAN, DAFT_TPU_FUZZ_SEED, …)
+    tpu_sanitize_plan: bool = False          # runtime plan sanitizer
+    tpu_sanitize_plan_sample: int = 64       # rows sampled per boundary
+    tpu_fuzz_seed: int = 0                   # differential fuzzer base seed
+    tpu_fuzz_count: int = 50                 # differential fuzzer seed count
 
 
 def _exec_config_from_env() -> ExecutionConfig:

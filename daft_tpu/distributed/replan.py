@@ -339,8 +339,7 @@ class StageReplanner:
 
         def walk(n):
             nonlocal rewrites
-            if isinstance(n, pp.Aggregate) \
-                    and hasattr(n, "group_rows_est"):
+            if isinstance(n, pp.Aggregate) and n.mode == "final":
                 ups = [u for u in acts if feeding(n, u)]
                 if ups:
                     rows = sum(acts[u].rows for u in ups)
@@ -363,13 +362,14 @@ class StageReplanner:
                                         or ndv >= 2 * old_ndv):
                             adaptive.count("ndv_corrections")
             if isinstance(n, pp.HashJoin):
-                for attr, child in (("left_bytes_est", n.children[0]),
-                                    ("right_bytes_est", n.children[1])):
-                    ups = [u for u in acts if feeding(child, u)]
-                    if ups:
-                        setattr(n, attr,
-                                sum(acts[u].nbytes for u in ups))
-                        rewrites += 1
+                lups = [u for u in acts if feeding(n.children[0], u)]
+                if lups:
+                    n.left_bytes_est = sum(acts[u].nbytes for u in lups)
+                    rewrites += 1
+                rups = [u for u in acts if feeding(n.children[1], u)]
+                if rups:
+                    n.right_bytes_est = sum(acts[u].nbytes for u in rups)
+                    rewrites += 1
             for c in n.children:
                 walk(c)
 

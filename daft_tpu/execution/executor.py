@@ -185,6 +185,8 @@ class LocalExecutor:
         if h is None:
             raise NotImplementedError(f"executor for {type(node).__name__}")
         it = h(node)
+        from ..analysis import plan_sanitizer
+        it = plan_sanitizer.wrap_node(node, it)
         if self.stats is not None:
             it = self.stats.instrument(node, it)
         return it

@@ -292,8 +292,12 @@ def grace_hash_join(ex, node) -> Iterator[MicroPartition]:
         from . import spill_io
 
         def read_pair(i):
-            return lambda: (lstore.bucket_batches(i),
-                            rstore.bucket_batches(i))
+            def read():
+                lb = lstore.bucket_batches(i)
+                rb = rstore.bucket_batches(i)
+                _grace_pair_check(i, n, node, lb, rb)
+                return lb, rb
+            return read
 
         pairs = spill_io.prefetch_ordered(
             (read_pair(i) for i in range(n)),
@@ -310,6 +314,24 @@ def grace_hash_join(ex, node) -> Iterator[MicroPartition]:
     finally:
         lstore.close()
         rstore.close()
+
+
+def _grace_pair_check(i: int, n: int, node, lbat, rbat) -> None:
+    """Plan-sanitizer hook (DAFT_TPU_SANITIZE_PLAN=1): a bucket pair read
+    back from the rotated-radix stores must re-hash into its own bucket —
+    depth 0 is contractually ``h % n``, bit-identical to
+    ``partition_by_hash``; a spill/IPC dtype drift breaks exactly this."""
+    from ..analysis import plan_sanitizer
+    if not plan_sanitizer.is_enabled():
+        return
+    if lbat:
+        plan_sanitizer.check_grace_pair(
+            i, n, list(node.left_on),
+            MicroPartition.from_recordbatch(lbat[0]))
+    if rbat:
+        plan_sanitizer.check_grace_pair(
+            i, n, list(node.right_on),
+            MicroPartition.from_recordbatch(rbat[0]))
 
 
 def join_copartitioned_pair(ex, lmp: MicroPartition, rmp: MicroPartition,

@@ -398,9 +398,11 @@ class PushExecutor(LocalExecutor):
                 out = self._map_stage(node, kernel)
             else:
                 out = self._driver_stage(node)
+        from ..analysis import plan_sanitizer
+        wrapped = plan_sanitizer.wrap_node(node, iter(out))
         if self.stats is not None:
-            return self.stats.instrument(node, iter(out))
-        return iter(out)
+            return self.stats.instrument(node, wrapped)
+        return wrapped
 
     def _driver_stage(self, node) -> Channel:
         """One dedicated thread runs the inherited handler generator and

@@ -219,6 +219,17 @@ def _retrace_raw() -> Dict[str, float]:
         return {}
 
 
+def _plansan_raw() -> Dict[str, float]:
+    """Raw snapshot of the plan-sanitizer counters (rule checks,
+    membership/order samples, conservation checks, violations) — empty
+    unless the plan sanitizer is armed; never raises."""
+    try:
+        from .analysis import plan_sanitizer
+        return plan_sanitizer.counters_snapshot()
+    except Exception:
+        return {}
+
+
 def device_kernel_ledger() -> Dict[str, dict]:
     """Process-wide per-dispatch achieved-bytes/flops ledger with derived
     roofline/MFU percentages (``costmodel.ledger_record`` feeds it at
@@ -354,6 +365,11 @@ class RuntimeStatsContext:
         # query's trace/recompile events — the per-query recompile tax
         self._retrace0 = _retrace_raw()
         self.retrace: Dict[str, float] = {}
+        # …and the plan sanitizer (DAFT_TPU_SANITIZE_PLAN): this query's
+        # plan-contract checks — rule schema equality, re-hashed
+        # membership samples, sort-order and row-conservation proofs
+        self._plansan0 = _plansan_raw()
+        self.plansan: Dict[str, float] = {}
         # context-local plane tallies (shuffle/io/recovery): counter
         # chokepoints bump these through the thread attribution installed
         # by the executors; finish() prefers them over the process diffs
@@ -496,6 +512,12 @@ class RuntimeStatsContext:
                 self._retrace0, _retrace_raw())
         except Exception:
             self.retrace = {}
+        try:
+            from .analysis import plan_sanitizer
+            self.plansan = plan_sanitizer.counters_delta(
+                self._plansan0, _plansan_raw())
+        except Exception:
+            self.plansan = {}
         self._emit_trace_spans()
 
     def _emit_trace_spans(self) -> None:
@@ -600,6 +622,7 @@ class RuntimeStatsContext:
         lines.extend(render_governor_block(self.governor))
         lines.extend(render_sanitizer_block(self.sanitizer))
         lines.extend(render_retrace_block(self.retrace))
+        lines.extend(render_plansan_block(self.plansan))
         lines.extend(render_serving_block(self.serving))
         if self.trace_summary:
             t = self.trace_summary
@@ -904,6 +927,29 @@ def render_retrace_block(s: Dict[str, float]) -> List[str]:
     return lines
 
 
+def render_plansan_block(s: Dict[str, float]) -> List[str]:
+    """Human lines for one query's plan-sanitizer delta (shared by
+    ``explain(analyze=True)`` and the dashboard; empty unless the plan
+    sanitizer is armed): contract checks this query paid and whether
+    any plan invariant broke — a healthy query reads violations 0."""
+    if not s:
+        return []
+    viol = int(s.get("violations", 0))
+    lines = ["plan discipline (plan sanitizer):"]
+    lines.append(
+        f"  this query: {int(s.get('rule_checks', 0))} rule schema "
+        f"checks, {int(s.get('membership_parts', 0))} partitions "
+        f"({int(s.get('membership_rows', 0))} rows) membership-sampled, "
+        f"{int(s.get('order_parts', 0))} order-checked, "
+        f"{int(s.get('conservation_checks', 0))} conservation proofs")
+    lines.append(
+        f"  contract violations: {viol} this query, "
+        f"{int(s.get('total_violations', 0))} total"
+        + (" (PLAN CONTRACT BROKEN — see plan_sanitizer.report())"
+           if viol else ""))
+    return lines
+
+
 # ---------------------------------------------------------------------------
 # per-process "last query" registry
 
@@ -1087,7 +1133,7 @@ def flight_entry(ctx: RuntimeStatsContext) -> dict:
     }
     for block in ("recovery", "shuffle", "exchange", "io", "spill",
                   "governor", "adaptive", "device_kernels", "serving",
-                  "sanitizer", "retrace"):
+                  "sanitizer", "retrace", "plansan"):
         v = getattr(ctx, block, None)
         if v:
             entry[block] = dict(v)

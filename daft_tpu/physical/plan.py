@@ -100,6 +100,11 @@ class Aggregate(PhysicalPlan):
         self.aggs = aggs
         self.group_by = group_by
         self.mode = mode
+        # estimate fields (advisory, rewritable by AQE/replan from
+        # measurements — see analysis/plan_contracts.py): expected output
+        # rows and group-key NDV, set by the translator on final-mode aggs
+        self.group_rows_est: Optional[int] = None
+        self.group_ndv: Optional[int] = None
 
 
 class DeviceFragmentAgg(PhysicalPlan):
@@ -182,6 +187,9 @@ class FusedRegion(PhysicalPlan):
         self.aggs = aggs              # partial aggs over joined columns
         self.group_by = group_by      # group keys over joined columns
         self.mode = mode
+        # estimate fields carried over from the folded Aggregate
+        self.group_rows_est: Optional[int] = None
+        self.group_ndv: Optional[int] = None
 
 
 class Dedup(PhysicalPlan):
@@ -247,6 +255,9 @@ class Exchange(PhysicalPlan):
         # re-sized by AQE from ACTUAL materialized bytes; user-requested
         # repartitions keep their exact count
         self.engine_inserted = engine_inserted
+        # estimate field: marks exchanges feeding a hash-join side so the
+        # executor can detect co-partitioned inputs
+        self.join_side = False
 
 
 class StageInput(PhysicalPlan):
@@ -273,6 +284,10 @@ class HashJoin(PhysicalPlan):
         self.right_on = right_on
         self.how = how
         self.strategy = strategy  # hash | broadcast_right | broadcast_left
+        # estimate fields: planner-side byte estimates, rewritten by the
+        # distributed re-planner from measured materializations
+        self.left_bytes_est: Optional[int] = None
+        self.right_bytes_est: Optional[int] = None
 
 
 class CrossJoin(PhysicalPlan):

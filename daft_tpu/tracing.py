@@ -706,6 +706,12 @@ def prometheus_text() -> str:
     except Exception:
         pass
     try:
+        from .analysis import plan_sanitizer
+        plane("plansan", plan_sanitizer.counters_snapshot(),
+              "plan sanitizer contract-check counter")
+    except Exception:
+        pass
+    try:
         from .device import costmodel
         for kind, d in sorted(costmodel.ledger_snapshot(raw=True).items()):
             emit(_prom_name("kernel", f"{kind}_dispatches") + "_total",

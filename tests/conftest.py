@@ -64,6 +64,7 @@ gc.freeze()
 import daft_tpu
 from daft_tpu import DataType, col
 from daft_tpu.analysis import lock_sanitizer as _lock_sanitizer
+from daft_tpu.analysis import plan_sanitizer as _plan_sanitizer
 from daft_tpu.analysis import retrace_sanitizer as _retrace_sanitizer
 
 
@@ -79,6 +80,11 @@ def device_tier(request, monkeypatch):
 
 def make_df(data):
     return daft_tpu.from_pydict(data)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -102,6 +108,12 @@ def pytest_sessionfinish(session, exitstatus):
     DAFT_TPU_SANITIZE_RETRACE also armed, print the retrace-sanitizer
     report and FAIL on any retrace-budget violation (a dispatch site
     that traced twice for one declared signature — the recompile tax)."""
+    if _plan_sanitizer.is_enabled():
+        print("\n" + _plan_sanitizer.report())
+        if _plan_sanitizer.summary().get("violations"):
+            print("daft-lint plan sanitizer: plan-contract violations "
+                  "detected — failing the session")
+            session.exitstatus = 1
     if _retrace_sanitizer.is_enabled():
         print("\n" + _retrace_sanitizer.report())
         if _retrace_sanitizer.summary().get("violations"):

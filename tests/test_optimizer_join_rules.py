@@ -1,0 +1,225 @@
+"""Before/after unit fixtures for the four join rewrite rules the plan
+fuzzer stresses hardest: SimplifyNullFilteredJoin, FilterNullJoinKey,
+SemiJoinReduction, PushDownJoinPredicate. Each test builds the BEFORE
+plan from a dataframe program, applies the single rule, and asserts the
+rewrite shape AND that the root schema is preserved (every one of these
+is registered schema-preserving in analysis/plan_contracts.py)."""
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col
+from daft_tpu.logical import plan as lp
+from daft_tpu.logical.optimizer import (
+    FilterNullJoinKey, PushDownJoinPredicate, SemiJoinReduction,
+    SimplifyNullFilteredJoin, split_conjuncts,
+)
+
+
+def walk(plan):
+    yield plan
+    for c in plan.children:
+        yield from walk(c)
+
+
+def joins(plan):
+    return [n for n in walk(plan) if isinstance(n, lp.Join)]
+
+
+def left_df():
+    return dt.from_pydict({"k": [1, 2, 3, None], "v": [10, 20, 30, 40]})
+
+
+def right_df():
+    return dt.from_pydict({"rk": [2, 3, None], "w": [7, 8, 9]})
+
+
+def apply(rule, df):
+    before = df._builder._plan
+    after = rule.apply(before)
+    assert list(after.schema().fields) == list(before.schema().fields), \
+        "rule must preserve the root schema"
+    return before, after
+
+
+# ------------------------------------------------ SimplifyNullFilteredJoin
+
+
+def test_null_rejecting_filter_strengthens_left_to_inner():
+    q = (left_df().join(right_df(), left_on="k", right_on="rk",
+                        how="left")
+         .where(col("w") > 0))
+    _, after = apply(SimplifyNullFilteredJoin(), q)
+    assert [j.how for j in joins(after)] == ["inner"]
+
+
+def test_outer_strengthens_by_rejected_side():
+    base = left_df().join(right_df(), left_on="k", right_on="rk",
+                          how="outer")
+    # rejecting a RIGHT column kills left-unmatched rows → RIGHT join
+    _, after = apply(SimplifyNullFilteredJoin(), base.where(col("w") > 0))
+    assert [j.how for j in joins(after)] == ["right"]
+    # rejecting a LEFT column → LEFT join
+    _, after = apply(SimplifyNullFilteredJoin(), base.where(col("v") > 0))
+    assert [j.how for j in joins(after)] == ["left"]
+    # rejecting both sides → inner
+    _, after = apply(SimplifyNullFilteredJoin(),
+                     base.where((col("v") > 0) & (col("w") > 0)))
+    assert [j.how for j in joins(after)] == ["inner"]
+
+
+def test_filter_on_preserved_side_does_not_strengthen():
+    q = (left_df().join(right_df(), left_on="k", right_on="rk",
+                        how="left")
+         .where(col("v") > 0))  # left columns are never NULL-padded here
+    before, after = apply(SimplifyNullFilteredJoin(), q)
+    assert [j.how for j in joins(after)] == ["left"]
+    assert after.semantic_id() == before.semantic_id()
+
+
+def test_null_safe_predicate_does_not_strengthen():
+    q = (left_df().join(right_df(), left_on="k", right_on="rk",
+                        how="left")
+         .where(col("w").is_null()))  # keeps NULL rows: not null-rejecting
+    _, after = apply(SimplifyNullFilteredJoin(), q)
+    assert [j.how for j in joins(after)] == ["left"]
+
+
+# ----------------------------------------------------- FilterNullJoinKey
+
+
+def _null_filter_sides(plan):
+    """(left_filtered, right_filtered) for the single join in plan."""
+    (j,) = joins(plan)
+
+    def filtered(child, key):
+        return (isinstance(child, lp.Filter)
+                and any(c._unalias().op == "not_null"
+                        and set(c.column_names()) == {key}
+                        for c in split_conjuncts(child.predicate)))
+    return filtered(j.children[0], "k"), filtered(j.children[1], "rk")
+
+
+@pytest.mark.parametrize("how,expect", [
+    ("inner", (True, True)),
+    ("semi", (True, True)),
+    ("left", (False, True)),
+    ("right", (True, False)),
+    ("anti", (False, True)),
+])
+def test_null_key_prefilter_side_table(how, expect):
+    q = left_df().join(right_df(), left_on="k", right_on="rk", how=how)
+    _, after = apply(FilterNullJoinKey(), q)
+    assert _null_filter_sides(after) == expect
+
+
+def test_null_key_prefilter_idempotent():
+    q = left_df().join(right_df(), left_on="k", right_on="rk",
+                       how="inner")
+    _, once = apply(FilterNullJoinKey(), q)
+    twice = FilterNullJoinKey().apply(once)
+    assert twice.semantic_id() == once.semantic_id()
+
+
+def test_null_key_prefilter_changes_no_answer():
+    q = left_df().join(right_df(), left_on="k", right_on="rk",
+                       how="inner")
+    assert sorted(zip(*q.to_pydict().values())) == \
+        sorted([(2, 20, 2, 7), (3, 30, 3, 8)])
+
+
+# ----------------------------------------------------- SemiJoinReduction
+
+
+def _small_thresholds(monkeypatch):
+    monkeypatch.setattr(SemiJoinReduction, "MIN_ROWS", 10)
+    monkeypatch.setattr(SemiJoinReduction, "RATIO", 1.5)
+
+
+def test_semi_join_reduction_rewrites_distinct_side(monkeypatch):
+    _small_thresholds(monkeypatch)
+    a = dt.from_pydict({"k": [1, 2, 3], "v": [1, 2, 3]})
+    s = dt.from_pydict({"k": [i % 8 for i in range(64)],
+                        "x": list(range(64))})
+    q = a.join(s.select("k").distinct(), left_on="k", right_on="k",
+               how="inner")
+    before, after = apply(SemiJoinReduction(), q)
+    semis = [j for j in joins(after) if j.how == "semi"]
+    assert semis, "expected a semi-join key prefilter under the Distinct"
+    # the transferred key projection uses content-derived fresh names
+    assert any(n.startswith("__sjr") for j in semis
+               for n in (e.name() for e in j.right_on))
+    assert len(joins(before)) == 1 and len(joins(after)) == 2
+
+
+def test_semi_join_reduction_respects_thresholds():
+    # default MIN_ROWS=500k: a 64-row side must never churn the plan
+    a = dt.from_pydict({"k": [1, 2, 3], "v": [1, 2, 3]})
+    s = dt.from_pydict({"k": [i % 8 for i in range(64)],
+                        "x": list(range(64))})
+    q = a.join(s.select("k").distinct(), left_on="k", right_on="k",
+               how="inner")
+    before, after = apply(SemiJoinReduction(), q)
+    assert after.semantic_id() == before.semantic_id()
+
+
+def test_semi_join_reduction_preserves_answer(monkeypatch):
+    _small_thresholds(monkeypatch)
+    a = dt.from_pydict({"k": [1, 2, 3], "v": [1, 2, 3]})
+    s = dt.from_pydict({"k": [i % 8 for i in range(64)],
+                        "x": list(range(64))})
+    q = a.join(s.select("k").distinct(), left_on="k", right_on="k",
+               how="inner")
+    plain = sorted(zip(*q.to_pydict().values()))
+    from daft_tpu.execution.executor import LocalExecutor
+    from daft_tpu.physical.translate import translate
+    rewritten = SemiJoinReduction().apply(q._builder._plan)
+    parts = list(LocalExecutor().run(translate(rewritten)))
+    got = {name: [] for name in rewritten.schema().column_names}
+    for p in parts:
+        for name, vals in p.to_pydict().items():
+            got[name].extend(vals)
+    assert sorted(zip(*got.values())) == plain
+
+
+# -------------------------------------------------- PushDownJoinPredicate
+
+
+def test_key_predicate_transfers_across_join():
+    q = (left_df().where(col("k") > 1)
+         .join(right_df(), left_on="k", right_on="rk", how="inner"))
+    _, after = apply(PushDownJoinPredicate(), q)
+    (j,) = joins(after)
+    right = j.children[1]
+    assert isinstance(right, lp.Filter)
+    transferred = [c for c in split_conjuncts(right.predicate)
+                   if set(c.column_names()) == {"rk"}]
+    assert transferred, "k>1 should clone to the right side as rk>1"
+
+
+def test_key_predicate_transfers_right_to_left():
+    q = left_df().join(right_df().where(col("rk") >= 2),
+                       left_on="k", right_on="rk", how="semi")
+    _, after = apply(PushDownJoinPredicate(), q)
+    (j,) = joins(after)
+    left = j.children[0]
+    assert isinstance(left, lp.Filter)
+    assert any(set(c.column_names()) == {"k"}
+               for c in split_conjuncts(left.predicate))
+
+
+def test_non_key_predicates_do_not_transfer():
+    q = (left_df().where(col("v") > 15)  # v is not a join key
+         .join(right_df(), left_on="k", right_on="rk", how="inner"))
+    before, after = apply(PushDownJoinPredicate(), q)
+    assert after.semantic_id() == before.semantic_id()
+
+
+def test_key_predicate_transfer_idempotent_and_correct():
+    q = (left_df().where(col("k") > 1)
+         .join(right_df(), left_on="k", right_on="rk", how="inner"))
+    _, once = apply(PushDownJoinPredicate(), q)
+    twice = PushDownJoinPredicate().apply(once)
+    assert twice.semantic_id() == once.semantic_id()
+    assert sorted(zip(*q.to_pydict().values())) == \
+        sorted([(2, 20, 2, 7), (3, 30, 3, 8)])

@@ -1,0 +1,285 @@
+"""Plan-discipline suite: the plan-contract registry round-trip, the
+physical-plan estimate-field fixtures (constructor-declared, no hasattr
+probing), the runtime plan sanitizer's checks, and the differential
+plan fuzzer's determinism + smoke run."""
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col
+from daft_tpu.analysis import plan_contracts, plan_fuzzer
+from daft_tpu.analysis import plan_sanitizer as ps
+from daft_tpu.context import execution_config_ctx
+from daft_tpu.logical import plan as lp
+from daft_tpu.physical import plan as pp
+from daft_tpu.physical.translate import translate
+from daft_tpu.recordbatch import RecordBatch
+from daft_tpu.micropartition import MicroPartition
+
+
+def pwalk(plan):
+    yield plan
+    for c in plan.children:
+        yield from pwalk(c)
+
+
+def _mp(data):
+    return MicroPartition.from_recordbatch(RecordBatch.from_pydict(data))
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_registry_names_resolve():
+    """Every registered node names a real class in its layer (the lint
+    proves the reverse direction: every class is registered)."""
+    for name in plan_contracts.LOGICAL_NODES:
+        assert hasattr(lp, name), f"LOGICAL_NODES has stale entry {name}"
+    for name in plan_contracts.PHYSICAL_NODES:
+        assert hasattr(pp, name), f"PHYSICAL_NODES has stale entry {name}"
+
+
+def test_replan_mutable_fields_registered():
+    from daft_tpu.distributed import replan, stages
+    for m in plan_contracts.REPLAN_MUTABLE:
+        assert (hasattr(pp, m.cls) or hasattr(stages, m.cls)
+                or hasattr(replan, m.cls)), \
+            f"REPLAN_MUTABLE stale class {m.cls}"
+        assert m.field in plan_contracts.REPLAN_MUTABLE_FIELDS
+
+
+def test_rule_contracts_cover_default_optimizer():
+    from daft_tpu.logical.optimizer import Optimizer
+    for batch in Optimizer().batches:
+        for rule in batch.rules:
+            name = type(rule).__name__
+            assert name in plan_contracts.RULE_CONTRACTS, \
+                f"optimizer rule {name} missing a RuleContract"
+
+
+# ------------------------------------- estimate-field constructor fixtures
+
+
+def test_aggregate_estimate_fields_declared():
+    """r20 fixed-point: estimate fields are constructor-declared with
+    None defaults — consumers never need hasattr probing."""
+    df = dt.from_pydict({"k": [1, 2, 2, 3], "v": [1.0, 2.0, 3.0, 4.0]})
+    plan = translate(df.groupby("k").agg(col("v").sum())
+                     ._builder.optimize()._plan)
+    aggs = [n for n in pwalk(plan) if isinstance(n, pp.Aggregate)]
+    assert aggs
+    for a in aggs:
+        # declared by the constructor (None) and possibly refined by the
+        # static planner — never a hasattr-guarded late binding
+        assert "group_rows_est" in a.__dict__
+        assert "group_ndv" in a.__dict__
+        assert a.group_rows_est is None \
+            or isinstance(a.group_rows_est, (int, float))
+        assert a.group_ndv is None or isinstance(a.group_ndv, (int, float))
+
+
+def test_hash_join_and_exchange_estimate_fields_declared():
+    l = dt.from_pydict({"k": list(range(64)),
+                        "v": [float(i) for i in range(64)]})
+    r = dt.from_pydict({"rk": list(range(0, 64, 2)), "w": list(range(32))})
+    with execution_config_ctx(broadcast_join_size_bytes_threshold=1):
+        q = l.into_partitions(4).join(r.into_partitions(4),
+                                      left_on="k", right_on="rk")
+        plan = translate(q._builder.optimize()._plan)
+    joins = [n for n in pwalk(plan) if isinstance(n, pp.HashJoin)]
+    exchanges = [n for n in pwalk(plan) if isinstance(n, pp.Exchange)]
+    assert joins and exchanges
+    for j in joins:
+        assert "left_bytes_est" in j.__dict__
+        assert "right_bytes_est" in j.__dict__
+        assert j.left_bytes_est is None \
+            or isinstance(j.left_bytes_est, (int, float))
+        assert j.right_bytes_est is None \
+            or isinstance(j.right_bytes_est, (int, float))
+    for e in exchanges:
+        assert "join_side" in e.__dict__
+
+
+def test_fused_region_estimate_fields_declared():
+    from daft_tpu.context import ExecutionConfig
+    from daft_tpu.device import runtime as drt
+    from daft_tpu.physical import fusion
+    if not drt.device_enabled():
+        pytest.skip("device tier disabled")
+    df = (dt.from_pydict({"k": [1, 2, 3, 4], "v": [1.0, 2.0, 3.0, 4.0]})
+          .where(col("k") > 1).select(col("k"),
+                                      (col("v") * 2).alias("v2")))
+    plan = fusion.fuse_regions(translate(df._builder.optimize()._plan),
+                               ExecutionConfig(tpu_fusion="1"))
+    regions = [n for n in pwalk(plan) if isinstance(n, pp.FusedRegion)]
+    assert regions, "exemplar should fuse into a region"
+    for reg in regions:
+        assert "group_rows_est" in reg.__dict__
+        assert reg.fallback.schema().fields == reg.schema().fields
+
+
+# ------------------------------------------------------------ sanitizer
+
+
+def test_check_rule_flags_schema_change():
+    s = ps.PlanSanitizer()
+    a = dt.from_pydict({"x": [1]}).schema()
+    b = dt.from_pydict({"x": [1.5]}).schema()
+    s.check_rule("PushDownFilter", a, b)
+    assert len(s.summary()["violations"]) == 1
+    assert "changed the root schema" in s.summary()["violations"][0]
+
+
+def test_check_rule_flags_unregistered_rule():
+    s = ps.PlanSanitizer()
+    sch = dt.from_pydict({"x": [1]}).schema()
+    s.check_rule("TotallyNovelRule", sch, sch)
+    assert any("not registered" in v for v in s.summary()["violations"])
+    # registered, schema-identical: clean
+    s2 = ps.PlanSanitizer()
+    s2.check_rule("PushDownFilter", sch, sch)
+    assert not s2.summary()["violations"]
+
+
+def test_order_check_flags_unsorted_partition():
+    s = ps.PlanSanitizer(sample_rows=16)
+
+    class Stub:
+        sort_by = (col("k"),)
+        descending = (False,)
+        nulls_first = (False,)
+
+    s._check_order(Stub(), _mp({"k": [3, 1, 2]}))
+    assert any("unsorted" in v for v in s.summary()["violations"])
+    s2 = ps.PlanSanitizer(sample_rows=16)
+    s2._check_order(Stub(), _mp({"k": [1, 2, 3]}))
+    assert not s2.summary()["violations"]
+
+
+def test_conservation_flags_row_loss():
+    s = ps.PlanSanitizer()
+
+    class Filter:  # names chosen to hit the registry contracts
+        children = ()
+
+    class Project:
+        def __init__(self, child):
+            self.children = [child]
+
+    child = Filter()
+    list(s.wrap(child, iter([_mp({"x": [1, 2, 3]})])))
+    parent = Project(child)
+    list(s.wrap(parent, iter([_mp({"x": [1, 2]})])))  # dropped a row
+    viols = s.summary()["violations"]
+    assert any("row-conservation" in v for v in viols), viols
+
+    s2 = ps.PlanSanitizer()
+    child2 = Filter()
+    list(s2.wrap(child2, iter([_mp({"x": [1, 2, 3]})])))
+    parent2 = Project(child2)
+    list(s2.wrap(parent2, iter([_mp({"x": [1, 2, 3]})])))
+    assert not s2.summary()["violations"]
+
+
+def test_grace_pair_membership_check(monkeypatch):
+    part = _mp({"k": [7] * 12, "v": list(range(12))})
+    true_bucket = next(
+        i for i, p in enumerate(part.partition_by_hash([col("k")], 4))
+        if len(p))
+    san = ps.PlanSanitizer(sample_rows=16)
+    monkeypatch.setattr(ps, "_global", san)
+    monkeypatch.setattr(ps, "_enabled", True)
+    ps.check_grace_pair(true_bucket, 4, [col("k")], part)
+    assert not san.summary()["violations"]
+    ps.check_grace_pair((true_bucket + 1) % 4, 4, [col("k")], part)
+    assert any("bucket membership" in v
+               for v in san.summary()["violations"])
+
+
+def test_sanitizer_end_to_end_clean_and_counters():
+    """Armed sanitizer over real queries: checks run, nothing trips,
+    per-query counter deltas carry the absolute violation level."""
+    was_enabled = ps.is_enabled()
+    ps.enable()
+    try:
+        before = ps.counters_snapshot()
+        l = dt.from_pydict({"k": list(range(256)),
+                            "v": [float(i) for i in range(256)]})
+        r = dt.from_pydict({"rk": list(range(0, 256, 2)),
+                            "w": list(range(128))})
+        with execution_config_ctx(broadcast_join_size_bytes_threshold=1):
+            out = (l.into_partitions(4).join(r.into_partitions(4),
+                                             left_on="k", right_on="rk")
+                   .sort("k").to_pydict())
+        assert len(out["k"]) == 128
+        after = ps.counters_snapshot()
+        delta = ps.counters_delta(before, after)
+        assert delta["rule_checks"] > 0
+        assert delta["membership_parts"] > 0
+        assert delta["order_parts"] > 0
+        assert delta["violations"] == 0
+        assert "total_violations" in delta
+        assert not ps.summary()["violations"]
+    finally:
+        # under DAFT_TPU_SANITIZE_PLAN=1 the sanitizer is armed for the
+        # whole session — leave it that way
+        if not was_enabled:
+            ps.disable()
+
+
+def test_sanitizer_stale_record_id_reuse_guard():
+    """A completed record whose node object died must not be read as a
+    child's books by a new node that recycled the id (the AQE replanning
+    bug class the weakref guard closes)."""
+    s = ps.PlanSanitizer()
+
+    class Filter:
+        children = ()
+
+    class Project:
+        def __init__(self, child):
+            self.children = [child]
+
+    child = Filter()
+    list(s.wrap(child, iter([_mp({"x": [1]})])))  # completed: 1 row
+    rec = s._records[id(child)]
+    fresh = Filter()  # a DIFFERENT object the stale record can't vouch for
+    s._records[id(fresh)] = rec  # simulate CPython id reuse
+    parent = Project(fresh)
+    list(s.wrap(parent, iter([_mp({"x": [1, 2, 3]})])))
+    assert not s.summary()["violations"]  # skipped, not misjudged
+
+
+# ---------------------------------------------------------------- fuzzer
+
+
+def test_fuzzer_is_deterministic():
+    t1, o1 = plan_fuzzer.gen_case(11)
+    t2, o2 = plan_fuzzer.gen_case(11)
+    assert t1 == t2 and o1 == o2
+    t3, o3 = plan_fuzzer.gen_case(12)
+    assert (t1, o1) != (t3, o3)
+
+
+def test_fuzzer_canonical_rows_order_insensitive():
+    a = plan_fuzzer.canonical_rows({"x": [1, None, 2], "y": [3.0, 4.0, None]})
+    b = plan_fuzzer.canonical_rows({"x": [2, 1, None], "y": [None, 3.0, 4.0]})
+    assert a == b
+    c = plan_fuzzer.canonical_rows({"x": [2, 1, None], "y": [None, 3.5, 4.0]})
+    assert a != c
+
+
+def test_fuzzer_smoke_local_modes():
+    res = plan_fuzzer.run_fuzz(count=2, seed=101,
+                               modes=("optimized", "fused", "spilled"))
+    assert res.seeds_run == 2
+    assert not res.mismatches, [m.repro() for m in res.mismatches]
+    assert not res.errors, res.errors
+
+
+@pytest.mark.slow
+def test_fuzzer_smoke_full_matrix():
+    res = plan_fuzzer.run_fuzz(count=5, seed=201)
+    assert res.seeds_run == 5
+    assert not res.mismatches, [m.repro() for m in res.mismatches]
+    assert not res.errors, res.errors
